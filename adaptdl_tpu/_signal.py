@@ -1,0 +1,51 @@
+"""Graceful-preemption signal handling.
+
+The scheduler preempts a job by deleting its pods, which delivers
+SIGTERM. Rather than dying mid-step, we record the signal in a flag that
+the training loop polls once per step; when every replica has observed
+it (agreement via an async control-plane allreduce, see
+:meth:`adaptdl_tpu.data.AdaptiveDataLoaderHelper.profile`), the job
+checkpoints and exits with code 143 so the controller treats it as a
+graceful rescale rather than a failure.
+
+(reference: adaptdl/adaptdl/_signal.py:29-42; exit-143 convention at
+sched/adaptdl_sched/controller.py:276-283.)
+"""
+
+from __future__ import annotations
+
+import signal
+
+GRACEFUL_EXIT_CODE = 143
+
+# A bare boolean: loads/stores are atomic in CPython and the handler runs
+# on the main thread between bytecodes, so taking a lock here could
+# deadlock against main-thread readers instead of protecting them.
+_exit_flag = False
+_installed = False
+
+
+def _handler(signum, frame):  # noqa: ARG001 - signal handler signature
+    global _exit_flag
+    _exit_flag = True
+
+
+def install_handlers() -> None:
+    """Install SIGTERM/SIGINT handlers (idempotent, main thread only)."""
+    global _installed
+    if _installed:
+        return
+    signal.signal(signal.SIGTERM, _handler)
+    signal.signal(signal.SIGINT, _handler)
+    _installed = True
+
+
+def get_exit_flag() -> bool:
+    """True once a termination signal has been received."""
+    return _exit_flag
+
+
+def set_exit_flag(value: bool = True) -> None:
+    """Set the flag programmatically (tests and in-process rescale)."""
+    global _exit_flag
+    _exit_flag = value

@@ -1,0 +1,119 @@
+"""Environment configuration for elastic TPU jobs.
+
+Every piece of scheduler→job communication happens through environment
+variables set at (re)start time, exactly as in the reference design
+(reference: adaptdl/adaptdl/env.py:23-173 and
+sched/adaptdl_sched/controller.py:374-407): the cluster layer restarts a
+job's processes with fresh ``ADAPTDL_*`` variables and the library reads
+them here. Nothing else in the framework touches ``os.environ`` for
+configuration.
+
+Terminology on TPU:
+
+- a *replica* is one data-parallel model replica. On TPU we use one
+  replica per chip, so ``num_replicas`` equals the total chip count of
+  the allocated slice(s).
+- a *node* in the reference (a GPU host) maps to a *slice* here: the
+  unit whose internal links (ICI) are fast and whose cross-unit links
+  (DCN) are slow. ``num_nodes`` therefore reports the number of slices,
+  which is what the goodput model's inter/intra-network split keys on.
+- a *process* is one JAX host process. ``process_rank``/``num_processes``
+  describe the multi-host layout (one process per TPU VM host).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _get_int(name: str, default: int) -> int:
+    value = os.environ.get(name)
+    return int(value) if value not in (None, "") else default
+
+
+def _get_str(name: str, default: str | None = None) -> str | None:
+    value = os.environ.get(name)
+    return value if value not in (None, "") else default
+
+
+def checkpoint_path() -> str | None:
+    """Directory for elastic checkpoints, shared across restarts.
+
+    Must be visible to all processes (typically GCS via gcsfuse or an
+    NFS/Filestore mount on GKE).
+    """
+    return _get_str("ADAPTDL_CHECKPOINT_PATH")
+
+
+def share_path() -> str | None:
+    """Shared scratch directory (tensorboard output and the like)."""
+    return _get_str("ADAPTDL_SHARE_PATH")
+
+
+def job_id() -> str | None:
+    """Unique job identifier, ``namespace/name`` under the k8s operator."""
+    return _get_str("ADAPTDL_JOB_ID")
+
+
+def master_addr() -> str:
+    """Host that runs the control-plane reducer server (rank 0)."""
+    return _get_str("ADAPTDL_MASTER_ADDR") or "127.0.0.1"
+
+
+def master_port() -> int:
+    """Port for the control-plane reducer server."""
+    return _get_int("ADAPTDL_MASTER_PORT", 0)
+
+
+def replica_rank() -> int:
+    """This replica's rank in [0, num_replicas)."""
+    return _get_int("ADAPTDL_REPLICA_RANK", 0)
+
+
+def num_replicas() -> int:
+    """Total data-parallel replicas (== total chips in this design)."""
+    return _get_int("ADAPTDL_NUM_REPLICAS", 1)
+
+
+def num_nodes() -> int:
+    """Number of slices (the reference's "nodes").
+
+    Defaults to ``num_processes()`` — one slice per host process —
+    when ``ADAPTDL_NUM_NODES`` is unset.
+    """
+    return _get_int("ADAPTDL_NUM_NODES", num_processes())
+
+
+def process_rank() -> int:
+    """This JAX host process's rank in [0, num_processes)."""
+    return _get_int("ADAPTDL_PROCESS_RANK", replica_rank())
+
+
+def num_processes() -> int:
+    """Total JAX host processes participating in the job."""
+    return _get_int("ADAPTDL_NUM_PROCESSES", num_replicas())
+
+
+def num_restarts() -> int:
+    """How many times this job has been restarted by the scheduler.
+
+    Used to index checkpoint directories so that a partially-written
+    checkpoint from a dying incarnation can never clobber the previous
+    complete one (reference: adaptdl/adaptdl/checkpoint.py:106-133).
+    """
+    return _get_int("ADAPTDL_NUM_RESTARTS", 0)
+
+
+def supervisor_url() -> str | None:
+    """Base URL of the cluster supervisor (rendezvous + sched hints)."""
+    return _get_str("ADAPTDL_SUPERVISOR_URL")
+
+
+def coordinator_addr() -> str | None:
+    """``host:port`` for ``jax.distributed.initialize`` on multi-host."""
+    return _get_str("ADAPTDL_COORDINATOR_ADDR")
+
+
+def sched_version() -> str | None:
+    """Scheduler semver, for trainer/scheduler compatibility checks."""
+    return _get_str("ADAPTDL_SCHED_VERSION")

@@ -1,0 +1,32 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Multi-chip sharding is validated without TPU hardware by forcing the
+host platform to present 8 devices, mirroring the reference's strategy
+of testing distributed behavior on one machine (reference:
+adaptdl/adaptdl/conftest.py). These env vars must be set before the
+first ``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from adaptdl_tpu import checkpoint  # noqa: E402
+
+# Re-exported fixture: forked multi-replica elastic test harness.
+from tests.elastic_harness import elastic_multiprocessing  # noqa: E402, F401
+
+
+@pytest.fixture(autouse=True)
+def _clean_state_registry():
+    """Isolate the global State registry between tests."""
+    checkpoint._reset_registry()
+    yield
+    checkpoint._reset_registry()

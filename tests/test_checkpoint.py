@@ -1,0 +1,92 @@
+"""Checkpoint State registry tests.
+
+Mirrors the reference's coverage (reference:
+adaptdl/adaptdl/checkpoint_test.py:32-70): save under one replica
+count, restore under another, atomicity of the restart-indexed dirs.
+"""
+
+import os
+import pickle
+
+import pytest
+
+from adaptdl_tpu import checkpoint, env
+
+
+class DictState(checkpoint.State):
+    def __init__(self, name, value=None):
+        super().__init__(name)
+        self.value = value
+        self.synced = 0
+
+    def sync(self):
+        self.synced += 1
+
+    def save(self, fileobj):
+        pickle.dump(self.value, fileobj)
+
+    def load(self, fileobj):
+        self.value = pickle.load(fileobj)
+
+
+def test_duplicate_name_rejected():
+    DictState("a")
+    with pytest.raises(ValueError):
+        DictState("a")
+
+
+def test_save_load_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    state = DictState("model", {"w": [1, 2, 3]})
+    checkpoint.save_all_states()
+    assert state.synced == 1
+    state.value = None
+    assert checkpoint.load_state(state)
+    assert state.value == {"w": [1, 2, 3]}
+
+
+def test_missing_checkpoint_returns_false(tmp_path, monkeypatch):
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    state = DictState("model")
+    assert not checkpoint.load_state(state)
+
+
+def test_latest_dir_wins_and_older_pruned(tmp_path, monkeypatch):
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    state = DictState("x", "old")
+    monkeypatch.setenv("ADAPTDL_NUM_RESTARTS", "0")
+    checkpoint.save_all_states()
+    state.value = "new"
+    monkeypatch.setenv("ADAPTDL_NUM_RESTARTS", "3")
+    checkpoint.save_all_states()
+    assert not os.path.isdir(tmp_path / "checkpoint-0.0"), "older dir pruned"
+    state.value = None
+    assert checkpoint.load_state(state)
+    assert state.value == "new"
+
+
+def test_nonrank0_does_not_write(tmp_path, monkeypatch):
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    monkeypatch.setenv("ADAPTDL_REPLICA_RANK", "1")
+    state = DictState("x", 42)
+    checkpoint.save_all_states()
+    assert state.synced == 1, "sync still runs on every replica"
+    assert checkpoint.latest_checkpoint_dir(str(tmp_path)) is None
+
+
+def test_elastic_save_then_restore_more_replicas(elastic_multiprocessing):
+    """Save with 1 replica, restart with 2, both replicas restore."""
+
+    def body():
+        state = DictState("counter")
+        if not checkpoint.load_state(state):
+            state.value = 0
+        if env.num_restarts() == 0:
+            state.value += 1
+            checkpoint.save_all_states()
+            return 2  # restart with 2 replicas
+        # Both replicas of the new incarnation see the saved value.
+        assert state.value == 1, (env.replica_rank(), state.value)
+        return 0
+
+    elastic_multiprocessing(body, num_replicas=1)

@@ -1,0 +1,62 @@
+"""Crash-safety: a complete checkpoint always exists on disk."""
+
+import os
+import pickle
+
+import pytest
+
+from adaptdl_tpu import checkpoint
+
+
+class Val(checkpoint.State):
+    def __init__(self, name, value=None):
+        super().__init__(name)
+        self.value = value
+
+    def save(self, fileobj):
+        pickle.dump(self.value, fileobj)
+
+    def load(self, fileobj):
+        self.value = pickle.load(fileobj)
+
+
+def test_resave_same_incarnation_never_deletes_before_replace(
+    tmp_path, monkeypatch
+):
+    """Periodic saves within one incarnation keep a complete dir alive."""
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    state = Val("v", 1)
+    checkpoint.save_all_states()
+    first = checkpoint.latest_checkpoint_dir(str(tmp_path))
+    state.value = 2
+    checkpoint.save_all_states()
+    second = checkpoint.latest_checkpoint_dir(str(tmp_path))
+    assert second != first, "new save gets a new versioned dir"
+    assert not os.path.isdir(first), "superseded dir pruned after success"
+    state.value = None
+    assert checkpoint.load_state(state)
+    assert state.value == 2
+
+
+def test_failed_resave_preserves_previous(tmp_path, monkeypatch):
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    good = Val("v", 7)
+    checkpoint.save_all_states()
+
+    class Bomb(checkpoint.State):
+        def save(self, fileobj):
+            raise OSError("disk on fire")
+
+        def load(self, fileobj):
+            pass
+
+    Bomb("bomb")
+    with pytest.raises(OSError):
+        checkpoint.save_all_states()
+    good.value = None
+    assert checkpoint.load_state(good)
+    assert good.value == 7
+    leftovers = [
+        e for e in os.listdir(tmp_path) if e.startswith("_tmp-checkpoint-")
+    ]
+    assert not leftovers, "failed save cleans its temp dir"
