@@ -1,0 +1,163 @@
+"""Property tests for the goodput model.
+
+Mirrors the reference's coverage (reference:
+adaptdl/adaptdl/goodput_test.py and fit_test.py): efficiency bounds,
+throughput monotonicity, optimize() feasibility, and a fit round-trip
+on synthetic timings generated from known parameters.
+"""
+
+import numpy as np
+import pytest
+
+from adaptdl_tpu.goodput import (
+    GoodputFunction,
+    GradParams,
+    PerfParams,
+    fit_perf_params,
+)
+
+# Realistic fitted constants (same ballpark as the reference's
+# regression anchor, sched/adaptdl_sched/policy/pollux_test.py:33-40).
+PERF = PerfParams(0.12, 0.0057, 0.024, 0.0063, 0.012, 0.0032, 1.14)
+GRAD = GradParams(sqr=0.00136, var=0.000502)
+INIT_BSZ = 128
+
+
+@pytest.fixture
+def fn():
+    return GoodputFunction(PERF, GRAD, INIT_BSZ)
+
+
+def test_efficiency_bounds_and_monotonicity(fn):
+    bsz = np.geomspace(INIT_BSZ, 100 * INIT_BSZ, 40)
+    eff = fn.efficiency(bsz)
+    assert np.all(eff <= 1.0 + 1e-9)
+    assert np.all(eff > 0)
+    assert fn.efficiency(INIT_BSZ) == pytest.approx(1.0)
+    assert np.all(np.diff(eff) < 1e-12), "efficiency decreases with batch"
+
+
+def test_throughput_increases_with_replicas_single_slice(fn):
+    replicas = np.arange(1, 9)
+    thр = fn.throughput(1, replicas, 128, 0)
+    assert np.all(np.diff(thр) > 0), "ICI all-reduce scales samples/s"
+
+
+def test_network_time_hierarchy(fn):
+    """Same chips: one slice beats a cross-slice (DCN) layout."""
+    single = fn.throughput(1, 8, 128, 0)
+    multi = fn.throughput(2, 8, 128, 0)
+    assert single > multi
+
+
+def test_goodput_equals_throughput_times_efficiency(fn):
+    g = fn.evaluate(1, 4, 256, 1)
+    t = fn.throughput(1, 4, 256, 1)
+    e = fn.efficiency(4 * 256 * 2)
+    assert g == pytest.approx(t * e)
+
+
+def test_optimize_feasible_and_scalar(fn):
+    goodput, atomic_bsz, accum = fn.optimize(
+        1, 4, max_batch_size=4096, atomic_bsz_range=(32, 256),
+        accumulation=True,
+    )
+    assert np.isscalar(atomic_bsz)
+    assert 32 <= atomic_bsz <= 256
+    assert accum >= 0
+    assert 4 * atomic_bsz * (accum + 1) >= INIT_BSZ
+    assert goodput > 0
+
+
+def test_optimize_single_replica_pins_batch_without_accum(fn):
+    _, atomic_bsz, accum = fn.optimize(1, 1, max_batch_size=1024)
+    assert atomic_bsz == INIT_BSZ
+    assert accum == 0
+
+
+def test_optimize_single_replica_requires_accum_when_scaling(fn):
+    _, atomic_bsz, accum = fn.optimize(
+        1, 1, max_batch_size=1024, atomic_bsz_range=(32, 1024),
+        accumulation=True,
+    )
+    global_bsz = atomic_bsz * (accum + 1)
+    if global_bsz > INIT_BSZ:
+        assert accum >= 1, "noise estimate needs >=2 micro-batches"
+
+
+def test_optimize_vectorized_matches_scalar(fn):
+    nodes = np.array([1, 1, 2, 4])
+    replicas = np.array([1, 4, 8, 16])
+    g_vec, bsz_vec, acc_vec = fn.optimize(
+        nodes, replicas, max_batch_size=4096, atomic_bsz_range=(32, 256),
+        accumulation=True,
+    )
+    for i in range(len(nodes)):
+        g, bsz, acc = fn.optimize(
+            int(nodes[i]), int(replicas[i]), max_batch_size=4096,
+            atomic_bsz_range=(32, 256), accumulation=True,
+        )
+        assert g == pytest.approx(g_vec[i])
+        assert bsz == bsz_vec[i]
+        assert acc == acc_vec[i]
+
+
+def test_goodput_monotonic_in_replicas(fn):
+    """More chips never decreases achievable goodput (same slice)."""
+    replicas = np.arange(1, 9)
+    goodput, _, _ = fn.optimize(
+        1, replicas, max_batch_size=4096, atomic_bsz_range=(32, 256),
+        accumulation=True,
+    )
+    assert np.all(np.diff(goodput) > -1e-9)
+
+
+def _synthetic_measurements(true_params, rng):
+    nodes, replicas, bsz = [], [], []
+    for n, r in [(1, 1), (1, 2), (1, 4), (1, 8), (2, 8), (2, 16), (4, 16)]:
+        for b in (64, 128, 256):
+            nodes.append(n)
+            replicas.append(r)
+            bsz.append(b)
+    nodes = np.array(nodes)
+    replicas = np.array(replicas)
+    bsz = np.array(bsz)
+    fn = GoodputFunction(true_params, GRAD, INIT_BSZ)
+    t_acc = true_params.alpha_c + true_params.beta_c * bsz
+    from adaptdl_tpu.goodput import _log_optim_time, _network_time
+
+    t_net = _network_time(np, true_params, nodes, replicas)
+    t_opt = np.exp(_log_optim_time(np, true_params, t_acc, t_net))
+    noise = lambda shape: rng.lognormal(0.0, 0.01, shape)  # noqa: E731
+    return nodes, replicas, bsz, t_acc * noise(t_acc.shape), t_opt * noise(
+        t_opt.shape
+    )
+
+
+def test_fit_round_trip():
+    rng = np.random.default_rng(0)
+    data = _synthetic_measurements(PERF, rng)
+    fitted = fit_perf_params(*data)
+    fit_fn = GoodputFunction(fitted, GRAD, INIT_BSZ)
+    true_fn = GoodputFunction(PERF, GRAD, INIT_BSZ)
+    # The fitted model should predict throughput within ~15% across the
+    # observed envelope.
+    for n, r, b in [(1, 2, 128), (1, 8, 64), (2, 16, 256), (4, 16, 128)]:
+        pred = fit_fn.throughput(n, r, b, 0)
+        true = true_fn.throughput(n, r, b, 0)
+        assert pred == pytest.approx(true, rel=0.15), (n, r, b)
+
+
+def test_fit_no_multinode_observations_pins_dcn_prior():
+    rng = np.random.default_rng(1)
+    nodes = np.ones(6, dtype=int)
+    replicas = np.array([1, 2, 2, 4, 4, 8])
+    bsz = np.array([64, 64, 128, 128, 256, 256])
+    t_acc = PERF.alpha_c + PERF.beta_c * bsz
+    from adaptdl_tpu.goodput import _log_optim_time, _network_time
+
+    t_net = _network_time(np, PERF, nodes, replicas)
+    t_opt = np.exp(_log_optim_time(np, PERF, t_acc, t_net))
+    fitted = fit_perf_params(nodes, replicas, bsz, t_acc, t_opt)
+    assert fitted.alpha_n >= 1.1 * fitted.alpha_r - 1e-12
+    assert fitted.beta_n >= 1.1 * fitted.beta_r - 1e-12
