@@ -1,0 +1,81 @@
+"""Module-level control-plane collectives.
+
+Thin facade over :class:`adaptdl_tpu.reducer.ObjectReducer` with the
+process-wide instance wired from ``ADAPTDL_*`` env vars. General but
+intentionally non-performant — use XLA collectives for anything large
+or hot (reference contract: adaptdl/adaptdl/collective.py:16-26).
+
+Every replica must invoke every collective here in the same order.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from typing import Any, Callable
+
+from adaptdl_tpu import env
+from adaptdl_tpu.reducer import ObjectReducer
+
+_reducer: ObjectReducer | None = None
+
+
+def default_reduce_fn(values: list[Any]) -> Any:
+    """Sum, which doubles as logical-or for bools and concat for lists."""
+    result = values[0]
+    for value in values[1:]:
+        result = result + value
+    return result
+
+
+def initialize(
+    master_addr: str | None = None,
+    master_port: int | None = None,
+    replica_rank: int | None = None,
+    num_replicas: int | None = None,
+) -> None:
+    """Create the process-wide reducer (no-op if already initialized)."""
+    global _reducer
+    if _reducer is not None:
+        return
+    _reducer = ObjectReducer(
+        master_addr if master_addr is not None else env.master_addr(),
+        master_port if master_port is not None else env.master_port(),
+        replica_rank if replica_rank is not None else env.replica_rank(),
+        num_replicas if num_replicas is not None else env.num_replicas(),
+    )
+
+
+def initialized() -> bool:
+    return _reducer is not None
+
+
+def teardown() -> None:
+    global _reducer
+    if _reducer is not None:
+        _reducer.close()
+        _reducer = None
+
+
+def _require() -> ObjectReducer:
+    if _reducer is None:
+        # Single-replica default: collectives degenerate gracefully so
+        # library code works without explicit initialization.
+        initialize("127.0.0.1", 0, 0, 1)
+    return _reducer
+
+
+def allreduce(obj: Any, reduce_fn: Callable = default_reduce_fn) -> Any:
+    """Reduce ``obj`` across replicas; all ranks receive the result."""
+    return _require().reduce(obj, reduce_fn)
+
+
+def allreduce_async(
+    obj: Any, reduce_fn: Callable = default_reduce_fn
+) -> Future:
+    """Async allreduce; overlap with compute, ``.result()`` to join."""
+    return _require().reduce_async(obj, reduce_fn)
+
+
+def broadcast(obj: Any, src: int = 0) -> Any:
+    """Every rank receives rank ``src``'s object."""
+    return _require().reduce(obj, lambda values: values[src])

@@ -1,0 +1,131 @@
+"""Star-topology control-plane reducer for small Python objects.
+
+Tensors ride XLA collectives over ICI/DCN; this module is only for the
+*control plane* — batch-size decisions, exit flags, progress counters —
+tiny objects exchanged a few times per step at most. A star over TCP is
+the right shape for that (reference concept:
+adaptdl/adaptdl/reducer.py; the implementation here is new).
+
+Design: every replica must invoke every collective in the same order
+(the same contract the reference documents at
+adaptdl/adaptdl/collective.py:23-25). That contract makes a server
+thread unnecessary: messages from client *r* arrive on its connection
+in send order, so operation *k* is simply the *k*-th message on each
+connection. Rank 0 performs the reduce synchronously inside its own
+call and replies to every client; a sequence number is carried and
+asserted to turn ordering violations into loud errors instead of
+silent corruption.
+
+``multiprocessing.connection`` provides framing + pickling; clients
+retry the connect for a while because under the k8s controller rank 0's
+pod may not be resolvable yet when workers start (reference race:
+adaptdl/adaptdl/reducer.py:74-96).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from multiprocessing.connection import Client, Listener
+from typing import Any, Callable
+
+_AUTHKEY = b"adaptdl-tpu-control-plane"
+_CONNECT_TIMEOUT = 300.0
+_CONNECT_INTERVAL = 0.5
+
+ReduceFn = Callable[[list[Any]], Any]
+
+
+class ObjectReducer:
+    """One per process; rank 0 is the hub, everyone else a spoke."""
+
+    def __init__(self, addr: str, port: int, rank: int, world_size: int):
+        self._rank = rank
+        self._world_size = world_size
+        self._seq = 0
+        self._conns: dict[int, Any] = {}
+        self._client = None
+        self._listener = None
+        # All socket traffic happens on this single worker so that async
+        # and sync collectives issued from user code interleave in
+        # invocation order, preserving the same-order contract.
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="adaptdl-reducer"
+        )
+        if world_size == 1:
+            return
+        if rank == 0:
+            self._listener = Listener(("0.0.0.0", port), authkey=_AUTHKEY)
+            accepted = 0
+            lock = threading.Lock()
+
+            # Accept sequentially; each spoke identifies itself first.
+            while accepted < world_size - 1:
+                conn = self._listener.accept()
+                peer_rank = conn.recv()
+                with lock:
+                    if peer_rank in self._conns:
+                        raise RuntimeError(
+                            f"duplicate rank {peer_rank} connected"
+                        )
+                    self._conns[peer_rank] = conn
+                accepted += 1
+        else:
+            deadline = time.monotonic() + _CONNECT_TIMEOUT
+            while True:
+                try:
+                    self._client = Client((addr, port), authkey=_AUTHKEY)
+                    break
+                except (ConnectionError, OSError):
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(_CONNECT_INTERVAL)
+            self._client.send(rank)
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def world_size(self) -> int:
+        return self._world_size
+
+    def _reduce_sync(self, obj: Any, reduce_fn: ReduceFn, seq: int) -> Any:
+        if self._world_size == 1:
+            return reduce_fn([obj])
+        if self._rank == 0:
+            values = [None] * self._world_size
+            values[0] = obj
+            for peer_rank, conn in self._conns.items():
+                peer_seq, value = conn.recv()
+                if peer_seq != seq:
+                    raise RuntimeError(
+                        "collective ordering violation: rank "
+                        f"{peer_rank} sent op {peer_seq}, expected {seq}"
+                    )
+                values[peer_rank] = value
+            result = reduce_fn(values)
+            for conn in self._conns.values():
+                conn.send(result)
+            return result
+        self._client.send((seq, obj))
+        return self._client.recv()
+
+    def reduce_async(self, obj: Any, reduce_fn: ReduceFn) -> Future:
+        """Queue a collective; result delivered via the Future."""
+        seq = self._seq
+        self._seq += 1
+        return self._executor.submit(self._reduce_sync, obj, reduce_fn, seq)
+
+    def reduce(self, obj: Any, reduce_fn: ReduceFn) -> Any:
+        return self.reduce_async(obj, reduce_fn).result()
+
+    def close(self) -> None:
+        self._executor.shutdown(wait=True)
+        for conn in self._conns.values():
+            conn.close()
+        if self._client is not None:
+            self._client.close()
+        if self._listener is not None:
+            self._listener.close()
