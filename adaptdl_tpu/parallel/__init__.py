@@ -1,0 +1,3 @@
+"""Mesh construction and sharding utilities for elastic SPMD training."""
+
+from adaptdl_tpu.parallel.mesh import create_mesh  # noqa: F401

@@ -1,0 +1,60 @@
+"""Device-mesh construction for elastic jobs.
+
+The replica axis of the reference (one process per GPU under
+DistributedDataParallel) becomes a named mesh axis here: gradients are
+averaged by ``lax.pmean`` over ``"data"``, and rescaling a job is
+re-creating the mesh over a different device set and re-materialising
+state onto it (see adaptdl_tpu.trainer). Extra axes ("model", "seq")
+slot in without touching the data-parallel machinery.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def create_mesh(
+    axes: dict[str, int] | None = None,
+    *,
+    devices=None,
+) -> Mesh:
+    """Build a Mesh over the job's devices.
+
+    ``axes`` maps axis name -> size in mesh order, e.g.
+    ``{"data": 4, "model": 2}``; a size of -1 means "all remaining
+    devices". Default: one ``"data"`` axis spanning every device.
+
+    Axis order follows the device enumeration, which on TPU follows the
+    physical topology — keep the fastest-varying (innermost) axis the
+    one carrying the heaviest collectives so they ride ICI.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    if axes is None:
+        axes = {DATA_AXIS: devices.size}
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if devices.size % known:
+            raise ValueError(
+                f"cannot infer -1 axis: {devices.size} devices not "
+                f"divisible by {known}"
+            )
+        sizes = [
+            devices.size // known if s == -1 else s for s in sizes
+        ]
+    total = int(np.prod(sizes))
+    if total != devices.size:
+        raise ValueError(
+            f"mesh axes {dict(zip(axes, sizes))} require {total} devices, "
+            f"have {devices.size}"
+        )
+    return Mesh(devices.reshape(sizes), tuple(axes.keys()))

@@ -1,0 +1,92 @@
+"""Learning-rate scaling rules for adaptive batch sizes.
+
+When the goodput optimizer grows the global batch by ``scale``x, the
+learning rate must follow. The reference implements these rules by
+monkey-patching ``optimizer.step`` (reference:
+adaptdl/adaptdl/torch/scaling_rules.py:88-101); here each rule is a
+pure function of jit-traced training statistics returning a
+multiplicative LR factor, applied to the optax update inside the train
+step — no mutation, no patching.
+
+Rules (formulas match the reference, scaling_rules.py:111-192):
+
+- AdaScale: factor = gain(scale) — the gradient-noise-aware rule that
+  preserves convergence per the AdaScale paper (ICML'20).
+- AdamScale: AdaScale ** 0.5, the variant safe for Adam/AdamW/RMSProp.
+- LinearScale / SqrtScale: classic heuristics.
+- LEGWScale: sqrt(scale) with a warmup proportional to scale.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from adaptdl_tpu import gns
+
+
+class RuleContext(NamedTuple):
+    """Everything a rule may consult. ``scale``/``batch_size`` are
+    static per compiled step; the rest are traced arrays."""
+
+    scale: float  # global_bsz / init_batch_size
+    batch_size: int  # current global batch size
+    init_batch_size: int
+    gns_state: gns.GNSState
+    progress: jnp.ndarray  # scale-invariant steps taken
+
+
+class ScalingRule:
+    """Base: no scaling (factor 1)."""
+
+    def lr_factor(self, ctx: RuleContext) -> jnp.ndarray:
+        return jnp.ones(())
+
+
+class AdaScale(ScalingRule):
+    def lr_factor(self, ctx: RuleContext) -> jnp.ndarray:
+        return gns.gain(ctx.gns_state, ctx.scale)
+
+
+class AdamScale(AdaScale):
+    def __init__(self, power: float = 0.5):
+        self.power = power
+
+    def lr_factor(self, ctx: RuleContext) -> jnp.ndarray:
+        return super().lr_factor(ctx) ** self.power
+
+
+class LinearScale(ScalingRule):
+    def lr_factor(self, ctx: RuleContext) -> jnp.ndarray:
+        return jnp.asarray(ctx.scale, jnp.float32)
+
+
+class SqrtScale(ScalingRule):
+    def lr_factor(self, ctx: RuleContext) -> jnp.ndarray:
+        return jnp.asarray(ctx.scale, jnp.float32) ** 0.5
+
+
+class LEGWScale(ScalingRule):
+    """sqrt(scale) target with a warmup stretched by ``scale``.
+
+    warmup length (in scale-invariant steps) =
+        base_warmup_epochs * scale * data_size / batch_size
+    which, since batch_size = scale * init_batch_size, is constant in
+    scale — but the *progress* axis it is compared against advances by
+    gain per step, preserving the reference's semantics
+    (scaling_rules.py:180-192).
+    """
+
+    def __init__(self, base_warmup_epochs: float, data_size: int):
+        self.base_warmup_epochs = base_warmup_epochs
+        self.data_size = data_size
+
+    def lr_factor(self, ctx: RuleContext) -> jnp.ndarray:
+        total_steps = (
+            self.base_warmup_epochs * ctx.scale * self.data_size
+            / ctx.batch_size
+        )
+        max_factor = ctx.scale**0.5
+        ratio = jnp.minimum(ctx.progress / total_steps, 1.0)
+        return max_factor * ratio

@@ -9,12 +9,18 @@ first ``import jax`` anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# The environment's TPU plugin forces its own platform list regardless
+# of JAX_PLATFORMS; override it before any backend is initialised.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

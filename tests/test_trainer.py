@@ -1,0 +1,182 @@
+"""ElasticTrainer tests on the virtual 8-device CPU mesh.
+
+Mirrors the reference's coverage (reference:
+adaptdl/adaptdl/torch/parallel_test.py — linear-regression convergence
+through restarts; gradient_noise_scale_test.py — estimator values).
+"""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from adaptdl_tpu import gns
+from adaptdl_tpu.parallel import create_mesh
+from adaptdl_tpu.scaling_rules import AdaScale
+from adaptdl_tpu.trainer import ElasticTrainer, TrainState
+
+TRUE_W = np.array([2.0, -3.0, 0.5, 1.5], np.float32)
+
+
+def _make_data(n, seed=0, noise=0.1):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = x @ TRUE_W + noise * rng.normal(size=n).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+def _loss_fn(params, batch, rng):
+    pred = batch["x"] @ params["w"] + params["b"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def _make_trainer(num_devices, **kwargs):
+    mesh = create_mesh(devices=jax.devices()[:num_devices])
+    params = {"w": jnp.zeros(4), "b": jnp.zeros(())}
+    defaults = dict(
+        loss_fn=_loss_fn,
+        params=params,
+        optimizer=optax.sgd(0.05),
+        init_batch_size=16,
+        scaling_rule=AdaScale(),
+        mesh=mesh,
+    )
+    defaults.update(kwargs)
+    return ElasticTrainer(**defaults)
+
+
+def _run_steps(trainer, state, data, atomic_bsz, accum_steps, steps, seed=1):
+    rng = np.random.default_rng(seed)
+    step_fn = trainer.train_step(atomic_bsz, accum_steps)
+    global_bsz = trainer.num_replicas * (accum_steps + 1) * atomic_bsz
+    metrics = None
+    for _ in range(steps):
+        idx = rng.integers(0, len(data["y"]), size=global_bsz)
+        batch = trainer.shard_batch(
+            {"x": data["x"][idx], "y": data["y"][idx]}
+        )
+        state, metrics = step_fn(state, batch)
+    return state, metrics
+
+
+def test_converges_multi_replica():
+    trainer = _make_trainer(8)
+    state = trainer.init_state()
+    data = _make_data(2048)
+    state, metrics = _run_steps(
+        trainer, state, data, atomic_bsz=16, accum_steps=0, steps=60
+    )
+    w = np.asarray(state.params["w"])
+    assert np.allclose(w, TRUE_W, atol=0.15), w
+    assert float(metrics["loss"]) < 0.05
+
+
+def test_gain_between_one_and_scale():
+    trainer = _make_trainer(8)
+    state = trainer.init_state()
+    data = _make_data(2048)
+    state, metrics = _run_steps(
+        trainer, state, data, atomic_bsz=16, accum_steps=1, steps=20
+    )
+    scale = float(metrics["scale"])
+    assert scale == pytest.approx(8 * 2 * 16 / 16)
+    gain = float(metrics["gain"])
+    assert 1.0 <= gain <= scale + 1e-6
+    # Noisy regression at batch 256 is far from the critical batch
+    # size, so the gain should be clearly sublinear.
+    assert gain < scale
+
+
+def test_progress_advances_by_gain():
+    trainer = _make_trainer(4)
+    state = trainer.init_state()
+    data = _make_data(512)
+    state, m = _run_steps(
+        trainer, state, data, atomic_bsz=16, accum_steps=0, steps=5
+    )
+    assert 0 < float(state.progress) <= 5 * float(m["scale"]) + 1e-6
+    assert int(state.step) == 5
+
+
+def test_single_replica_differenced_estimator():
+    trainer = _make_trainer(1)
+    state = trainer.init_state()
+    data = _make_data(512)
+    state, metrics = _run_steps(
+        trainer, state, data, atomic_bsz=16, accum_steps=0, steps=10
+    )
+    assert bool(state.gns.ema_is_biased)
+    assert bool(state.gns.prev_grad_valid)
+    assert float(metrics["grad_var"]) > 0
+    # Scaling up with accumulation switches to unbiased estimates and
+    # resets the EMAs.
+    state, metrics = _run_steps(
+        trainer, state, data, atomic_bsz=16, accum_steps=1, steps=5
+    )
+    assert not bool(state.gns.ema_is_biased)
+
+
+def test_estimator_consistency_across_replica_counts():
+    """GNS estimates from 8x1 and 1x(accum 8) agree in expectation."""
+    data = _make_data(4096, noise=0.5)
+    t8 = _make_trainer(8, init_batch_size=8)
+    s8, _ = _run_steps(t8, t8.init_state(), data, 8, 0, 40)
+    t1 = _make_trainer(1, init_batch_size=8)
+    s1, _ = _run_steps(t1, t1.init_state(), data, 8, 7, 40)
+    var8 = float(gns.var_avg(s8.gns))
+    var1 = float(gns.var_avg(s1.gns))
+    assert var8 == pytest.approx(var1, rel=0.5), (var8, var1)
+
+
+def test_checkpoint_restores_onto_different_mesh(tmp_path, monkeypatch):
+    """Save on a 2-device mesh, restore onto 8 devices, keep training."""
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    data = _make_data(1024)
+
+    t2 = _make_trainer(2)
+    holder = {"state": t2.init_state()}
+    ck = t2.make_checkpoint_state(
+        lambda: holder["state"],
+        lambda s: holder.__setitem__("state", s),
+    )
+    holder["state"], _ = _run_steps(t2, holder["state"], data, 16, 0, 20)
+    from adaptdl_tpu import checkpoint as ckpt_mod
+
+    ckpt_mod.save_all_states()
+    progress_before = float(holder["state"].progress)
+    ck.unregister()
+
+    t8 = _make_trainer(8)
+    holder8 = {"state": t8.init_state()}
+    ck8 = t8.make_checkpoint_state(
+        lambda: holder8["state"],
+        lambda s: holder8.__setitem__("state", s),
+    )
+    assert ckpt_mod.load_state(ck8)
+    restored = holder8["state"]
+    assert float(restored.progress) == pytest.approx(progress_before)
+    assert np.allclose(
+        np.asarray(restored.params["w"]),
+        np.asarray(holder["state"].params["w"]),
+    )
+    # Training continues on the new mesh.
+    state, metrics = _run_steps(t8, restored, data, 16, 0, 10)
+    assert int(state.step) == 30
+    assert float(metrics["loss"]) < 1.0
+    ck8.unregister()
+
+
+def test_adam_preconditioned_gns():
+    trainer = _make_trainer(
+        4,
+        optimizer=optax.adam(1e-2),
+        precondition="adam",
+    )
+    state = trainer.init_state()
+    data = _make_data(512)
+    state, metrics = _run_steps(trainer, state, data, 16, 0, 10)
+    assert np.isfinite(float(metrics["grad_sqr"]))
+    assert np.isfinite(float(metrics["grad_var"]))
+    assert float(metrics["loss"]) < 20.0
