@@ -1,0 +1,410 @@
+"""Elastic, adaptive-batch-size data pipeline.
+
+``AdaptiveDataLoader`` is the user's inner loop and the place where all
+the elasticity machinery meets (reference:
+adaptdl/adaptdl/torch/data.py):
+
+- **ElasticSampler**: deterministic epoch shuffling; partitions the
+  *remaining* samples of an epoch evenly across replicas, so a job
+  restarted mid-epoch at a different replica count divides the rest of
+  the epoch among its new replicas (reference: data.py:63-111).
+- **adaptive batch size**: each loop entry (and periodically during
+  it) re-optimizes (atomic_bsz, accum_steps) with the fitted goodput
+  function, adopting a new configuration only for >5% predicted
+  speedup; the result is broadcast from rank 0 so every replica uses
+  identical shapes (reference: data.py:270-305). TPU delta: candidate
+  sizes are *bucketed* (multiples of 8 below 128, multiples of 64
+  above) because every new shape is an XLA recompile — hysteresis plus
+  bucketing keeps recompiles rare.
+- **graceful preemption**: once per step the loader polls the SIGTERM
+  flag through an *async* control-plane allreduce (overlapped with the
+  device step), and when all replicas agree, checkpoints and exits
+  with code 143 (reference: data.py:311-334).
+- **replay**: finished loops are skipped after a restart; the
+  interrupted loop resumes at its saved position (reference:
+  data.py:361-379).
+
+The loader yields *global* host batches (numpy) shaped
+``[num_replicas * (accum_steps+1) * atomic_bsz, ...]`` in replica-major
+order, matching ``ElasticTrainer.shard_batch``'s data-axis layout: one
+process feeds all its addressable devices (the SPMD model), instead of
+the reference's one-loader-per-GPU-process model.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from typing import Any, Iterator
+
+import numpy as np
+
+from adaptdl_tpu import (
+    _signal,
+    checkpoint,
+    collective,
+    env,
+    metrics,
+)
+
+LOG = logging.getLogger(__name__)
+
+SPEEDUP_THRESHOLD = 1.05
+_current_dataloader: "AdaptiveDataLoader | None" = None
+
+
+def current_dataloader() -> "AdaptiveDataLoader | None":
+    return _current_dataloader
+
+
+def bucket_atomic_bsz(atomic_bsz: int) -> int:
+    """Round a candidate atomic batch size DOWN onto the recompile
+    grid. Rounding down keeps every batch-size cap the goodput
+    optimizer already enforced (max_batch_size, local bounds) intact;
+    rounding up could silently exceed them."""
+    if atomic_bsz <= 8:
+        return max(int(atomic_bsz), 1)
+    if atomic_bsz <= 128:
+        return int(atomic_bsz // 8 * 8)
+    return int(atomic_bsz // 64 * 64)
+
+
+class ElasticSampler:
+    """Deterministic shuffle + remaining-sample partition.
+
+    ``set_position(epoch, index)`` establishes where the epoch stands;
+    ``replica_indices(rank)`` yields the indices replica ``rank`` will
+    consume for the rest of the epoch. All replicas derive the same
+    permutation from the epoch number alone.
+    """
+
+    def __init__(self, dataset_size: int, shuffle: bool = True, seed: int = 0):
+        self.dataset_size = dataset_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.index = 0  # samples of this epoch already consumed
+        self._perm_cache: tuple[int, np.ndarray] | None = None
+
+    def set_position(self, epoch: int, index: int) -> None:
+        self.epoch = epoch
+        self.index = index
+
+    def _permutation(self) -> np.ndarray:
+        if not self.shuffle:
+            return np.arange(self.dataset_size)
+        if self._perm_cache is None or self._perm_cache[0] != self.epoch:
+            rng = np.random.default_rng((self.seed, self.epoch))
+            self._perm_cache = (self.epoch, rng.permutation(self.dataset_size))
+        return self._perm_cache[1]
+
+    def remaining(self) -> int:
+        return max(self.dataset_size - self.index, 0)
+
+    def next_indices(self, count: int) -> np.ndarray:
+        """The next ``count`` sample indices of this epoch, in
+        replica-major order: caller lays them out contiguously per
+        replica, matching the data-axis sharding split."""
+        return self._permutation()[self.index : self.index + count]
+
+
+class AdaptiveDataLoader:
+    """Iterates global batches with adaptive sizing and elasticity.
+
+    Args:
+      dataset: indexable providing ``dataset[i] -> pytree of arrays``
+        OR a dict of equal-length numpy arrays (fast path).
+      batch_size: the initial (and LR-reference) global batch size.
+      shuffle: deterministic per-epoch shuffling.
+      drop_last: drop the trailing partial batch (required under XLA's
+        static shapes; the epoch accounting treats the tail as done).
+      name: checkpoint registry key, must be unique per loader.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        seed: int = 0,
+        name: str = "adaptdl_dataloader",
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._size = _dataset_size(dataset)
+        self.sampler = ElasticSampler(self._size, shuffle, seed)
+        self._max_batch_size: int | None = None
+        self._local_bsz_bounds: tuple[int, int] | None = None
+        self._gradient_accumulation = False
+        # Current configuration (all replicas agree).
+        self._atomic_bsz = max(batch_size // env.num_replicas(), 1)
+        self._accum_steps = 0
+        # Replay bookkeeping, keyed per epoch: after a restart only the
+        # interrupted epoch re-runs, so finished-loop counts from other
+        # epochs must not suppress its loops (reference keys loop
+        # positions per epoch for the same reason, data.py:336-379).
+        self._loops_finished: dict[int, int] = {}
+        self._loops_started: dict[int, int] = {}
+        self._exit_future = None
+        self._reoptimize_every = 50  # optimizer steps between re-opts
+        self._last_profiled_config: tuple[int, int] | None = None
+        metrics.set_batch_size_config(batch_size)
+        self._checkpoint = _DataLoaderCheckpoint(name, self)
+        checkpoint.load_state(self._checkpoint)
+
+    # -- configuration -------------------------------------------------
+
+    def autoscale_batch_size(
+        self,
+        max_batch_size: int,
+        local_bsz_bounds: tuple[int, int] | None = None,
+        gradient_accumulation: bool = False,
+    ) -> None:
+        """Let the goodput model choose the global batch size up to
+        ``max_batch_size`` (reference API: data.py:242-268)."""
+        if max_batch_size < self.batch_size:
+            raise ValueError("max_batch_size below initial batch size")
+        self._max_batch_size = max_batch_size
+        self._local_bsz_bounds = local_bsz_bounds
+        self._gradient_accumulation = gradient_accumulation
+        metrics.set_batch_size_config(
+            self.batch_size,
+            max_batch_size,
+            local_bsz_bounds,
+            gradient_accumulation,
+        )
+
+    @property
+    def current_atomic_bsz(self) -> int:
+        return self._atomic_bsz
+
+    @property
+    def current_accum_steps(self) -> int:
+        return self._accum_steps
+
+    @property
+    def current_batch_size(self) -> int:
+        """Global batch size currently in effect."""
+        return (
+            env.num_replicas()
+            * self._atomic_bsz
+            * (self._accum_steps + 1)
+        )
+
+    @property
+    def current_local_bsz(self) -> int:
+        return self._atomic_bsz * (self._accum_steps + 1)
+
+    # -- adaptive sizing ----------------------------------------------
+
+    def _optimize_batch_size(self) -> None:
+        """Re-optimize (atomic_bsz, accum_steps); adopt on >5% speedup."""
+        if env.replica_rank() == 0:
+            decision = self._rank0_decision()
+        else:
+            decision = None
+        decision = collective.broadcast(decision)
+        self._atomic_bsz, self._accum_steps = decision
+
+    def _rank0_decision(self) -> tuple[int, int]:
+        num_replicas = env.num_replicas()
+        if self._max_batch_size is None:
+            return max(self.batch_size // num_replicas, 1), 0
+        goodput_fn = metrics.get_goodput_fn()
+        if goodput_fn is None:
+            # No fitted model yet: split the initial batch size.
+            atomic = max(self.batch_size // num_replicas, 1)
+            if self._local_bsz_bounds is not None:
+                atomic = int(
+                    np.clip(atomic, *self._local_bsz_bounds)
+                )
+            return atomic, 0
+        num_nodes = env.num_nodes()
+        # The restored config may be infeasible at the new replica
+        # count (e.g. global batch beyond max_batch_size after growing
+        # the job); then the optimizer's choice is adopted outright.
+        current_feasible = (
+            self.current_batch_size <= self._max_batch_size
+            and (
+                self._local_bsz_bounds is None
+                or self._local_bsz_bounds[0]
+                <= self._atomic_bsz
+                <= self._local_bsz_bounds[1]
+            )
+            and self.current_batch_size >= self.batch_size
+        )
+        current_goodput = (
+            goodput_fn(
+                num_nodes, num_replicas, self._atomic_bsz, self._accum_steps
+            )
+            if current_feasible
+            else 0.0
+        )
+        _, atomic_bsz, accum_steps = goodput_fn.optimize(
+            num_nodes,
+            num_replicas,
+            max_batch_size=self._max_batch_size,
+            atomic_bsz_range=self._local_bsz_bounds,
+            accumulation=self._gradient_accumulation,
+        )
+        atomic_bsz = bucket_atomic_bsz(int(atomic_bsz))
+        if self._local_bsz_bounds is not None:
+            atomic_bsz = int(
+                np.clip(
+                    atomic_bsz,
+                    self._local_bsz_bounds[0],
+                    self._local_bsz_bounds[1],
+                )
+            )
+        candidate_goodput = goodput_fn(
+            num_nodes, num_replicas, atomic_bsz, int(accum_steps)
+        )
+        if candidate_goodput > SPEEDUP_THRESHOLD * current_goodput:
+            return atomic_bsz, int(accum_steps)
+        return self._atomic_bsz, self._accum_steps
+
+    # -- elasticity ----------------------------------------------------
+
+    def _check_exit(self) -> None:
+        """Overlapped exit-flag agreement; checkpoint+exit(143) once
+        every replica has seen the signal."""
+        if self._exit_future is not None:
+            should_exit = self._exit_future.result()
+            if should_exit:
+                LOG.info("graceful exit: saving states and exiting 143")
+                checkpoint.save_all_states()
+                sys.exit(_signal.GRACEFUL_EXIT_CODE)
+        self._exit_future = collective.allreduce_async(
+            bool(_signal.get_exit_flag()), lambda vs: any(vs)
+        )
+
+    # -- iteration -----------------------------------------------------
+
+    def __len__(self) -> int:
+        return max(self._size // self.current_batch_size, 1)
+
+    def __iter__(self) -> Iterator[Any]:
+        global _current_dataloader
+        if _current_dataloader is not None:
+            raise RuntimeError(
+                "only one AdaptiveDataLoader loop may be active"
+            )
+        epoch = _loop_epoch()
+        started = self._loops_started.get(epoch, 0)
+        finished = self._loops_finished.get(epoch, 0)
+        if started < finished:
+            # This loop of this epoch completed before the restart.
+            self._loops_started[epoch] = started + 1
+            return
+        self._loops_started[epoch] = started + 1
+        if self.sampler.epoch != epoch:
+            # A fresh epoch for this loader (the restored position only
+            # applies to the epoch it was saved in).
+            self.sampler.set_position(epoch, 0)
+        _current_dataloader = self
+        try:
+            self._optimize_batch_size()
+            steps = 0
+            while True:
+                remaining = self.sampler.remaining()
+                global_bsz = self.current_batch_size
+                if remaining == 0 or (
+                    remaining < global_bsz and self.drop_last
+                ):
+                    break
+                take = min(global_bsz, remaining)
+                self._check_exit()
+                batch = _gather(
+                    self.dataset, self.sampler.next_indices(take)
+                )
+                config = (self._atomic_bsz, self._accum_steps)
+                start = time.monotonic()
+                yield batch
+                elapsed = time.monotonic() - start
+                if take == global_bsz:
+                    if config == self._last_profiled_config:
+                        metrics.profile_step(
+                            self._atomic_bsz, self._accum_steps, elapsed
+                        )
+                    else:
+                        # First step at a new shape includes XLA compile
+                        # time; recording it would poison the perf fit.
+                        self._last_profiled_config = config
+                self.sampler.index += take
+                steps += 1
+                if steps % self._reoptimize_every == 0:
+                    self._optimize_batch_size()
+            self._loops_finished[epoch] = finished + 1
+            # Dead bookkeeping from earlier epochs never replays.
+            for key in [k for k in self._loops_finished if k < epoch]:
+                del self._loops_finished[key]
+                self._loops_started.pop(key, None)
+            self.sampler.index = 0
+        finally:
+            _current_dataloader = None
+
+
+def _loop_epoch() -> int:
+    from adaptdl_tpu import epoch as epoch_mod
+
+    current = epoch_mod.current_epoch()
+    return current if current is not None else 0
+
+
+def _dataset_size(dataset) -> int:
+    if isinstance(dataset, dict):
+        return len(next(iter(dataset.values())))
+    return len(dataset)
+
+
+def _gather(dataset, index: np.ndarray):
+    if isinstance(dataset, dict):
+        return {k: v[index] for k, v in dataset.items()}
+    samples = [dataset[int(i)] for i in index]
+    first = samples[0]
+    if isinstance(first, dict):
+        return {
+            k: np.stack([s[k] for s in samples]) for k in first
+        }
+    if isinstance(first, (tuple, list)):
+        return type(first)(
+            np.stack([s[j] for s in samples]) for j in range(len(first))
+        )
+    return np.stack(samples)
+
+
+class _DataLoaderCheckpoint(checkpoint.State):
+    """Persists loop/epoch position for mid-epoch resume (reference:
+    data.py:547-575)."""
+
+    def __init__(self, name: str, loader: AdaptiveDataLoader):
+        super().__init__(name)
+        self._loader = loader
+
+    def save(self, fileobj):
+        import pickle
+
+        loader = self._loader
+        pickle.dump(
+            {
+                "epoch": loader.sampler.epoch,
+                "index": loader.sampler.index,
+                "loops_finished": loader._loops_finished,
+                "atomic_bsz": loader._atomic_bsz,
+                "accum_steps": loader._accum_steps,
+            },
+            fileobj,
+        )
+
+    def load(self, fileobj):
+        import pickle
+
+        payload = pickle.load(fileobj)
+        loader = self._loader
+        loader.sampler.set_position(payload["epoch"], payload["index"])
+        loader._loops_finished = payload["loops_finished"]
+        loader._atomic_bsz = payload["atomic_bsz"]
+        loader._accum_steps = payload["accum_steps"]

@@ -1,0 +1,259 @@
+"""Per-step profiling, perf-param fitting, and hint reporting.
+
+The reference profiles three times per step via backward hooks and CUDA
+events (reference: adaptdl/adaptdl/torch/_metrics.py:29-66,
+parallel.py:107-146). Under XLA the whole step is one fused program, so
+hook timing is impossible — and unnecessary. The TPU profiling model:
+
+- ``profile_step``: wall-clock of the full jitted step (host-timed with
+  ``block_until_ready``), keyed by (num_nodes, num_replicas,
+  atomic_bsz) exactly like the reference's profile table.
+- The compute/communication split the perf model needs comes from a
+  one-off *compute-only calibration* per atomic_bsz: the same
+  microbatch gradient computation compiled without the collective
+  (``ElasticTrainer`` provides it). ``accum`` observations are the
+  calibration times; ``optim`` observations are
+  ``measured_step_time - accum_steps * accum_time`` — the residual
+  containing the gradient sync, with XLA's compute/comm overlap
+  absorbed into the model's gamma p-norm.
+
+Every ``fit_interval`` seconds, rank 0 refits PerfParams and posts
+sched hints (reference cadence: _metrics.py:60-66). All of it lives in
+a checkpointable ``MetricsState``.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from adaptdl_tpu import checkpoint, env, sched_hints
+from adaptdl_tpu.goodput import (
+    GoodputFunction,
+    GradParams,
+    PerfParams,
+    fit_perf_params,
+)
+
+LOG = logging.getLogger(__name__)
+
+DEFAULT_FIT_INTERVAL = 30.0
+
+
+@dataclass
+class _ProfileEntry:
+    optim_time_sum: float = 0.0
+    optim_count: int = 0
+    accum_time_sum: float = 0.0
+    accum_count: int = 0
+
+
+@dataclass
+class MetricsState:
+    """Everything the adaptation engine knows about this job so far."""
+
+    profile: dict[tuple[int, int, int], _ProfileEntry] = field(
+        default_factory=lambda: defaultdict(_ProfileEntry)
+    )
+    perf_params: PerfParams | None = None
+    grad_params: GradParams | None = None
+    init_batch_size: int | None = None
+    max_batch_size: int | None = None
+    local_bsz_bounds: tuple[int, int] | None = None
+    gradient_accumulation: bool = False
+    max_profiled_replicas: int = 0
+    progress: float = 0.0
+
+
+_state = MetricsState()
+_last_fit_time: float | None = None
+
+
+def _reset_state() -> None:
+    """Test isolation."""
+    global _state, _last_fit_time
+    _state = MetricsState()
+    _last_fit_time = None
+
+
+def current_state() -> MetricsState:
+    return _state
+
+
+def set_batch_size_config(
+    init_batch_size: int,
+    max_batch_size: int | None = None,
+    local_bsz_bounds: tuple[int, int] | None = None,
+    gradient_accumulation: bool = False,
+) -> None:
+    _state.init_batch_size = init_batch_size
+    _state.max_batch_size = max_batch_size
+    _state.local_bsz_bounds = local_bsz_bounds
+    _state.gradient_accumulation = gradient_accumulation
+
+
+def profile_accum_time(atomic_bsz: int, accum_time: float) -> None:
+    """Record a compute-only (no-sync) calibration measurement."""
+    key = (env.num_nodes(), env.num_replicas(), atomic_bsz)
+    entry = _state.profile[key]
+    entry.accum_time_sum += accum_time
+    entry.accum_count += 1
+
+
+def profile_step(
+    atomic_bsz: int, accum_steps: int, step_time: float
+) -> None:
+    """Record one full fused-step wall-clock measurement.
+
+    The optim-time observation is the step time minus the modelled
+    accumulation micro-steps, clamped to stay positive.
+    """
+    key = (env.num_nodes(), env.num_replicas(), atomic_bsz)
+    entry = _state.profile[key]
+    if accum_steps > 0 and entry.accum_count > 0:
+        accum_time = entry.accum_time_sum / entry.accum_count
+        optim_time = max(
+            step_time - accum_steps * accum_time, 0.1 * step_time
+        )
+    else:
+        optim_time = step_time
+    entry.optim_time_sum += optim_time
+    entry.optim_count += 1
+    _state.max_profiled_replicas = max(
+        _state.max_profiled_replicas, env.num_replicas()
+    )
+    _maybe_fit_and_report()
+
+
+def update_grad_params(sqr: float, var: float) -> None:
+    """Latest GNS estimates from the train step's fused statistics."""
+    _state.grad_params = GradParams(sqr=float(sqr), var=float(var))
+
+
+def update_progress(progress: float) -> None:
+    _state.progress = float(progress)
+
+
+def _fit() -> PerfParams | None:
+    nodes, replicas, bszs, accum_times, optim_times = [], [], [], [], []
+    for (n, r, bsz), entry in _state.profile.items():
+        if entry.optim_count == 0:
+            continue
+        # A missing calibration falls back to the optim time, which
+        # keeps the fit feasible on fresh jobs.
+        if entry.accum_count > 0:
+            accum = entry.accum_time_sum / entry.accum_count
+        else:
+            accum = entry.optim_time_sum / entry.optim_count
+        nodes.append(n)
+        replicas.append(r)
+        bszs.append(bsz)
+        accum_times.append(accum)
+        optim_times.append(entry.optim_time_sum / entry.optim_count)
+    if not nodes:
+        return None
+    return fit_perf_params(nodes, replicas, bszs, accum_times, optim_times)
+
+
+def _maybe_fit_and_report(
+    now: float | None = None, interval: float = DEFAULT_FIT_INTERVAL
+) -> None:
+    global _last_fit_time
+    now = time.monotonic() if now is None else now
+    if _last_fit_time is not None and now - _last_fit_time < interval:
+        return
+    _last_fit_time = now
+    if env.replica_rank() != 0:
+        return
+    fit_and_report_now()
+
+
+def fit_and_report_now() -> None:
+    """Refit perf params and (best-effort) post sched hints."""
+    perf = _fit()
+    if perf is not None:
+        _state.perf_params = perf
+    if _state.init_batch_size is None:
+        return
+    hints = sched_hints.empty_hints()
+    hints["initBatchSize"] = _state.init_batch_size
+    if _state.local_bsz_bounds is not None:
+        hints["localBszBounds"] = list(_state.local_bsz_bounds)
+    hints["maxBatchSize"] = _state.max_batch_size
+    hints["maxProfiledReplicas"] = _state.max_profiled_replicas
+    hints["gradientAccumulation"] = _state.gradient_accumulation
+    if _state.grad_params is not None:
+        hints["gradParams"] = dict(_state.grad_params._asdict())
+    if _state.perf_params is not None:
+        hints["perfParams"] = {
+            k: float(v) for k, v in _state.perf_params._asdict().items()
+        }
+    sched_hints.post_sched_hints(hints)
+
+
+def get_goodput_fn() -> GoodputFunction | None:
+    """Assembled from the latest fitted perf + grad params, or None
+    until both exist (reference: _metrics.py:96-101)."""
+    if (
+        _state.perf_params is None
+        or _state.grad_params is None
+        or _state.init_batch_size is None
+    ):
+        return None
+    return GoodputFunction(
+        _state.perf_params, _state.grad_params, _state.init_batch_size
+    )
+
+
+class _MetricsCheckpoint(checkpoint.State):
+    """Profiles and fitted params survive restarts, so a rescaled job
+    does not re-learn its performance model from scratch."""
+
+    def __init__(self):
+        super().__init__("adaptdl_metrics")
+
+    def sync(self) -> None:
+        # Rank 0's view is authoritative; no cross-replica merge needed
+        # because every replica profiles identical fused steps.
+        pass
+
+    def save(self, fileobj):
+        payload = {
+            "profile": dict(_state.profile),
+            "perf_params": _state.perf_params,
+            "grad_params": _state.grad_params,
+            "init_batch_size": _state.init_batch_size,
+            "max_batch_size": _state.max_batch_size,
+            "local_bsz_bounds": _state.local_bsz_bounds,
+            "gradient_accumulation": _state.gradient_accumulation,
+            "max_profiled_replicas": _state.max_profiled_replicas,
+            "progress": _state.progress,
+        }
+        pickle.dump(payload, fileobj)
+
+    def load(self, fileobj):
+        payload = pickle.load(fileobj)
+        profile = defaultdict(_ProfileEntry)
+        profile.update(payload["profile"])
+        _state.profile = profile
+        _state.perf_params = payload["perf_params"]
+        _state.grad_params = payload["grad_params"]
+        _state.init_batch_size = payload["init_batch_size"]
+        _state.max_batch_size = payload["max_batch_size"]
+        _state.local_bsz_bounds = payload["local_bsz_bounds"]
+        _state.gradient_accumulation = payload["gradient_accumulation"]
+        _state.max_profiled_replicas = payload["max_profiled_replicas"]
+        _state.progress = payload["progress"]
+
+
+def ensure_checkpoint_registered() -> None:
+    try:
+        _MetricsCheckpoint()
+    except ValueError:
+        pass  # already registered

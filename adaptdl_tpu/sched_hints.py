@@ -1,0 +1,77 @@
+"""Scheduler-hints client: the job -> cluster half of the Pollux loop.
+
+Each job periodically POSTs its fitted goodput-model parameters to the
+supervisor; the cluster allocator turns them into speedup functions and
+re-optimizes every job's allocation. The schema mirrors the reference
+so dashboards/tools translate 1:1 (reference:
+adaptdl/adaptdl/sched_hints.py:33-59).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from adaptdl_tpu import env
+from adaptdl_tpu.goodput import GradParams, PerfParams
+
+LOG = logging.getLogger(__name__)
+
+PERF_PARAMS_KEYS = tuple(PerfParams._fields)
+GRAD_PARAMS_KEYS = tuple(GradParams._fields)
+
+# Hint keys: camelCase on the wire, matching the reference schema and
+# the AdaptDLJob CRD's status.train field.
+SCHED_HINTS_KEYS = (
+    "initBatchSize",
+    "localBszBounds",
+    "maxBatchSize",
+    "maxProfiledReplicas",
+    "gradientAccumulation",
+    "gradParams",
+    "perfParams",
+)
+
+
+def empty_hints() -> dict[str, Any]:
+    return {key: None for key in SCHED_HINTS_KEYS}
+
+
+def validate_hints(hints: dict[str, Any]) -> None:
+    unknown = set(hints) - set(SCHED_HINTS_KEYS)
+    if unknown:
+        raise ValueError(f"unknown sched hint keys: {sorted(unknown)}")
+    if hints.get("perfParams") is not None:
+        missing = set(PERF_PARAMS_KEYS) - set(hints["perfParams"])
+        if missing:
+            raise ValueError(f"perfParams missing {sorted(missing)}")
+    if hints.get("gradParams") is not None:
+        missing = set(GRAD_PARAMS_KEYS) - set(hints["gradParams"])
+        if missing:
+            raise ValueError(f"gradParams missing {sorted(missing)}")
+
+
+def post_sched_hints(
+    hints: dict[str, Any], job_id: str | None = None
+) -> bool:
+    """PUT hints to the supervisor; returns False on any failure.
+
+    Hint delivery is best-effort: training never blocks on the
+    scheduler being reachable.
+    """
+    url = env.supervisor_url()
+    job_id = job_id if job_id is not None else env.job_id()
+    if not url or not job_id:
+        return False
+    validate_hints(hints)
+    try:
+        import requests
+
+        response = requests.put(
+            f"{url}/hints/{job_id}", json=hints, timeout=10
+        )
+        response.raise_for_status()
+        return True
+    except Exception as exc:  # noqa: BLE001 - best effort by design
+        LOG.warning("failed to post sched hints: %s", exc)
+        return False

@@ -114,6 +114,7 @@ class ElasticTrainer:
         self._seed = seed
         self._init_params = params
         self._step_cache: dict[tuple[int, int], Callable] = {}
+        self._calibrated: set[int] = set()
 
     @property
     def num_replicas(self) -> int:
@@ -287,6 +288,78 @@ class ElasticTrainer:
         """Host batch -> jax arrays sharded along the data axis."""
         sharding = NamedSharding(self.mesh, P(DATA_AXIS))
         return jax.device_put(batch, sharding)
+
+    # ---- profiling integration --------------------------------------
+
+    def _build_compute_only(self, atomic_bsz: int):
+        """One microbatch forward+backward with no collective: the
+        calibration measurement that splits compute from gradient-sync
+        time in the perf model (hook timing being impossible under XLA
+        fusion; see adaptdl_tpu.metrics)."""
+
+        def per_replica(params, local_batch, rng):
+            params_v = jax.lax.pcast(params, DATA_AXIS, to="varying")
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
+            loss, grads = jax.value_and_grad(self.loss_fn)(
+                params_v, local_batch, rng
+            )
+            total = gns.normsqr(grads) + loss
+            return total[None]
+
+        sharded = shard_map(
+            per_replica,
+            mesh=self.mesh,
+            in_specs=(P(), P(DATA_AXIS), P()),
+            out_specs=P(DATA_AXIS),
+        )
+        return jax.jit(sharded)
+
+    def calibrate_accum_time(
+        self, state: TrainState, host_batch: Any, atomic_bsz: int,
+        repeats: int = 3,
+    ) -> float:
+        """Time the compute-only microbatch step; record into metrics."""
+        import time as _time
+
+        from adaptdl_tpu import metrics as metrics_mod
+
+        fn = self._build_compute_only(atomic_bsz)
+        micro = jax.tree.map(
+            lambda x: x[: self.num_replicas * atomic_bsz], host_batch
+        )
+        micro = self.shard_batch(micro)
+        jax.block_until_ready(fn(state.params, micro, state.rng))  # compile
+        best = float("inf")
+        for _ in range(repeats):
+            start = _time.monotonic()
+            jax.block_until_ready(fn(state.params, micro, state.rng))
+            best = min(best, _time.monotonic() - start)
+        metrics_mod.profile_accum_time(atomic_bsz, best)
+        return best
+
+    def run_step(self, state: TrainState, host_batch: Any, dataloader):
+        """One elastic step wired to the dataloader's current config:
+        calibrates new batch sizes, runs the fused step, and feeds the
+        GNS statistics and progress back into the metrics engine."""
+        from adaptdl_tpu import metrics as metrics_mod
+
+        atomic_bsz = dataloader.current_atomic_bsz
+        accum_steps = dataloader.current_accum_steps
+        if atomic_bsz not in self._calibrated:
+            self.calibrate_accum_time(state, host_batch, atomic_bsz)
+            self._calibrated.add(atomic_bsz)
+        step_fn = self.train_step(atomic_bsz, accum_steps)
+        batch = self.shard_batch(host_batch)
+        state, metrics_out = step_fn(state, batch)
+        # Block so the dataloader's wall-clock covers the whole fused
+        # step (profiling correctness beats dispatch pipelining here;
+        # the reference pays the same sync for its hook timings).
+        jax.block_until_ready(metrics_out["loss"])
+        metrics_mod.update_grad_params(
+            float(metrics_out["grad_sqr"]), float(metrics_out["grad_var"])
+        )
+        metrics_mod.update_progress(float(metrics_out["progress"]))
+        return state, metrics_out
 
     # ---- checkpoint integration -------------------------------------
 

@@ -1,0 +1,169 @@
+"""End-to-end elastic training slice.
+
+The round-1 milestone test (SURVEY.md section 7 step 2): a full user
+program — ElasticTrainer + AdaptiveDataLoader with
+autoscale_batch_size + remaining_epochs_until + Accumulator — is
+preempted mid-training, "restarted" with a different replica count,
+resumes from the checkpoint, and converges. Replica rescale is
+simulated in-process by rebuilding every component over a different
+device mesh, exactly what a restarted process does.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from adaptdl_tpu import (
+    _signal,
+    checkpoint,
+    collective,
+    epoch,
+    metrics,
+)
+from adaptdl_tpu.accumulator import Accumulator
+from adaptdl_tpu.data import AdaptiveDataLoader
+from adaptdl_tpu.parallel import create_mesh
+from adaptdl_tpu.scaling_rules import AdaScale
+from adaptdl_tpu.trainer import ElasticTrainer
+
+TRUE_W = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+DATASET_SIZE = 512
+EPOCHS = 10
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    epoch._reset_state()
+    metrics._reset_state()
+    _signal.set_exit_flag(False)
+    yield
+    epoch._reset_state()
+    metrics._reset_state()
+    _signal.set_exit_flag(False)
+    collective.teardown()
+
+
+def _dataset():
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(DATASET_SIZE, 4)).astype(np.float32)
+    y = x @ TRUE_W + 0.05 * rng.normal(size=DATASET_SIZE).astype(
+        np.float32
+    )
+    return {"x": x, "y": y}
+
+
+def _loss_fn(params, batch, rng):
+    pred = batch["x"] @ params["w"] + params["b"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def _incarnation(num_replicas, preempt_after_steps=None):
+    """One process incarnation of the user program.
+
+    Returns (final_state, epochs_visited, losses) or raises SystemExit
+    on simulated preemption.
+    """
+    checkpoint._reset_registry()
+    epoch._reset_state()
+    metrics._reset_state()
+    mesh = create_mesh(devices=jax.devices()[:num_replicas])
+    trainer = ElasticTrainer(
+        loss_fn=_loss_fn,
+        params={"w": jnp.zeros(4), "b": jnp.zeros(())},
+        optimizer=optax.sgd(0.05),
+        init_batch_size=32,
+        scaling_rule=AdaScale(),
+        mesh=mesh,
+    )
+    holder = {"state": trainer.init_state()}
+    trainer.make_checkpoint_state(
+        lambda: holder["state"],
+        lambda s: holder.__setitem__("state", s),
+    )
+    checkpoint.load_state(checkpoint._registry["elastic_trainer"])
+    metrics.ensure_checkpoint_registered()
+    checkpoint.load_state(checkpoint._registry["adaptdl_metrics"])
+
+    dataset = _dataset()
+    loader = AdaptiveDataLoader(dataset, batch_size=32, name="e2e-loader")
+    loader.autoscale_batch_size(
+        256, local_bsz_bounds=(8, 64), gradient_accumulation=True
+    )
+    accum = Accumulator(name="e2e-accum")
+
+    epochs_visited = []
+    losses = []
+    steps = 0
+    for e in epoch.remaining_epochs_until(EPOCHS):
+        epochs_visited.append(e)
+        for batch in loader:
+            holder["state"], m = trainer.run_step(
+                holder["state"], batch, loader
+            )
+            accum["loss_sum"] += float(m["loss"])
+            accum["steps"] += 1
+            steps += 1
+            if (
+                preempt_after_steps is not None
+                and steps == preempt_after_steps
+            ):
+                _signal.set_exit_flag(True)
+        with accum.synchronized():
+            losses.append(accum["loss_sum"] / max(accum["steps"], 1))
+        accum.reset()
+    return holder["state"], epochs_visited, losses
+
+
+def test_elastic_preempt_rescale_resume(tmp_path, monkeypatch):
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    monkeypatch.setenv("ADAPTDL_NUM_NODES", "1")
+
+    # Incarnation 0: 2 replicas, preempted after a few steps.
+    monkeypatch.setenv("ADAPTDL_NUM_REPLICAS", "2")
+    monkeypatch.setenv("ADAPTDL_NUM_RESTARTS", "0")
+    with pytest.raises(SystemExit) as exc_info:
+        _incarnation(2, preempt_after_steps=5)
+    assert exc_info.value.code == 143
+    assert checkpoint.latest_checkpoint_dir(str(tmp_path)) is not None
+
+    # Incarnation 1: rescaled to 8 replicas, runs to completion.
+    monkeypatch.setenv("ADAPTDL_NUM_REPLICAS", "8")
+    monkeypatch.setenv("ADAPTDL_NUM_RESTARTS", "1")
+    _signal.set_exit_flag(False)
+    state, epochs_visited, losses = _incarnation(8)
+
+    # Resumed at the interrupted epoch (0), finished all 6.
+    assert epochs_visited[0] == 0
+    assert epochs_visited[-1] == EPOCHS - 1
+    # Converged to the true weights.
+    w = np.asarray(state.params["w"])
+    assert np.allclose(w, TRUE_W, atol=0.2), w
+    assert losses[-1] < 0.1
+    # Profiling survived and accumulated across both incarnations.
+    assert metrics.current_state().max_profiled_replicas == 8
+
+
+def test_fixed_batch_size_run(tmp_path, monkeypatch):
+    """No autoscaling: plain elastic DP training end-to-end."""
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    monkeypatch.setenv("ADAPTDL_NUM_REPLICAS", "4")
+    checkpoint._reset_registry()
+    mesh = create_mesh(devices=jax.devices()[:4])
+    trainer = ElasticTrainer(
+        loss_fn=_loss_fn,
+        params={"w": jnp.zeros(4), "b": jnp.zeros(())},
+        optimizer=optax.sgd(0.05),
+        init_batch_size=32,
+        mesh=mesh,
+    )
+    state = trainer.init_state()
+    loader = AdaptiveDataLoader(
+        _dataset(), batch_size=32, name="e2e-fixed"
+    )
+    for e in epoch.remaining_epochs_until(3):
+        for batch in loader:
+            state, m = trainer.run_step(state, batch, loader)
+    assert float(m["loss"]) < 0.1
