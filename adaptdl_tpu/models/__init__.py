@@ -1,0 +1,24 @@
+"""Model zoo mirroring the reference's example families.
+
+Reference examples (reference: examples/, tutorial/): MNIST CNN
+(tutorial/mnist_step_5.py), CIFAR ResNet18
+(examples/pytorch-cifar/main.py), transformer LM
+(examples/transformer/), BERT MLM (examples/BERT/), NCF
+(examples/NCF/), DCGAN (examples/dcgan/), linear regression
+(examples/linear_regression/). Each model here ships a flax module, an
+init helper, and a ``loss_fn(params, batch, rng)`` compatible with
+``ElasticTrainer``.
+"""
+
+from adaptdl_tpu.models.cnn import SmallCNN, cnn_loss_fn, init_cnn  # noqa: F401
+from adaptdl_tpu.models.resnet import (  # noqa: F401
+    ResNet18,
+    init_resnet18,
+    resnet_loss_fn,
+)
+from adaptdl_tpu.models.transformer import (  # noqa: F401
+    TransformerLM,
+    TransformerConfig,
+    init_transformer,
+    lm_loss_fn,
+)

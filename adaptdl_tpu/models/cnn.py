@@ -1,0 +1,54 @@
+"""Small CNN for MNIST-class tasks (reference:
+tutorial/mnist_step_5.py's Net: two convs + two dense layers)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class SmallCNN(nn.Module):
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True, rng=None):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        if train and rng is not None:
+            x = nn.Dropout(0.25, deterministic=False)(x, rng=rng)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+def init_cnn(
+    rng=None, image_size: int = 28, channels: int = 1, **kwargs
+):
+    model = SmallCNN(**kwargs)
+    rng = rng if rng is not None else jax.random.key(0)
+    dummy = jnp.zeros((1, image_size, image_size, channels))
+    params = model.init(rng, dummy, train=False)["params"]
+    return model, params
+
+
+def cnn_loss_fn(model: SmallCNN):
+    """ElasticTrainer-compatible mean cross-entropy."""
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply(
+            {"params": params}, batch["image"], train=True, rng=rng
+        )
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]
+        ).mean()
+
+    return loss_fn

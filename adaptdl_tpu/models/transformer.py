@@ -1,0 +1,187 @@
+"""Flagship decoder-only transformer LM (reference families:
+examples/transformer/ WMT LM and examples/BERT/ MLM).
+
+TPU-first design notes:
+
+- einsum-shaped attention and MLP so XLA tiles every contraction onto
+  the MXU; compute dtype bfloat16 on TPU, params float32.
+- pre-LN blocks with optional per-block rematerialisation
+  (``jax.checkpoint`` via ``nn.remat``) to trade FLOPs for HBM.
+- RoPE positions (no position table to re-shard on sequence-length
+  changes).
+- the attention inner function is pluggable: the default is plain
+  causal attention; the sequence-parallel path substitutes ring
+  attention from ``adaptdl_tpu.parallel.ring_attention`` without
+  touching the rest of the model.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    max_seq_len: int = 2048
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    # attention_fn(q, k, v, axis_name=None) -> out; q/k/v are
+    # [batch, heads, seq, head_dim]; None selects causal attention.
+    attention_fn: Callable | None = None
+
+
+def rope(x: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
+    """Rotary position embedding over the last (head_dim) axis.
+
+    x: [batch, heads, seq, head_dim]; positions: [seq].
+    """
+    head_dim = x.shape[-1]
+    freqs = 1.0 / (
+        10000.0 ** (jnp.arange(0, head_dim, 2) / head_dim)
+    )
+    angles = positions[:, None] * freqs[None, :]  # [seq, head_dim/2]
+    sin = jnp.sin(angles)[None, None, :, :].astype(x.dtype)
+    cos = jnp.cos(angles)[None, None, :, :].astype(x.dtype)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    rotated = jnp.stack(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return rotated.reshape(x.shape)
+
+
+def causal_attention(q, k, v, axis_name=None):
+    """Plain causal attention; q/k/v: [batch, heads, seq, head_dim]."""
+    del axis_name
+    seq_len = q.shape[2]
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    )
+    logits = logits * scale
+    mask = jnp.tril(jnp.ones((seq_len, seq_len), bool))
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+class Attention(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        head_dim = cfg.d_model // cfg.num_heads
+        qkv = nn.DenseGeneral(
+            (3, cfg.num_heads, head_dim),
+            axis=-1,
+            dtype=cfg.dtype,
+            use_bias=False,
+            name="qkv",
+        )(x)
+        q, k, v = jnp.moveaxis(qkv, -3, 0)  # each [b, s, h, d]
+        q = jnp.swapaxes(q, 1, 2)  # [b, h, s, d]
+        k = jnp.swapaxes(k, 1, 2)
+        v = jnp.swapaxes(v, 1, 2)
+        q = rope(q, positions)
+        k = rope(k, positions)
+        attn = cfg.attention_fn or causal_attention
+        out = attn(q, k, v)  # [b, h, s, d]
+        out = jnp.swapaxes(out, 1, 2).reshape(
+            x.shape[:-1] + (cfg.d_model,)
+        )
+        return nn.DenseGeneral(
+            cfg.d_model, dtype=cfg.dtype, use_bias=False, name="out"
+        )(out)
+
+
+class Block(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, dropout_rng=None):
+        cfg = self.config
+        y = nn.LayerNorm(dtype=cfg.dtype, use_bias=False)(x)
+        y = Attention(cfg, name="attention")(y, positions)
+        if cfg.dropout_rate > 0 and dropout_rng is not None:
+            y = nn.Dropout(cfg.dropout_rate, deterministic=False)(
+                y, rng=dropout_rng
+            )
+        x = x + y
+        y = nn.LayerNorm(dtype=cfg.dtype, use_bias=False)(x)
+        y = nn.Dense(
+            cfg.d_ff, dtype=cfg.dtype, use_bias=False, name="ff_up"
+        )(y)
+        y = nn.gelu(y)
+        y = nn.Dense(
+            cfg.d_model, dtype=cfg.dtype, use_bias=False, name="ff_down"
+        )(y)
+        return x + y
+
+
+class TransformerLM(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = True, rng=None):
+        cfg = self.config
+        embed = nn.Embed(
+            cfg.vocab_size,
+            cfg.d_model,
+            dtype=cfg.dtype,
+            name="embed",
+        )
+        x = embed(tokens)
+        positions = jnp.arange(tokens.shape[1])
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, static_argnums=())
+        for layer in range(cfg.num_layers):
+            dropout_rng = (
+                jax.random.fold_in(rng, layer)
+                if (train and rng is not None and cfg.dropout_rate > 0)
+                else None
+            )
+            x = block_cls(cfg, name=f"layer_{layer}")(
+                x, positions, dropout_rng
+            )
+        x = nn.LayerNorm(dtype=cfg.dtype, use_bias=False)(x)
+        # Tied output head through the embedding table keeps the only
+        # O(vocab x d_model) matmul single-sourced.
+        return embed.attend(x).astype(jnp.float32)
+
+
+def init_transformer(config: TransformerConfig, rng=None, seq_len=None):
+    model = TransformerLM(config)
+    rng = rng if rng is not None else jax.random.key(0)
+    seq_len = seq_len or min(config.max_seq_len, 128)
+    dummy = jnp.zeros((1, seq_len), jnp.int32)
+    params = model.init(rng, dummy, train=False)["params"]
+    return model, params
+
+
+def lm_loss_fn(model: TransformerLM):
+    """Next-token cross-entropy; batch = {"tokens": [b, s+1] int32}."""
+
+    def loss_fn(params, batch, rng):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = model.apply(
+            {"params": params}, inputs, train=True, rng=rng
+        )
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets
+        ).mean()
+
+    return loss_fn

@@ -132,6 +132,17 @@ class ElasticTrainer:
             rng=jax.random.key(self._seed),
         )
         replicated = NamedSharding(self.mesh, P())
+        # Copy: device_put aliases buffers whose sharding already
+        # matches, and the donated train step would then delete the
+        # caller's initial params out from under a second trainer.
+        state = jax.tree.map(
+            lambda x: jnp.array(x, copy=True)
+            if isinstance(x, jax.Array) and not jax.dtypes.issubdtype(
+                x.dtype, jax.dtypes.prng_key
+            )
+            else x,
+            state,
+        )
         return jax.device_put(state, replicated)
 
     def _precond(self, opt_state):
@@ -343,6 +354,15 @@ class ElasticTrainer:
         GNS statistics and progress back into the metrics engine."""
         from adaptdl_tpu import metrics as metrics_mod
 
+        from adaptdl_tpu import env as env_mod
+
+        if env_mod.num_replicas() != self.num_replicas:
+            raise RuntimeError(
+                f"ADAPTDL_NUM_REPLICAS={env_mod.num_replicas()} but the "
+                f"trainer mesh has {self.num_replicas} data-parallel "
+                "devices; the dataloader sizes batches by the env value "
+                "so they must agree"
+            )
         atomic_bsz = dataloader.current_atomic_bsz
         accum_steps = dataloader.current_accum_steps
         if atomic_bsz not in self._calibrated:

@@ -1,0 +1,193 @@
+"""Benchmark: elastic goodput retention on the CIFAR ResNet-18 config.
+
+Measures the BASELINE.md north-star metric on real hardware: goodput
+(statistical efficiency x samples/s) of the *adaptive* batch-size path
+relative to the fixed-allocation baseline on the same chip(s). The
+fixed run (batch 128, the reference CIFAR config:
+examples/pytorch-cifar/main.py + tests/short-workload/
+resnet18-cifar10.sh) is the denominator; the adaptive run lets the
+goodput model pick (atomic_bsz, accum_steps) up to 4096 with local
+bounds (64, 1024).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline is the ratio against the fixed-allocation goodput (the
+self-generated baseline; the reference publishes no numbers —
+BASELINE.md). >= 0.90 meets the north-star; > 1.0 means the adaptive
+policy beats fixed allocation outright.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _make_dataset(n: int, image_size: int, num_classes: int = 10):
+    rng = np.random.default_rng(0)
+    templates = rng.normal(
+        size=(num_classes, image_size, image_size, 3)
+    ).astype(np.float32)
+    labels = rng.integers(0, num_classes, size=n)
+    images = 0.5 * templates[labels] + rng.normal(
+        size=(n, image_size, image_size, 3)
+    ).astype(np.float32)
+    return {"image": images, "label": labels.astype(np.int32)}
+
+
+def _steady_state_time(trainer, state, step_fn, batch, steps: int):
+    """Median step wall-clock after warmup; returns (state, seconds)."""
+    import jax
+
+    state, m = step_fn(state, batch)  # compile + warmup
+    jax.block_until_ready(m["loss"])
+    times = []
+    for _ in range(steps):
+        start = time.monotonic()
+        state, m = step_fn(state, batch)
+        jax.block_until_ready(m["loss"])
+        times.append(time.monotonic() - start)
+    return state, float(np.median(times)), m
+
+
+def main(quick: bool = False):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from adaptdl_tpu import metrics
+    from adaptdl_tpu.data import AdaptiveDataLoader
+    from adaptdl_tpu.goodput import GradParams
+    from adaptdl_tpu.models import init_resnet18, resnet_loss_fn
+    from adaptdl_tpu.scaling_rules import AdaScale
+    from adaptdl_tpu.trainer import ElasticTrainer
+
+    import os
+
+    # Single-process SPMD: one replica per addressable device.
+    os.environ.setdefault(
+        "ADAPTDL_NUM_REPLICAS", str(len(jax.devices()))
+    )
+    on_tpu = jax.devices()[0].platform != "cpu"
+    full = on_tpu and not quick
+    image_size = 32 if full else 8
+    width = 64 if full else 8
+    dataset_n = 8192 if full else 512
+    measure_steps = 30 if full else 3
+    adapt_steps = 120 if full else 8
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    init_bsz = 128 if full else 32
+    max_bsz = 4096 if full else 128
+    bounds = (64, 1024) if full else (8, 64)
+
+    model, params = init_resnet18(
+        image_size=image_size, width=width, dtype=dtype
+    )
+    dataset = _make_dataset(dataset_n, image_size)
+    _log(f"bench: platform={jax.devices()[0].platform} width={width}")
+
+    def make_trainer():
+        return ElasticTrainer(
+            loss_fn=resnet_loss_fn(model),
+            params=params,
+            optimizer=optax.sgd(0.1, momentum=0.9),
+            init_batch_size=init_bsz,
+            scaling_rule=AdaScale(),
+        )
+
+    # ---- fixed-allocation baseline: batch 128 -----------------------
+    metrics._reset_state()
+    trainer = make_trainer()
+    state = trainer.init_state()
+    atomic_fixed = init_bsz // trainer.num_replicas
+    step_fn = trainer.train_step(atomic_fixed, 0)
+    rng = np.random.default_rng(1)
+    idx = rng.integers(0, dataset_n, size=init_bsz)
+    batch = trainer.shard_batch(
+        {k: v[idx] for k, v in dataset.items()}
+    )
+    state, t_fixed, _ = _steady_state_time(
+        trainer, state, step_fn, batch, measure_steps
+    )
+    goodput_fixed = init_bsz / t_fixed  # efficiency(128) == 1
+    _log(
+        f"fixed: batch={init_bsz} step={t_fixed*1e3:.1f}ms "
+        f"goodput={goodput_fixed:.1f}"
+    )
+
+    # ---- adaptive run: goodput model drives the batch size ----------
+    metrics._reset_state()
+    trainer = make_trainer()
+    state = trainer.init_state()
+    loader = AdaptiveDataLoader(
+        dataset, batch_size=init_bsz, name="bench-loader"
+    )
+    loader.autoscale_batch_size(
+        max_bsz, local_bsz_bounds=bounds, gradient_accumulation=True
+    )
+    loader._reoptimize_every = 10
+    steps = 0
+    from adaptdl_tpu import epoch as epoch_mod
+
+    for e in epoch_mod.remaining_epochs_until(1_000_000):
+        for host_batch in loader:
+            state, m = trainer.run_step(state, host_batch, loader)
+            steps += 1
+            if steps % 10 == 0:
+                metrics._maybe_fit_and_report(interval=0.0)
+            if steps >= adapt_steps:
+                break
+        if steps >= adapt_steps:
+            break
+    final_atomic = loader.current_atomic_bsz
+    final_accum = loader.current_accum_steps
+    final_bsz = loader.current_batch_size
+    # Steady-state throughput at the adapted configuration.
+    step_fn = trainer.train_step(final_atomic, final_accum)
+    idx = rng.integers(0, dataset_n, size=final_bsz)
+    batch = trainer.shard_batch(
+        {k: v[idx] for k, v in dataset.items()}
+    )
+    state, t_adapt, m = _steady_state_time(
+        trainer, state, step_fn, batch, measure_steps
+    )
+    grad_params = metrics.current_state().grad_params or GradParams(
+        float(m["grad_sqr"]), float(m["grad_var"])
+    )
+    from adaptdl_tpu.goodput import GoodputFunction, PerfParams
+
+    efficiency = GoodputFunction(
+        metrics.current_state().perf_params
+        or PerfParams(0.1, 0.01, 0.02, 0.006, 0.01, 0.003, 1.1),
+        grad_params,
+        init_bsz,
+    ).efficiency(final_bsz)
+    goodput_adapt = (final_bsz / t_adapt) * float(efficiency)
+    _log(
+        f"adaptive: batch={final_bsz} (atomic={final_atomic}, "
+        f"accum={final_accum}) step={t_adapt*1e3:.1f}ms "
+        f"eff={float(efficiency):.3f} goodput={goodput_adapt:.1f}"
+    )
+
+    ratio = goodput_adapt / goodput_fixed
+    print(
+        json.dumps(
+            {
+                "metric": "elastic_goodput_retention_resnet18_cifar",
+                "value": round(ratio, 4),
+                "unit": "x_fixed_allocation_goodput",
+                "vs_baseline": round(ratio, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main(quick="--quick" in sys.argv)
