@@ -1,0 +1,7 @@
+"""Cluster scheduler: Pollux policy, allocator core, supervisor.
+
+The reference's Kubernetes scheduler package (reference:
+sched/adaptdl_sched/) re-targeted at TPU slices: the "node" axis is a
+slice (the unit whose internal ICI links are not shareable between
+jobs), replicas are chips, and cluster autoscaling requests slices.
+"""

@@ -1,0 +1,3 @@
+from adaptdl_tpu.sched.policy.pollux import PolluxPolicy  # noqa: F401
+from adaptdl_tpu.sched.policy.speedup import SpeedupFunction  # noqa: F401
+from adaptdl_tpu.sched.policy.utils import JobInfo, NodeInfo  # noqa: F401

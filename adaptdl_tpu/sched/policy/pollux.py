@@ -1,0 +1,394 @@
+"""Pollux scheduling policy over TPU slices.
+
+Co-optimizes every job's replica allocation and the cluster size by
+maximizing the sum of goodput-derived speedups (OSDI'21 Pollux;
+reference: sched/adaptdl_sched/policy/pollux.py). Key semantics kept
+from the reference, re-expressed for slices:
+
+- state: integer matrix ``A[j, s]`` = replicas of job j on slice s,
+  with as many *virtual* slices appended as real ones so the search can
+  propose growing the cluster (autoscaling).
+- objectives: (-sum of scaled speedups, number of active slices).
+  Speedups are normalized by each job's dominant resource share so one
+  "fair share" of the cluster ~ speedup 1; solutions that move a job
+  off its current allocation pay a 10% restart penalty (checkpoint-
+  restart is cheap but not free).
+- feasibility (the repair step): pinned (non-preemptible, already
+  running) jobs keep their allocation; at most one *distributed* job
+  per slice — a job spanning chips owns the slice's ICI; per-job
+  min/max replica bounds; per-slice resource capacity.
+- the final allocation is chosen from the Pareto front subject to the
+  autoscaler's node budget; desired cluster size targets average
+  utilization inside [0.35, 0.65] (reference: pollux.py:121-142).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+from collections import OrderedDict
+
+import numpy as np
+
+from adaptdl_tpu.sched.policy import nsga2
+from adaptdl_tpu.sched.policy.utils import JobInfo, NodeInfo
+
+LOG = logging.getLogger(__name__)
+
+RESTART_PENALTY = 0.1
+
+
+class PolluxPolicy:
+    def __init__(self, pop_size: int = 100, generations: int = 100):
+        self._pop_size = pop_size
+        self._generations = generations
+        self._min_util = 0.35
+        self._max_util = 0.65
+        self._prev_population = None
+        self._prev_jobs: list = []
+        self._prev_nodes: list = []
+
+    # -- single-job arrival (cheap path) ------------------------------
+
+    def allocate_job(self, job_info: JobInfo, nodes: dict) -> list:
+        """First-fit of a newly arrived job's min_replicas (reference:
+        pollux.py:43-70)."""
+        want = max(job_info.min_replicas, 1)
+        for name, node in _sorted_nodes(nodes).items():
+            fits = min(
+                node.resources.get(rtype, 0) // amount
+                for rtype, amount in job_info.resources.items()
+                if amount > 0
+            )
+            if fits >= want:
+                return [name] * want
+        return []
+
+    # -- full optimization cycle --------------------------------------
+
+    def optimize(self, jobs, nodes, base_allocations, node_template):
+        """One Pollux cycle.
+
+        Args:
+          jobs: {job_key: JobInfo} incomplete jobs.
+          nodes: {node_key: NodeInfo} existing slices.
+          base_allocations: {job_key: [node_key per replica]} current.
+          node_template: NodeInfo for a provisionable slice.
+
+        Returns:
+          (allocations, desired_nodes)
+        """
+        if not jobs or not nodes:
+            return {}, len(nodes)
+
+        def pinned(key, job):
+            return not job.preemptible and bool(base_allocations.get(key))
+
+        jobs = OrderedDict(
+            sorted(
+                jobs.items(),
+                key=lambda kv: (
+                    not pinned(*kv),
+                    kv[1].min_replicas,
+                    kv[1].creation_timestamp,
+                ),
+            )
+        )
+        nodes = _sorted_nodes(nodes)
+        job_list = list(jobs.values())
+        # Real slices followed by equally many virtual (requestable).
+        node_list = list(nodes.values()) + [node_template] * len(nodes)
+
+        base_state = np.zeros((len(jobs), len(node_list)), dtype=int)
+        node_index = {key: i for i, key in enumerate(nodes)}
+        for j, key in enumerate(jobs):
+            for node_key in base_allocations.get(key, []):
+                if node_key in node_index:
+                    base_state[j, node_index[node_key]] += 1
+
+        problem = _Problem(job_list, node_list, base_state)
+        seeds = self._seed_population(jobs, nodes, base_state)
+        population, F, front = nsga2.minimize(
+            evaluate=problem.evaluate,
+            initial=seeds,
+            crossover=problem.crossover,
+            mutate=problem.mutate,
+            repair=problem.repair,
+            pop_size=self._pop_size,
+            generations=self._generations,
+        )
+        self._prev_population = copy.deepcopy(population)
+        self._prev_jobs = list(jobs)
+        self._prev_nodes = list(nodes)
+
+        states = population[front].reshape(
+            front.size, len(jobs), len(node_list)
+        )
+        values = F[front]
+        utilities = problem.cluster_utilities(states)
+        desired_nodes = self._desired_nodes(utilities, values, len(nodes))
+        pick = _select_within_budget(
+            values, min(len(nodes), desired_nodes)
+        )
+        if pick is None:
+            return {}, desired_nodes
+        chosen = states[pick]
+        allocations = {}
+        node_keys = list(nodes)
+        for j, key in enumerate(jobs):
+            alloc = []
+            for s, node_key in enumerate(node_keys):
+                alloc.extend([node_key] * int(chosen[j, s]))
+            allocations[key] = alloc
+        return allocations, desired_nodes
+
+    def _seed_population(self, jobs, nodes, base_state):
+        """Warm start from the previous population, remapped across job
+        and node churn (reference: pollux.py:94-119)."""
+        flat_base = base_state.reshape(1, -1)
+        if self._prev_population is None:
+            return flat_base
+        prev = self._prev_population.reshape(
+            self._prev_population.shape[0],
+            len(self._prev_jobs),
+            -1,
+        )
+        num_nodes = base_state.shape[1]
+        states = np.zeros(
+            (prev.shape[0], len(jobs), num_nodes), dtype=int
+        )
+        prev_job_idx = {k: i for i, k in enumerate(self._prev_jobs)}
+        prev_node_idx = {k: i for i, k in enumerate(self._prev_nodes)}
+        job_pairs = [
+            (j, prev_job_idx[key])
+            for j, key in enumerate(jobs)
+            if key in prev_job_idx
+        ]
+        if job_pairs:
+            dst_j, src_j = map(list, zip(*job_pairs))
+            # Physical slices by name; new/virtual ones consume the
+            # previous run's virtual columns in order.
+            spare = len(self._prev_nodes)
+            for s, key in enumerate(nodes):
+                if key in prev_node_idx:
+                    src_col = prev_node_idx[key]
+                elif spare < prev.shape[2]:
+                    src_col = spare
+                    spare += 1
+                else:
+                    continue
+                states[:, dst_j, s] = prev[:, src_j, src_col]
+            for s in range(len(nodes), num_nodes):
+                if spare >= prev.shape[2]:
+                    break
+                states[:, dst_j, s] = prev[:, src_j, spare]
+                spare += 1
+        return np.concatenate(
+            [flat_base, states.reshape(states.shape[0], -1)], axis=0
+        )
+
+    def _desired_nodes(self, utilities, values, num_nodes):
+        pick = _select_within_budget(values, num_nodes)
+        if pick is not None and (
+            self._min_util <= utilities[pick] <= self._max_util
+        ):
+            return num_nodes
+        target = (self._min_util + self._max_util) / 2
+        best_util, best_nodes = np.inf, num_nodes
+        for util, (_, active) in zip(utilities, values):
+            if util < self._min_util:
+                continue
+            if np.isclose(util, best_util) and active > best_nodes:
+                best_nodes = active
+            if abs(util - target) < abs(best_util - target):
+                best_util, best_nodes = util, active
+        return int(best_nodes)
+
+
+def _sorted_nodes(nodes: dict) -> OrderedDict:
+    """Stable preference order: reliable slices first."""
+    return OrderedDict(
+        sorted(nodes.items(), key=lambda kv: (kv[1].preemptible, kv[0]))
+    )
+
+
+def _select_within_budget(values, max_nodes):
+    """Best total speedup among solutions within the node budget."""
+    feasible = values[:, 1] <= max_nodes
+    if not feasible.any():
+        return None
+    score = np.where(feasible, values[:, 0], 0.0)
+    return int(np.argmin(score))
+
+
+class _Problem:
+    """Objectives + variation operators over allocation matrices."""
+
+    def __init__(self, jobs, nodes, base_state):
+        self.jobs = jobs
+        self.nodes = nodes
+        self.base_state = base_state
+        self.shape = base_state.shape
+        num_jobs, num_nodes = self.shape
+        self._pinned = np.array(
+            [
+                not job.preemptible and base_state[j].any()
+                for j, job in enumerate(jobs)
+            ]
+        )
+        rtypes = sorted({r for job in jobs for r in job.resources})
+        self._job_res = np.array(
+            [[job.resources.get(r, 0) for r in rtypes] for job in jobs],
+            dtype=np.int64,
+        )
+        self._node_res = np.array(
+            [[n.resources.get(r, 0) for r in rtypes] for n in nodes],
+            dtype=np.int64,
+        )
+        # Dominant share: fraction of the whole cluster one replica
+        # occupies on its scarcest resource type.
+        with np.errstate(divide="ignore", invalid="ignore"):
+            share = self._job_res / self._node_res.sum(axis=0)
+        self._dominant_share = np.nan_to_num(share).max(axis=1)
+        # Per (job, node) replica capacity, net of pinned jobs' usage.
+        used = (
+            base_state[self._pinned, :, None]
+            * self._job_res[self._pinned][:, None, :]
+        ).sum(axis=0)
+        avail = np.maximum(self._node_res - used, 0)
+        caps = []
+        for j in range(num_jobs):
+            req = self._job_res[j]
+            with np.errstate(divide="ignore", invalid="ignore"):
+                per = np.where(req > 0, avail // np.maximum(req, 1), 10**9)
+            caps.append(per.min(axis=1))
+        self._cap = np.stack(caps)  # (jobs, nodes)
+        self._min_replicas = np.array([j.min_replicas for j in jobs])
+        self._max_replicas = np.array([j.max_replicas for j in jobs])
+
+    # -- objectives ----------------------------------------------------
+
+    def _speedups(self, states):
+        active_nodes = np.count_nonzero(states, axis=2)
+        replicas = states.sum(axis=2)
+        columns = [
+            job.speedup_fn(active_nodes[:, j], replicas[:, j])
+            for j, job in enumerate(self.jobs)
+        ]
+        return np.stack(columns, axis=1).astype(float)
+
+    def _cluster_sizes(self, states):
+        order = np.arange(1, self.shape[1] + 1)
+        return np.max(
+            np.where(states.any(axis=1), order, 0), axis=1
+        )
+
+    def evaluate(self, flat_pop):
+        states = flat_pop.reshape(-1, *self.shape)
+        speedups = self._speedups(states)
+        scaled = speedups * self._dominant_share * len(self.nodes)
+        moved = (states != self.base_state).any(axis=2)
+        scaled = np.where(moved, scaled * (1 - RESTART_PENALTY), scaled)
+        return np.column_stack(
+            [-scaled.sum(axis=1), self._cluster_sizes(states)]
+        )
+
+    def cluster_utilities(self, states):
+        """Mean speedup-per-replica weighted by resource share, per
+        state (reference: pollux.py:302-335)."""
+        replicas = states.sum(axis=2)
+        speedups = self._speedups(states)
+        active = states.sum(axis=1) > 0  # (pop, nodes)
+        total = (active[:, :, None] * self._node_res).sum(axis=1)
+        alloc = replicas[:, :, None] * self._job_res
+        with np.errstate(divide="ignore", invalid="ignore"):
+            shares = np.where(alloc > 0, alloc / total[:, None, :], 0.0)
+            per_job = np.where(replicas > 0, speedups / replicas, 0.0)
+        util = (per_job[:, :, None] * shares).sum(axis=1)
+        return util.max(axis=1)
+
+    # -- variation ------------------------------------------------------
+
+    def crossover(self, parents_a, parents_b, rng):
+        a = parents_a.reshape(-1, *self.shape)
+        b = parents_b.reshape(-1, *self.shape)
+        n = a.shape[0]
+        # Exchange whole jobs at a random split point...
+        point = rng.integers(self.shape[0] + 1, size=(n, 1, 1))
+        take_a = np.arange(self.shape[0])[None, :, None] < point
+        child = np.where(take_a, a, b)
+        # ...and draw the child's cluster budget between the parents'.
+        size_a = self._cluster_sizes(a)
+        size_b = self._cluster_sizes(b)
+        lo = np.minimum(size_a, size_b)
+        hi = np.maximum(size_a, size_b)
+        budget = lo + (rng.integers(1 << 30, size=n) % (hi - lo + 1))
+        beyond = np.arange(self.shape[1])[None, None, :] >= budget[:, None, None]
+        child = np.where(beyond, 0, child)
+        return child.reshape(n, -1)
+
+    def mutate(self, flat_pop, rng):
+        states = flat_pop.reshape(-1, *self.shape).copy()
+        nonzero = np.count_nonzero(states, axis=2, keepdims=True)
+        zero = self.shape[1] - nonzero
+        # Equalize mutation pressure between occupied and empty cells.
+        prob = np.where(
+            states > 0,
+            1.0 / np.maximum(nonzero, 1),
+            1.0 / np.maximum(zero, 1),
+        )
+        hit = rng.random(states.shape) < prob
+        draw = rng.integers(0, self._cap[None] + 1, size=states.shape)
+        states[hit] = draw[hit]
+        return states.reshape(states.shape[0], -1)
+
+    def repair(self, flat_pop):
+        """Project arbitrary matrices onto the feasible set."""
+        states = flat_pop.reshape(-1, *self.shape).copy()
+        pop = states.shape[0]
+        # Pinned jobs keep their base allocation verbatim.
+        states[:, self._pinned] = self.base_state[self._pinned]
+        # A distributed job owns its slices' ICI: on every slice, keep
+        # only the first distributed job (in the sorted priority
+        # order), clearing later claimants.
+        distributed = (np.count_nonzero(states, axis=2) > 1)[:, :, None]
+        claims = (states > 0) & distributed
+        later_claim = claims.cumsum(axis=1) > 1
+        states[later_claim & claims] = 0
+        # Per-job replica ceiling: greedily keep replicas in a random
+        # node order so no single column is systematically favored.
+        shuffled = np.argsort(
+            np.random.default_rng(0).random(states.shape), axis=2
+        )
+        inverse = np.argsort(shuffled, axis=2)
+        shuffled_states = np.take_along_axis(states, shuffled, axis=2)
+        running = shuffled_states.cumsum(axis=2)
+        allowed = np.minimum(running, self._max_replicas[None, :, None])
+        shuffled_states = np.diff(
+            allowed, axis=2, prepend=np.zeros((pop, self.shape[0], 1), int)
+        )
+        states = np.take_along_axis(shuffled_states, inverse, axis=2)
+        # Per-slice capacity (net of pinned usage), job-priority order.
+        per_cap = np.minimum(states, self._cap[None])
+        # Resource units cap allocations across *different* jobs.
+        res_usage = (
+            per_cap[:, :, :, None] * self._job_res[None, :, None, :]
+        ).cumsum(axis=1)
+        over = res_usage > self._node_avail()[None, None]
+        # Scale back any job pushing a slice over capacity: zero its
+        # allocation on that slice (coarse but safe; the GA refines).
+        violating = over.any(axis=3)
+        states = np.where(violating, 0, per_cap)
+        # Jobs that end up below min_replicas get nothing at all.
+        under = states.sum(axis=2) < self._min_replicas[None, :]
+        states = np.where(under[:, :, None], 0, states)
+        # Pinned jobs are exempt from the above zeroing.
+        states[:, self._pinned] = self.base_state[self._pinned]
+        return states.reshape(pop, -1)
+
+    def _node_avail(self):
+        used = (
+            self.base_state[self._pinned, :, None]
+            * self._job_res[self._pinned][:, None, :]
+        ).sum(axis=0)
+        return np.maximum(self._node_res - used, 0)

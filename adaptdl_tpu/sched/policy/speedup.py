@@ -1,0 +1,69 @@
+"""Speedup function: a job's goodput normalized by its base goodput.
+
+Wraps a fitted :class:`adaptdl_tpu.goodput.GoodputFunction` as
+``speedup(num_nodes, num_replicas)``, the quantity the Pollux policy
+sums across jobs. Because the genetic search evaluates the same small
+set of (slices, replicas) points thousands of times per cycle, results
+are cached in a dense table and computed lazily on first use
+(reference: sched/adaptdl_sched/policy/speedup.py:27-70 — the memo
+design here differs: a dict-of-computed-points with vectorized fill).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SpeedupFunction:
+    def __init__(
+        self,
+        goodput_fn,
+        max_batch_size: int | None = None,
+        atomic_bsz_range: tuple[int, int] | None = None,
+        accumulation: bool = False,
+    ):
+        self._goodput_fn = goodput_fn
+        self._max_batch_size = max_batch_size
+        self._atomic_bsz_range = atomic_bsz_range
+        self._accumulation = accumulation
+        # Base goodput: one replica on one slice.
+        self._base_goodput, _, _ = goodput_fn.optimize(
+            1,
+            1,
+            max_batch_size=max_batch_size,
+            atomic_bsz_range=atomic_bsz_range,
+            accumulation=accumulation,
+        )
+        self._cache: dict[tuple[int, int], float] = {(0, 0): 0.0}
+
+    def __call__(self, num_nodes, num_replicas):
+        scalar = np.isscalar(num_nodes) and np.isscalar(num_replicas)
+        nodes = np.atleast_1d(np.asarray(num_nodes, dtype=int))
+        replicas = np.atleast_1d(np.asarray(num_replicas, dtype=int))
+        nodes, replicas = np.broadcast_arrays(nodes, replicas)
+        shape = nodes.shape
+        nodes = nodes.ravel()
+        replicas = replicas.ravel()
+        out = np.zeros(nodes.shape, dtype=float)
+        # Identify points not yet cached and evaluate them in one
+        # vectorized optimize() call.
+        keys = list(zip(nodes.tolist(), replicas.tolist()))
+        missing = sorted(
+            {k for k in keys if k not in self._cache and k[1] > 0}
+        )
+        if missing:
+            m_nodes = np.array([k[0] for k in missing])
+            m_replicas = np.array([k[1] for k in missing])
+            goodput, _, _ = self._goodput_fn.optimize(
+                np.maximum(m_nodes, 1),
+                m_replicas,
+                max_batch_size=self._max_batch_size,
+                atomic_bsz_range=self._atomic_bsz_range,
+                accumulation=self._accumulation,
+            )
+            for key, g in zip(missing, np.atleast_1d(goodput)):
+                self._cache[key] = float(g) / self._base_goodput
+        for i, key in enumerate(keys):
+            out[i] = self._cache.get(key, 0.0)
+        out = out.reshape(shape)
+        return float(out.reshape(-1)[0]) if scalar else out

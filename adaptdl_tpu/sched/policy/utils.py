@@ -1,0 +1,29 @@
+"""Scheduler-facing job/node descriptors (reference:
+sched/adaptdl_sched/policy/utils.py:16-47). On TPU a "node" is a slice:
+the unit of fast ICI connectivity."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+
+@dataclass
+class JobInfo:
+    resources: dict[str, int]  # per-replica requests (e.g. {"tpu": 1})
+    speedup_fn: Callable  # speedup(num_nodes, num_replicas) -> float
+    creation_timestamp: float = 0.0
+    min_replicas: int = 0
+    max_replicas: int = 1
+    preemptible: bool = True
+
+    def __post_init__(self):
+        assert self.max_replicas > 0
+        assert self.min_replicas <= self.max_replicas
+
+
+@dataclass
+class NodeInfo:
+    resources: dict[str, int]  # total allocatable (e.g. {"tpu": 8})
+    preemptible: bool = False  # spot/preemptible slice
+    extra: dict = field(default_factory=dict)

@@ -1,0 +1,137 @@
+"""Pollux policy tests with synthetic fitted constants (reference:
+sched/adaptdl_sched/policy/pollux_test.py:27-60,
+non_preemptible_test.py, speedup_test.py)."""
+
+import numpy as np
+import pytest
+
+from adaptdl_tpu.goodput import GoodputFunction, GradParams, PerfParams
+from adaptdl_tpu.sched.policy import (
+    JobInfo,
+    NodeInfo,
+    PolluxPolicy,
+    SpeedupFunction,
+)
+
+# Regression-anchor constants (same ballpark as the reference's tests).
+PERF = PerfParams(0.121, 0.00568, 0.0236, 0.00634, 0.0118, 0.00317, 1.14)
+GRAD = GradParams(sqr=0.00136, var=0.000502)
+
+
+def _speedup_fn():
+    return SpeedupFunction(
+        GoodputFunction(PERF, GRAD, 128),
+        max_batch_size=1280,
+        atomic_bsz_range=(64, 256),
+        accumulation=True,
+    )
+
+
+def _job(ts=0.0, min_replicas=0, max_replicas=8, preemptible=True):
+    return JobInfo(
+        resources={"tpu": 1},
+        speedup_fn=_speedup_fn(),
+        creation_timestamp=ts,
+        min_replicas=min_replicas,
+        max_replicas=max_replicas,
+        preemptible=preemptible,
+    )
+
+
+def _nodes(n=2, chips=4):
+    return {
+        f"slice-{i}": NodeInfo(resources={"tpu": chips}) for i in range(n)
+    }
+
+
+@pytest.fixture
+def policy():
+    return PolluxPolicy(pop_size=24, generations=20)
+
+
+def test_speedup_function_monotone_and_cached():
+    fn = _speedup_fn()
+    assert fn(1, 1) == pytest.approx(1.0)
+    assert fn(0, 0) == 0.0
+    values = fn(np.array([1, 1, 1, 2]), np.array([1, 2, 4, 8]))
+    assert np.all(np.diff(values) > 0)
+    # Cached second call returns identical values.
+    again = fn(np.array([1, 1, 1, 2]), np.array([1, 2, 4, 8]))
+    assert np.array_equal(values, again)
+
+
+def test_allocate_job_first_fit(policy):
+    nodes = _nodes(2, chips=4)
+    alloc = policy.allocate_job(_job(min_replicas=2), nodes)
+    assert len(alloc) == 2
+    assert len(set(alloc)) == 1  # one slice
+    too_big = policy.allocate_job(
+        _job(min_replicas=9, max_replicas=16), nodes
+    )
+    assert too_big == []
+
+
+def test_optimize_allocates_all_jobs(policy):
+    jobs = {f"job-{i}": _job(ts=i) for i in range(3)}
+    nodes = _nodes(2, chips=4)
+    allocations, desired = policy.optimize(
+        jobs, nodes, {}, NodeInfo(resources={"tpu": 4})
+    )
+    total = {k: len(v) for k, v in allocations.items()}
+    # Every job gets something; capacity is respected.
+    assert all(total[k] >= 1 for k in jobs), total
+    per_node = {}
+    for k, alloc in allocations.items():
+        for node in alloc:
+            per_node[node] = per_node.get(node, 0) + 1
+    assert all(v <= 4 for v in per_node.values()), per_node
+    assert desired >= 1
+
+
+def test_optimize_respects_max_replicas(policy):
+    jobs = {"only": _job(max_replicas=2)}
+    nodes = _nodes(2, chips=4)
+    allocations, _ = policy.optimize(
+        jobs, nodes, {}, NodeInfo(resources={"tpu": 4})
+    )
+    assert len(allocations["only"]) <= 2
+
+
+def test_distributed_job_owns_its_slice(policy):
+    """Two jobs may not both run distributed on one slice (ICI)."""
+    jobs = {f"job-{i}": _job(ts=i, min_replicas=2) for i in range(2)}
+    nodes = _nodes(2, chips=8)
+    allocations, _ = policy.optimize(
+        jobs, nodes, {}, NodeInfo(resources={"tpu": 8})
+    )
+    spanning = {}
+    for key, alloc in allocations.items():
+        if len(alloc) > 1:
+            for node in set(alloc):
+                spanning.setdefault(node, set()).add(key)
+    for node, claimants in spanning.items():
+        assert len(claimants) == 1, (node, claimants)
+
+
+def test_non_preemptible_job_pinned(policy):
+    jobs = {
+        "pinned": _job(preemptible=False),
+        "other": _job(ts=1.0),
+    }
+    nodes = _nodes(2, chips=4)
+    base = {"pinned": ["slice-0", "slice-0"]}
+    allocations, _ = policy.optimize(
+        jobs, nodes, base, NodeInfo(resources={"tpu": 4})
+    )
+    assert allocations["pinned"] == ["slice-0", "slice-0"]
+
+
+def test_warm_start_across_cycles(policy):
+    jobs = {f"job-{i}": _job(ts=i) for i in range(2)}
+    nodes = _nodes(2, chips=4)
+    template = NodeInfo(resources={"tpu": 4})
+    a1, _ = policy.optimize(jobs, nodes, {}, template)
+    # Second cycle with one new job and one departed.
+    jobs2 = {"job-1": jobs["job-1"], "job-2": _job(ts=2)}
+    a2, _ = policy.optimize(jobs2, nodes, a1, template)
+    assert set(a2) == {"job-1", "job-2"}
