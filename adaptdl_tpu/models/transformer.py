@@ -37,9 +37,14 @@ class TransformerConfig:
     dropout_rate: float = 0.0
     dtype: Any = jnp.bfloat16
     remat: bool = True
-    # attention_fn(q, k, v, axis_name=None) -> out; q/k/v are
-    # [batch, heads, seq, head_dim]; None selects causal attention.
+    # attention_fn(q, k, v) -> out; q/k/v are [batch, heads, seq,
+    # head_dim]; None selects plain causal attention (or ring
+    # attention when seq_axis is set).
     attention_fn: Callable | None = None
+    # Mesh axis the sequence dim is sharded over (sequence
+    # parallelism): positions become global and attention defaults to
+    # ring attention over this axis.
+    seq_axis: str | None = None
 
 
 def rope(x: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
@@ -96,7 +101,16 @@ class Attention(nn.Module):
         v = jnp.swapaxes(v, 1, 2)
         q = rope(q, positions)
         k = rope(k, positions)
-        attn = cfg.attention_fn or causal_attention
+        attn = cfg.attention_fn
+        if attn is None:
+            if cfg.seq_axis is not None:
+                from adaptdl_tpu.parallel.ring_attention import (
+                    make_ring_attention,
+                )
+
+                attn = make_ring_attention(cfg.seq_axis)
+            else:
+                attn = causal_attention
         out = attn(q, k, v)  # [b, h, s, d]
         out = jnp.swapaxes(out, 1, 2).reshape(
             x.shape[:-1] + (cfg.d_model,)
@@ -143,7 +157,15 @@ class TransformerLM(nn.Module):
             name="embed",
         )
         x = embed(tokens)
-        positions = jnp.arange(tokens.shape[1])
+        if cfg.seq_axis is not None:
+            # Sequence-sharded: this device holds one contiguous block
+            # of the global sequence; positions must be global for RoPE
+            # and the ring-attention causal mask to line up.
+            positions = jax.lax.axis_index(
+                cfg.seq_axis
+            ) * tokens.shape[1] + jnp.arange(tokens.shape[1])
+        else:
+            positions = jnp.arange(tokens.shape[1])
         block_cls = Block
         if cfg.remat:
             block_cls = nn.remat(Block, static_argnums=())
@@ -163,11 +185,18 @@ class TransformerLM(nn.Module):
 
 
 def init_transformer(config: TransformerConfig, rng=None, seq_len=None):
+    import dataclasses
+
     model = TransformerLM(config)
+    # Parameter shapes don't depend on the parallelism config, and the
+    # mapped seq axis doesn't exist outside shard_map — init unsharded.
+    init_model = TransformerLM(
+        dataclasses.replace(config, seq_axis=None, attention_fn=None)
+    )
     rng = rng if rng is not None else jax.random.key(0)
     seq_len = seq_len or min(config.max_seq_len, 128)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
-    params = model.init(rng, dummy, train=False)["params"]
+    params = init_model.init(rng, dummy, train=False)["params"]
     return model, params
 
 

@@ -40,7 +40,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from adaptdl_tpu import checkpoint, gns
-from adaptdl_tpu.parallel.mesh import DATA_AXIS, create_mesh
+from adaptdl_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS, create_mesh
 from adaptdl_tpu.scaling_rules import RuleContext, ScalingRule
 
 try:  # jax >= 0.6 exposes shard_map at top level
@@ -121,7 +121,22 @@ class ElasticTrainer:
 
     @property
     def num_replicas(self) -> int:
+        """Data-parallel replicas. A sequence-sharded group of devices
+        counts as ONE replica: its members hold pieces of the same
+        logical batch element, so GNS sample counting and batch-size
+        math key on the data axis alone."""
         return self.mesh.shape[DATA_AXIS]
+
+    @property
+    def seq_shards(self) -> int:
+        return self.mesh.shape.get(SEQ_AXIS, 1)
+
+    def _batch_spec(self, leaf) -> P:
+        """Data axis on dim 0; with sequence parallelism, seq-sharded
+        leaves (ndim >= 2, seq at dim 1 by contract) also split dim 1."""
+        if self.seq_shards > 1 and getattr(leaf, "ndim", 0) >= 2:
+            return P(DATA_AXIS, SEQ_AXIS)
+        return P(DATA_AXIS)
 
     def init_state(self) -> TrainState:
         """Fresh TrainState, replicated over the mesh."""
@@ -175,6 +190,7 @@ class ElasticTrainer:
 
     def _build_step(self, atomic_bsz: int, accum_steps: int):
         num_replicas = self.num_replicas
+        seq_shards = self.seq_shards
         num_micro = accum_steps + 1
         count = num_replicas * num_micro
         accum_scale = num_replicas * atomic_bsz / self.init_batch_size
@@ -189,18 +205,25 @@ class ElasticTrainer:
             # noise signal the GNS needs. Varying params keep gradients
             # local; the cross-replica mean is taken explicitly below.
             params = state.params
-            params_v = jax.lax.pcast(params, DATA_AXIS, to="varying")
+            varying_axes = (
+                (DATA_AXIS, SEQ_AXIS) if seq_shards > 1 else DATA_AXIS
+            )
+            params_v = jax.lax.pcast(params, varying_axes, to="varying")
             precond = self._precond(state.opt_state)
             precond_v = (
                 None
                 if precond is None
-                else jax.lax.pcast(precond, DATA_AXIS, to="varying")
+                else jax.lax.pcast(precond, varying_axes, to="varying")
             )
             # Per-replica, per-step rng; microbatch rngs split below.
             rng = jax.random.fold_in(state.rng, state.step)
             rng = jax.random.fold_in(
                 rng, jax.lax.axis_index(DATA_AXIS)
             )
+            if seq_shards > 1:
+                rng = jax.random.fold_in(
+                    rng, jax.lax.axis_index(SEQ_AXIS)
+                )
 
             micro_batches = jax.tree.map(
                 lambda x: x.reshape(
@@ -216,6 +239,13 @@ class ElasticTrainer:
                 loss, grad = jax.value_and_grad(self.loss_fn)(
                     params_v, mb, mb_rng
                 )
+                if seq_shards > 1:
+                    # A sequence-sharded group is one logical replica:
+                    # average its shard-gradients *before* the GNS
+                    # squared norm so the noise statistics see whole-
+                    # sample gradients.
+                    grad = jax.lax.pmean(grad, SEQ_AXIS)
+                    loss = jax.lax.pmean(loss, SEQ_AXIS)
                 grad_sum = jax.tree.map(jnp.add, grad_sum, grad)
                 lsqr_sum = lsqr_sum + gns.normsqr(grad, precond_v)
                 return (grad_sum, lsqr_sum, loss_sum + loss), None
@@ -225,6 +255,8 @@ class ElasticTrainer:
             )
             # The carry accumulates per-replica values, so mark it as
             # varying over the data axis for shard_map's vma tracking.
+            # (With sequence parallelism the carry stays seq-UNvarying:
+            # grad/loss are pmean'ed over the seq axis inside the body.)
             init = jax.lax.pcast(
                 (zeros, jnp.zeros(()), jnp.zeros(())),
                 DATA_AXIS,
@@ -290,18 +322,38 @@ class ElasticTrainer:
             }
             return new_state, metrics
 
+        batch_spec = (
+            P(DATA_AXIS, SEQ_AXIS) if seq_shards > 1 else P(DATA_AXIS)
+        )
         sharded = shard_map(
             per_replica_step,
             mesh=self.mesh,
-            in_specs=(P(), P(DATA_AXIS)),
+            in_specs=(P(), batch_spec),
             out_specs=(P(), P()),
         )
         return jax.jit(sharded, donate_argnums=0)
 
     def shard_batch(self, batch: Any) -> Any:
-        """Host batch -> jax arrays sharded along the data axis."""
-        sharding = NamedSharding(self.mesh, P(DATA_AXIS))
-        return jax.device_put(batch, sharding)
+        """Host batch -> jax arrays sharded along the data axis (and
+        the seq axis on dim 1 under sequence parallelism)."""
+        if self.seq_shards > 1:
+            bad = [
+                x
+                for x in jax.tree.leaves(batch)
+                if getattr(x, "ndim", 0) < 2
+            ]
+            if bad:
+                raise ValueError(
+                    "sequence parallelism requires every batch leaf to "
+                    "be at least 2-D ([batch, seq, ...]); got a leaf "
+                    f"with shape {getattr(bad[0], 'shape', None)}"
+                )
+        return jax.tree.map(
+            lambda x: jax.device_put(
+                x, NamedSharding(self.mesh, self._batch_spec(x))
+            ),
+            batch,
+        )
 
     # ---- profiling integration --------------------------------------
 
@@ -311,19 +363,29 @@ class ElasticTrainer:
         time in the perf model (hook timing being impossible under XLA
         fusion; see adaptdl_tpu.metrics)."""
 
+        seq_shards = self.seq_shards
+        varying_axes = (
+            (DATA_AXIS, SEQ_AXIS) if seq_shards > 1 else DATA_AXIS
+        )
+
         def per_replica(params, local_batch, rng):
-            params_v = jax.lax.pcast(params, DATA_AXIS, to="varying")
+            params_v = jax.lax.pcast(params, varying_axes, to="varying")
             rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
             loss, grads = jax.value_and_grad(self.loss_fn)(
                 params_v, local_batch, rng
             )
             total = gns.normsqr(grads) + loss
+            if seq_shards > 1:
+                total = jax.lax.pmean(total, SEQ_AXIS)
             return total[None]
 
+        batch_spec = (
+            P(DATA_AXIS, SEQ_AXIS) if seq_shards > 1 else P(DATA_AXIS)
+        )
         sharded = shard_map(
             per_replica,
             mesh=self.mesh,
-            in_specs=(P(), P(DATA_AXIS), P()),
+            in_specs=(P(), batch_spec, P()),
             out_specs=P(DATA_AXIS),
         )
         return jax.jit(sharded)
