@@ -43,7 +43,12 @@ from adaptdl_tpu.goodput import (
 
 LOG = logging.getLogger(__name__)
 
-DEFAULT_FIT_INTERVAL = 30.0
+def _default_fit_interval() -> float:
+    """Seconds between perf refits/hint posts (reference cadence 30s,
+    _metrics.py:60-66); ADAPTDL_FIT_INTERVAL overrides (tests, demos)."""
+    import os
+
+    return float(os.environ.get("ADAPTDL_FIT_INTERVAL", "30"))
 
 
 @dataclass
@@ -162,9 +167,10 @@ def _fit() -> PerfParams | None:
 
 
 def _maybe_fit_and_report(
-    now: float | None = None, interval: float = DEFAULT_FIT_INTERVAL
+    now: float | None = None, interval: float | None = None
 ) -> None:
     global _last_fit_time
+    interval = _default_fit_interval() if interval is None else interval
     now = time.monotonic() if now is None else now
     if _last_fit_time is not None and now - _last_fit_time < interval:
         return

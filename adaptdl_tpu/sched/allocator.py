@@ -1,0 +1,139 @@
+"""Allocator: turns posted hints into Pollux allocations.
+
+Builds a :class:`JobInfo` per job from its sched hints — notably
+``max_replicas = min(2 x maxProfiledReplicas, spec max)`` so a job can
+only scale ~2x past what it has profiled, keeping the speedup model's
+extrapolation honest (reference: sched/adaptdl_sched/allocator.py:
+181-221) — then runs :class:`PolluxPolicy` over the available slices
+and writes ``allocation`` back into the shared state for whatever
+worker-lifecycle backend (local runner, k8s operator) is attached.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from adaptdl_tpu.goodput import GoodputFunction, GradParams, PerfParams
+from adaptdl_tpu.sched.policy import (
+    JobInfo,
+    NodeInfo,
+    PolluxPolicy,
+    SpeedupFunction,
+)
+from adaptdl_tpu.sched.state import ClusterState
+
+LOG = logging.getLogger(__name__)
+
+FINISHED = ("Succeeded", "Failed")
+
+
+def job_info_from_hints(
+    hints: dict | None, spec: dict, creation_timestamp: float
+) -> JobInfo:
+    """JobInfo for the policy; falls back to single-replica until the
+    job has posted a usable performance model."""
+    resources = dict(spec.get("resources") or {"tpu": 1})
+    spec_max = int(spec.get("max_replicas", 1))
+    min_replicas = int(spec.get("min_replicas", 0))
+    preemptible = bool(spec.get("preemptible", True))
+    speedup_fn = None
+    max_replicas = max(min_replicas, 1)
+    if hints and hints.get("perfParams") and hints.get("gradParams"):
+        perf = PerfParams(**hints["perfParams"])
+        grad = GradParams(**hints["gradParams"])
+        goodput_fn = GoodputFunction(
+            perf, grad, hints["initBatchSize"]
+        )
+        bounds = hints.get("localBszBounds")
+        speedup_fn = SpeedupFunction(
+            goodput_fn,
+            max_batch_size=hints.get("maxBatchSize"),
+            atomic_bsz_range=tuple(bounds) if bounds else None,
+            accumulation=bool(hints.get("gradientAccumulation")),
+        )
+        profiled = int(hints.get("maxProfiledReplicas") or 1)
+        # Profiling gates scale-up: at most double what was measured.
+        max_replicas = min(max(2 * profiled, 1), spec_max)
+    if speedup_fn is None:
+        # Linear-optimism placeholder for brand-new jobs: enough to get
+        # one replica scheduled so profiling can begin.
+        speedup_fn = lambda n, r: r  # noqa: E731
+        max_replicas = max(min_replicas, 1)
+    return JobInfo(
+        resources=resources,
+        speedup_fn=speedup_fn,
+        creation_timestamp=creation_timestamp,
+        min_replicas=min_replicas,
+        max_replicas=max(max_replicas, max(min_replicas, 1)),
+        preemptible=preemptible,
+    )
+
+
+class Allocator:
+    """Periodic Pollux optimization over the shared cluster state."""
+
+    def __init__(
+        self,
+        state: ClusterState,
+        nodes: dict[str, NodeInfo],
+        node_template: NodeInfo | None = None,
+        policy: PolluxPolicy | None = None,
+        interval: float = 60.0,
+    ):
+        self._state = state
+        self._nodes = nodes
+        self._template = node_template or next(iter(nodes.values()))
+        self._policy = policy or PolluxPolicy()
+        self._interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def optimize_once(self) -> dict[str, list[str]]:
+        jobs = {}
+        base = {}
+        for key, record in self._state.jobs().items():
+            if record.status in FINISHED:
+                continue
+            jobs[key] = job_info_from_hints(
+                record.hints, record.spec, record.creation_timestamp
+            )
+            base[key] = list(record.allocation)
+        if not jobs:
+            return {}
+        allocations, desired = self._policy.optimize(
+            jobs, self._nodes, base, self._template
+        )
+        for key, alloc in allocations.items():
+            record = self._state.get_job(key)
+            if record is not None and record.allocation != alloc:
+                LOG.info("allocation %s: %s -> %s", key,
+                         record.allocation, alloc)
+                self._state.update(key, allocation=alloc)
+        return allocations
+
+    def start(self) -> None:
+        # First cycle runs synchronously so a newly created job has an
+        # allocation the moment start() returns.
+        try:
+            self.optimize_once()
+        except Exception:  # noqa: BLE001
+            LOG.exception("initial allocator cycle failed")
+
+        def loop():
+            while not self._stop.wait(self._interval):
+                try:
+                    self.optimize_once()
+                except Exception:  # noqa: BLE001
+                    LOG.exception("allocator cycle failed")
+
+        self._thread = threading.Thread(
+            target=loop, name="adaptdl-allocator", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

@@ -1,0 +1,226 @@
+"""Local elastic runner: the one-machine job controller.
+
+Runs a user training script elastically on the local machine's chips,
+playing the part the reference splits between the k8s controller and
+the Ray/AWS single-job controller (reference:
+sched/adaptdl_sched/controller.py lifecycle +
+ray/adaptdl_ray/aws/controller.py single-job form):
+
+- hosts the supervisor (hints + rendezvous REST) and the Pollux
+  allocator over one "local" slice whose capacity is the chip count,
+- launches the script as a subprocess with the full ``ADAPTDL_*``
+  environment of its current allocation,
+- watches for allocation changes; on change delivers SIGTERM so the
+  job checkpoints and exits 143 (treated as a graceful rescale, never
+  a failure — reference: controller.py:276-283), then relaunches with
+  ``ADAPTDL_NUM_RESTARTS + 1``,
+- distinguishes real failures (nonzero, non-143) with a retry budget.
+
+This is also the mechanism for verifying the whole elastic loop on a
+dev box: job posts hints -> allocator re-optimizes -> SIGTERM ->
+checkpoint-restart at the new replica count.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import portpicker
+
+from adaptdl_tpu._signal import GRACEFUL_EXIT_CODE
+from adaptdl_tpu.sched.allocator import Allocator
+from adaptdl_tpu.sched.policy import NodeInfo, PolluxPolicy
+from adaptdl_tpu.sched.state import ClusterState
+from adaptdl_tpu.sched.supervisor import Supervisor
+
+LOG = logging.getLogger(__name__)
+
+
+class LocalElasticRunner:
+    def __init__(
+        self,
+        script: str,
+        num_chips: int,
+        checkpoint_dir: str,
+        job_name: str = "default/local",
+        min_replicas: int = 0,
+        max_replicas: int | None = None,
+        allocator_interval: float = 5.0,
+        max_failures: int = 2,
+        extra_env: dict | None = None,
+        pop_size: int = 24,
+        generations: int = 20,
+        term_grace_period: float = 120.0,
+    ):
+        self.term_grace_period = term_grace_period
+        self.script = script
+        self.num_chips = num_chips
+        self.checkpoint_dir = checkpoint_dir
+        self.job_name = job_name
+        self.max_replicas = max_replicas or num_chips
+        self.min_replicas = min_replicas
+        self.max_failures = max_failures
+        self.extra_env = dict(extra_env or {})
+        self.restarts = 0
+        self.state = ClusterState()
+        self.state.create_job(
+            job_name,
+            spec={
+                "resources": {"tpu": 1},
+                "min_replicas": min_replicas,
+                "max_replicas": self.max_replicas,
+                "preemptible": True,
+            },
+        )
+        self.supervisor = Supervisor(self.state)
+        nodes = {"local": NodeInfo(resources={"tpu": num_chips})}
+        self.allocator = Allocator(
+            self.state,
+            nodes,
+            policy=PolluxPolicy(pop_size=pop_size, generations=generations),
+            interval=allocator_interval,
+        )
+
+    def _job_env(self, num_replicas: int) -> dict:
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env.update(
+            {
+                "ADAPTDL_JOB_ID": self.job_name,
+                "ADAPTDL_CHECKPOINT_PATH": self.checkpoint_dir,
+                "ADAPTDL_MASTER_ADDR": "127.0.0.1",
+                "ADAPTDL_MASTER_PORT": str(portpicker.pick_unused_port()),
+                "ADAPTDL_REPLICA_RANK": "0",
+                "ADAPTDL_NUM_REPLICAS": str(num_replicas),
+                "ADAPTDL_NUM_PROCESSES": "1",
+                "ADAPTDL_NUM_NODES": "1",
+                "ADAPTDL_NUM_RESTARTS": str(self.restarts),
+                "ADAPTDL_SUPERVISOR_URL": self.supervisor.url,
+            }
+        )
+        return env
+
+    def run(self) -> int:
+        """Run the job to completion; returns the final exit code."""
+        self.supervisor.start()
+        self.allocator.start()
+        failures = 0
+        try:
+            # Fallback if the allocator's first cycle yielded nothing.
+            if not self.state.get_allocation(self.job_name):
+                initial = max(self.min_replicas, 1)
+                self.state.update(
+                    self.job_name, allocation=["local"] * initial
+                )
+            while True:
+                allocation = list(
+                    self.state.get_allocation(self.job_name)
+                )
+                num_replicas = max(len(allocation), 1)
+                LOG.info(
+                    "starting %s: replicas=%d restarts=%d",
+                    self.job_name,
+                    num_replicas,
+                    self.restarts,
+                )
+                self.state.update(self.job_name, status="Running")
+                proc = subprocess.Popen(
+                    [sys.executable, self.script],
+                    env=self._job_env(num_replicas),
+                )
+                code, signalled = self._supervise(proc, allocation)
+                if code == 0:
+                    self.state.update(self.job_name, status="Succeeded")
+                    return 0
+                if code == GRACEFUL_EXIT_CODE or (
+                    # Our own SIGTERM landed before the job installed
+                    # its handler (e.g. still importing jax): that is a
+                    # rescale, not a failure.
+                    signalled
+                    and code == -signal.SIGTERM
+                ):
+                    self.restarts += 1
+                    continue
+                failures += 1
+                LOG.warning(
+                    "%s failed with code %s (%d/%d)",
+                    self.job_name,
+                    code,
+                    failures,
+                    self.max_failures,
+                )
+                if failures > self.max_failures:
+                    self.state.update(self.job_name, status="Failed")
+                    return code
+                self.restarts += 1
+        finally:
+            self.allocator.stop()
+            self.supervisor.stop()
+
+    def _supervise(self, proc: subprocess.Popen, allocation):
+        """Wait for the process; SIGTERM it if the allocation moves,
+        escalating to SIGKILL if the grace period expires. Returns
+        (exit_code, we_signalled_it)."""
+        signalled = False
+        term_deadline = None
+        while True:
+            code = proc.poll()
+            if code is not None:
+                return code, signalled
+            current = self.state.get_allocation(self.job_name) or []
+            if not signalled and list(current) != list(allocation):
+                LOG.info(
+                    "allocation drift %s -> %s: requesting graceful "
+                    "rescale",
+                    allocation,
+                    current,
+                )
+                proc.send_signal(signal.SIGTERM)
+                signalled = True
+                term_deadline = time.monotonic() + self.term_grace_period
+            if (
+                term_deadline is not None
+                and time.monotonic() > term_deadline
+            ):
+                LOG.warning(
+                    "grace period expired; killing %s", self.job_name
+                )
+                proc.kill()
+                term_deadline = None
+            time.sleep(0.2)
+
+
+def main() -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="Run a training script elastically on this machine."
+    )
+    parser.add_argument("script")
+    parser.add_argument("--chips", type=int, default=None)
+    parser.add_argument("--checkpoint-dir", required=True)
+    parser.add_argument("--min-replicas", type=int, default=0)
+    parser.add_argument("--max-replicas", type=int, default=None)
+    args = parser.parse_args()
+    chips = args.chips
+    if chips is None:
+        import jax
+
+        chips = len(jax.devices())
+    runner = LocalElasticRunner(
+        args.script,
+        num_chips=chips,
+        checkpoint_dir=args.checkpoint_dir,
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
+    )
+    return runner.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

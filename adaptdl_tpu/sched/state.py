@@ -1,0 +1,105 @@
+"""Shared cluster state: the contract between scheduler components.
+
+The reference's controller, allocator, and supervisor communicate
+exclusively through the AdaptDLJob CRD's status fields so each is
+independently restartable (reference: SURVEY.md section 1 "Scheduler
+internal", sched/adaptdl_sched/allocator.py:103-106 /
+controller.py:112-131). This module is that contract lifted out of
+Kubernetes: a small threadsafe job table with waiters, which the
+in-process/local backend uses directly and a k8s backend mirrors into
+CRD status.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class JobRecord:
+    key: str  # "namespace/name"
+    spec: dict = field(default_factory=dict)  # min/max replicas, etc.
+    hints: dict | None = None  # posted SCHED_HINTS
+    allocation: list[str] = field(default_factory=list)
+    status: str = "Pending"  # Pending|Starting|Running|Stopping|Succeeded|Failed
+    # rank -> address ("host:port"), registered by running workers.
+    workers: dict[int, str] = field(default_factory=dict)
+    group: int = 0  # restart group; workers of older groups are stale
+    creation_timestamp: float = field(default_factory=time.time)
+
+
+class ClusterState:
+    """Threadsafe job table with change notification."""
+
+    def __init__(self):
+        self._jobs: dict[str, JobRecord] = {}
+        self._cond = threading.Condition()
+
+    def create_job(self, key: str, spec: dict | None = None) -> JobRecord:
+        with self._cond:
+            if key in self._jobs:
+                raise ValueError(f"job exists: {key}")
+            record = JobRecord(key=key, spec=dict(spec or {}))
+            self._jobs[key] = record
+            self._cond.notify_all()
+            return record
+
+    def get_job(self, key: str) -> JobRecord | None:
+        with self._cond:
+            return self._jobs.get(key)
+
+    def get_workers(self, key: str) -> dict[int, str] | None:
+        """Snapshot of a job's registered workers (readers must not
+        iterate the live dict — registration mutates it concurrently)."""
+        with self._cond:
+            record = self._jobs.get(key)
+            return None if record is None else dict(record.workers)
+
+    def get_allocation(self, key: str) -> list[str] | None:
+        with self._cond:
+            record = self._jobs.get(key)
+            return None if record is None else list(record.allocation)
+
+    def jobs(self) -> dict[str, JobRecord]:
+        with self._cond:
+            return dict(self._jobs)
+
+    def remove_job(self, key: str) -> None:
+        with self._cond:
+            self._jobs.pop(key, None)
+            self._cond.notify_all()
+
+    def update(self, key: str, **fields: Any) -> None:
+        with self._cond:
+            record = self._jobs[key]
+            for name, value in fields.items():
+                setattr(record, name, value)
+            self._cond.notify_all()
+
+    def register_worker(
+        self, key: str, group: int, rank: int, address: str
+    ) -> None:
+        with self._cond:
+            record = self._jobs[key]
+            if group > record.group:
+                record.group = group
+                record.workers = {}
+            if group == record.group:
+                record.workers[rank] = address
+            self._cond.notify_all()
+
+    def wait_for(self, predicate, timeout: float | None = None) -> bool:
+        """Block until ``predicate(jobs_dict)`` is true (or timeout)."""
+        deadline = None if timeout is None else time.time() + timeout
+        with self._cond:
+            while not predicate(self._jobs):
+                remaining = (
+                    None if deadline is None else deadline - time.time()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True

@@ -1,0 +1,162 @@
+"""Supervisor: the cluster's REST face toward running jobs.
+
+Endpoints (URL shapes match the reference so trainer-side code is
+backend-agnostic; reference: sched/adaptdl_sched/supervisor.py:45-80):
+
+- ``GET /discover/{namespace}/{name}/{group}?replicas=N`` — long-polls
+  until all N workers of restart-group ``group`` have registered,
+  then returns their addresses by rank (rank-0 rendezvous).
+- ``PUT /register/{namespace}/{name}/{group}/{rank}`` — worker
+  self-registration (the k8s backend gets this from pod IPs instead).
+- ``PUT /hints/{namespace}/{name}`` — validated sched-hints intake.
+- ``GET /hints/{namespace}/{name}``, ``GET /healthz``.
+
+Runs its own thread + aiohttp event loop so trainers and the local
+runner can use it without an async main.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+
+from aiohttp import web
+
+from adaptdl_tpu import sched_hints
+from adaptdl_tpu.sched.state import ClusterState
+
+LOG = logging.getLogger(__name__)
+
+_POLL_INTERVAL = 0.25
+_DISCOVER_TIMEOUT = 300.0
+
+
+class Supervisor:
+    def __init__(self, state: ClusterState, host="127.0.0.1", port=0):
+        self._state = state
+        self._host = host
+        self._port = port
+        self._thread: threading.Thread | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._started = threading.Event()
+
+    # -- handlers -----------------------------------------------------
+
+    async def _discover(self, request: web.Request) -> web.Response:
+        key = "{namespace}/{name}".format(**request.match_info)
+        group = int(request.match_info["group"])
+        want = int(request.query.get("replicas", "0"))
+        deadline = (
+            asyncio.get_event_loop().time() + _DISCOVER_TIMEOUT
+        )
+        while True:
+            record = self._state.get_job(key)
+            if record is not None and record.group == group:
+                workers = self._state.get_workers(key) or {}
+                if (want and len(workers) >= want) or (
+                    not want and workers
+                ):
+                    return web.json_response(
+                        {str(rank): addr for rank, addr in workers.items()}
+                    )
+            if asyncio.get_event_loop().time() > deadline:
+                return web.json_response(
+                    {"error": "discover timeout"}, status=408
+                )
+            await asyncio.sleep(_POLL_INTERVAL)
+
+    async def _register(self, request: web.Request) -> web.Response:
+        key = "{namespace}/{name}".format(**request.match_info)
+        group = int(request.match_info["group"])
+        rank = int(request.match_info["rank"])
+        body = await request.json()
+        if self._state.get_job(key) is None:
+            return web.json_response({"error": "no such job"}, status=404)
+        self._state.register_worker(key, group, rank, body["address"])
+        return web.json_response({"ok": True})
+
+    async def _put_hints(self, request: web.Request) -> web.Response:
+        key = "{namespace}/{name}".format(**request.match_info)
+        hints = await request.json()
+        try:
+            sched_hints.validate_hints(hints)
+        except ValueError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        if self._state.get_job(key) is None:
+            return web.json_response({"error": "no such job"}, status=404)
+        self._state.update(key, hints=hints)
+        return web.json_response({"ok": True})
+
+    async def _get_hints(self, request: web.Request) -> web.Response:
+        key = "{namespace}/{name}".format(**request.match_info)
+        record = self._state.get_job(key)
+        if record is None:
+            return web.json_response({"error": "no such job"}, status=404)
+        return web.json_response(record.hints or {})
+
+    async def _healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    # -- lifecycle ----------------------------------------------------
+
+    def _build_app(self) -> web.Application:
+        app = web.Application()
+        app.add_routes(
+            [
+                web.get(
+                    "/discover/{namespace}/{name}/{group}", self._discover
+                ),
+                web.put(
+                    "/register/{namespace}/{name}/{group}/{rank}",
+                    self._register,
+                ),
+                web.put("/hints/{namespace}/{name}", self._put_hints),
+                web.get("/hints/{namespace}/{name}", self._get_hints),
+                web.get("/healthz", self._healthz),
+            ]
+        )
+        return app
+
+    def start(self) -> str:
+        """Start in a background thread; returns the base URL."""
+
+        def run():
+            try:
+                self._loop = asyncio.new_event_loop()
+                asyncio.set_event_loop(self._loop)
+                runner = web.AppRunner(self._build_app())
+                self._loop.run_until_complete(runner.setup())
+                site = web.TCPSite(runner, self._host, self._port)
+                self._loop.run_until_complete(site.start())
+                self._port = site._server.sockets[0].getsockname()[1]
+            except BaseException as exc:  # noqa: BLE001
+                self._error = exc
+                self._started.set()
+                return
+            self._started.set()
+            self._loop.run_forever()
+            self._loop.run_until_complete(runner.cleanup())
+
+        self._error: BaseException | None = None
+        self._thread = threading.Thread(
+            target=run, name="adaptdl-supervisor", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError("supervisor failed to start within 30s")
+        if self._error is not None:
+            raise RuntimeError(
+                f"supervisor failed to start: {self._error!r}"
+            ) from self._error
+        return self.url
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self._port}"
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=10)

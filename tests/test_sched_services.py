@@ -1,0 +1,111 @@
+"""Supervisor + allocator service tests (reference coverage:
+sched/adaptdl_sched/validator_test.py-style handler tests and
+allocator behavior)."""
+
+import time
+
+import pytest
+import requests
+
+from adaptdl_tpu.sched.allocator import Allocator, job_info_from_hints
+from adaptdl_tpu.sched.policy import NodeInfo, PolluxPolicy
+from adaptdl_tpu.sched.state import ClusterState
+from adaptdl_tpu.sched.supervisor import Supervisor
+
+HINTS = {
+    "initBatchSize": 128,
+    "localBszBounds": [64, 256],
+    "maxBatchSize": 1280,
+    "maxProfiledReplicas": 2,
+    "gradientAccumulation": True,
+    "gradParams": {"sqr": 0.00136, "var": 0.000502},
+    "perfParams": {
+        "alpha_c": 0.121,
+        "beta_c": 0.00568,
+        "alpha_n": 0.0236,
+        "beta_n": 0.00634,
+        "alpha_r": 0.0118,
+        "beta_r": 0.00317,
+        "gamma": 1.14,
+    },
+}
+
+
+@pytest.fixture
+def cluster():
+    state = ClusterState()
+    state.create_job("test/job", spec={"max_replicas": 8})
+    supervisor = Supervisor(state)
+    url = supervisor.start()
+    yield state, url
+    supervisor.stop()
+
+
+def test_healthz(cluster):
+    _, url = cluster
+    assert requests.get(f"{url}/healthz", timeout=5).json() == {"ok": True}
+
+
+def test_hints_roundtrip_and_validation(cluster):
+    state, url = cluster
+    r = requests.put(f"{url}/hints/test/job", json=HINTS, timeout=5)
+    assert r.status_code == 200
+    assert state.get_job("test/job").hints == HINTS
+    assert requests.get(f"{url}/hints/test/job", timeout=5).json() == HINTS
+    bad = dict(HINTS, nonsense=1)
+    assert (
+        requests.put(f"{url}/hints/test/job", json=bad, timeout=5)
+        .status_code
+        == 400
+    )
+    assert (
+        requests.put(f"{url}/hints/test/nope", json=HINTS, timeout=5)
+        .status_code
+        == 404
+    )
+
+
+def test_register_and_discover(cluster):
+    state, url = cluster
+    r = requests.put(
+        f"{url}/register/test/job/0/0",
+        json={"address": "10.0.0.1:1234"},
+        timeout=5,
+    )
+    assert r.status_code == 200
+    got = requests.get(
+        f"{url}/discover/test/job/0?replicas=1", timeout=10
+    ).json()
+    assert got == {"0": "10.0.0.1:1234"}
+    # A newer restart group supersedes stale workers.
+    requests.put(
+        f"{url}/register/test/job/1/0",
+        json={"address": "10.0.0.2:1234"},
+        timeout=5,
+    )
+    assert state.get_job("test/job").workers == {0: "10.0.0.2:1234"}
+
+
+def test_job_info_from_hints_gates_scaleup():
+    info = job_info_from_hints(HINTS, {"max_replicas": 64}, 0.0)
+    assert info.max_replicas == 4  # 2 x maxProfiledReplicas
+    assert info.speedup_fn(1, 2) > 1.0
+    fresh = job_info_from_hints(None, {"max_replicas": 64}, 0.0)
+    assert fresh.max_replicas == 1
+
+
+def test_allocator_assigns_and_grows():
+    state = ClusterState()
+    state.create_job("ns/a", spec={"max_replicas": 8})
+    nodes = {"slice-0": NodeInfo(resources={"tpu": 8})}
+    allocator = Allocator(
+        state,
+        nodes,
+        policy=PolluxPolicy(pop_size=16, generations=10),
+    )
+    first = allocator.optimize_once()
+    assert len(first["ns/a"]) == 1  # unprofiled: one replica
+    state.update("ns/a", hints=HINTS)
+    second = allocator.optimize_once()
+    assert 1 <= len(second["ns/a"]) <= 4
+    assert len(second["ns/a"]) >= len(first["ns/a"])
