@@ -34,3 +34,4 @@ SURVEY.md section 2):
 __version__ = "0.1.0"
 
 from adaptdl_tpu import env  # noqa: F401
+from adaptdl_tpu.bootstrap import initialize_job  # noqa: F401

@@ -1,0 +1,91 @@
+"""Job initialization: the ``init_process_group`` equivalent.
+
+One call wires a training process into the elastic cluster (reference:
+adaptdl/adaptdl/torch/__init__.py:51-127, whose steps were: supervisor
+discovery, version check, object-collective init, torch.distributed
+init). The TPU-native sequence:
+
+1. install graceful-preemption signal handlers,
+2. (multi-process) register with the supervisor and long-poll
+   ``/discover`` until all processes of this restart group are known,
+3. initialize the control-plane object collectives (star reducer),
+4. (multi-host) ``jax.distributed.initialize`` so all hosts see the
+   global device set — the NCCL-rendezvous equivalent; XLA collectives
+   then ride ICI/DCN.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from adaptdl_tpu import _signal, collective, env
+
+LOG = logging.getLogger(__name__)
+
+
+def _discover_peers() -> dict[int, str] | None:
+    """Register with the supervisor and wait for all peer processes."""
+    import socket
+
+    import requests
+
+    url = env.supervisor_url()
+    job = env.job_id()
+    if not url or not job or env.num_processes() <= 1:
+        return None
+    group = env.num_restarts()
+    rank = env.process_rank()
+    address = f"{socket.gethostbyname(socket.gethostname())}"
+    requests.put(
+        f"{url}/register/{job}/{group}/{rank}",
+        json={"address": address},
+        timeout=30,
+    ).raise_for_status()
+    response = requests.get(
+        f"{url}/discover/{job}/{group}",
+        params={"replicas": env.num_processes()},
+        timeout=330,
+    )
+    response.raise_for_status()
+    return {int(r): addr for r, addr in response.json().items()}
+
+
+def initialize_job(distributed: bool | None = None) -> None:
+    """Initialize this process for (possibly multi-host) elastic
+    training. Idempotent; safe to call in single-process jobs."""
+    import os
+
+    _signal.install_handlers()
+    if "ADAPTDL_NUM_REPLICAS" not in os.environ:
+        # Standalone single-process run: one replica per local device,
+        # so the dataloader's batch math and the trainer's default mesh
+        # agree without any scheduler in the loop.
+        import jax
+
+        os.environ["ADAPTDL_NUM_REPLICAS"] = str(len(jax.devices()))
+    peers = None
+    try:
+        peers = _discover_peers()
+    except Exception:  # noqa: BLE001 - rendezvous is best-effort local
+        LOG.exception("supervisor discovery failed; continuing solo")
+    if not collective.initialized():
+        master = peers.get(0) if peers else None
+        collective.initialize(
+            master_addr=master or env.master_addr(),
+            master_port=env.master_port(),
+            replica_rank=env.process_rank(),
+            num_replicas=env.num_processes(),
+        )
+    should_distribute = (
+        distributed
+        if distributed is not None
+        else env.num_processes() > 1 and env.coordinator_addr() is not None
+    )
+    if should_distribute:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=env.coordinator_addr(),
+            num_processes=env.num_processes(),
+            process_id=env.process_rank(),
+        )

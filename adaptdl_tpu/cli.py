@@ -1,0 +1,172 @@
+"""Command-line interface: submit / ls / logs / cp / tensorboard.
+
+The reference ships ``adaptdl`` with submit (docker build + CRD
+create), logs, ls, cp, and tensorboard management against Kubernetes
+(reference: cli/bin/adaptdl:133-396, cli/adaptdl_cli/*). This CLI
+keeps the same verb surface with two backends:
+
+- **local** (default, fully functional): jobs run under the
+  :class:`~adaptdl_tpu.sched.local_runner.LocalElasticRunner` on this
+  machine's chips; job state is queried from the runner's supervisor.
+- **k8s** (rendering): ``submit --backend k8s`` emits an AdaptDLJob
+  manifest for the GKE operator (see adaptdl_tpu/sched/k8s/) and
+  applies it with kubectl when available — no in-cluster docker
+  registry dance; images come from Artifact Registry.
+
+Usage:
+    adaptdl-tpu submit train.py --checkpoint-dir /ckpt [--chips N]
+    adaptdl-tpu ls --supervisor http://HOST:PORT
+    adaptdl-tpu logs --log-file /ckpt/job.log
+    adaptdl-tpu cp /ckpt/checkpoint-3.0/model ./model.bin
+    adaptdl-tpu tensorboard --logdir /shared
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import subprocess
+import sys
+
+
+def _cmd_submit(args) -> int:
+    if args.backend == "k8s":
+        from adaptdl_tpu.sched.k8s import render_job_manifest
+
+        manifest = render_job_manifest(
+            name=args.name or "adaptdl-job",
+            script=args.script,
+            image=args.image,
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas or 8,
+            checkpoint_claim=args.checkpoint_claim,
+        )
+        if shutil.which("kubectl") and not args.dry_run:
+            proc = subprocess.run(
+                ["kubectl", "apply", "-f", "-"],
+                input=manifest.encode(),
+            )
+            return proc.returncode
+        print(manifest)
+        return 0
+
+    from adaptdl_tpu.sched.local_runner import LocalElasticRunner
+
+    chips = args.chips
+    if chips is None:
+        import jax
+
+        chips = len(jax.devices())
+    extra_env = {}
+    if args.log_file:
+        # The runner inherits stdio; redirect ourselves when asked.
+        log = open(args.log_file, "ab", buffering=0)
+        import os
+
+        os.dup2(log.fileno(), 1)
+        os.dup2(log.fileno(), 2)
+    runner = LocalElasticRunner(
+        args.script,
+        num_chips=chips,
+        checkpoint_dir=args.checkpoint_dir,
+        job_name=args.name or "default/cli-job",
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
+        extra_env=extra_env,
+    )
+    return runner.run()
+
+
+def _cmd_ls(args) -> int:
+    import requests
+
+    text = requests.get(f"{args.supervisor}/metrics", timeout=10).text
+    print(text, end="")
+    return 0
+
+
+def _cmd_hints(args) -> int:
+    import requests
+
+    response = requests.get(
+        f"{args.supervisor}/hints/{args.job}", timeout=10
+    )
+    print(json.dumps(response.json(), indent=2))
+    return 0
+
+
+def _cmd_logs(args) -> int:
+    cmd = ["tail"]
+    if args.follow:
+        cmd.append("-f")
+    cmd.extend(["-n", str(args.lines), args.log_file])
+    return subprocess.call(cmd)
+
+
+def _cmd_cp(args) -> int:
+    shutil.copy2(args.src, args.dst)
+    return 0
+
+
+def _cmd_tensorboard(args) -> int:
+    if shutil.which("tensorboard") is None:
+        print(
+            "tensorboard is not installed in this environment",
+            file=sys.stderr,
+        )
+        return 1
+    return subprocess.call(
+        ["tensorboard", "--logdir", args.logdir, "--port", str(args.port)]
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="adaptdl-tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("submit", help="run a training script elastically")
+    p.add_argument("script")
+    p.add_argument("--backend", choices=("local", "k8s"), default="local")
+    p.add_argument("--name")
+    p.add_argument("--chips", type=int, default=None)
+    p.add_argument("--checkpoint-dir", default="/tmp/adaptdl-ckpt")
+    p.add_argument("--min-replicas", type=int, default=0)
+    p.add_argument("--max-replicas", type=int, default=None)
+    p.add_argument("--log-file")
+    p.add_argument("--image", default="adaptdl-tpu:latest")
+    p.add_argument("--checkpoint-claim", default="adaptdl-checkpoints")
+    p.add_argument("--dry-run", action="store_true")
+    p.set_defaults(fn=_cmd_submit)
+
+    p = sub.add_parser("ls", help="list jobs known to a supervisor")
+    p.add_argument("--supervisor", required=True)
+    p.set_defaults(fn=_cmd_ls)
+
+    p = sub.add_parser("hints", help="show a job's posted sched hints")
+    p.add_argument("job", help="namespace/name")
+    p.add_argument("--supervisor", required=True)
+    p.set_defaults(fn=_cmd_hints)
+
+    p = sub.add_parser("logs", help="tail a local job's log file")
+    p.add_argument("--log-file", required=True)
+    p.add_argument("-f", "--follow", action="store_true")
+    p.add_argument("-n", "--lines", type=int, default=50)
+    p.set_defaults(fn=_cmd_logs)
+
+    p = sub.add_parser("cp", help="copy a file out of a checkpoint dir")
+    p.add_argument("src")
+    p.add_argument("dst")
+    p.set_defaults(fn=_cmd_cp)
+
+    p = sub.add_parser("tensorboard", help="launch tensorboard")
+    p.add_argument("--logdir", required=True)
+    p.add_argument("--port", type=int, default=6006)
+    p.set_defaults(fn=_cmd_tensorboard)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

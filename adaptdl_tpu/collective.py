@@ -37,11 +37,13 @@ def initialize(
     global _reducer
     if _reducer is not None:
         return
+    # The control plane is per-PROCESS (one SPMD process drives many
+    # chip replicas), so the default world size is num_processes.
     _reducer = ObjectReducer(
         master_addr if master_addr is not None else env.master_addr(),
         master_port if master_port is not None else env.master_port(),
-        replica_rank if replica_rank is not None else env.replica_rank(),
-        num_replicas if num_replicas is not None else env.num_replicas(),
+        replica_rank if replica_rank is not None else env.process_rank(),
+        num_replicas if num_replicas is not None else env.num_processes(),
     )
 
 

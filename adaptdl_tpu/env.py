@@ -90,8 +90,13 @@ def process_rank() -> int:
 
 
 def num_processes() -> int:
-    """Total JAX host processes participating in the job."""
-    return _get_int("ADAPTDL_NUM_PROCESSES", num_replicas())
+    """Total JAX host processes participating in the job.
+
+    Defaults to 1: under SPMD one process drives many replicas (chips),
+    unlike the reference's one-process-per-replica model. Multi-host
+    launchers must set ``ADAPTDL_NUM_PROCESSES`` explicitly.
+    """
+    return _get_int("ADAPTDL_NUM_PROCESSES", 1)
 
 
 def num_restarts() -> int:

@@ -208,16 +208,31 @@ class GoodputFunction:
 
 
 def _fit_objective(
-    jnp, params, num_nodes, num_replicas, atomic_bsz, accum_time, optim_time
+    jnp,
+    params,
+    num_nodes,
+    num_replicas,
+    atomic_bsz,
+    accum_time,
+    optim_time,
+    weight,
 ):
-    """Log-space RMSE of predicted vs measured step times + priors."""
+    """Log-space weighted RMSE of predicted vs measured step times +
+    priors. ``weight`` masks padding rows (inputs are padded to bucket
+    sizes so the jitted objective compiles once per bucket, not once
+    per new profile entry)."""
     pred_acc = _accum_time(jnp, params, atomic_bsz)
     pred_net = _network_time(jnp, params, num_nodes, num_replicas)
     pred_log_opt = _log_optim_time(jnp, params, pred_acc, pred_net)
+    total = jnp.sum(weight)
     err_acc = jnp.sqrt(
-        jnp.mean((jnp.log(pred_acc) - jnp.log(accum_time)) ** 2)
+        jnp.sum(weight * (jnp.log(pred_acc) - jnp.log(accum_time)) ** 2)
+        / total
     )
-    err_opt = jnp.sqrt(jnp.mean((pred_log_opt - jnp.log(optim_time)) ** 2))
+    err_opt = jnp.sqrt(
+        jnp.sum(weight * (pred_log_opt - jnp.log(optim_time)) ** 2)
+        / total
+    )
     # Prefer small gamma (easier landscape) and small retrogression
     # relative to the constant network terms (optimistic scaling).
     reg_gamma = 1e-3 * (params[6] - 1.0) ** 2
@@ -225,6 +240,28 @@ def _fit_objective(
         (params[3] / params[2]) ** 2 + (params[5] / params[4]) ** 2
     )
     return err_acc + err_opt + reg_gamma + reg_retro
+
+
+_jitted_objective_cache = None
+
+
+def _get_jitted_objective():
+    """Module-level jitted value-and-grad: one persistent function so
+    jax's compile cache actually hits across repeated fits."""
+    global _jitted_objective_cache
+    if _jitted_objective_cache is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def value_and_grad(params, args):
+            def objective(p):
+                return _fit_objective(jnp, p, *args)
+
+            return jax.value_and_grad(objective)(params)
+
+        _jitted_objective_cache = value_and_grad
+    return _jitted_objective_cache
 
 
 def fit_perf_params(
@@ -265,24 +302,34 @@ def fit_perf_params(
         init[3] = upper[3] = lower[3]  # retrogression unidentifiable
         init[5] = upper[5] = lower[5]
 
+    # Pad observations to the next power-of-two bucket: the jitted
+    # objective then compiles once per bucket instead of once per new
+    # profile entry (the fit re-runs every ~30s as profiles grow).
+    n = len(num_nodes)
+    padded = 1 << max(n - 1, 1).bit_length()
+    weight = np.zeros(padded)
+    weight[:n] = 1.0
+
+    def _pad(a, fill):
+        out = np.full(padded, fill, dtype=float)
+        out[:n] = a
+        return out
+
     with jax.enable_x64():
         args64 = tuple(
             jnp.asarray(a, dtype=jnp.float64)
             for a in (
-                num_nodes,
-                num_replicas,
-                atomic_bsz,
-                accum_step_time,
-                optim_step_time,
+                _pad(num_nodes, 1),
+                _pad(num_replicas, 1),
+                _pad(atomic_bsz, 1),
+                _pad(accum_step_time, 1),
+                _pad(optim_step_time, 1),
+                weight,
             )
         )
 
-        def objective(p, args):
-            return _fit_objective(jnp, p, *args)
-
-        # Trace once; L-BFGS calls this hundreds of times per fit and the
-        # fit reruns every ~30s during training.
-        value_and_grad = jax.jit(jax.value_and_grad(objective))
+        # Trace once per bucket shape (cached across fit calls).
+        value_and_grad = _get_jitted_objective()
 
         def fun(p):
             value, grad = value_and_grad(

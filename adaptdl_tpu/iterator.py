@@ -1,0 +1,66 @@
+"""BPTT-style language-model iteration.
+
+The reference's ``AdaptiveBPTTIterator`` shards BPTT windows of a flat
+token corpus across replicas, with start-index remapping when the
+batch geometry changes on rescale and equal-iteration clamping to
+avoid asymmetric-collective deadlocks (reference:
+adaptdl/adaptdl/torch/iterator.py:49-105). Under this framework none
+of that machinery is needed: a corpus is *viewed* as a dataset of
+(input, target) windows, and the ordinary
+:class:`~adaptdl_tpu.data.AdaptiveDataLoader` supplies deterministic
+partitioning, position-based mid-epoch resume at any replica count,
+adaptive batch sizing, and static shapes (drop_last) — so the whole
+component reduces to the windowing view plus a convenience
+constructor.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from adaptdl_tpu.data import AdaptiveDataLoader
+
+
+class TokenWindowDataset:
+    """View a flat token array as BPTT windows.
+
+    Window ``i`` covers tokens ``[i*bptt, i*bptt + bptt]`` (one extra
+    token so inputs/targets are aligned shifts). Samples are dicts
+    ``{"inputs": [bptt], "targets": [bptt]}``.
+    """
+
+    def __init__(self, tokens: np.ndarray, bptt: int):
+        tokens = np.asarray(tokens)
+        if tokens.ndim != 1:
+            raise ValueError("corpus must be a flat 1-D token array")
+        self.tokens = tokens
+        self.bptt = bptt
+        self._num_windows = max((len(tokens) - 1) // bptt, 0)
+
+    def __len__(self) -> int:
+        return self._num_windows
+
+    def __getitem__(self, index: int) -> dict:
+        start = index * self.bptt
+        window = self.tokens[start : start + self.bptt + 1]
+        return {
+            "inputs": window[:-1].astype(np.int32),
+            "targets": window[1:].astype(np.int32),
+        }
+
+
+def AdaptiveBPTTLoader(
+    tokens: np.ndarray,
+    batch_size: int,
+    bptt: int,
+    shuffle: bool = True,
+    **kwargs,
+) -> AdaptiveDataLoader:
+    """Elastic BPTT loader over a flat corpus (drop-in for the
+    reference's AdaptiveBPTTIterator use sites)."""
+    return AdaptiveDataLoader(
+        TokenWindowDataset(tokens, bptt),
+        batch_size=batch_size,
+        shuffle=shuffle,
+        **kwargs,
+    )

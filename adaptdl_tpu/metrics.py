@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import logging
 import pickle
+import threading
 import time
 from collections import defaultdict
 from dataclasses import dataclass, field
@@ -78,13 +79,18 @@ class MetricsState:
 
 _state = MetricsState()
 _last_fit_time: float | None = None
+_profile_lock = threading.Lock()
+_fit_thread: threading.Thread | None = None
 
 
 def _reset_state() -> None:
     """Test isolation."""
-    global _state, _last_fit_time
+    global _state, _last_fit_time, _fit_thread
+    if _fit_thread is not None and _fit_thread.is_alive():
+        _fit_thread.join(timeout=60)
     _state = MetricsState()
     _last_fit_time = None
+    _fit_thread = None
 
 
 def current_state() -> MetricsState:
@@ -106,9 +112,10 @@ def set_batch_size_config(
 def profile_accum_time(atomic_bsz: int, accum_time: float) -> None:
     """Record a compute-only (no-sync) calibration measurement."""
     key = (env.num_nodes(), env.num_replicas(), atomic_bsz)
-    entry = _state.profile[key]
-    entry.accum_time_sum += accum_time
-    entry.accum_count += 1
+    with _profile_lock:
+        entry = _state.profile[key]
+        entry.accum_time_sum += accum_time
+        entry.accum_count += 1
 
 
 def profile_step(
@@ -120,19 +127,20 @@ def profile_step(
     accumulation micro-steps, clamped to stay positive.
     """
     key = (env.num_nodes(), env.num_replicas(), atomic_bsz)
-    entry = _state.profile[key]
-    if accum_steps > 0 and entry.accum_count > 0:
-        accum_time = entry.accum_time_sum / entry.accum_count
-        optim_time = max(
-            step_time - accum_steps * accum_time, 0.1 * step_time
+    with _profile_lock:
+        entry = _state.profile[key]
+        if accum_steps > 0 and entry.accum_count > 0:
+            accum_time = entry.accum_time_sum / entry.accum_count
+            optim_time = max(
+                step_time - accum_steps * accum_time, 0.1 * step_time
+            )
+        else:
+            optim_time = step_time
+        entry.optim_time_sum += optim_time
+        entry.optim_count += 1
+        _state.max_profiled_replicas = max(
+            _state.max_profiled_replicas, env.num_replicas()
         )
-    else:
-        optim_time = step_time
-    entry.optim_time_sum += optim_time
-    entry.optim_count += 1
-    _state.max_profiled_replicas = max(
-        _state.max_profiled_replicas, env.num_replicas()
-    )
     _maybe_fit_and_report()
 
 
@@ -147,7 +155,12 @@ def update_progress(progress: float) -> None:
 
 def _fit() -> PerfParams | None:
     nodes, replicas, bszs, accum_times, optim_times = [], [], [], [], []
-    for (n, r, bsz), entry in _state.profile.items():
+    with _profile_lock:
+        snapshot = [
+            (key, _ProfileEntry(**vars(entry)))
+            for key, entry in _state.profile.items()
+        ]
+    for (n, r, bsz), entry in snapshot:
         if entry.optim_count == 0:
             continue
         # A missing calibration falls back to the optim time, which
@@ -177,7 +190,36 @@ def _maybe_fit_and_report(
     _last_fit_time = now
     if env.replica_rank() != 0:
         return
-    fit_and_report_now()
+    # Fit in the background: the refit compiles/solves on the host and
+    # must never stall the training step loop.
+    global _fit_thread
+    if _fit_thread is None or not _fit_thread.is_alive():
+        _fit_thread = threading.Thread(
+            target=fit_and_report_now,
+            name="adaptdl-fit",
+            daemon=True,
+        )
+        _fit_thread.start()
+        _ensure_atexit_join()
+
+
+_atexit_registered = False
+
+
+def _ensure_atexit_join() -> None:
+    """Join any in-flight fit at interpreter exit: a daemon thread
+    killed mid-XLA-call aborts the process with a C++ exception."""
+    global _atexit_registered
+    if _atexit_registered:
+        return
+    _atexit_registered = True
+    import atexit
+
+    def _join():
+        if _fit_thread is not None and _fit_thread.is_alive():
+            _fit_thread.join(timeout=60)
+
+    atexit.register(_join)
 
 
 def fit_and_report_now() -> None:

@@ -1,0 +1,130 @@
+"""Kubernetes/GKE binding: manifests + operator.
+
+The reference deploys a CRD + three-container scheduler Deployment via
+Helm (reference: helm/adaptdl-sched/templates/: adaptdl-crd.yaml,
+adaptdl-sched.yaml) and creates worker pods from the controller
+(reference: sched/adaptdl_sched/controller.py:333-432). This package
+provides the TPU-flavored equivalents:
+
+- :func:`render_job_manifest` / :data:`CRD_MANIFEST` /
+  :data:`SCHED_DEPLOYMENT_MANIFEST`: pure-text manifest rendering, no
+  k8s client required (used by the CLI's ``submit --backend k8s``).
+- :mod:`adaptdl_tpu.sched.k8s.operator`: the controller reconciling
+  AdaptDLJob CRs onto TPU node pools — requires ``kubernetes_asyncio``
+  at runtime (not bundled in this dev image; the operator imports it
+  lazily).
+
+Slice semantics: each worker pod requests ``google.com/tpu`` chips and
+pins to a node pool whose slice topology the allocator chose; one
+distributed job per slice (the allocator's repair rule) maps to the
+one-pod-slice-per-job constraint of TPU node pools.
+"""
+
+from __future__ import annotations
+
+CRD_MANIFEST = """\
+apiVersion: apiextensions.k8s.io/v1
+kind: CustomResourceDefinition
+metadata:
+  name: adaptdljobs.adaptdl.org
+spec:
+  group: adaptdl.org
+  names:
+    kind: AdaptDLJob
+    plural: adaptdljobs
+    singular: adaptdljob
+  scope: Namespaced
+  versions:
+    - name: v1
+      served: true
+      storage: true
+      subresources:
+        status: {}
+      schema:
+        openAPIV3Schema:
+          type: object
+          properties:
+            spec:
+              type: object
+              required: [template]
+              properties:
+                minReplicas: {type: integer, minimum: 0}
+                maxReplicas: {type: integer, minimum: 1}
+                preemptible: {type: boolean}
+                template: {type: object, x-kubernetes-preserve-unknown-fields: true}
+            status:
+              type: object
+              x-kubernetes-preserve-unknown-fields: true
+"""
+
+SCHED_DEPLOYMENT_MANIFEST = """\
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: adaptdl-sched
+spec:
+  replicas: 1
+  selector:
+    matchLabels: {app: adaptdl-sched}
+  template:
+    metadata:
+      labels: {app: adaptdl-sched}
+    spec:
+      serviceAccountName: adaptdl-sched
+      containers:
+        - name: controller
+          image: {image}
+          command: ["python", "-m", "adaptdl_tpu.sched.k8s.operator", "controller"]
+        - name: allocator
+          image: {image}
+          command: ["python", "-m", "adaptdl_tpu.sched.k8s.operator", "allocator"]
+        - name: supervisor
+          image: {image}
+          command: ["python", "-m", "adaptdl_tpu.sched.k8s.operator", "supervisor"]
+          ports: [{containerPort: 8080}]
+"""
+
+
+def render_job_manifest(
+    name: str,
+    script: str,
+    image: str,
+    min_replicas: int = 0,
+    max_replicas: int = 8,
+    checkpoint_claim: str = "adaptdl-checkpoints",
+    namespace: str = "default",
+    tpu_chips_per_replica: int = 1,
+) -> str:
+    """An AdaptDLJob manifest for the operator (reference CRD spec
+    shape: helm/adaptdl-sched/templates/adaptdl-crd.yaml:31-48)."""
+    return f"""\
+apiVersion: adaptdl.org/v1
+kind: AdaptDLJob
+metadata:
+  name: {name}
+  namespace: {namespace}
+spec:
+  minReplicas: {min_replicas}
+  maxReplicas: {max_replicas}
+  preemptible: true
+  template:
+    spec:
+      restartPolicy: Never
+      containers:
+        - name: main
+          image: {image}
+          command: ["python", "{script}"]
+          resources:
+            limits:
+              google.com/tpu: {tpu_chips_per_replica}
+          volumeMounts:
+            - name: checkpoints
+              mountPath: /adaptdl/checkpoints
+          env:
+            - name: ADAPTDL_CHECKPOINT_PATH
+              value: /adaptdl/checkpoints/{namespace}-{name}
+      volumes:
+        - name: checkpoints
+          persistentVolumeClaim:
+            claimName: {checkpoint_claim}
+"""

@@ -1,0 +1,256 @@
+"""GKE operator: AdaptDLJob reconciliation onto TPU node pools.
+
+The controller half of the k8s backend (reference:
+sched/adaptdl_sched/controller.py:61-184 state machine,
+allocator.py:56-134 loops, supervisor.py REST). It reuses the
+backend-agnostic cores — :class:`~adaptdl_tpu.sched.state.ClusterState`,
+:class:`~adaptdl_tpu.sched.allocator.Allocator`, and
+:class:`~adaptdl_tpu.sched.supervisor.Supervisor` — and only this
+module touches the Kubernetes API, so everything above it is exercised
+by the in-repo test suite without a cluster.
+
+Lifecycle (mirrors the reference's semantics):
+
+    Pending -> Starting -> Running -> Stopping -> (Pending | done)
+
+- a job whose pods' group annotations disagree with
+  ``status.allocation`` is Stopping (allocation drift -> rescale;
+  reference: controller.py:310-318);
+- pod exit code 143 is a graceful rescale, never a failure
+  (reference: controller.py:276-283); evictions are tolerated;
+- worker pods get the full ``ADAPTDL_*`` env, rank/group annotations,
+  a checkpoint volume, and ``google.com/tpu`` resource limits pinned
+  to the slice's node pool.
+
+Requires ``kubernetes_asyncio`` (imported lazily; not present in the
+dev image, so this module is exercised on real clusters only).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import sys
+
+from adaptdl_tpu.sched.allocator import Allocator
+from adaptdl_tpu.sched.policy import NodeInfo
+from adaptdl_tpu.sched.state import ClusterState
+from adaptdl_tpu.sched.supervisor import Supervisor
+
+LOG = logging.getLogger(__name__)
+
+GROUP = "adaptdl.org"
+VERSION = "v1"
+PLURAL = "adaptdljobs"
+GRACEFUL_EXIT = 143
+
+
+def _require_k8s():
+    try:
+        import kubernetes_asyncio  # noqa: F401
+
+        from kubernetes_asyncio import client, config, watch
+    except ImportError as exc:  # pragma: no cover - needs a cluster
+        raise RuntimeError(
+            "the k8s operator requires kubernetes_asyncio; install it "
+            "in the scheduler image"
+        ) from exc
+    return client, config, watch
+
+
+class Operator:  # pragma: no cover - requires a live cluster
+    """Single-process operator hosting controller + allocator +
+    supervisor against one namespace."""
+
+    def __init__(self, namespace: str | None = None):
+        self.namespace = namespace or os.environ.get(
+            "ADAPTDL_NAMESPACE", "default"
+        )
+        self.state = ClusterState()
+        self.supervisor = Supervisor(
+            self.state, host="0.0.0.0", port=8080
+        )
+        self.allocator: Allocator | None = None
+
+    async def run(self):
+        client, config, watch = _require_k8s()
+        await config.load_incluster_config()
+        api = client.CustomObjectsApi()
+        core = client.CoreV1Api()
+        self.supervisor.start()
+        nodes = await self._discover_slices(core)
+        self.allocator = Allocator(
+            self.state,
+            nodes,
+            node_template=next(iter(nodes.values())),
+        )
+        self.allocator.start()
+        await asyncio.gather(
+            self._watch_jobs(api, watch),
+            self._reconcile_loop(api, core),
+        )
+
+    async def _discover_slices(self, core) -> dict[str, NodeInfo]:
+        """TPU node pools -> slices: nodes sharing a pool label form
+        one schedulable slice whose capacity is its chip total."""
+        nodes = {}
+        listing = await core.list_node()
+        for node in listing.items:
+            tpus = int(
+                (node.status.allocatable or {}).get("google.com/tpu", 0)
+            )
+            if tpus <= 0:
+                continue
+            pool = node.metadata.labels.get(
+                "cloud.google.com/gke-nodepool", node.metadata.name
+            )
+            info = nodes.setdefault(
+                pool, NodeInfo(resources={"tpu": 0})
+            )
+            info.resources["tpu"] += tpus
+        return nodes
+
+    async def _watch_jobs(self, api, watch):
+        w = watch.Watch()
+        async for event in w.stream(
+            api.list_namespaced_custom_object,
+            GROUP,
+            VERSION,
+            self.namespace,
+            PLURAL,
+        ):
+            obj = event["object"]
+            key = f"{self.namespace}/{obj['metadata']['name']}"
+            if event["type"] == "DELETED":
+                self.state.remove_job(key)
+                continue
+            if self.state.get_job(key) is None:
+                spec = obj.get("spec", {})
+                self.state.create_job(
+                    key,
+                    spec={
+                        "resources": {"tpu": 1},
+                        "min_replicas": spec.get("minReplicas", 0),
+                        "max_replicas": spec.get("maxReplicas", 1),
+                        "preemptible": spec.get("preemptible", True),
+                        "template": spec.get("template", {}),
+                    },
+                )
+
+    async def _reconcile_loop(self, api, core, interval: float = 5.0):
+        while True:
+            for key, record in self.state.jobs().items():
+                try:
+                    await self._reconcile_job(api, core, key, record)
+                except Exception:  # noqa: BLE001
+                    LOG.exception("reconcile failed for %s", key)
+            await asyncio.sleep(interval)
+
+    async def _reconcile_job(self, api, core, key, record):
+        namespace, name = key.split("/", 1)
+        selector = f"adaptdl/job={name}"
+        pods = await core.list_namespaced_pod(
+            namespace, label_selector=selector
+        )
+        live = [p for p in pods.items if p.metadata.deletion_timestamp is None]
+        desired = record.allocation
+
+        def pod_group(pod):
+            return int(pod.metadata.annotations.get("adaptdl/group", -1))
+
+        drifted = any(pod_group(p) != record.group for p in live)
+        failed = []
+        for pod in live:
+            for status in pod.status.container_statuses or []:
+                term = status.state.terminated
+                if term and term.exit_code not in (0, GRACEFUL_EXIT):
+                    failed.append((pod.metadata.name, term.exit_code))
+        if failed:
+            LOG.warning("%s worker failures: %s", key, failed)
+        if drifted or failed or len(live) != len(desired):
+            # Stop everything; next pass recreates at the new group.
+            for pod in live:
+                await core.delete_namespaced_pod(
+                    pod.metadata.name, namespace
+                )
+            if live:
+                return
+            self.state.update(key, group=record.group + 1)
+            for rank, node in enumerate(desired):
+                await core.create_namespaced_pod(
+                    namespace,
+                    self._worker_pod(name, record, rank, node),
+                )
+            self.state.update(
+                key, status="Running" if desired else "Pending"
+            )
+
+    def _worker_pod(self, name, record, rank, node_pool):
+        template = dict(record.spec.get("template") or {})
+        spec = dict(template.get("spec") or {})
+        containers = [dict(c) for c in spec.get("containers", [])]
+        env = [
+            {"name": "ADAPTDL_JOB_ID", "value": record.key},
+            {"name": "ADAPTDL_REPLICA_RANK", "value": str(rank)},
+            {"name": "ADAPTDL_PROCESS_RANK", "value": str(rank)},
+            {
+                "name": "ADAPTDL_NUM_REPLICAS",
+                "value": str(len(record.allocation)),
+            },
+            {
+                "name": "ADAPTDL_NUM_PROCESSES",
+                "value": str(len(record.allocation)),
+            },
+            {
+                "name": "ADAPTDL_NUM_NODES",
+                "value": str(len(set(record.allocation))),
+            },
+            {
+                "name": "ADAPTDL_NUM_RESTARTS",
+                "value": str(record.group),
+            },
+            {
+                "name": "ADAPTDL_SUPERVISOR_URL",
+                "value": os.environ.get(
+                    "ADAPTDL_SUPERVISOR_URL",
+                    "http://adaptdl-supervisor:8080",
+                ),
+            },
+        ]
+        for container in containers:
+            container.setdefault("env", []).extend(env)
+        spec["containers"] = containers
+        spec["restartPolicy"] = "Never"
+        spec.setdefault("nodeSelector", {})[
+            "cloud.google.com/gke-nodepool"
+        ] = node_pool
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": f"{name}-{record.group}-{rank}",
+                "labels": {"adaptdl/job": name},
+                "annotations": {
+                    "adaptdl/group": str(record.group),
+                    "adaptdl/rank": str(rank),
+                },
+            },
+            "spec": spec,
+        }
+
+
+def main():  # pragma: no cover - requires a live cluster
+    logging.basicConfig(level=logging.INFO)
+    role = sys.argv[1] if len(sys.argv) > 1 else "controller"
+    operator = Operator()
+    if role == "supervisor":
+        operator.supervisor._port = 8080
+        operator.supervisor.start()
+        asyncio.get_event_loop().run_forever()
+    else:
+        asyncio.run(operator.run())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

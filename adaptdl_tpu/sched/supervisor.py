@@ -98,6 +98,40 @@ class Supervisor:
     async def _healthz(self, request: web.Request) -> web.Response:
         return web.json_response({"ok": True})
 
+    async def _metrics(self, request: web.Request) -> web.Response:
+        """Prometheus text exposition (reference exports job counters
+        from the controller on :9091, controller.py:35-41; here the
+        supervisor serves cluster-visible gauges directly)."""
+        lines = [
+            "# TYPE adaptdl_jobs gauge",
+            "# TYPE adaptdl_job_replicas gauge",
+            "# TYPE adaptdl_job_batch_size gauge",
+        ]
+        jobs = self._state.jobs()
+        by_status: dict[str, int] = {}
+        for record in jobs.values():
+            by_status[record.status] = by_status.get(record.status, 0) + 1
+        for status, count in sorted(by_status.items()):
+            lines.append(
+                f'adaptdl_jobs{{status="{status}"}} {count}'
+            )
+        for key, record in sorted(jobs.items()):
+            label = f'job="{key}"'
+            lines.append(
+                f"adaptdl_job_replicas{{{label}}} "
+                f"{len(record.allocation)}"
+            )
+            hints = record.hints or {}
+            if hints.get("initBatchSize"):
+                lines.append(
+                    f"adaptdl_job_batch_size{{{label}}} "
+                    f"{hints['initBatchSize']}"
+                )
+        return web.Response(
+            text="\n".join(lines) + "\n",
+            content_type="text/plain",
+        )
+
     # -- lifecycle ----------------------------------------------------
 
     def _build_app(self) -> web.Application:
@@ -114,6 +148,7 @@ class Supervisor:
                 web.put("/hints/{namespace}/{name}", self._put_hints),
                 web.get("/hints/{namespace}/{name}", self._get_hints),
                 web.get("/healthz", self._healthz),
+                web.get("/metrics", self._metrics),
             ]
         )
         return app

@@ -1,0 +1,69 @@
+"""TensorBoard export of the adaptation metrics.
+
+The reference dumps gain, gradient sqr/var, lr factor, batch sizes,
+and progress to TensorBoard from inside AdaptiveDataParallel
+(reference: adaptdl/adaptdl/torch/parallel.py:176-202, data.py:381-398).
+Here it is an explicit, optional writer fed from the train step's
+metrics dict. Uses TensorFlow's summary writer when available (the
+standard TPU-VM image ships it); silently no-ops otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+
+from adaptdl_tpu import env
+
+
+class MetricsWriter:
+    """Writes per-step adaptation metrics for one replica group."""
+
+    def __init__(self, logdir: str | None = None):
+        logdir = logdir or env.share_path()
+        self._writer = None
+        if logdir is None:
+            return
+        try:
+            import tensorflow as tf  # heavyweight; optional
+        except Exception:  # noqa: BLE001 - any import failure: no-op
+            return
+        path = os.path.join(
+            logdir, f"replica-{env.replica_rank()}", "adaptdl"
+        )
+        self._writer = tf.summary.create_file_writer(path)
+        self._tf = tf
+
+    def write(self, step: int, metrics: dict, dataloader=None) -> None:
+        """Log a train step's metrics (and the loader's batch
+        geometry) under the same tags the reference exports."""
+        if self._writer is None:
+            return
+        tf = self._tf
+        with self._writer.as_default(step=int(step)):
+            for key in (
+                "loss",
+                "gain",
+                "lr_factor",
+                "grad_sqr",
+                "grad_var",
+                "progress",
+                "scale",
+            ):
+                if key in metrics:
+                    tf.summary.scalar(
+                        f"adaptdl/{key}", float(metrics[key])
+                    )
+            if dataloader is not None:
+                tf.summary.scalar(
+                    "adaptdl/batch_size", dataloader.current_batch_size
+                )
+                tf.summary.scalar(
+                    "adaptdl/atomic_bsz", dataloader.current_atomic_bsz
+                )
+                tf.summary.scalar(
+                    "adaptdl/accum_steps", dataloader.current_accum_steps
+                )
+
+    def flush(self) -> None:
+        if self._writer is not None:
+            self._writer.flush()

@@ -106,7 +106,16 @@ class ElasticTrainer:
         self.optimizer = optimizer
         self.init_batch_size = init_batch_size
         self.scaling_rule = scaling_rule or ScalingRule()
-        self.mesh = mesh if mesh is not None else create_mesh()
+        if mesh is None:
+            # Default mesh: one data-parallel replica per chip of this
+            # job's allocation (ADAPTDL_NUM_REPLICAS, set by the
+            # scheduler or defaulted by initialize_job).
+            from adaptdl_tpu import env as env_mod
+
+            mesh = create_mesh(
+                devices=jax.devices()[: env_mod.num_replicas()]
+            )
+        self.mesh = mesh
         if precondition not in (None, "adam"):
             raise ValueError(f"unknown precondition: {precondition!r}")
         self.precondition = precondition
@@ -210,10 +219,12 @@ class ElasticTrainer:
             )
             params_v = jax.lax.pcast(params, varying_axes, to="varying")
             precond = self._precond(state.opt_state)
+            # The preconditioner multiplies gradients *after* their
+            # seq-axis pmean, so it is data-varying only.
             precond_v = (
                 None
                 if precond is None
-                else jax.lax.pcast(precond, varying_axes, to="varying")
+                else jax.lax.pcast(precond, DATA_AXIS, to="varying")
             )
             # Per-replica, per-step rng; microbatch rngs split below.
             rng = jax.random.fold_in(state.rng, state.step)

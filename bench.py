@@ -148,7 +148,7 @@ def main(quick: bool = False):
             state, m = trainer.run_step(state, host_batch, loader)
             steps += 1
             if steps % 10 == 0:
-                metrics._maybe_fit_and_report(interval=0.0)
+                metrics.fit_and_report_now()
             if steps >= adapt_steps:
                 break
         if steps >= adapt_steps:

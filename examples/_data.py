@@ -1,0 +1,54 @@
+"""Synthetic datasets for the examples (this environment has no
+network egress, so the classic downloads are replaced by learnable
+synthetic tasks of the same shapes)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+# Make the examples runnable from a plain checkout (no pip install).
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def synthetic_images(
+    n: int, image_size: int, channels: int, num_classes: int, seed: int = 0
+):
+    """Class-template images + noise: learnable stand-in for
+    MNIST/CIFAR."""
+    rng = np.random.default_rng(seed)
+    templates = rng.normal(
+        size=(num_classes, image_size, image_size, channels)
+    ).astype(np.float32)
+    labels = rng.integers(0, num_classes, size=n)
+    images = 0.8 * templates[labels] + 0.6 * rng.normal(
+        size=(n, image_size, image_size, channels)
+    ).astype(np.float32)
+    return {"image": images, "label": labels.astype(np.int32)}
+
+
+def synthetic_tokens(n: int, seq_len: int, vocab: int, seed: int = 0):
+    """Deterministic arithmetic sequences: a fully learnable LM task."""
+    rng = np.random.default_rng(seed)
+    start = rng.integers(0, vocab, size=(n, 1))
+    stride = rng.integers(1, 4, size=(n, 1))
+    seqs = (start + stride * np.arange(seq_len + 1)[None, :]) % vocab
+    return {"tokens": seqs.astype(np.int32)}
+
+
+def force_cpu_devices(count: int = 8) -> None:
+    """Run an example on a virtual CPU mesh (dev boxes without TPU)."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={count}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

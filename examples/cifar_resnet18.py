@@ -1,0 +1,93 @@
+"""CIFAR-10-class ResNet-18 with adaptive batch size.
+
+The reference's headline example config (reference:
+examples/pytorch-cifar/main.py:76-77 — bs=128, lr=0.1,
+autoscale_batch_size(4096, (32, 1024), accumulation)) on the
+elastic-TPU stack: GroupNorm ResNet-18, SGD+momentum with AdaScale,
+goodput-driven batch sizing.
+
+Run:   python examples/cifar_resnet18.py --cpu --epochs 2
+Elastic on all local chips:
+       python -m adaptdl_tpu.sched.local_runner \\
+           examples/cifar_resnet18.py --checkpoint-dir /tmp/cifar-ck
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+from _data import force_cpu_devices, synthetic_images  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--epochs", type=int, default=10)
+    parser.add_argument("--width", type=int, default=None)
+    args = parser.parse_args()
+    if args.cpu:
+        force_cpu_devices()
+
+    import jax.numpy as jnp
+    import optax
+
+    import adaptdl_tpu
+    from adaptdl_tpu import checkpoint, epoch, metrics
+    from adaptdl_tpu.accumulator import Accumulator
+    from adaptdl_tpu.data import AdaptiveDataLoader
+    from adaptdl_tpu.models import init_resnet18, resnet_loss_fn
+    from adaptdl_tpu.scaling_rules import AdaScale
+    from adaptdl_tpu.trainer import ElasticTrainer
+
+    adaptdl_tpu.initialize_job()
+    on_cpu = args.cpu
+    width = args.width or (16 if on_cpu else 64)
+    model, params = init_resnet18(
+        image_size=32,
+        width=width,
+        dtype=jnp.float32 if on_cpu else jnp.bfloat16,
+    )
+    trainer = ElasticTrainer(
+        loss_fn=resnet_loss_fn(model),
+        params=params,
+        optimizer=optax.sgd(0.1, momentum=0.9),
+        init_batch_size=128,
+        scaling_rule=AdaScale(),
+    )
+    holder = {"state": trainer.init_state()}
+    ckpt = trainer.make_checkpoint_state(
+        lambda: holder["state"],
+        lambda s: holder.__setitem__("state", s),
+    )
+    checkpoint.load_state(ckpt)
+    metrics.ensure_checkpoint_registered()
+
+    n = 2048 if on_cpu else 50000
+    loader = AdaptiveDataLoader(
+        synthetic_images(n, 32, 3, 10), batch_size=128
+    )
+    loader.autoscale_batch_size(
+        4096, local_bsz_bounds=(32, 1024), gradient_accumulation=True
+    )
+    accum = Accumulator()
+    for e in epoch.remaining_epochs_until(args.epochs):
+        for batch in loader:
+            holder["state"], m = trainer.run_step(
+                holder["state"], batch, loader
+            )
+            accum["loss_sum"] += float(m["loss"])
+            accum["steps"] += 1
+        with accum.synchronized():
+            print(
+                f"epoch {e}: "
+                f"loss={accum['loss_sum'] / max(accum['steps'], 1):.4f} "
+                f"batch_size={loader.current_batch_size} "
+                f"(atomic={loader.current_atomic_bsz}, "
+                f"accum={loader.current_accum_steps})"
+            )
+        accum.reset()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Minimal elastic job: linear regression (reference:
+examples/linear_regression/).
+
+Run:   python examples/linear_regression.py --cpu
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+from _data import force_cpu_devices  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--epochs", type=int, default=10)
+    args = parser.parse_args()
+    if args.cpu:
+        force_cpu_devices()
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import adaptdl_tpu
+    from adaptdl_tpu import checkpoint, epoch, metrics
+    from adaptdl_tpu.data import AdaptiveDataLoader
+    from adaptdl_tpu.scaling_rules import AdaScale
+    from adaptdl_tpu.trainer import ElasticTrainer
+
+    adaptdl_tpu.initialize_job()
+    true_w = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4096, 4)).astype(np.float32)
+    y = x @ true_w + 0.1 * rng.normal(size=4096).astype(np.float32)
+
+    trainer = ElasticTrainer(
+        loss_fn=lambda p, b, r: jnp.mean(
+            (b["x"] @ p["w"] + p["b"] - b["y"]) ** 2
+        ),
+        params={"w": jnp.zeros(4), "b": jnp.zeros(())},
+        optimizer=optax.sgd(0.05),
+        init_batch_size=32,
+        scaling_rule=AdaScale(),
+    )
+    holder = {"state": trainer.init_state()}
+    ckpt = trainer.make_checkpoint_state(
+        lambda: holder["state"],
+        lambda s: holder.__setitem__("state", s),
+    )
+    checkpoint.load_state(ckpt)
+    metrics.ensure_checkpoint_registered()
+
+    loader = AdaptiveDataLoader({"x": x, "y": y}, batch_size=32)
+    loader.autoscale_batch_size(
+        512, local_bsz_bounds=(8, 128), gradient_accumulation=True
+    )
+    for e in epoch.remaining_epochs_until(args.epochs):
+        for batch in loader:
+            holder["state"], m = trainer.run_step(
+                holder["state"], batch, loader
+            )
+        print(f"epoch {e}: loss={float(m['loss']):.5f}")
+    print("w:", np.asarray(holder["state"].params["w"]), "target:", true_w)
+
+
+if __name__ == "__main__":
+    main()

@@ -109,3 +109,31 @@ def test_allocator_assigns_and_grows():
     second = allocator.optimize_once()
     assert 1 <= len(second["ns/a"]) <= 4
     assert len(second["ns/a"]) >= len(first["ns/a"])
+
+
+def test_metrics_exposition(cluster):
+    state, url = cluster
+    state.update("test/job", allocation=["slice-0"] * 3, hints=HINTS)
+    text = requests.get(f"{url}/metrics", timeout=5).text
+    assert 'adaptdl_jobs{status="Pending"} 1' in text
+    assert 'adaptdl_job_replicas{job="test/job"} 3' in text
+    assert 'adaptdl_job_batch_size{job="test/job"} 128' in text
+
+
+def test_k8s_manifest_rendering():
+    import yaml
+
+    from adaptdl_tpu.sched.k8s import CRD_MANIFEST, render_job_manifest
+
+    crd = yaml.safe_load(CRD_MANIFEST)
+    assert crd["spec"]["names"]["kind"] == "AdaptDLJob"
+    job = yaml.safe_load(
+        render_job_manifest(
+            "myjob", "train.py", "gcr.io/x/img:1", max_replicas=16
+        )
+    )
+    assert job["spec"]["maxReplicas"] == 16
+    container = job["spec"]["template"]["spec"]["containers"][0]
+    assert container["resources"]["limits"]["google.com/tpu"] == 1
+    env = {e["name"]: e["value"] for e in container["env"]}
+    assert env["ADAPTDL_CHECKPOINT_PATH"].endswith("default-myjob")

@@ -1,0 +1,57 @@
+"""Step 1: a plain, non-elastic training script.
+
+The starting point of the adoption path (reference:
+tutorial/mnist_step_1.py): an ordinary jitted train loop with nothing
+from the elastic framework yet. Steps 2-5 convert it incrementally.
+
+Run on a dev box:  python tutorial/mnist_step_1.py --cpu
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, "examples")
+from _data import force_cpu_devices, synthetic_images  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--epochs", type=int, default=3)
+    args = parser.parse_args()
+    if args.cpu:
+        force_cpu_devices()
+
+    import jax
+    import numpy as np
+    import optax
+
+    from adaptdl_tpu.models import cnn_loss_fn, init_cnn
+
+    model, params = init_cnn(image_size=16, channels=1)
+    loss_fn = cnn_loss_fn(model)
+    optimizer = optax.adam(1e-3)
+    opt_state = optimizer.init(params)
+    data = synthetic_images(2048, 16, 1, 10)
+
+    @jax.jit
+    def train_step(params, opt_state, batch, rng):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.default_rng(0)
+    key = jax.random.key(0)
+    for epoch in range(args.epochs):
+        for start in range(0, 2048, 64):
+            idx = slice(start, start + 64)
+            batch = {k: v[idx] for k, v in data.items()}
+            key, step_key = jax.random.split(key)
+            params, opt_state, loss = train_step(
+                params, opt_state, batch, step_key
+            )
+        print(f"epoch {epoch}: loss={float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Step 3: AdaptiveDataLoader — elastic, checkpoint-restart-safe input.
+
+The loader partitions each epoch across replicas, checkpoints its
+position, resumes mid-epoch after a rescale, and exits gracefully
+(143) when the scheduler preempts the job (reference step:
+tutorial/mnist_step_3.py).
+
+Run:  python tutorial/mnist_step_3.py --cpu
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, "examples")
+from _data import force_cpu_devices, synthetic_images  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--epochs", type=int, default=3)
+    args = parser.parse_args()
+    if args.cpu:
+        force_cpu_devices()
+
+    import optax
+
+    import adaptdl_tpu
+    from adaptdl_tpu import checkpoint
+    from adaptdl_tpu.data import AdaptiveDataLoader
+    from adaptdl_tpu.models import cnn_loss_fn, init_cnn
+    from adaptdl_tpu.scaling_rules import AdamScale
+    from adaptdl_tpu.trainer import ElasticTrainer
+
+    adaptdl_tpu.initialize_job()
+
+    model, params = init_cnn(image_size=16, channels=1)
+    trainer = ElasticTrainer(
+        loss_fn=cnn_loss_fn(model),
+        params=params,
+        optimizer=optax.adam(1e-3),
+        init_batch_size=64,
+        scaling_rule=AdamScale(),
+    )
+    holder = {"state": trainer.init_state()}
+    ckpt = trainer.make_checkpoint_state(
+        lambda: holder["state"],
+        lambda s: holder.__setitem__("state", s),
+    )
+    checkpoint.load_state(ckpt)
+
+    loader = AdaptiveDataLoader(
+        synthetic_images(2048, 16, 1, 10), batch_size=64
+    )
+    for epoch in range(args.epochs):
+        for batch in loader:
+            holder["state"], metrics = trainer.run_step(
+                holder["state"], batch, loader
+            )
+        print(f"epoch {epoch}: loss={float(metrics['loss']):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Step 4: adaptive batch size + replay-safe epochs.
+
+``autoscale_batch_size`` hands the global batch size (and gradient
+accumulation) to the goodput model; ``remaining_epochs_until`` makes
+the epoch loop resume at the interrupted epoch after a restart
+(reference step: tutorial/mnist_step_4.py, config
+autoscale_batch_size(1028, (32, 128)) from tutorial/mnist_step_5.py:124).
+
+Run:  python tutorial/mnist_step_4.py --cpu
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, "examples")
+from _data import force_cpu_devices, synthetic_images  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--epochs", type=int, default=4)
+    args = parser.parse_args()
+    if args.cpu:
+        force_cpu_devices()
+
+    import optax
+
+    import adaptdl_tpu
+    from adaptdl_tpu import checkpoint, epoch, metrics
+    from adaptdl_tpu.data import AdaptiveDataLoader
+    from adaptdl_tpu.models import cnn_loss_fn, init_cnn
+    from adaptdl_tpu.scaling_rules import AdamScale
+    from adaptdl_tpu.trainer import ElasticTrainer
+
+    adaptdl_tpu.initialize_job()
+
+    model, params = init_cnn(image_size=16, channels=1)
+    trainer = ElasticTrainer(
+        loss_fn=cnn_loss_fn(model),
+        params=params,
+        optimizer=optax.adam(1e-3),
+        init_batch_size=64,
+        scaling_rule=AdamScale(),
+    )
+    holder = {"state": trainer.init_state()}
+    ckpt = trainer.make_checkpoint_state(
+        lambda: holder["state"],
+        lambda s: holder.__setitem__("state", s),
+    )
+    checkpoint.load_state(ckpt)
+    metrics.ensure_checkpoint_registered()
+
+    loader = AdaptiveDataLoader(
+        synthetic_images(2048, 16, 1, 10), batch_size=64
+    )
+    loader.autoscale_batch_size(
+        1024, local_bsz_bounds=(32, 128), gradient_accumulation=True
+    )
+    for e in epoch.remaining_epochs_until(args.epochs):
+        for batch in loader:
+            holder["state"], m = trainer.run_step(
+                holder["state"], batch, loader
+            )
+        print(
+            f"epoch {e}: loss={float(m['loss']):.4f} "
+            f"batch_size={loader.current_batch_size}"
+        )
+
+
+if __name__ == "__main__":
+    main()

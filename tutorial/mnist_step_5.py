@@ -1,0 +1,99 @@
+"""Step 5: the complete elastic program with replay-safe metrics.
+
+Adds the ``Accumulator`` so aggregated statistics (train loss, eval
+accuracy) are summed across replicas and replayed exactly across
+restarts — the full adoption path (reference: tutorial/mnist_step_5.py
+:121-136).
+
+Run standalone:        python tutorial/mnist_step_5.py --cpu
+Run under the elastic  python -m adaptdl_tpu.sched.local_runner \\
+local runner:              tutorial/mnist_step_5.py --checkpoint-dir /tmp/ck
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, "examples")
+from _data import force_cpu_devices, synthetic_images  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--epochs", type=int, default=4)
+    args = parser.parse_args()
+    if args.cpu:
+        force_cpu_devices()
+
+    import optax
+
+    import adaptdl_tpu
+    from adaptdl_tpu import checkpoint, epoch, metrics
+    from adaptdl_tpu.accumulator import Accumulator
+    from adaptdl_tpu.data import AdaptiveDataLoader
+    from adaptdl_tpu.models import cnn_loss_fn, init_cnn
+    from adaptdl_tpu.scaling_rules import AdamScale
+    from adaptdl_tpu.trainer import ElasticTrainer
+
+    adaptdl_tpu.initialize_job()
+
+    model, params = init_cnn(image_size=16, channels=1)
+    trainer = ElasticTrainer(
+        loss_fn=cnn_loss_fn(model),
+        params=params,
+        optimizer=optax.adam(1e-3),
+        init_batch_size=64,
+        scaling_rule=AdamScale(),
+    )
+    holder = {"state": trainer.init_state()}
+    ckpt = trainer.make_checkpoint_state(
+        lambda: holder["state"],
+        lambda s: holder.__setitem__("state", s),
+    )
+    checkpoint.load_state(ckpt)
+    metrics.ensure_checkpoint_registered()
+
+    train_data = synthetic_images(2048, 16, 1, 10, seed=0)
+    eval_data = synthetic_images(512, 16, 1, 10, seed=1)
+    loader = AdaptiveDataLoader(train_data, batch_size=64)
+    loader.autoscale_batch_size(
+        1024, local_bsz_bounds=(32, 128), gradient_accumulation=True
+    )
+    eval_loader = AdaptiveDataLoader(
+        eval_data, batch_size=128, shuffle=False, name="eval-loader"
+    )
+    accum = Accumulator()
+
+    import jax
+
+    @jax.jit
+    def count_correct(params, batch):
+        logits = model.apply(
+            {"params": params}, batch["image"], train=False
+        )
+        return (logits.argmax(-1) == batch["label"]).sum()
+
+    for e in epoch.remaining_epochs_until(args.epochs):
+        for batch in loader:
+            holder["state"], m = trainer.run_step(
+                holder["state"], batch, loader
+            )
+            accum["train_loss_sum"] += float(m["loss"])
+            accum["train_steps"] += 1
+        for batch in eval_loader:
+            accum["correct"] += int(
+                count_correct(holder["state"].params, batch)
+            )
+            accum["seen"] += len(batch["label"])
+        with accum.synchronized():
+            print(
+                f"epoch {e}: "
+                f"loss={accum['train_loss_sum'] / max(accum['train_steps'], 1):.4f} "
+                f"acc={accum['correct'] / max(accum['seen'], 1):.3f} "
+                f"batch_size={loader.current_batch_size}"
+            )
+        accum.reset()
+
+
+if __name__ == "__main__":
+    main()
