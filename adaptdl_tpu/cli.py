@@ -31,6 +31,14 @@ import sys
 
 
 def _cmd_submit(args) -> int:
+    from adaptdl_tpu.sched.validator import validate_job_spec
+
+    validate_job_spec(
+        {
+            "min_replicas": args.min_replicas,
+            "max_replicas": args.max_replicas or 8,
+        }
+    )
     if args.backend == "k8s":
         from adaptdl_tpu.sched.k8s import render_job_manifest
 

@@ -24,11 +24,15 @@ adaptdl/adaptdl/torch/data.py):
   interrupted loop resumes at its saved position (reference:
   data.py:361-379).
 
-The loader yields *global* host batches (numpy) shaped
-``[num_replicas * (accum_steps+1) * atomic_bsz, ...]`` in replica-major
-order, matching ``ElasticTrainer.shard_batch``'s data-axis layout: one
-process feeds all its addressable devices (the SPMD model), instead of
-the reference's one-loader-per-GPU-process model.
+Batch contract (replica-major, matching
+``ElasticTrainer.shard_batch``'s data-axis layout): on a single-process
+job the loader yields the *global* host batch, shaped
+``[num_replicas * (accum_steps+1) * atomic_bsz, ...]``; on a
+multi-host job (``ADAPTDL_NUM_PROCESSES > 1``) it yields only this
+process's contiguous block of those rows (``1/num_processes`` of
+them), which ``shard_batch`` reassembles into the global array. Either
+way one process feeds all its addressable devices (the SPMD model),
+instead of the reference's one-loader-per-GPU-process model.
 """
 
 from __future__ import annotations
@@ -317,9 +321,23 @@ class AdaptiveDataLoader:
                     break
                 take = min(global_bsz, remaining)
                 self._check_exit()
-                batch = _gather(
-                    self.dataset, self.sampler.next_indices(take)
-                )
+                indices = self.sampler.next_indices(take)
+                num_processes = env.num_processes()
+                if num_processes > 1:
+                    # Multi-host: each process materialises only its
+                    # own replicas' rows (replica-major layout, so a
+                    # process's block is contiguous); shard_batch
+                    # assembles the global array from the local parts.
+                    if take % num_processes:
+                        raise RuntimeError(
+                            "global batch not divisible across "
+                            f"{num_processes} processes (take={take}); "
+                            "use drop_last=True for multi-host jobs"
+                        )
+                    block = take // num_processes
+                    start = env.process_rank() * block
+                    indices = indices[start : start + block]
+                batch = _gather(self.dataset, indices)
                 config = (self._atomic_bsz, self._accum_steps)
                 start = time.monotonic()
                 yield batch

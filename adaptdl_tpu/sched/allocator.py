@@ -81,12 +81,14 @@ class Allocator:
         node_template: NodeInfo | None = None,
         policy: PolluxPolicy | None = None,
         interval: float = 60.0,
+        expander=None,
     ):
         self._state = state
         self._nodes = nodes
         self._template = node_template or next(iter(nodes.values()))
         self._policy = policy or PolluxPolicy()
         self._interval = interval
+        self._expander = expander
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -105,6 +107,8 @@ class Allocator:
         allocations, desired = self._policy.optimize(
             jobs, self._nodes, base, self._template
         )
+        if self._expander is not None:
+            self._expander.request(desired)
         for key, alloc in allocations.items():
             record = self._state.get_job(key)
             if record is not None and record.allocation != alloc:

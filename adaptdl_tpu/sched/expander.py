@@ -1,0 +1,95 @@
+"""Cluster expander: turns desired slice counts into provisioning.
+
+The reference keeps one anti-affinity placeholder pod per desired node
+so the k8s cluster-autoscaler provisions capacity (reference:
+sched/adaptdl_sched/cluster_expander.py:28-163). On GKE, TPU node
+pools can be resized directly, so the expander reduces to a reconcile
+loop against an abstract provisioner: the allocator's
+``desired_nodes`` output in, provisioner resize calls out, with
+hysteresis so transient dips don't thrash slice pools (slices take
+minutes to come up).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Protocol
+
+LOG = logging.getLogger(__name__)
+
+
+class SliceProvisioner(Protocol):
+    """Backend hook: e.g. the GKE node-pool API, or a test fake."""
+
+    def current_slices(self) -> int: ...
+
+    def set_slices(self, count: int) -> None: ...
+
+
+class ClusterExpander:
+    def __init__(
+        self,
+        provisioner: SliceProvisioner,
+        min_slices: int = 0,
+        max_slices: int = 64,
+        scale_down_delay: float = 300.0,
+        interval: float = 30.0,
+    ):
+        self._provisioner = provisioner
+        self._min = min_slices
+        self._max = max_slices
+        self._scale_down_delay = scale_down_delay
+        self._interval = interval
+        self._desired = min_slices
+        self._below_since: float | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def request(self, desired_slices: int) -> None:
+        """Latest desired slice count from the allocator."""
+        self._desired = int(
+            min(max(desired_slices, self._min), self._max)
+        )
+
+    def reconcile_once(self, now: float | None = None) -> int:
+        """Apply the desired count: grow immediately, shrink only after
+        the desire has stayed below current for scale_down_delay."""
+        now = time.monotonic() if now is None else now
+        current = self._provisioner.current_slices()
+        desired = self._desired
+        if desired > current:
+            LOG.info("expanding cluster: %d -> %d slices", current, desired)
+            self._provisioner.set_slices(desired)
+            self._below_since = None
+        elif desired < current:
+            if self._below_since is None:
+                self._below_since = now
+            elif now - self._below_since >= self._scale_down_delay:
+                LOG.info(
+                    "shrinking cluster: %d -> %d slices", current, desired
+                )
+                self._provisioner.set_slices(desired)
+                self._below_since = None
+        else:
+            self._below_since = None
+        return self._provisioner.current_slices()
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self._interval):
+                try:
+                    self.reconcile_once()
+                except Exception:  # noqa: BLE001
+                    LOG.exception("expander reconcile failed")
+
+        self._thread = threading.Thread(
+            target=loop, name="adaptdl-expander", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

@@ -34,9 +34,31 @@ import os
 import sys
 
 from adaptdl_tpu.sched.allocator import Allocator
+from adaptdl_tpu.sched.expander import ClusterExpander
 from adaptdl_tpu.sched.policy import NodeInfo
 from adaptdl_tpu.sched.state import ClusterState
 from adaptdl_tpu.sched.supervisor import Supervisor
+from adaptdl_tpu.sched.validator import (
+    ValidationError,
+    validate_job_spec,
+    validate_job_update,
+)
+
+
+class LoggingProvisioner:
+    """Default SliceProvisioner: records and logs the desired slice
+    count. Replace with a GKE node-pool resizer (the Cloud API, not
+    k8s) to make autoscaling actuate; this is the integration point."""
+
+    def __init__(self, initial: int = 0):
+        self._slices = initial
+
+    def current_slices(self) -> int:
+        return self._slices
+
+    def set_slices(self, count: int) -> None:
+        LOG.info("desired TPU slices: %d -> %d", self._slices, count)
+        self._slices = count
 
 LOG = logging.getLogger(__name__)
 
@@ -80,12 +102,17 @@ class Operator:  # pragma: no cover - requires a live cluster
         core = client.CoreV1Api()
         self.supervisor.start()
         nodes = await self._discover_slices(core)
+        self.expander = ClusterExpander(
+            LoggingProvisioner(initial=len(nodes))
+        )
         self.allocator = Allocator(
             self.state,
             nodes,
             node_template=next(iter(nodes.values())),
+            expander=self.expander,
         )
         self.allocator.start()
+        self.expander.start()
         await asyncio.gather(
             self._watch_jobs(api, watch),
             self._reconcile_loop(api, core),
@@ -125,18 +152,24 @@ class Operator:  # pragma: no cover - requires a live cluster
             if event["type"] == "DELETED":
                 self.state.remove_job(key)
                 continue
-            if self.state.get_job(key) is None:
-                spec = obj.get("spec", {})
-                self.state.create_job(
-                    key,
-                    spec={
-                        "resources": {"tpu": 1},
-                        "min_replicas": spec.get("minReplicas", 0),
-                        "max_replicas": spec.get("maxReplicas", 1),
-                        "preemptible": spec.get("preemptible", True),
-                        "template": spec.get("template", {}),
-                    },
-                )
+            spec = obj.get("spec", {})
+            normalized = {
+                "resources": {"tpu": 1},
+                "min_replicas": spec.get("minReplicas", 0),
+                "max_replicas": spec.get("maxReplicas", 1),
+                "preemptible": spec.get("preemptible", True),
+                "template": spec.get("template", {}),
+            }
+            existing = self.state.get_job(key)
+            try:
+                if existing is None:
+                    validate_job_spec(normalized)
+                    self.state.create_job(key, spec=normalized)
+                else:
+                    # Scaling limits and template are immutable.
+                    validate_job_update(existing.spec, normalized)
+            except ValidationError as exc:
+                LOG.warning("rejecting %s: %s", key, exc)
 
     async def _reconcile_loop(self, api, core, interval: float = 5.0):
         while True:

@@ -68,15 +68,16 @@ class LocalElasticRunner:
         self.extra_env = dict(extra_env or {})
         self.restarts = 0
         self.state = ClusterState()
-        self.state.create_job(
-            job_name,
-            spec={
-                "resources": {"tpu": 1},
-                "min_replicas": min_replicas,
-                "max_replicas": self.max_replicas,
-                "preemptible": True,
-            },
-        )
+        spec = {
+            "resources": {"tpu": 1},
+            "min_replicas": min_replicas,
+            "max_replicas": self.max_replicas,
+            "preemptible": True,
+        }
+        from adaptdl_tpu.sched.validator import validate_job_spec
+
+        validate_job_spec(spec)
+        self.state.create_job(job_name, spec=spec)
         self.supervisor = Supervisor(self.state)
         nodes = {"local": NodeInfo(resources={"tpu": num_chips})}
         self.allocator = Allocator(

@@ -359,6 +359,27 @@ class ElasticTrainer:
                     "be at least 2-D ([batch, seq, ...]); got a leaf "
                     f"with shape {getattr(bad[0], 'shape', None)}"
                 )
+        from adaptdl_tpu import env as env_mod
+
+        if env_mod.num_processes() > 1:
+            # Multi-host: each process holds only its replicas' rows
+            # (the loader's contract); assemble the global array from
+            # the per-process local data. Fail fast if the jax runtime
+            # wasn't actually initialized multi-process — otherwise the
+            # half-sized batch surfaces as an opaque reshape error.
+            if jax.process_count() != env_mod.num_processes():
+                raise RuntimeError(
+                    f"ADAPTDL_NUM_PROCESSES={env_mod.num_processes()} "
+                    f"but jax.process_count()={jax.process_count()}; "
+                    "multi-host jobs must call initialize_job() with "
+                    "ADAPTDL_COORDINATOR_ADDR set"
+                )
+            return jax.tree.map(
+                lambda x: jax.make_array_from_process_local_data(
+                    NamedSharding(self.mesh, self._batch_spec(x)), x
+                ),
+                batch,
+            )
         return jax.tree.map(
             lambda x: jax.device_put(
                 x, NamedSharding(self.mesh, self._batch_spec(x))
@@ -410,10 +431,15 @@ class ElasticTrainer:
 
         from adaptdl_tpu import metrics as metrics_mod
 
+        from adaptdl_tpu import env as env_mod
+
         fn = self._build_compute_only(atomic_bsz)
-        micro = jax.tree.map(
-            lambda x: x[: self.num_replicas * atomic_bsz], host_batch
+        # host_batch rows are process-local (the loader's multi-host
+        # contract); take this process's share of one microbatch.
+        local_rows = (
+            self.num_replicas * atomic_bsz // env_mod.num_processes()
         )
+        micro = jax.tree.map(lambda x: x[:local_rows], host_batch)
         micro = self.shard_batch(micro)
         jax.block_until_ready(fn(state.params, micro, state.rng))  # compile
         best = float("inf")

@@ -237,3 +237,20 @@ def test_drop_last_false_terminates_with_partial_tail(monkeypatch):
     # And the next loop starts cleanly from a full epoch.
     sizes2 = [len(b["y"]) for b in loader]
     assert sizes2 == [32, 32, 32, 4]
+
+
+def test_multihost_loader_yields_process_local_block(monkeypatch):
+    """Process p of P materialises exactly its contiguous row block of
+    the replica-major global batch."""
+    monkeypatch.setenv("ADAPTDL_NUM_REPLICAS", "4")
+    data = _dataset(128)
+    loader_global = AdaptiveDataLoader(data, batch_size=32, name="mh-g")
+    global_batches = [b["y"] for b in loader_global]
+
+    monkeypatch.setenv("ADAPTDL_NUM_PROCESSES", "2")
+    monkeypatch.setenv("ADAPTDL_PROCESS_RANK", "1")
+    loader_local = AdaptiveDataLoader(data, batch_size=32, name="mh-l")
+    local_batches = [b["y"] for b in loader_local]
+    assert len(local_batches) == len(global_batches)
+    for g, l in zip(global_batches, local_batches):
+        np.testing.assert_array_equal(l, g[16:])  # second half

@@ -1,0 +1,59 @@
+"""Validator + expander tests (reference: validator_test.py's webhook
+checks; cluster_expander reconcile behavior)."""
+
+import pytest
+
+from adaptdl_tpu.sched.expander import ClusterExpander
+from adaptdl_tpu.sched.validator import (
+    ValidationError,
+    validate_job_spec,
+    validate_job_update,
+)
+
+
+def test_spec_validation():
+    validate_job_spec({"min_replicas": 0, "max_replicas": 4})
+    with pytest.raises(ValidationError):
+        validate_job_spec({"min_replicas": 4, "max_replicas": 2})
+    with pytest.raises(ValidationError):
+        validate_job_spec({"max_replicas": 0})
+    with pytest.raises(ValidationError):
+        validate_job_spec(
+            {"max_replicas": 2, "resources": {"tpu": -1}}
+        )
+
+
+def test_update_immutability():
+    old = {"min_replicas": 1, "max_replicas": 4, "template": {"a": 1}}
+    validate_job_update(old, dict(old))
+    with pytest.raises(ValidationError):
+        validate_job_update(old, dict(old, max_replicas=8))
+    with pytest.raises(ValidationError):
+        validate_job_update(old, dict(old, template={"a": 2}))
+
+
+class FakeProvisioner:
+    def __init__(self, slices=2):
+        self.slices = slices
+
+    def current_slices(self):
+        return self.slices
+
+    def set_slices(self, count):
+        self.slices = count
+
+
+def test_expander_grows_immediately_shrinks_with_delay():
+    prov = FakeProvisioner(slices=2)
+    exp = ClusterExpander(prov, max_slices=8, scale_down_delay=100.0)
+    exp.request(5)
+    assert exp.reconcile_once(now=0.0) == 5
+    # Desire drops; no immediate shrink.
+    exp.request(2)
+    assert exp.reconcile_once(now=10.0) == 5
+    assert exp.reconcile_once(now=50.0) == 5
+    # After the delay, shrink applies.
+    assert exp.reconcile_once(now=111.0) == 2
+    # Bounds clamp.
+    exp.request(99)
+    assert exp.reconcile_once(now=120.0) == 8
