@@ -16,6 +16,14 @@ from adaptdl_tpu.models.resnet import (  # noqa: F401
     init_resnet18,
     resnet_loss_fn,
 )
+from adaptdl_tpu.models.dcgan import (  # noqa: F401
+    Discriminator,
+    Generator,
+    discriminator_loss_fn,
+    init_dcgan,
+    make_generator_step,
+)
+from adaptdl_tpu.models.ncf import NeuMF, init_ncf, ncf_loss_fn  # noqa: F401
 from adaptdl_tpu.models.transformer import (  # noqa: F401
     TransformerLM,
     TransformerConfig,

@@ -1,0 +1,63 @@
+"""Neural collaborative filtering (reference family: examples/NCF/).
+
+NeuMF-style: GMF (elementwise product of user/item embeddings) fused
+with an MLP tower, sigmoid output over implicit feedback. Batches are
+``{"user": [b], "item": [b], "label": [b]}`` with 0/1 labels
+(negative sampling happens in the data pipeline).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class NeuMF(nn.Module):
+    num_users: int
+    num_items: int
+    embed_dim: int = 32
+    mlp_dims: tuple = (64, 32, 16)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, user, item):
+        gmf_u = nn.Embed(self.num_users, self.embed_dim, name="gmf_user")(
+            user
+        )
+        gmf_i = nn.Embed(self.num_items, self.embed_dim, name="gmf_item")(
+            item
+        )
+        gmf = gmf_u * gmf_i
+        mlp_u = nn.Embed(self.num_users, self.embed_dim, name="mlp_user")(
+            user
+        )
+        mlp_i = nn.Embed(self.num_items, self.embed_dim, name="mlp_item")(
+            item
+        )
+        x = jnp.concatenate([mlp_u, mlp_i], axis=-1).astype(self.dtype)
+        for dim in self.mlp_dims:
+            x = nn.relu(nn.Dense(dim, dtype=self.dtype)(x))
+        fused = jnp.concatenate([gmf.astype(self.dtype), x], axis=-1)
+        return nn.Dense(1, dtype=jnp.float32)(fused)[..., 0]
+
+
+def init_ncf(num_users: int, num_items: int, rng=None, **kwargs):
+    model = NeuMF(num_users=num_users, num_items=num_items, **kwargs)
+    rng = rng if rng is not None else jax.random.key(0)
+    dummy = jnp.zeros((1,), jnp.int32)
+    params = model.init(rng, dummy, dummy)["params"]
+    return model, params
+
+
+def ncf_loss_fn(model: NeuMF):
+    def loss_fn(params, batch, rng):
+        logits = model.apply(
+            {"params": params}, batch["user"], batch["item"]
+        )
+        return optax.sigmoid_binary_cross_entropy(
+            logits, batch["label"]
+        ).mean()
+
+    return loss_fn

@@ -1,0 +1,64 @@
+"""Preemption-notice listener for spot/preemptible TPU VMs.
+
+The reference polls the EC2 spot-termination metadata endpoint and
+triggers the graceful checkpoint-exit path (reference:
+ray/adaptdl_ray/aws/worker.py:33-70). GCE exposes the same signal at
+the instance metadata server: ``/computeMetadata/v1/instance/preempted``
+flips to TRUE when the VM is being reclaimed (and ACPI G2 follows).
+This listener polls it in a daemon thread and raises the same
+graceful-exit flag the SIGTERM handler uses, so a spot reclaim looks
+exactly like a scheduler preemption to the training loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from adaptdl_tpu import _signal
+
+LOG = logging.getLogger(__name__)
+
+GCE_PREEMPTED_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/preempted"
+)
+_HEADERS = {"Metadata-Flavor": "Google"}
+
+
+def poll_once(url: str = GCE_PREEMPTED_URL, timeout: float = 2.0) -> bool:
+    """True if the metadata server reports this VM as preempted."""
+    import requests
+
+    try:
+        response = requests.get(url, headers=_HEADERS, timeout=timeout)
+        return response.status_code == 200 and (
+            response.text.strip().upper() == "TRUE"
+        )
+    except Exception:  # noqa: BLE001 - metadata server unreachable
+        return False
+
+
+def start_listener(
+    url: str = GCE_PREEMPTED_URL, interval: float = 5.0
+) -> threading.Event:
+    """Poll for preemption in the background; on notice, set the
+    graceful-exit flag (checkpoint + exit 143 at the next step).
+
+    Returns a stop event for tests/teardown.
+    """
+    stop = threading.Event()
+
+    def loop():
+        while not stop.wait(interval):
+            if poll_once(url):
+                LOG.warning(
+                    "preemption notice received; requesting graceful exit"
+                )
+                _signal.set_exit_flag(True)
+                return
+
+    thread = threading.Thread(
+        target=loop, name="adaptdl-preemption", daemon=True
+    )
+    thread.start()
+    return stop

@@ -88,6 +88,10 @@ class ElasticTrainer:
         Adam's second moments (the reference's AdamGradientNoiseScale,
         gradient_noise_scale.py:289-330).
       smoothing: GNS EMA retention per unit scale.
+      has_aux: when True, the step takes a third *replicated* input
+        forwarded to ``loss_fn(params, batch, rng, aux)`` — for
+        non-batch data such as a GAN's generator parameters or a
+        teacher model's weights.
     """
 
     def __init__(
@@ -101,7 +105,9 @@ class ElasticTrainer:
         precondition: str | None = None,
         smoothing: float = 0.999,
         seed: int = 0,
+        has_aux: bool = False,
     ):
+        self.has_aux = has_aux
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.init_batch_size = init_batch_size
@@ -206,7 +212,7 @@ class ElasticTrainer:
         scale = accum_scale * num_micro
         batch_size = num_replicas * num_micro * atomic_bsz
 
-        def per_replica_step(state: TrainState, local_batch):
+        def per_replica_step(state: TrainState, local_batch, aux):
             # Differentiate wrt a per-replica *varying* view of the
             # params: under shard_map's vma system, grads of replicated
             # params are auto-psum'ed across the mesh, which would hand
@@ -247,9 +253,14 @@ class ElasticTrainer:
             def micro_step(carry, inputs):
                 grad_sum, lsqr_sum, loss_sum = carry
                 mb, mb_rng = inputs
-                loss, grad = jax.value_and_grad(self.loss_fn)(
-                    params_v, mb, mb_rng
-                )
+                if self.has_aux:
+                    loss, grad = jax.value_and_grad(self.loss_fn)(
+                        params_v, mb, mb_rng, aux
+                    )
+                else:
+                    loss, grad = jax.value_and_grad(self.loss_fn)(
+                        params_v, mb, mb_rng
+                    )
                 if seq_shards > 1:
                     # A sequence-sharded group is one logical replica:
                     # average its shard-gradients *before* the GNS
@@ -339,10 +350,14 @@ class ElasticTrainer:
         sharded = shard_map(
             per_replica_step,
             mesh=self.mesh,
-            in_specs=(P(), batch_spec),
+            in_specs=(P(), batch_spec, P()),
             out_specs=(P(), P()),
         )
-        return jax.jit(sharded, donate_argnums=0)
+        jitted = jax.jit(sharded, donate_argnums=0)
+        if self.has_aux:
+            return jitted
+        # Hide the unused aux slot from non-aux callers.
+        return lambda state, batch: jitted(state, batch, ())
 
     def shard_batch(self, batch: Any) -> Any:
         """Host batch -> jax arrays sharded along the data axis (and

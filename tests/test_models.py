@@ -91,3 +91,81 @@ def test_transformer_lm_trains():
         state, m = step(state, batch)
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_ncf_trains():
+    from adaptdl_tpu.models.ncf import init_ncf, ncf_loss_fn
+
+    model, params = init_ncf(
+        num_users=50, num_items=40, embed_dim=8, mlp_dims=(16, 8)
+    )
+    mesh = create_mesh(devices=jax.devices()[:4])
+    trainer = ElasticTrainer(
+        ncf_loss_fn(model), params, optax.adam(5e-3), 32, mesh=mesh
+    )
+    state = trainer.init_state()
+    step = trainer.train_step(8, 0)
+    rng = np.random.default_rng(0)
+    # Learnable structure: user and item parity agree -> positive.
+    users = rng.integers(0, 50, size=2048)
+    items = rng.integers(0, 40, size=2048)
+    labels = ((users + items) % 2 == 0).astype(np.float32)
+    losses = []
+    for _ in range(40):
+        idx = rng.integers(0, 2048, size=32)
+        batch = trainer.shard_batch(
+            {
+                "user": users[idx].astype(np.int32),
+                "item": items[idx].astype(np.int32),
+                "label": labels[idx],
+            }
+        )
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_dcgan_alternating_steps():
+    from adaptdl_tpu.models.dcgan import (
+        Discriminator,
+        Generator,
+        discriminator_loss_fn,
+        init_dcgan,
+        make_generator_step,
+    )
+
+    gen, g_params, disc, d_params = init_dcgan(
+        latent_dim=8, base_features=8, channels=1
+    )
+    mesh = create_mesh(devices=jax.devices()[:2])
+    trainer = ElasticTrainer(
+        discriminator_loss_fn(disc, gen),
+        d_params,
+        optax.adam(2e-4),
+        8,
+        mesh=mesh,
+        has_aux=True,
+    )
+    d_state = trainer.init_state()
+    g_opt = optax.adam(2e-4)
+    g_opt_state = g_opt.init(g_params)
+    g_step = make_generator_step(gen, disc, g_opt)
+    d_step = trainer.train_step(4, 0)
+
+    rng = np.random.default_rng(0)
+    for i in range(3):
+        batch = trainer.shard_batch(
+            {
+                "image": rng.normal(size=(8, 32, 32, 1)).astype(
+                    np.float32
+                ),
+                "z": rng.normal(size=(8, 8)).astype(np.float32),
+            }
+        )
+        d_state, d_m = d_step(d_state, batch, g_params)
+        z = jnp.asarray(rng.normal(size=(8, 8)).astype(np.float32))
+        g_params, g_opt_state, g_loss = g_step(
+            g_params, g_opt_state, d_state.params, z
+        )
+    assert np.isfinite(float(d_m["loss"]))
+    assert np.isfinite(float(g_loss))

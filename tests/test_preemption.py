@@ -1,0 +1,46 @@
+"""Preemption listener test with a fake metadata endpoint (reference
+strategy: aws/test_worker.py runs with a mocked metadata server)."""
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import portpicker
+
+from adaptdl_tpu import _signal
+from adaptdl_tpu.sched import preemption
+
+
+class FakeMetadata(BaseHTTPRequestHandler):
+    preempted = False
+
+    def do_GET(self):
+        body = b"TRUE" if type(self).preempted else b"FALSE"
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+def test_listener_sets_exit_flag_on_preemption():
+    _signal.set_exit_flag(False)
+    port = portpicker.pick_unused_port()
+    server = HTTPServer(("127.0.0.1", port), FakeMetadata)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{port}/preempted"
+    try:
+        assert not preemption.poll_once(url)
+        stop = preemption.start_listener(url, interval=0.1)
+        time.sleep(0.3)
+        assert not _signal.get_exit_flag()
+        FakeMetadata.preempted = True
+        deadline = time.time() + 5
+        while not _signal.get_exit_flag() and time.time() < deadline:
+            time.sleep(0.05)
+        assert _signal.get_exit_flag()
+        stop.set()
+    finally:
+        server.shutdown()
+        _signal.set_exit_flag(False)
