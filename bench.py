@@ -196,5 +196,22 @@ def main(quick: bool = False):
     )
 
 
+def _install_watchdog(seconds: int = 540) -> None:
+    """A wedged TPU tunnel can hang even jax.devices(); fail loudly
+    instead of letting the driver's timeout reap a silent process."""
+    import signal
+
+    def on_alarm(signum, frame):  # noqa: ARG001
+        _log(
+            f"bench watchdog: no result after {seconds}s — TPU backend "
+            "likely unreachable (tunnel wedged?)"
+        )
+        sys.exit(2)
+
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+
+
 if __name__ == "__main__":
+    _install_watchdog()
     main(quick="--quick" in sys.argv)

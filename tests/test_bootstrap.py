@@ -1,0 +1,38 @@
+"""initialize_job rendezvous: forked processes register with a real
+supervisor and discover all peers (reference path:
+adaptdl/adaptdl/torch/__init__.py:95-127)."""
+
+import os
+
+from adaptdl_tpu.sched.state import ClusterState
+from adaptdl_tpu.sched.supervisor import Supervisor
+
+
+def test_multiprocess_rendezvous(elastic_multiprocessing):
+    state = ClusterState()
+    state.create_job("test/boot", spec={})
+    supervisor = Supervisor(state)
+    url = supervisor.start()
+
+    def body():
+        os.environ["ADAPTDL_SUPERVISOR_URL"] = url
+        os.environ["ADAPTDL_JOB_ID"] = "test/boot"
+        from adaptdl_tpu import collective, env
+        from adaptdl_tpu.bootstrap import _discover_peers
+
+        peers = _discover_peers()
+        assert peers is not None
+        assert set(peers) == {0, 1, 2}
+        # All three processes then wire the control plane and agree.
+        collective.initialize()
+        try:
+            total = collective.allreduce(env.process_rank())
+            assert total == 3
+        finally:
+            collective.teardown()
+        return 0
+
+    try:
+        elastic_multiprocessing(body, num_replicas=3)
+    finally:
+        supervisor.stop()
