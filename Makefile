@@ -1,0 +1,29 @@
+# Dev loop (reference analog: Makefile build/push/deploy targets).
+
+PY ?= python
+CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: test test-fast bench bench-quick dryrun examples lint
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+test-fast:
+	$(PY) -m pytest tests/ -x -q --deselect tests/test_local_runner.py \
+	    --deselect tests/test_multi_runner.py
+
+bench:
+	$(PY) bench.py
+
+bench-quick:
+	$(CPU_ENV) $(PY) -c "import jax; jax.config.update('jax_platforms','cpu'); import bench; bench.main(quick=True)"
+
+dryrun:
+	$(CPU_ENV) $(PY) -c "import jax; jax.config.update('jax_platforms','cpu'); import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+examples:
+	$(PY) examples/linear_regression.py --cpu --epochs 3
+	$(PY) tutorial/mnist_step_5.py --cpu --epochs 2
+
+lint:
+	$(PY) -m compileall -q adaptdl_tpu examples tutorial tests bench.py __graft_entry__.py

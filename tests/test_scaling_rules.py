@@ -1,0 +1,100 @@
+"""Scaling-rule LR math (reference:
+adaptdl/adaptdl/torch/scaling_rules_test.py — 9 tests on the rule
+formulas)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from adaptdl_tpu import gns
+from adaptdl_tpu.scaling_rules import (
+    AdaScale,
+    AdamScale,
+    LEGWScale,
+    LinearScale,
+    RuleContext,
+    ScalingRule,
+    SqrtScale,
+)
+
+
+def _ctx(scale=4.0, sqr=0.01, var=0.04, progress=0.0, batch_size=None):
+    state = gns.GNSState(
+        sqr_biased=jnp.asarray(sqr),
+        sqr_unbias=jnp.asarray(1.0),
+        var_biased=jnp.asarray(var),
+        var_unbias=jnp.asarray(1.0),
+        ema_is_biased=jnp.zeros((), bool),
+        prev_grad={"w": jnp.zeros(2)},
+        prev_grad_valid=jnp.zeros((), bool),
+    )
+    return RuleContext(
+        scale=scale,
+        batch_size=batch_size or int(32 * scale),
+        init_batch_size=32,
+        gns_state=state,
+        progress=jnp.asarray(progress),
+    )
+
+
+def test_base_rule_is_identity():
+    assert float(ScalingRule().lr_factor(_ctx())) == 1.0
+
+
+def test_adascale_equals_gain_formula():
+    ctx = _ctx(scale=4.0, sqr=0.01, var=0.04)
+    expected = (0.04 + 0.01) / (0.04 / 4.0 + 0.01)
+    assert float(AdaScale().lr_factor(ctx)) == pytest.approx(expected)
+
+
+def test_adascale_bounds():
+    """gain in [1, scale]: noise-dominated -> scale, signal-dominated
+    -> 1."""
+    noisy = _ctx(scale=8.0, sqr=1e-8, var=1.0)
+    assert float(AdaScale().lr_factor(noisy)) == pytest.approx(
+        8.0, rel=1e-3
+    )
+    clean = _ctx(scale=8.0, sqr=1.0, var=1e-6)
+    assert float(AdaScale().lr_factor(clean)) == pytest.approx(
+        1.0, rel=1e-3
+    )
+
+
+def test_adamscale_is_sqrt_of_adascale():
+    ctx = _ctx(scale=4.0)
+    ada = float(AdaScale().lr_factor(ctx))
+    assert float(AdamScale().lr_factor(ctx)) == pytest.approx(
+        np.sqrt(ada)
+    )
+    assert float(
+        AdamScale(power=0.25).lr_factor(ctx)
+    ) == pytest.approx(ada**0.25)
+
+
+def test_linear_and_sqrt():
+    ctx = _ctx(scale=9.0)
+    assert float(LinearScale().lr_factor(ctx)) == 9.0
+    assert float(SqrtScale().lr_factor(ctx)) == 3.0
+
+
+def test_legw_warmup_ramp_and_plateau():
+    rule = LEGWScale(base_warmup_epochs=2, data_size=1024)
+    scale = 4.0
+    # total warmup steps = 2 * scale * 1024 / (scale*32) = 64.
+    ramp_mid = _ctx(scale=scale, progress=32.0)
+    assert float(rule.lr_factor(ramp_mid)) == pytest.approx(
+        np.sqrt(scale) * 0.5
+    )
+    done = _ctx(scale=scale, progress=1000.0)
+    assert float(rule.lr_factor(done)) == pytest.approx(np.sqrt(scale))
+    start = _ctx(scale=scale, progress=0.0)
+    assert float(rule.lr_factor(start)) == 0.0
+
+
+def test_gain_var_floor_guard():
+    """Zero-variance estimates are floored, keeping gain finite."""
+    ctx = _ctx(scale=4.0, sqr=0.0, var=0.0)
+    factor = float(AdaScale().lr_factor(ctx))
+    assert np.isfinite(factor)
+    assert 1.0 <= factor <= 4.0
