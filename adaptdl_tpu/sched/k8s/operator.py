@@ -94,6 +94,7 @@ class Operator:  # pragma: no cover - requires a live cluster
             self.state, host="0.0.0.0", port=8080
         )
         self.allocator: Allocator | None = None
+        self.expander: ClusterExpander | None = None
 
     async def run(self):
         client, config, watch = _require_k8s()
