@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Run N concurrent elastic jobs under one Pollux allocator (default 2).
+set -euo pipefail
+N="${1:-2}"
+WORK="$(mktemp -d)"
+python - "$N" "$WORK" <<'PY'
+import sys, shutil, os
+from adaptdl_tpu.sched.multi_runner import JobSpec, MultiJobRunner
+
+n, work = int(sys.argv[1]), sys.argv[2]
+pool = [
+    "examples/linear_regression.py",
+    "examples/cifar_resnet18.py",
+    "examples/transformer_lm.py",
+]
+jobs = []
+for i in range(n):
+    ck = os.path.join(work, f"ckpt{i}")
+    os.makedirs(ck, exist_ok=True)
+    jobs.append(JobSpec(
+        name=f"soak/job{i}",
+        script=pool[i % len(pool)],
+        checkpoint_dir=ck,
+    ))
+import jax
+runner = MultiJobRunner(jobs, num_chips=len(jax.devices()))
+print(runner.run())
+PY
