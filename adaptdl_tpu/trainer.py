@@ -192,11 +192,13 @@ class ElasticTrainer:
         )
 
     def train_step(self, atomic_bsz: int, accum_steps: int = 0) -> Callable:
-        """Compiled ``(state, global_batch) -> (state, metrics)``.
+        """Compiled ``(state, global_batch) -> (state, metrics)`` (or
+        ``(state, global_batch, aux) -> ...`` when ``has_aux``).
 
         ``global_batch`` leaves have leading dim
         ``num_replicas * (accum_steps+1) * atomic_bsz`` and should be
-        sharded with ``shard_batch``. Cached per configuration.
+        sharded with ``shard_batch``; ``aux`` is replicated. Cached per
+        configuration.
         """
         key = (atomic_bsz, accum_steps)
         if key not in self._step_cache:
