@@ -1,0 +1,41 @@
+"""Tensor-parallel sharding specs for the model zoo.
+
+Megatron-style layouts expressed as PartitionSpecs over the mesh's
+"model" axis, consumed by ``ElasticTrainer(param_sharding_fn=...)``:
+attention QKV projections split by head (column-parallel), output
+projections split on their input dim (row-parallel), FFN up/down
+likewise. With the trainer's partial-manual step, GSPMD reads these
+layouts off the parameters and inserts the all-gathers/reduce-scatters
+— no hand-written TP collectives in model code (the reference has no
+tensor parallelism at all; SURVEY.md section 2.7).
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from adaptdl_tpu.parallel.mesh import MODEL_AXIS
+
+
+def transformer_tp_specs(path, leaf) -> P:
+    """``param_sharding_fn`` for :class:`TransformerLM`.
+
+    Layout by parameter role:
+    - ``qkv/kernel [d_model, 3, heads, head_dim]`` → heads sharded
+    - ``out/kernel [d_model(=heads*hd), d_model]`` → rows sharded (the
+      head-concat dim), matching the attention output's layout
+    - ``ff_up/kernel [d_model, d_ff]`` → columns sharded
+    - ``ff_down/kernel [d_ff, d_model]`` → rows sharded
+    - embeddings and LayerNorm scales replicated.
+    """
+    keys = [getattr(k, "key", getattr(k, "name", "")) for k in path]
+    joined = "/".join(str(k) for k in keys)
+    if "qkv" in joined and leaf.ndim == 4:
+        return P(None, None, MODEL_AXIS, None)
+    if "attention/out" in joined and leaf.ndim == 2:
+        return P(MODEL_AXIS, None)
+    if "ff_up" in joined and leaf.ndim == 2:
+        return P(None, MODEL_AXIS)
+    if "ff_down" in joined and leaf.ndim == 2:
+        return P(MODEL_AXIS, None)
+    return P()

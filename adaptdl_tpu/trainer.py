@@ -40,7 +40,12 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from adaptdl_tpu import checkpoint, gns
-from adaptdl_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS, create_mesh
+from adaptdl_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    create_mesh,
+)
 from adaptdl_tpu.scaling_rules import RuleContext, ScalingRule
 
 try:  # jax >= 0.6 exposes shard_map at top level
@@ -92,6 +97,14 @@ class ElasticTrainer:
         forwarded to ``loss_fn(params, batch, rng, aux)`` — for
         non-batch data such as a GAN's generator parameters or a
         teacher model's weights.
+      param_sharding_fn: optional ``(path_tuple, leaf) ->
+        PartitionSpec`` assigning tensor-parallel shardings over the
+        mesh's "model" axis. Tensor parallelism runs in GSPMD *auto*
+        mode: the step stays manual over "data"/"seq" (the per-replica
+        gradient access the GNS needs) while XLA propagates the model
+        -axis shardings and inserts the TP collectives — the
+        compiler-first division of labor (manual where the algorithm
+        needs per-device values, automatic where it doesn't).
     """
 
     def __init__(
@@ -106,8 +119,10 @@ class ElasticTrainer:
         smoothing: float = 0.999,
         seed: int = 0,
         has_aux: bool = False,
+        param_sharding_fn: Callable | None = None,
     ):
         self.has_aux = has_aux
+        self.param_sharding_fn = param_sharding_fn
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.init_batch_size = init_batch_size
@@ -153,30 +168,51 @@ class ElasticTrainer:
             return P(DATA_AXIS, SEQ_AXIS)
         return P(DATA_AXIS)
 
-    def init_state(self) -> TrainState:
-        """Fresh TrainState, replicated over the mesh."""
-        params = self._init_params
-        state = TrainState(
-            params=params,
-            opt_state=self.optimizer.init(params),
-            gns=gns.init(params),
-            progress=jnp.zeros((), jnp.float32),
-            step=jnp.zeros((), jnp.int32),
-            rng=jax.random.key(self._seed),
+    def _param_spec_tree(self, params):
+        if self.param_sharding_fn is None:
+            return jax.tree.map(lambda _: P(), params)
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: self.param_sharding_fn(path, leaf), params
         )
-        replicated = NamedSharding(self.mesh, P())
-        # Copy: device_put aliases buffers whose sharding already
-        # matches, and the donated train step would then delete the
-        # caller's initial params out from under a second trainer.
-        state = jax.tree.map(
-            lambda x: jnp.array(x, copy=True)
+
+    def init_state(self) -> TrainState:
+        """Fresh TrainState on the mesh: data-parallel leaves
+        replicated, tensor-parallel params laid out per
+        ``param_sharding_fn``."""
+
+        def put(x, spec):
+            # Copy: device_put aliases buffers whose sharding already
+            # matches, and the donated train step would then delete the
+            # caller's initial params out from under a second trainer.
             if isinstance(x, jax.Array) and not jax.dtypes.issubdtype(
                 x.dtype, jax.dtypes.prng_key
-            )
-            else x,
-            state,
+            ):
+                x = jnp.array(x, copy=True)
+            return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+        specs = self._param_spec_tree(self._init_params)
+        params = jax.tree.map(put, self._init_params, specs)
+        # Optimizer moments follow the params' layout: eager
+        # zeros_like on a sharded array preserves its sharding.
+        opt_state = self.optimizer.init(params)
+        gns_state = gns.init(params)
+        gns_state = gns_state._replace(
+            prev_grad=jax.tree.map(put, gns_state.prev_grad, specs),
+            sqr_biased=put(gns_state.sqr_biased, P()),
+            sqr_unbias=put(gns_state.sqr_unbias, P()),
+            var_biased=put(gns_state.var_biased, P()),
+            var_unbias=put(gns_state.var_unbias, P()),
+            ema_is_biased=put(gns_state.ema_is_biased, P()),
+            prev_grad_valid=put(gns_state.prev_grad_valid, P()),
         )
-        return jax.device_put(state, replicated)
+        return TrainState(
+            params=params,
+            opt_state=opt_state,
+            gns=gns_state,
+            progress=put(jnp.zeros((), jnp.float32), P()),
+            step=put(jnp.zeros((), jnp.int32), P()),
+            rng=put(jax.random.key(self._seed), P()),
+        )
 
     def _precond(self, opt_state):
         if self.precondition != "adam":
@@ -349,11 +385,23 @@ class ElasticTrainer:
         batch_spec = (
             P(DATA_AXIS, SEQ_AXIS) if seq_shards > 1 else P(DATA_AXIS)
         )
+        extra = {}
+        if MODEL_AXIS in self.mesh.shape:
+            # Partial-manual mode: collectives stay manual over the
+            # data (and seq) axes where the GNS needs per-device
+            # values; the model axis remains automatic so GSPMD
+            # propagates the params' tensor-parallel shardings and
+            # inserts the TP collectives itself.
+            manual = {DATA_AXIS}
+            if seq_shards > 1:
+                manual.add(SEQ_AXIS)
+            extra["axis_names"] = manual
         sharded = shard_map(
             per_replica_step,
             mesh=self.mesh,
             in_specs=(P(), batch_spec, P()),
             out_specs=(P(), P()),
+            **extra,
         )
         jitted = jax.jit(sharded, donate_argnums=0)
         if self.has_aux:
@@ -431,11 +479,18 @@ class ElasticTrainer:
         batch_spec = (
             P(DATA_AXIS, SEQ_AXIS) if seq_shards > 1 else P(DATA_AXIS)
         )
+        extra = {}
+        if MODEL_AXIS in self.mesh.shape:
+            manual = {DATA_AXIS}
+            if seq_shards > 1:
+                manual.add(SEQ_AXIS)
+            extra["axis_names"] = manual
         sharded = shard_map(
             per_replica,
             mesh=self.mesh,
             in_specs=(P(), batch_spec, P()),
             out_specs=P(DATA_AXIS),
+            **extra,
         )
         return jax.jit(sharded)
 
