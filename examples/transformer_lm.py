@@ -24,6 +24,7 @@ def main():
     parser.add_argument("--cpu", action="store_true")
     parser.add_argument("--epochs", type=int, default=5)
     parser.add_argument("--seq-shards", type=int, default=1)
+    parser.add_argument("--tp-shards", type=int, default=1)
     parser.add_argument("--seq-len", type=int, default=None)
     args = parser.parse_args()
     if args.cpu:
@@ -69,23 +70,32 @@ def main():
         ).mean()
 
     # ADAPTDL_NUM_REPLICAS counts *data-parallel* replicas; a
-    # seq-sharded group of chips forms one replica, so the chips of
-    # this allocation divide between the two axes.
-    if seq_shards > 1:
+    # seq- or tensor-sharded group of chips forms one replica, so the
+    # chips of this allocation divide between the axes.
+    tp_shards = args.tp_shards
+    group = seq_shards * tp_shards
+    if group > 1:
         import os
 
         chips = int(os.environ["ADAPTDL_NUM_REPLICAS"])
-        data_shards = max(chips // seq_shards, 1)
+        data_shards = max(chips // group, 1)
         os.environ["ADAPTDL_NUM_REPLICAS"] = str(data_shards)
     else:
         data_shards = env.num_replicas()
-    num_devices = data_shards * seq_shards
-    mesh_axes = (
-        {"data": data_shards, "seq": seq_shards}
-        if seq_shards > 1
-        else {"data": data_shards}
-    )
+    num_devices = data_shards * group
+    mesh_axes = {"data": data_shards}
+    if seq_shards > 1:
+        mesh_axes["seq"] = seq_shards
+    if tp_shards > 1:
+        mesh_axes["model"] = tp_shards
     mesh = create_mesh(mesh_axes, devices=jax.devices()[:num_devices])
+    param_sharding_fn = None
+    if tp_shards > 1:
+        from adaptdl_tpu.parallel.tensor_parallel import (
+            transformer_tp_specs,
+        )
+
+        param_sharding_fn = transformer_tp_specs
     trainer = ElasticTrainer(
         loss_fn=loss_fn,
         params=params,
@@ -94,6 +104,7 @@ def main():
         scaling_rule=AdamScale(),
         precondition="adam",
         mesh=mesh,
+        param_sharding_fn=param_sharding_fn,
     )
     holder = {"state": trainer.init_state()}
     ckpt = trainer.make_checkpoint_state(
