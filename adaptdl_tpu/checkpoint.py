@@ -59,6 +59,13 @@ class State:
     def load(self, fileobj: IO[bytes]) -> None:
         raise NotImplementedError
 
+    def commit(self) -> None:
+        """Hook: the checkpoint containing this state's :meth:`save`
+        output is now durably on disk (the registry rename succeeded).
+        The place to prune side-payloads superseded by this save —
+        anything still referenced by an *older* complete checkpoint must
+        not be deleted before this point. Runs on rank 0 only."""
+
     def unregister(self) -> None:
         """Remove this state from the registry (tests, teardown)."""
         _registry.pop(self.name, None)
@@ -127,6 +134,8 @@ def save_all_states() -> None:
     for entry in os.listdir(root):
         if entry.startswith(_TMP_PREFIX):
             shutil.rmtree(os.path.join(root, entry), ignore_errors=True)
+    for state in list(_registry.values()):
+        state.commit()
 
 
 def load_state(state: State) -> bool:

@@ -227,6 +227,13 @@ class AdaptiveDataLoader:
                 )
             return atomic, 0
         num_nodes = env.num_nodes()
+        # Score configurations at the topology that is actually
+        # running: the ring/TP collective terms belong in both sides
+        # of the comparison, and the atomic-bsz memory ceiling scales
+        # with the shard group (each chip holds 1/(sp*tp) of a
+        # microbatch's activations).
+        sp, tp = metrics.active_topology()
+        group = sp * tp
         # The restored config may be infeasible at the new replica
         # count (e.g. global batch beyond max_batch_size after growing
         # the job); then the optimizer's choice is adopted outright.
@@ -236,13 +243,18 @@ class AdaptiveDataLoader:
                 self._local_bsz_bounds is None
                 or self._local_bsz_bounds[0]
                 <= self._atomic_bsz
-                <= self._local_bsz_bounds[1]
+                <= self._local_bsz_bounds[1] * group
             )
             and self.current_batch_size >= self.batch_size
         )
         current_goodput = (
             goodput_fn(
-                num_nodes, num_replicas, self._atomic_bsz, self._accum_steps
+                num_nodes,
+                num_replicas,
+                self._atomic_bsz,
+                self._accum_steps,
+                seq_shards=sp,
+                model_shards=tp,
             )
             if current_feasible
             else 0.0
@@ -253,6 +265,8 @@ class AdaptiveDataLoader:
             max_batch_size=self._max_batch_size,
             atomic_bsz_range=self._local_bsz_bounds,
             accumulation=self._gradient_accumulation,
+            seq_shards=sp,
+            model_shards=tp,
         )
         atomic_bsz = bucket_atomic_bsz(int(atomic_bsz))
         if self._local_bsz_bounds is not None:
@@ -260,11 +274,16 @@ class AdaptiveDataLoader:
                 np.clip(
                     atomic_bsz,
                     self._local_bsz_bounds[0],
-                    self._local_bsz_bounds[1],
+                    self._local_bsz_bounds[1] * group,
                 )
             )
         candidate_goodput = goodput_fn(
-            num_nodes, num_replicas, atomic_bsz, int(accum_steps)
+            num_nodes,
+            num_replicas,
+            atomic_bsz,
+            int(accum_steps),
+            seq_shards=sp,
+            model_shards=tp,
         )
         if candidate_goodput > SPEEDUP_THRESHOLD * current_goodput:
             return atomic_bsz, int(accum_steps)

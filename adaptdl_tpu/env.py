@@ -75,6 +75,22 @@ def num_replicas() -> int:
     return _get_int("ADAPTDL_NUM_REPLICAS", 1)
 
 
+def seq_shards() -> int:
+    """Sequence-parallel shards per replica group (ring attention).
+
+    A seq-sharded group of chips forms ONE data-parallel replica; the
+    scheduler advertises its chosen factorization here and launchers
+    build the mesh accordingly. Not a reference concept — the reference
+    has no parallelism axis beyond data (SURVEY §2.7).
+    """
+    return _get_int("ADAPTDL_SEQ_SHARDS", 1)
+
+
+def model_shards() -> int:
+    """Tensor-parallel shards per replica group (GSPMD model axis)."""
+    return _get_int("ADAPTDL_MODEL_SHARDS", 1)
+
+
 def num_nodes() -> int:
     """Number of slices (the reference's "nodes").
 

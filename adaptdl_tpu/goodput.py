@@ -38,15 +38,34 @@ import scipy.optimize
 class PerfParams(NamedTuple):
     """Fitted performance-model parameters.
 
-    Step-time model (all times in seconds):
+    Step-time model (all times in seconds), for a job factorized as
+    ``dp`` data-parallel replica groups of ``sp x tp``
+    (sequence-parallel x tensor-parallel) chips each:
 
-    - accum step (no sync):  ``T_acc = alpha_c + beta_c * atomic_bsz``
-    - network: ``alpha_n + beta_n * max(replicas - 2, 0)`` when the job
+    - accum step (no grad sync): compute is linear in the *per-chip*
+      share of the replica's microbatch,
+      ``alpha_c + beta_c * atomic_bsz / (sp * tp)``, plus the in-step
+      collectives the shards cost —
+      ring attention's KV rotation ``(sp-1)/sp * (alpha_sp + beta_sp *
+      atomic_bsz / tp)`` and tensor-parallel activation collectives
+      ``(tp-1)/tp * (alpha_tp + beta_tp * atomic_bsz / sp)`` (both ride
+      ICI within the replica group, both appear in compute-only
+      calibration steps because they live inside forward/backward).
+    - gradient sync: ``alpha_n + beta_n * max(dp - 2, 0)`` when the job
       spans slices (DCN bottleneck), ``alpha_r + beta_r * ...`` when it
       is confined to one slice (ICI bottleneck), ~0 for one replica.
     - optim step (with sync): ``(T_acc**gamma + T_net**gamma)**(1/gamma)``
       — gamma in [1, 10] interpolates between no overlap (1) and
       perfect overlap (max, ~10).
+
+    The first 7 fields are the reference's published Pollux model with
+    DCN/ICI in place of inter/intra-node NCCL (reference:
+    adaptdl/adaptdl/goodput.py:31-49); the last 4 price the sp/tp mesh
+    axes the reference does not have, so the scheduler can search
+    (data, seq, model) factorizations on the same fitted surface. They
+    default to 0 (optimistic-until-profiled, the same philosophy as the
+    reference's unidentified-term pinning) and old 7-field checkpoints
+    unpickle into them cleanly.
     """
 
     alpha_c: float
@@ -56,6 +75,10 @@ class PerfParams(NamedTuple):
     alpha_r: float
     beta_r: float
     gamma: float
+    alpha_sp: float = 0.0
+    beta_sp: float = 0.0
+    alpha_tp: float = 0.0
+    beta_tp: float = 0.0
 
 
 class GradParams(NamedTuple):
@@ -71,9 +94,22 @@ class GradParams(NamedTuple):
 # fitting).
 
 
-def _accum_time(xp, params, atomic_bsz):
-    """Forward+backward time: linear in the per-chip batch size."""
-    return params[0] + params[1] * atomic_bsz
+def _accum_time(xp, params, atomic_bsz, seq_shards=1, model_shards=1):
+    """Forward+backward time of one microbatch on one chip.
+
+    Compute divides across the replica group's sp x tp chips; the ring
+    and TP collective terms are the price of that division (zero when
+    the corresponding axis is unsharded).
+    """
+    shards = seq_shards * model_shards
+    compute = params[0] + params[1] * atomic_bsz / shards
+    ring = ((seq_shards - 1) / xp.maximum(seq_shards, 1)) * (
+        params[7] + params[8] * atomic_bsz / model_shards
+    )
+    tp = ((model_shards - 1) / xp.maximum(model_shards, 1)) * (
+        params[9] + params[10] * atomic_bsz / seq_shards
+    )
+    return compute + ring + tp
 
 
 def _network_time(xp, params, num_nodes, num_replicas):
@@ -108,21 +144,61 @@ class GoodputFunction:
         self._grad_params = GradParams(*grad_params)
         self._init_batch_size = init_batch_size
 
-    def __call__(self, num_nodes, num_replicas, atomic_bsz, accum_steps):
-        return self.evaluate(num_nodes, num_replicas, atomic_bsz, accum_steps)
+    def __call__(
+        self,
+        num_nodes,
+        num_replicas,
+        atomic_bsz,
+        accum_steps,
+        seq_shards=1,
+        model_shards=1,
+    ):
+        return self.evaluate(
+            num_nodes,
+            num_replicas,
+            atomic_bsz,
+            accum_steps,
+            seq_shards=seq_shards,
+            model_shards=model_shards,
+        )
 
-    def evaluate(self, num_nodes, num_replicas, atomic_bsz, accum_steps):
+    def evaluate(
+        self,
+        num_nodes,
+        num_replicas,
+        atomic_bsz,
+        accum_steps,
+        seq_shards=1,
+        model_shards=1,
+    ):
+        """num_replicas counts *data-parallel* replica groups; each
+        group spans seq_shards*model_shards chips. sp/tp leave the
+        statistical batch size untouched — they divide the sample, not
+        multiply the samples."""
         batch_size = num_replicas * atomic_bsz * (accum_steps + 1)
         assert np.all(batch_size >= self._init_batch_size)
         return self.throughput(
-            num_nodes, num_replicas, atomic_bsz, accum_steps
+            num_nodes,
+            num_replicas,
+            atomic_bsz,
+            accum_steps,
+            seq_shards=seq_shards,
+            model_shards=model_shards,
         ) * self.efficiency(batch_size)
 
-    def throughput(self, num_nodes, num_replicas, atomic_bsz, accum_steps):
+    def throughput(
+        self,
+        num_nodes,
+        num_replicas,
+        atomic_bsz,
+        accum_steps,
+        seq_shards=1,
+        model_shards=1,
+    ):
         """Samples/second: an iteration is accum_steps silent accumulation
         micro-steps plus one optim step that includes the gradient sync."""
         p = self._perf_params
-        t_acc = _accum_time(np, p, atomic_bsz)
+        t_acc = _accum_time(np, p, atomic_bsz, seq_shards, model_shards)
         t_net = _network_time(np, p, num_nodes, num_replicas)
         t_opt = np.exp(_log_optim_time(np, p, t_acc, t_net))
         iter_time = accum_steps * t_acc + t_opt
@@ -145,13 +221,19 @@ class GoodputFunction:
         atomic_bsz_range=None,
         accumulation: bool = False,
         num_candidates: int = 50,
+        seq_shards: int = 1,
+        model_shards: int = 1,
     ):
-        """Best (goodput, atomic_bsz, accum_steps) per allocation.
+        """Best (goodput, atomic_bsz, accum_steps) per allocation, at a
+        *fixed* (seq_shards, model_shards) topology.
 
         Vectorized over broadcastable ``num_nodes``/``num_replicas``:
         candidate global batch sizes are sampled geometrically between
         the feasible minimum and ``max_batch_size``, converted to
-        per-chip (atomic_bsz, accum_steps) pairs, and scored.
+        per-chip (atomic_bsz, accum_steps) pairs, and scored. The
+        atomic-bsz memory ceiling scales with the shard count — an
+        sp x tp group holds only ``1/(sp*tp)`` of each microbatch's
+        activations per chip.
         """
         num_nodes = np.asarray(num_nodes)
         num_replicas = np.asarray(num_replicas)
@@ -163,6 +245,9 @@ class GoodputFunction:
         min_atomic, max_atomic = atomic_bsz_range or (None, None)
         min_atomic = min_atomic or 1
         max_atomic = max_atomic or max_batch_size
+        group = seq_shards * model_shards
+        if group > 1:
+            max_atomic = max_atomic * group
 
         shape = np.broadcast_shapes(num_nodes.shape, num_replicas.shape)
         scalar_out = shape == ()
@@ -196,7 +281,14 @@ class GoodputFunction:
             )
         atomic_bsz = np.clip(atomic_bsz, min_atomic, max_atomic).astype(int)
 
-        goodput = self.evaluate(nodes, replicas, atomic_bsz, accum_steps)
+        goodput = self.evaluate(
+            nodes,
+            replicas,
+            atomic_bsz,
+            accum_steps,
+            seq_shards=seq_shards,
+            model_shards=model_shards,
+        )
         best = np.argmax(goodput, axis=0)
         cols = np.arange(goodput.shape[1])
         goodput = goodput[best, cols].reshape(shape)
@@ -206,6 +298,98 @@ class GoodputFunction:
             return goodput.item(), atomic_bsz.item(), accum_steps.item()
         return goodput, atomic_bsz, accum_steps
 
+    def optimize_topology(
+        self,
+        num_nodes,
+        num_chips,
+        max_batch_size=None,
+        atomic_bsz_range=None,
+        accumulation: bool = False,
+        num_candidates: int = 50,
+        max_seq_shards: int = 1,
+        max_model_shards: int = 1,
+    ):
+        """Best configuration over (data, seq, model) factorizations.
+
+        ``num_chips`` counts total chips in the allocation; every
+        power-of-two factorization ``chips = dp * sp * tp`` with
+        ``sp <= max_seq_shards``, ``tp <= max_model_shards`` and at
+        least one replica group per spanned slice is scored with
+        :meth:`optimize` and the argmax wins. This is the search the
+        reference never needed — its only axis is data parallelism
+        (reference: adaptdl/adaptdl/goodput.py:88-148 searches batch
+        geometry at fixed parallelism) — and it is what lets a
+        long-context job with a tight ``max_batch_size`` keep using
+        chips past its statistical-efficiency cliff: extra chips go to
+        sequence/model shards instead of more replicas.
+
+        Returns ``(goodput, atomic_bsz, accum_steps, seq_shards,
+        model_shards)``, vectorized like :meth:`optimize`.
+        """
+        num_nodes = np.asarray(num_nodes)
+        num_chips = np.asarray(num_chips)
+        shape = np.broadcast_shapes(num_nodes.shape, num_chips.shape)
+        scalar_out = shape == ()
+        nodes = np.broadcast_to(num_nodes, shape).ravel()
+        chips = np.broadcast_to(num_chips, shape).ravel()
+
+        def pow2s(limit):
+            out, v = [], 1
+            while v <= limit:
+                out.append(v)
+                v *= 2
+            return out
+
+        factorizations = [
+            (sp, tp)
+            for sp in pow2s(max(int(max_seq_shards), 1))
+            for tp in pow2s(max(int(max_model_shards), 1))
+        ]
+        results = []
+        for sp, tp in factorizations:
+            group = sp * tp
+            dp = chips // group
+            valid = (dp * group == chips) & (dp >= np.maximum(nodes, 1))
+            # Placeholder dp=1 keeps optimize()'s vectorized call well
+            # formed for invalid rows; their goodput is masked to 0.
+            dp_safe = np.where(valid, np.maximum(dp, 1), 1)
+            nodes_safe = np.where(valid, np.maximum(nodes, 1), 1)
+            g, ab, ac = self.optimize(
+                nodes_safe,
+                dp_safe,
+                max_batch_size=max_batch_size,
+                atomic_bsz_range=atomic_bsz_range,
+                accumulation=accumulation,
+                num_candidates=num_candidates,
+                seq_shards=sp,
+                model_shards=tp,
+            )
+            g = np.where(valid, np.atleast_1d(g), 0.0)
+            results.append(
+                (g, np.atleast_1d(ab), np.atleast_1d(ac), sp, tp)
+            )
+        all_g = np.stack([r[0] for r in results])
+        best = np.argmax(all_g, axis=0)
+        cols = np.arange(all_g.shape[1])
+        goodput = all_g[best, cols].reshape(shape)
+        atomic_bsz = np.stack([r[1] for r in results])[best, cols].reshape(
+            shape
+        )
+        accum_steps = np.stack([r[2] for r in results])[
+            best, cols
+        ].reshape(shape)
+        sps = np.array([r[3] for r in results])[best].reshape(shape)
+        tps = np.array([r[4] for r in results])[best].reshape(shape)
+        if scalar_out:
+            return (
+                goodput.item(),
+                atomic_bsz.item(),
+                accum_steps.item(),
+                sps.item(),
+                tps.item(),
+            )
+        return goodput, atomic_bsz, accum_steps, sps, tps
+
 
 def _fit_objective(
     jnp,
@@ -213,6 +397,8 @@ def _fit_objective(
     num_nodes,
     num_replicas,
     atomic_bsz,
+    seq_shards,
+    model_shards,
     accum_time,
     optim_time,
     weight,
@@ -221,7 +407,7 @@ def _fit_objective(
     priors. ``weight`` masks padding rows (inputs are padded to bucket
     sizes so the jitted objective compiles once per bucket, not once
     per new profile entry)."""
-    pred_acc = _accum_time(jnp, params, atomic_bsz)
+    pred_acc = _accum_time(jnp, params, atomic_bsz, seq_shards, model_shards)
     pred_net = _network_time(jnp, params, num_nodes, num_replicas)
     pred_log_opt = _log_optim_time(jnp, params, pred_acc, pred_net)
     total = jnp.sum(weight)
@@ -265,7 +451,13 @@ def _get_jitted_objective():
 
 
 def fit_perf_params(
-    num_nodes, num_replicas, atomic_bsz, accum_step_time, optim_step_time
+    num_nodes,
+    num_replicas,
+    atomic_bsz,
+    accum_step_time,
+    optim_step_time,
+    seq_shards=None,
+    model_shards=None,
 ) -> PerfParams:
     """Fit PerfParams to profiled timings via L-BFGS-B + jax.grad.
 
@@ -273,7 +465,10 @@ def fit_perf_params(
     pinned (e.g. DCN terms without any multi-slice measurements), which
     keeps the speedup model optimistic about unexplored allocations so
     the scheduler will actually try them (reference behavior:
-    adaptdl/adaptdl/goodput.py:175-194).
+    adaptdl/adaptdl/goodput.py:175-194). Unprofiled ring/TP terms get
+    an ICI-latency prior rather than zero — sharding an axis is never
+    entirely free, so the topology search cannot runaway-shard on pure
+    optimism.
     """
     import jax
     import jax.numpy as jnp
@@ -283,10 +478,19 @@ def fit_perf_params(
     atomic_bsz = np.asarray(atomic_bsz, dtype=float)
     accum_step_time = np.asarray(accum_step_time, dtype=float)
     optim_step_time = np.asarray(optim_step_time, dtype=float)
+    if seq_shards is None:
+        seq_shards = np.ones_like(num_nodes)
+    if model_shards is None:
+        model_shards = np.ones_like(num_nodes)
+    seq_shards = np.asarray(seq_shards, dtype=float)
+    model_shards = np.asarray(model_shards, dtype=float)
 
-    init = np.array([1e-1, 1e-2, 1e-1, 1e-2, 1e-1, 1e-2, 1.0 + 1e-3])
-    lower = np.array([1e-8, 1e-8, 1e-8, 1e-8, 1e-8, 1e-8, 1.0])
-    upper = np.array([np.inf] * 6 + [10.0])
+    init = np.array(
+        [1e-1, 1e-2, 1e-1, 1e-2, 1e-1, 1e-2, 1.0 + 1e-3]
+        + [1e-2, 1e-3, 1e-2, 1e-3]
+    )
+    lower = np.array([1e-8] * 6 + [1.0] + [1e-8] * 4)
+    upper = np.array([np.inf] * 6 + [10.0] + [np.inf] * 4)
 
     if len(np.unique(atomic_bsz)) == 1:
         # One observed batch size can't separate the constant and linear
@@ -301,6 +505,14 @@ def fit_perf_params(
     if not np.any(num_replicas > 2):
         init[3] = upper[3] = lower[3]  # retrogression unidentifiable
         init[5] = upper[5] = lower[5]
+    sp_observed = bool(np.any(seq_shards > 1))
+    tp_observed = bool(np.any(model_shards > 1))
+    if not sp_observed:
+        init[7] = upper[7] = lower[7]  # ring terms unidentifiable
+        init[8] = upper[8] = lower[8]
+    if not tp_observed:
+        init[9] = upper[9] = lower[9]  # TP terms unidentifiable
+        init[10] = upper[10] = lower[10]
 
     # Pad observations to the next power-of-two bucket: the jitted
     # objective then compiles once per bucket instead of once per new
@@ -322,6 +534,8 @@ def fit_perf_params(
                 _pad(num_nodes, 1),
                 _pad(num_replicas, 1),
                 _pad(atomic_bsz, 1),
+                _pad(seq_shards, 1),
+                _pad(model_shards, 1),
                 _pad(accum_step_time, 1),
                 _pad(optim_step_time, 1),
                 weight,
@@ -348,4 +562,11 @@ def fit_perf_params(
         # Prior: crossing DCN is never cheaper than staying on ICI.
         params[2] = max(params[2], params[4] * 1.1)
         params[3] = max(params[3], params[5] * 1.1)
+    # Priors for unprofiled sharding axes: a ring hop / TP collective
+    # costs at least the fitted ICI latency — optimistic enough that
+    # the scheduler will try the axis, never literally free.
+    if not sp_observed:
+        params[7] = max(params[7], params[4])
+    if not tp_observed:
+        params[9] = max(params[9], params[4])
     return PerfParams(*params)

@@ -62,9 +62,15 @@ class _ProfileEntry:
 
 @dataclass
 class MetricsState:
-    """Everything the adaptation engine knows about this job so far."""
+    """Everything the adaptation engine knows about this job so far.
 
-    profile: dict[tuple[int, int, int], _ProfileEntry] = field(
+    Profile keys are ``(num_nodes, num_replicas, seq_shards,
+    model_shards, atomic_bsz)`` — the reference's (nodes, replicas,
+    bsz) keying (reference: _metrics.py:29-66) extended with the two
+    sharding axes so the fit can identify the ring/TP collective terms.
+    """
+
+    profile: dict[tuple[int, int, int, int, int], _ProfileEntry] = field(
         default_factory=lambda: defaultdict(_ProfileEntry)
     )
     perf_params: PerfParams | None = None
@@ -74,6 +80,8 @@ class MetricsState:
     local_bsz_bounds: tuple[int, int] | None = None
     gradient_accumulation: bool = False
     max_profiled_replicas: int = 0
+    max_seq_shards: int = 1
+    max_model_shards: int = 1
     progress: float = 0.0
 
 
@@ -81,16 +89,40 @@ _state = MetricsState()
 _last_fit_time: float | None = None
 _profile_lock = threading.Lock()
 _fit_thread: threading.Thread | None = None
+_active_topology: tuple[int, int] | None = None
 
 
 def _reset_state() -> None:
     """Test isolation."""
-    global _state, _last_fit_time, _fit_thread
+    global _state, _last_fit_time, _fit_thread, _active_topology
     if _fit_thread is not None and _fit_thread.is_alive():
         _fit_thread.join(timeout=60)
     _state = MetricsState()
     _last_fit_time = None
     _fit_thread = None
+    _active_topology = None
+
+
+def set_active_topology(seq_shards: int, model_shards: int) -> None:
+    """Registered by the trainer with the (sp, tp) its mesh actually
+    has. Profiles and batch decisions key on THIS, never on the
+    scheduler's requested ADAPTDL_SEQ_SHARDS — a job is free to build
+    a different mesh (e.g. CLI flags), and mis-keyed timings would
+    teach the fit ring/TP terms from measurements that never ran
+    those collectives."""
+    global _active_topology
+    _active_topology = (
+        max(int(seq_shards), 1),
+        max(int(model_shards), 1),
+    )
+
+
+def active_topology() -> tuple[int, int]:
+    """The training process's live (seq_shards, model_shards):
+    whatever the trainer registered, else the scheduler's request."""
+    if _active_topology is not None:
+        return _active_topology
+    return (env.seq_shards(), env.model_shards())
 
 
 def current_state() -> MetricsState:
@@ -109,9 +141,25 @@ def set_batch_size_config(
     _state.gradient_accumulation = gradient_accumulation
 
 
+def set_topology_config(
+    max_seq_shards: int = 1, max_model_shards: int = 1
+) -> None:
+    """Advertise how far this job can shard each sample (sequence
+    shards need ring attention in the model; model shards need a
+    param_sharding_fn). The scheduler's topology search stays within
+    these limits."""
+    _state.max_seq_shards = max(int(max_seq_shards), 1)
+    _state.max_model_shards = max(int(max_model_shards), 1)
+
+
+def _profile_key(atomic_bsz: int) -> tuple[int, int, int, int, int]:
+    sp, tp = active_topology()
+    return (env.num_nodes(), env.num_replicas(), sp, tp, atomic_bsz)
+
+
 def profile_accum_time(atomic_bsz: int, accum_time: float) -> None:
     """Record a compute-only (no-sync) calibration measurement."""
-    key = (env.num_nodes(), env.num_replicas(), atomic_bsz)
+    key = _profile_key(atomic_bsz)
     with _profile_lock:
         entry = _state.profile[key]
         entry.accum_time_sum += accum_time
@@ -126,7 +174,7 @@ def profile_step(
     The optim-time observation is the step time minus the modelled
     accumulation micro-steps, clamped to stay positive.
     """
-    key = (env.num_nodes(), env.num_replicas(), atomic_bsz)
+    key = _profile_key(atomic_bsz)
     with _profile_lock:
         entry = _state.profile[key]
         if accum_steps > 0 and entry.accum_count > 0:
@@ -138,8 +186,14 @@ def profile_step(
             optim_time = step_time
         entry.optim_time_sum += optim_time
         entry.optim_count += 1
+        # The allocator's 2x scale-up gate works in CHIPS (the policy's
+        # replica axis is chips once topology search is in play), so
+        # profiled coverage must count chips too: a dp=1 x sp=8 run has
+        # profiled 8 chips, not 1 replica — otherwise sp-factorized
+        # jobs would be permanently capped at 2 chips.
+        sp, tp = active_topology()
         _state.max_profiled_replicas = max(
-            _state.max_profiled_replicas, env.num_replicas()
+            _state.max_profiled_replicas, env.num_replicas() * sp * tp
         )
     _maybe_fit_and_report()
 
@@ -154,13 +208,15 @@ def update_progress(progress: float) -> None:
 
 
 def _fit() -> PerfParams | None:
-    nodes, replicas, bszs, accum_times, optim_times = [], [], [], [], []
+    nodes, replicas, bszs = [], [], []
+    sps, tps = [], []
+    accum_times, optim_times = [], []
     with _profile_lock:
         snapshot = [
             (key, _ProfileEntry(**vars(entry)))
             for key, entry in _state.profile.items()
         ]
-    for (n, r, bsz), entry in snapshot:
+    for (n, r, sp, tp, bsz), entry in snapshot:
         if entry.optim_count == 0:
             continue
         # A missing calibration falls back to the optim time, which
@@ -171,12 +227,22 @@ def _fit() -> PerfParams | None:
             accum = entry.optim_time_sum / entry.optim_count
         nodes.append(n)
         replicas.append(r)
+        sps.append(sp)
+        tps.append(tp)
         bszs.append(bsz)
         accum_times.append(accum)
         optim_times.append(entry.optim_time_sum / entry.optim_count)
     if not nodes:
         return None
-    return fit_perf_params(nodes, replicas, bszs, accum_times, optim_times)
+    return fit_perf_params(
+        nodes,
+        replicas,
+        bszs,
+        accum_times,
+        optim_times,
+        seq_shards=sps,
+        model_shards=tps,
+    )
 
 
 def _maybe_fit_and_report(
@@ -236,6 +302,8 @@ def fit_and_report_now() -> None:
     hints["maxBatchSize"] = _state.max_batch_size
     hints["maxProfiledReplicas"] = _state.max_profiled_replicas
     hints["gradientAccumulation"] = _state.gradient_accumulation
+    hints["maxSeqShards"] = _state.max_seq_shards
+    hints["maxModelShards"] = _state.max_model_shards
     if _state.grad_params is not None:
         hints["gradParams"] = dict(_state.grad_params._asdict())
     if _state.perf_params is not None:
@@ -281,6 +349,8 @@ class _MetricsCheckpoint(checkpoint.State):
             "local_bsz_bounds": _state.local_bsz_bounds,
             "gradient_accumulation": _state.gradient_accumulation,
             "max_profiled_replicas": _state.max_profiled_replicas,
+            "max_seq_shards": _state.max_seq_shards,
+            "max_model_shards": _state.max_model_shards,
             "progress": _state.progress,
         }
         pickle.dump(payload, fileobj)
@@ -288,7 +358,11 @@ class _MetricsCheckpoint(checkpoint.State):
     def load(self, fileobj):
         payload = pickle.load(fileobj)
         profile = defaultdict(_ProfileEntry)
-        profile.update(payload["profile"])
+        for key, entry in payload["profile"].items():
+            if len(key) == 3:  # pre-sp/tp checkpoint: (n, r, bsz)
+                n, r, bsz = key
+                key = (n, r, 1, 1, bsz)
+            profile[key] = entry
         _state.profile = profile
         _state.perf_params = payload["perf_params"]
         _state.grad_params = payload["grad_params"]
@@ -297,6 +371,8 @@ class _MetricsCheckpoint(checkpoint.State):
         _state.local_bsz_bounds = payload["local_bsz_bounds"]
         _state.gradient_accumulation = payload["gradient_accumulation"]
         _state.max_profiled_replicas = payload["max_profiled_replicas"]
+        _state.max_seq_shards = payload.get("max_seq_shards", 1)
+        _state.max_model_shards = payload.get("max_model_shards", 1)
         _state.progress = payload["progress"]
 
 

@@ -52,6 +52,8 @@ def job_info_from_hints(
             max_batch_size=hints.get("maxBatchSize"),
             atomic_bsz_range=tuple(bounds) if bounds else None,
             accumulation=bool(hints.get("gradientAccumulation")),
+            max_seq_shards=int(hints.get("maxSeqShards") or 1),
+            max_model_shards=int(hints.get("maxModelShards") or 1),
         )
         profiled = int(hints.get("maxProfiledReplicas") or 1)
         # Profiling gates scale-up: at most double what was measured.
@@ -111,10 +113,23 @@ class Allocator:
             self._expander.request(desired)
         for key, alloc in allocations.items():
             record = self._state.get_job(key)
-            if record is not None and record.allocation != alloc:
-                LOG.info("allocation %s: %s -> %s", key,
-                         record.allocation, alloc)
-                self._state.update(key, allocation=alloc)
+            if record is None:
+                continue
+            # Publish the factorization behind this allocation's
+            # speedup so the launcher can build the matching mesh.
+            topology = None
+            best_config = getattr(
+                jobs[key].speedup_fn, "best_config", None
+            )
+            if best_config is not None and alloc:
+                _, _, sp, tp = best_config(len(set(alloc)), len(alloc))
+                topology = {"seqShards": sp, "modelShards": tp}
+            if record.allocation != alloc or record.topology != topology:
+                LOG.info("allocation %s: %s -> %s (topology %s)", key,
+                         record.allocation, alloc, topology)
+                self._state.update(
+                    key, allocation=alloc, topology=topology
+                )
         return allocations
 
     def start(self) -> None:

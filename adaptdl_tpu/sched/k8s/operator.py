@@ -181,6 +181,21 @@ class Operator:  # pragma: no cover - requires a live cluster
                     LOG.exception("reconcile failed for %s", key)
             await asyncio.sleep(interval)
 
+    @staticmethod
+    def _launch_fingerprint(record) -> str:
+        """Identity of the (allocation, topology) pair a worker pod was
+        launched with; any change — including a same-size allocation on
+        different pools or a topology-only refit — must restart the
+        group (reference analog: controller.py:310-318 compares pod
+        annotations against status.allocation)."""
+        import hashlib
+        import json
+
+        payload = json.dumps(
+            [list(record.allocation), record.topology], sort_keys=True
+        )
+        return hashlib.sha1(payload.encode()).hexdigest()[:12]
+
     async def _reconcile_job(self, api, core, key, record):
         namespace, name = key.split("/", 1)
         selector = f"adaptdl/job={name}"
@@ -193,7 +208,12 @@ class Operator:  # pragma: no cover - requires a live cluster
         def pod_group(pod):
             return int(pod.metadata.annotations.get("adaptdl/group", -1))
 
-        drifted = any(pod_group(p) != record.group for p in live)
+        fingerprint = self._launch_fingerprint(record)
+        drifted = any(
+            pod_group(p) != record.group
+            or p.metadata.annotations.get("adaptdl/config") != fingerprint
+            for p in live
+        )
         failed = []
         for pod in live:
             for status in pod.status.container_statuses or []:
@@ -251,6 +271,18 @@ class Operator:  # pragma: no cover - requires a live cluster
                     "http://adaptdl-supervisor:8080",
                 ),
             },
+            {
+                "name": "ADAPTDL_SEQ_SHARDS",
+                "value": str(
+                    (record.topology or {}).get("seqShards", 1)
+                ),
+            },
+            {
+                "name": "ADAPTDL_MODEL_SHARDS",
+                "value": str(
+                    (record.topology or {}).get("modelShards", 1)
+                ),
+            },
         ]
         for container in containers:
             container.setdefault("env", []).extend(env)
@@ -268,6 +300,7 @@ class Operator:  # pragma: no cover - requires a live cluster
                 "annotations": {
                     "adaptdl/group": str(record.group),
                     "adaptdl/rank": str(rank),
+                    "adaptdl/config": self._launch_fingerprint(record),
                 },
             },
             "spec": spec,

@@ -87,7 +87,9 @@ class LocalElasticRunner:
             interval=allocator_interval,
         )
 
-    def _job_env(self, num_replicas: int) -> dict:
+    def _job_env(
+        self, num_replicas: int, topology: dict | None
+    ) -> dict:
         env = dict(os.environ)
         env.update(self.extra_env)
         env.update(
@@ -104,6 +106,9 @@ class LocalElasticRunner:
                 "ADAPTDL_SUPERVISOR_URL": self.supervisor.url,
             }
         )
+        topology = topology or {}
+        env["ADAPTDL_SEQ_SHARDS"] = str(topology.get("seqShards", 1))
+        env["ADAPTDL_MODEL_SHARDS"] = str(topology.get("modelShards", 1))
         return env
 
     def run(self) -> int:
@@ -119,22 +124,25 @@ class LocalElasticRunner:
                     self.job_name, allocation=["local"] * initial
                 )
             while True:
-                allocation = list(
-                    self.state.get_allocation(self.job_name)
+                allocation, topology = self.state.get_launch_config(
+                    self.job_name
                 )
                 num_replicas = max(len(allocation), 1)
                 LOG.info(
-                    "starting %s: replicas=%d restarts=%d",
+                    "starting %s: replicas=%d restarts=%d topology=%s",
                     self.job_name,
                     num_replicas,
                     self.restarts,
+                    topology,
                 )
                 self.state.update(self.job_name, status="Running")
                 proc = subprocess.Popen(
                     [sys.executable, self.script],
-                    env=self._job_env(num_replicas),
+                    env=self._job_env(num_replicas, topology),
                 )
-                code, signalled = self._supervise(proc, allocation)
+                code, signalled = self._supervise(
+                    proc, allocation, topology
+                )
                 if code == 0:
                     self.state.update(self.job_name, status="Succeeded")
                     return 0
@@ -163,23 +171,34 @@ class LocalElasticRunner:
             self.allocator.stop()
             self.supervisor.stop()
 
-    def _supervise(self, proc: subprocess.Popen, allocation):
-        """Wait for the process; SIGTERM it if the allocation moves,
-        escalating to SIGKILL if the grace period expires. Returns
-        (exit_code, we_signalled_it)."""
+    def _supervise(
+        self, proc: subprocess.Popen, allocation, topology=None
+    ):
+        """Wait for the process; SIGTERM it if the allocation or the
+        chosen topology moves, escalating to SIGKILL if the grace
+        period expires. Returns (exit_code, we_signalled_it)."""
         signalled = False
         term_deadline = None
         while True:
             code = proc.poll()
             if code is not None:
                 return code, signalled
-            current = self.state.get_allocation(self.job_name) or []
-            if not signalled and list(current) != list(allocation):
+            current, cur_topology = self.state.get_launch_config(
+                self.job_name
+            )
+            drifted = list(current) != list(allocation) or (
+                # Topology-only drift (same chips, new sp/tp): the
+                # running mesh no longer matches the scheduler's
+                # accounting, so rescale for it too.
+                cur_topology or {}
+            ) != (topology or {})
+            if not signalled and drifted:
                 LOG.info(
-                    "allocation drift %s -> %s: requesting graceful "
-                    "rescale",
+                    "drift %s/%s -> %s/%s: requesting graceful rescale",
                     allocation,
+                    topology,
                     current,
+                    cur_topology,
                 )
                 proc.send_signal(signal.SIGTERM)
                 signalled = True

@@ -89,7 +89,9 @@ class MultiJobRunner:
 
     # -- per-job lifecycle (one thread each) --------------------------
 
-    def _job_env(self, job: JobSpec, num_replicas: int) -> dict:
+    def _job_env(
+        self, job: JobSpec, num_replicas: int, topology: dict | None
+    ) -> dict:
         env = dict(os.environ)
         env.update(job.extra_env)
         env.update(
@@ -110,12 +112,19 @@ class MultiJobRunner:
                 "ADAPTDL_SUPERVISOR_URL": self.supervisor.url,
             }
         )
+        topology = topology or {}
+        env["ADAPTDL_SEQ_SHARDS"] = str(topology.get("seqShards", 1))
+        env["ADAPTDL_MODEL_SHARDS"] = str(
+            topology.get("modelShards", 1)
+        )
         return env
 
     def _run_job(self, job: JobSpec) -> None:
         failures = 0
         while True:
-            allocation = self.state.get_allocation(job.name) or []
+            allocation, topology = self.state.get_launch_config(
+                job.name
+            )
             if not allocation:
                 # Wait until the allocator gives this job chips.
                 self.state.wait_for(
@@ -125,17 +134,20 @@ class MultiJobRunner:
                 continue
             num_replicas = len(allocation)
             LOG.info(
-                "starting %s: replicas=%d restarts=%d",
+                "starting %s: replicas=%d restarts=%d topology=%s",
                 job.name,
                 num_replicas,
                 self.restart_counts[job.name],
+                topology,
             )
             self.state.update(job.name, status="Running")
             proc = subprocess.Popen(
                 [sys.executable, job.script],
-                env=self._job_env(job, num_replicas),
+                env=self._job_env(job, num_replicas, topology),
             )
-            code, signalled = self._supervise(proc, job, allocation)
+            code, signalled = self._supervise(
+                proc, job, allocation, topology
+            )
             if code == 0:
                 self.state.update(job.name, status="Succeeded")
                 self.exit_codes[job.name] = 0
@@ -159,20 +171,30 @@ class MultiJobRunner:
                 return
             self.restart_counts[job.name] += 1
 
-    def _supervise(self, proc, job, allocation):
+    def _supervise(self, proc, job, allocation, topology=None):
         signalled = False
         term_deadline = None
         while True:
             code = proc.poll()
             if code is not None:
                 return code, signalled
-            current = self.state.get_allocation(job.name) or []
-            if not signalled and list(current) != list(allocation):
+            current, cur_topology = self.state.get_launch_config(
+                job.name
+            )
+            drifted = list(current) != list(allocation) or (
+                # A topology-only change (same chips, new sp/tp) also
+                # requires a rescale: the running mesh no longer
+                # matches what the scheduler is accounting for.
+                cur_topology or {}
+            ) != (topology or {})
+            if not signalled and drifted:
                 LOG.info(
-                    "%s allocation drift %d -> %d replicas",
+                    "%s drift: %d -> %d replicas, topology %s -> %s",
                     job.name,
                     len(allocation),
                     len(current),
+                    topology,
+                    cur_topology,
                 )
                 proc.send_signal(signal.SIGTERM)
                 signalled = True

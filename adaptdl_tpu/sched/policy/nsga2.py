@@ -88,7 +88,7 @@ def minimize(
     initial: np.ndarray,
     crossover: Callable[[np.ndarray, np.ndarray, np.random.Generator], np.ndarray],
     mutate: Callable[[np.ndarray, np.random.Generator], np.ndarray],
-    repair: Callable[[np.ndarray], np.ndarray],
+    repair: Callable[[np.ndarray, np.random.Generator], np.ndarray],
     pop_size: int = 100,
     generations: int = 100,
     seed: int = 0,
@@ -99,15 +99,16 @@ def minimize(
     - evaluate(pop) -> (n, n_obj) objectives to minimize
     - crossover(parents_a, parents_b, rng) -> children
     - mutate(pop, rng) -> pop
-    - repair(pop) -> pop (feasibility projection)
+    - repair(pop, rng) -> pop (feasibility projection; rng so any
+      tie-breaking randomness differs per generation)
     """
     rng = np.random.default_rng(seed)
-    pop = repair(np.asarray(initial))
+    pop = repair(np.asarray(initial), rng)
     if pop.shape[0] < pop_size:
         # Fill by mutating copies of the seeds.
         reps = -(-pop_size // pop.shape[0])
         pop = np.concatenate([pop] * reps, axis=0)[:pop_size]
-        pop[1:] = repair(mutate(pop[1:], rng))
+        pop[1:] = repair(mutate(pop[1:], rng), rng)
     F = evaluate(pop)
 
     for _ in range(generations):
@@ -124,7 +125,7 @@ def minimize(
         parents_a = pop[tournament(pop_size)]
         parents_b = pop[tournament(pop_size)]
         children = crossover(parents_a, parents_b, rng)
-        children = repair(mutate(children, rng))
+        children = repair(mutate(children, rng), rng)
         child_F = evaluate(children)
         merged = np.concatenate([pop, children], axis=0)
         merged_F = np.concatenate([F, child_F], axis=0)

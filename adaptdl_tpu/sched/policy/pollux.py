@@ -217,7 +217,9 @@ def _select_within_budget(values, max_nodes):
     feasible = values[:, 1] <= max_nodes
     if not feasible.any():
         return None
-    score = np.where(feasible, values[:, 0], 0.0)
+    # Infeasible solutions must never win the argmin, even when every
+    # feasible score is exactly 0 (negated speedups are <= 0).
+    score = np.where(feasible, values[:, 0], np.inf)
     return int(np.argmin(score))
 
 
@@ -342,24 +344,29 @@ class _Problem:
         states[hit] = draw[hit]
         return states.reshape(states.shape[0], -1)
 
-    def repair(self, flat_pop):
+    def repair(self, flat_pop, rng=None):
         """Project arbitrary matrices onto the feasible set."""
+        if rng is None:
+            rng = np.random.default_rng(0)
         states = flat_pop.reshape(-1, *self.shape).copy()
         pop = states.shape[0]
         # Pinned jobs keep their base allocation verbatim.
         states[:, self._pinned] = self.base_state[self._pinned]
         # A distributed job owns its slices' ICI: on every slice, keep
         # only the first distributed job (in the sorted priority
-        # order), clearing later claimants.
-        distributed = (np.count_nonzero(states, axis=2) > 1)[:, :, None]
+        # order), clearing later claimants. "Distributed" = more than
+        # one replica anywhere — even a single-slice 2-replica job
+        # psums over its slice's ICI, so it may not share the slice
+        # with another multi-replica job.
+        distributed = (states.sum(axis=2) > 1)[:, :, None]
         claims = (states > 0) & distributed
         later_claim = claims.cumsum(axis=1) > 1
         states[later_claim & claims] = 0
         # Per-job replica ceiling: greedily keep replicas in a random
-        # node order so no single column is systematically favored.
-        shuffled = np.argsort(
-            np.random.default_rng(0).random(states.shape), axis=2
-        )
+        # node order so no single column is systematically favored —
+        # drawn from the GA's rng so the shuffle actually varies
+        # across repairs rather than repeating one fixed permutation.
+        shuffled = np.argsort(rng.random(states.shape), axis=2)
         inverse = np.argsort(shuffled, axis=2)
         shuffled_states = np.take_along_axis(states, shuffled, axis=2)
         running = shuffled_states.cumsum(axis=2)

@@ -1,12 +1,21 @@
 """Speedup function: a job's goodput normalized by its base goodput.
 
 Wraps a fitted :class:`adaptdl_tpu.goodput.GoodputFunction` as
-``speedup(num_nodes, num_replicas)``, the quantity the Pollux policy
+``speedup(num_nodes, num_chips)``, the quantity the Pollux policy
 sums across jobs. Because the genetic search evaluates the same small
-set of (slices, replicas) points thousands of times per cycle, results
+set of (slices, chips) points thousands of times per cycle, results
 are cached in a dense table and computed lazily on first use
 (reference: sched/adaptdl_sched/policy/speedup.py:27-70 — the memo
 design here differs: a dict-of-computed-points with vectorized fill).
+
+Topology: when the job advertises ``maxSeqShards``/``maxModelShards``
+> 1, every chip count is scored by
+:meth:`GoodputFunction.optimize_topology` — the best (data, seq,
+model) factorization of those chips — so the policy's integer "replica"
+axis transparently becomes a *chip* axis and sequence/tensor-parallel
+configurations compete inside the same speedup number. The chosen
+factorization per point is kept for the allocator to publish
+(:meth:`best_config`).
 """
 
 from __future__ import annotations
@@ -21,20 +30,43 @@ class SpeedupFunction:
         max_batch_size: int | None = None,
         atomic_bsz_range: tuple[int, int] | None = None,
         accumulation: bool = False,
+        max_seq_shards: int = 1,
+        max_model_shards: int = 1,
     ):
         self._goodput_fn = goodput_fn
         self._max_batch_size = max_batch_size
         self._atomic_bsz_range = atomic_bsz_range
         self._accumulation = accumulation
-        # Base goodput: one replica on one slice.
-        self._base_goodput, _, _ = goodput_fn.optimize(
-            1,
-            1,
-            max_batch_size=max_batch_size,
-            atomic_bsz_range=atomic_bsz_range,
-            accumulation=accumulation,
-        )
+        self._max_seq_shards = max(int(max_seq_shards or 1), 1)
+        self._max_model_shards = max(int(max_model_shards or 1), 1)
+        # Base goodput: one chip on one slice.
+        base, *_ = self._optimize(np.array([1]), np.array([1]))
+        self._base_goodput = float(np.atleast_1d(base)[0])
         self._cache: dict[tuple[int, int], float] = {(0, 0): 0.0}
+        # (nodes, chips) -> (atomic_bsz, accum_steps, sp, tp)
+        self._config: dict[tuple[int, int], tuple[int, int, int, int]] = {}
+
+    def _optimize(self, nodes, chips):
+        return self._goodput_fn.optimize_topology(
+            nodes,
+            chips,
+            max_batch_size=self._max_batch_size,
+            atomic_bsz_range=self._atomic_bsz_range,
+            accumulation=self._accumulation,
+            max_seq_shards=self._max_seq_shards,
+            max_model_shards=self._max_model_shards,
+        )
+
+    def best_config(
+        self, num_nodes: int, num_chips: int
+    ) -> tuple[int, int, int, int]:
+        """(atomic_bsz, accum_steps, seq_shards, model_shards) behind
+        the speedup at this allocation — what the controller exports as
+        ADAPTDL_SEQ_SHARDS / ADAPTDL_MODEL_SHARDS."""
+        self(num_nodes, num_chips)  # warm the cache
+        return self._config.get(
+            (int(num_nodes), int(num_chips)), (0, 0, 1, 1)
+        )
 
     def __call__(self, num_nodes, num_replicas):
         scalar = np.isscalar(num_nodes) and np.isscalar(num_replicas)
@@ -46,23 +78,30 @@ class SpeedupFunction:
         replicas = replicas.ravel()
         out = np.zeros(nodes.shape, dtype=float)
         # Identify points not yet cached and evaluate them in one
-        # vectorized optimize() call.
+        # vectorized optimize call.
         keys = list(zip(nodes.tolist(), replicas.tolist()))
         missing = sorted(
             {k for k in keys if k not in self._cache and k[1] > 0}
         )
         if missing:
             m_nodes = np.array([k[0] for k in missing])
-            m_replicas = np.array([k[1] for k in missing])
-            goodput, _, _ = self._goodput_fn.optimize(
-                np.maximum(m_nodes, 1),
-                m_replicas,
-                max_batch_size=self._max_batch_size,
-                atomic_bsz_range=self._atomic_bsz_range,
-                accumulation=self._accumulation,
+            m_chips = np.array([k[1] for k in missing])
+            goodput, bsz, accum, sps, tps = self._optimize(
+                np.maximum(m_nodes, 1), m_chips
             )
-            for key, g in zip(missing, np.atleast_1d(goodput)):
-                self._cache[key] = float(g) / self._base_goodput
+            goodput = np.atleast_1d(goodput)
+            bsz = np.atleast_1d(bsz)
+            accum = np.atleast_1d(accum)
+            sps = np.atleast_1d(sps)
+            tps = np.atleast_1d(tps)
+            for i, key in enumerate(missing):
+                self._cache[key] = float(goodput[i]) / self._base_goodput
+                self._config[key] = (
+                    int(bsz[i]),
+                    int(accum[i]),
+                    int(sps[i]),
+                    int(tps[i]),
+                )
         for i, key in enumerate(keys):
             out[i] = self._cache.get(key, 0.0)
         out = out.reshape(shape)

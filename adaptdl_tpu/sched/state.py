@@ -24,6 +24,10 @@ class JobRecord:
     spec: dict = field(default_factory=dict)  # min/max replicas, etc.
     hints: dict | None = None  # posted SCHED_HINTS
     allocation: list[str] = field(default_factory=list)
+    # Scheduler-chosen mesh factorization for the current allocation:
+    # {"seqShards": s, "modelShards": t} (exported to the job as
+    # ADAPTDL_SEQ_SHARDS / ADAPTDL_MODEL_SHARDS by the launcher).
+    topology: dict | None = None
     status: str = "Pending"  # Pending|Starting|Running|Stopping|Succeeded|Failed
     # rank -> address ("host:port"), registered by running workers.
     workers: dict[int, str] = field(default_factory=dict)
@@ -62,6 +66,22 @@ class ClusterState:
         with self._cond:
             record = self._jobs.get(key)
             return None if record is None else list(record.allocation)
+
+    def get_launch_config(
+        self, key: str
+    ) -> tuple[list[str], dict | None]:
+        """Allocation + topology as ONE locked snapshot — the allocator
+        writes them together, and a launcher pairing a new topology
+        with a stale chip count would build a mesh the scheduler never
+        scored."""
+        with self._cond:
+            record = self._jobs.get(key)
+            if record is None:
+                return [], None
+            return (
+                list(record.allocation),
+                dict(record.topology) if record.topology else None,
+            )
 
     def jobs(self) -> dict[str, JobRecord]:
         with self._cond:

@@ -18,10 +18,18 @@ from adaptdl_tpu.goodput import GradParams, PerfParams
 LOG = logging.getLogger(__name__)
 
 PERF_PARAMS_KEYS = tuple(PerfParams._fields)
+# The 7 base (Pollux-published) params are required on the wire; the
+# sp/tp extension terms are optional — PerfParams defaults them to 0,
+# so hints from a pure data-parallel job stay reference-shaped.
+PERF_PARAMS_REQUIRED = tuple(
+    f for f in PerfParams._fields if PerfParams._field_defaults.get(f) is None
+)
 GRAD_PARAMS_KEYS = tuple(GradParams._fields)
 
 # Hint keys: camelCase on the wire, matching the reference schema and
-# the AdaptDLJob CRD's status.train field.
+# the AdaptDLJob CRD's status.train field; maxSeqShards/maxModelShards
+# advertise the job's sharding limits for the topology search (no
+# reference analog — the reference has no sp/tp axes).
 SCHED_HINTS_KEYS = (
     "initBatchSize",
     "localBszBounds",
@@ -30,6 +38,8 @@ SCHED_HINTS_KEYS = (
     "gradientAccumulation",
     "gradParams",
     "perfParams",
+    "maxSeqShards",
+    "maxModelShards",
 )
 
 
@@ -42,9 +52,12 @@ def validate_hints(hints: dict[str, Any]) -> None:
     if unknown:
         raise ValueError(f"unknown sched hint keys: {sorted(unknown)}")
     if hints.get("perfParams") is not None:
-        missing = set(PERF_PARAMS_KEYS) - set(hints["perfParams"])
+        missing = set(PERF_PARAMS_REQUIRED) - set(hints["perfParams"])
         if missing:
             raise ValueError(f"perfParams missing {sorted(missing)}")
+        bad = set(hints["perfParams"]) - set(PERF_PARAMS_KEYS)
+        if bad:
+            raise ValueError(f"unknown perfParams keys: {sorted(bad)}")
     if hints.get("gradParams") is not None:
         missing = set(GRAD_PARAMS_KEYS) - set(hints["gradParams"])
         if missing:

@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import os
 import pickle
+import re
+import shutil
 from typing import Any, Callable
 
 import jax
@@ -33,12 +35,54 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from adaptdl_tpu import checkpoint, env
 
 
-def _payload_dir(name: str) -> str:
+def _sharded_root() -> str:
     root = env.checkpoint_path()
     assert root is not None, "ADAPTDL_CHECKPOINT_PATH is not set"
-    return os.path.join(
-        os.path.abspath(root), "sharded", f"{name}-g{env.num_restarts()}"
-    )
+    return os.path.join(os.path.abspath(root), "sharded")
+
+
+def _payload_pattern(name: str) -> re.Pattern:
+    # A bare "{name}-g{restart}" (no ".{seq}") is the pre-versioning
+    # naming; accept it (as seq 0) so commit() prunes dirs left by
+    # older incarnations instead of leaking them forever.
+    return re.compile(rf"^{re.escape(name)}-g(\d+)(?:\.(\d+))?$")
+
+
+def _list_payload_dirs(name: str) -> list[tuple[int, int, str]]:
+    """(restart, seq, path) for this state's payload dirs, ascending."""
+    root = _sharded_root()
+    pattern = _payload_pattern(name)
+    found = []
+    try:
+        entries = os.listdir(root)
+    except FileNotFoundError:
+        return []
+    for entry in entries:
+        m = pattern.match(entry)
+        if m:
+            seq = int(m.group(2)) if m.group(2) else 0
+            found.append(
+                (int(m.group(1)), seq, os.path.join(root, entry))
+            )
+    return sorted(found)
+
+
+def _next_payload_dir(name: str) -> str:
+    """A fresh, versioned payload dir for the save about to happen.
+
+    Every save within an incarnation gets its own ``{name}-g{restart}.
+    {seq}`` directory: the payload referenced by the last COMPLETE
+    registry checkpoint is never overwritten in place, so a crash at
+    any point during the orbax write (or between it and the registry
+    rename) leaves the previous checkpoint's payload untouched.
+    Deterministic across processes: all processes scan the same shared
+    directory in lockstep (sync() runs collectively before the rank-0
+    registry write).
+    """
+    existing = _list_payload_dirs(name)
+    restart = env.num_restarts()
+    seq = max((s for r, s, _ in existing if r == restart), default=-1) + 1
+    return os.path.join(_sharded_root(), f"{name}-g{restart}.{seq}")
 
 
 class ShardedTrainerCheckpoint(checkpoint.State):
@@ -72,20 +116,32 @@ class ShardedTrainerCheckpoint(checkpoint.State):
     # -- State protocol ----------------------------------------------
 
     def sync(self) -> None:
-        """All processes write their shards via orbax."""
+        """All processes write their shards via orbax — into a fresh
+        versioned directory, never over a payload an existing complete
+        checkpoint still references."""
         import orbax.checkpoint as ocp
 
         state = self._get_state()
         # RNG keys are opaque; store raw key data alongside.
         state = state._replace(rng=jax.random.key_data(state.rng))
-        path = _payload_dir(self.name)
+        path = _next_payload_dir(self.name)
         checkpointer = ocp.StandardCheckpointer()
-        checkpointer.save(path, state, force=True)
+        checkpointer.save(path, state)
         checkpointer.wait_until_finished()
         self._last_payload_dir = path
 
     def save(self, fileobj) -> None:
         pickle.dump({"payload_dir": self._last_payload_dir}, fileobj)
+
+    def commit(self) -> None:
+        """Registry rename succeeded: every payload dir other than the
+        one just written is now unreferenced (the registry pruned all
+        older checkpoint dirs in the same step) — drop them, including
+        orphans from crashed incarnations."""
+        keep = self._last_payload_dir
+        for _, _, path in _list_payload_dirs(self.name):
+            if path != keep:
+                shutil.rmtree(path, ignore_errors=True)
 
     def load(self, fileobj) -> None:
         import orbax.checkpoint as ocp

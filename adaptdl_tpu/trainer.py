@@ -142,6 +142,14 @@ class ElasticTrainer:
         self.precondition = precondition
         self.smoothing = smoothing
         self._seed = seed
+        # Register the mesh's true (sp, tp) so profiling keys and the
+        # dataloader's goodput decisions reflect the topology that is
+        # actually running, not the scheduler's request.
+        from adaptdl_tpu import metrics as metrics_mod
+
+        metrics_mod.set_active_topology(
+            self.seq_shards, self.mesh.shape.get(MODEL_AXIS, 1)
+        )
         self._init_params = params
         self._step_cache: dict[tuple[int, int], Callable] = {}
         self._calibrated: set[int] = set()
@@ -174,6 +182,41 @@ class ElasticTrainer:
         return jax.tree_util.tree_map_with_path(
             lambda path, leaf: self.param_sharding_fn(path, leaf), params
         )
+
+    def state_spec_tree(self, state: "TrainState"):
+        """PartitionSpec tree for a full TrainState.
+
+        Params take ``param_sharding_fn`` specs; derived trees that
+        mirror the params — optimizer moments, the GNS prev-grad — take
+        the *same* specs, identified by path suffix + shape (an optax
+        ``mu`` leaf's path ends with the corresponding param's path).
+        Everything else (counts, EMA scalars, rng, progress) is
+        replicated.
+        """
+        if self.param_sharding_fn is None:
+            return jax.tree.map(lambda _: P(), state)
+        param_leaves = jax.tree_util.tree_flatten_with_path(state.params)[0]
+        spec_leaves = jax.tree.leaves(
+            self._param_spec_tree(state.params),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        matchers = [
+            (tuple(path), np.shape(leaf), spec)
+            for (path, leaf), spec in zip(param_leaves, spec_leaves)
+        ]
+
+        def assign(path, leaf):
+            path = tuple(path)
+            for ppath, shape, spec in matchers:
+                if (
+                    len(path) >= len(ppath)
+                    and path[-len(ppath):] == ppath
+                    and np.shape(leaf) == shape
+                ):
+                    return spec
+            return P()
+
+        return jax.tree_util.tree_map_with_path(assign, state)
 
     def init_state(self) -> TrainState:
         """Fresh TrainState on the mesh: data-parallel leaves
@@ -575,9 +618,16 @@ class ElasticTrainer:
 class TrainerCheckpoint(checkpoint.State):
     """Persists a TrainState device-agnostically.
 
-    Save: fetch to host numpy (data-parallel state is replicated, so
-    every process holds the full value). Load: device_put onto the
-    *current* mesh — a checkpoint written by a 1-chip incarnation
+    Save: fetch to host numpy (requires every shard to be addressable
+    from this process — always true single-host; multi-host
+    tensor-parallel state must use ShardedTrainerCheckpoint instead,
+    and save() raises a pointed error rather than crashing inside
+    np.asarray). Load: device_put onto the *current* mesh with the
+    trainer's full-state spec tree — data-parallel leaves come back
+    replicated, ``param_sharding_fn`` leaves (and their optimizer
+    moments / GNS mirrors) come back tensor-parallel sharded, so a
+    model that only fits sharded never materialises replicated at
+    restore time. A checkpoint written by a 1-chip incarnation
     restores onto 64 chips and vice versa (the reference reloads
     rank-0 full state similarly, checkpoint.py:151-156, but has no
     notion of re-materialising onto a device mesh).
@@ -591,6 +641,17 @@ class TrainerCheckpoint(checkpoint.State):
 
     def save(self, fileobj):
         state = self._get_state()
+        for leaf in jax.tree.leaves(state):
+            if (
+                isinstance(leaf, jax.Array)
+                and not leaf.is_fully_addressable
+            ):
+                raise RuntimeError(
+                    "TrainerCheckpoint cannot gather state with shards "
+                    "on other processes (multi-host sharded params); "
+                    "use ShardedTrainerCheckpoint for multi-host "
+                    "tensor/sequence-sharded state"
+                )
         # RNG keys are opaque typed arrays; store raw key data.
         state = state._replace(rng=jax.random.key_data(state.rng))
         pickle.dump(jax.tree.map(np.asarray, state), fileobj)
@@ -600,5 +661,14 @@ class TrainerCheckpoint(checkpoint.State):
         host_state = host_state._replace(
             rng=jax.random.wrap_key_data(jnp.asarray(host_state.rng))
         )
-        replicated = NamedSharding(self._trainer.mesh, P())
-        self._set_state(jax.device_put(host_state, replicated))
+        trainer = self._trainer
+        specs = trainer.state_spec_tree(host_state)
+        self._set_state(
+            jax.tree.map(
+                lambda x, s: jax.device_put(
+                    x, NamedSharding(trainer.mesh, s)
+                ),
+                host_state,
+                specs,
+            )
+        )

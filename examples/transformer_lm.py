@@ -23,8 +23,12 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--cpu", action="store_true")
     parser.add_argument("--epochs", type=int, default=5)
-    parser.add_argument("--seq-shards", type=int, default=1)
-    parser.add_argument("--tp-shards", type=int, default=1)
+    # Default to the scheduler's chosen factorization (exported as
+    # ADAPTDL_SEQ_SHARDS / ADAPTDL_MODEL_SHARDS by the launcher when
+    # the goodput topology search picks a dp x sp x tp mesh); flags
+    # override for manual runs.
+    parser.add_argument("--seq-shards", type=int, default=None)
+    parser.add_argument("--tp-shards", type=int, default=None)
     parser.add_argument("--seq-len", type=int, default=None)
     args = parser.parse_args()
     if args.cpu:
@@ -44,7 +48,9 @@ def main():
 
     adaptdl_tpu.initialize_job()
     on_cpu = args.cpu
-    seq_shards = args.seq_shards
+    seq_shards = (
+        args.seq_shards if args.seq_shards is not None else env.seq_shards()
+    )
     seq_len = args.seq_len or (32 if on_cpu else 512)
     assert seq_len % max(seq_shards, 1) == 0
 
@@ -72,7 +78,9 @@ def main():
     # ADAPTDL_NUM_REPLICAS counts *data-parallel* replicas; a
     # seq- or tensor-sharded group of chips forms one replica, so the
     # chips of this allocation divide between the axes.
-    tp_shards = args.tp_shards
+    tp_shards = (
+        args.tp_shards if args.tp_shards is not None else env.model_shards()
+    )
     group = seq_shards * tp_shards
     if group > 1:
         import os
@@ -124,6 +132,17 @@ def main():
     loader = AdaptiveDataLoader(dataset, batch_size=32)
     loader.autoscale_batch_size(
         1024, local_bsz_bounds=(4, 128), gradient_accumulation=True
+    )
+    # Advertise how far this model can shard each sample: the largest
+    # power of two dividing seq_len (the scheduler only picks
+    # power-of-two factorizations, and a non-dividing choice would
+    # assert on every restart), and TP up to the head count.
+    max_sp = 1
+    while max_sp * 2 <= 8 and seq_len % (max_sp * 2) == 0:
+        max_sp *= 2
+    metrics.set_topology_config(
+        max_seq_shards=max_sp,
+        max_model_shards=min(config.num_heads, 8),
     )
     for e in epoch.remaining_epochs_until(args.epochs):
         for batch in loader:

@@ -161,3 +161,126 @@ def test_fit_no_multinode_observations_pins_dcn_prior():
     fitted = fit_perf_params(nodes, replicas, bsz, t_acc, t_opt)
     assert fitted.alpha_n >= 1.1 * fitted.alpha_r - 1e-12
     assert fitted.beta_n >= 1.1 * fitted.beta_r - 1e-12
+
+
+# ---- (data, seq, model) topology search --------------------------------
+
+# A long-context-style job: gradient signal dominates noise, so batch
+# scaling past init buys almost nothing (efficiency ~ 1/scale) and the
+# only productive use of extra chips is sharding each sample.
+GRAD_LONGCTX = GradParams(sqr=0.01, var=0.001)
+PERF_SP = PerfParams(
+    0.02, 0.004, 0.2, 0.01, 0.05, 0.02, 1.5,
+    alpha_sp=0.005, beta_sp=0.0005, alpha_tp=0.01, beta_tp=0.001,
+)
+
+
+def test_perf_params_seven_field_compat():
+    """Wire/checkpoint compat: 7-value params fill zero sharding terms."""
+    p = PerfParams(0.12, 0.0057, 0.024, 0.0063, 0.012, 0.0032, 1.14)
+    assert p.alpha_sp == 0.0 and p.beta_tp == 0.0
+    fn = GoodputFunction(
+        (0.12, 0.0057, 0.024, 0.0063, 0.012, 0.0032, 1.14), GRAD, INIT_BSZ
+    )
+    assert fn.throughput(1, 2, 128, 0) > 0
+
+
+def test_topology_matches_fixed_optimize_when_dp_only():
+    fn = GoodputFunction(PERF_SP, GRAD, INIT_BSZ)
+    g, bsz, acc = fn.optimize(
+        1, 8, max_batch_size=4096, atomic_bsz_range=(32, 256),
+        accumulation=True,
+    )
+    gt, bszt, acct, sp, tp = fn.optimize_topology(
+        1, 8, max_batch_size=4096, atomic_bsz_range=(32, 256),
+        accumulation=True, max_seq_shards=1, max_model_shards=1,
+    )
+    assert sp == 1 and tp == 1
+    assert gt == pytest.approx(g)
+    assert bszt == bsz and acct == acc
+
+
+def test_topology_search_prefers_seq_shards_for_long_context():
+    """With a tight statistical batch budget, extra chips should go to
+    the sequence axis, and that factorization must beat pure DP."""
+    fn = GoodputFunction(PERF_SP, GRAD_LONGCTX, 8)
+    pure_dp, _, _ = fn.optimize(
+        1, 8, max_batch_size=16, atomic_bsz_range=(1, 4),
+        accumulation=True,
+    )
+    g, bsz, acc, sp, tp = fn.optimize_topology(
+        1, 8, max_batch_size=16, atomic_bsz_range=(1, 4),
+        accumulation=True, max_seq_shards=8,
+    )
+    assert sp > 1, "long-context job should shard sequences"
+    assert g > pure_dp
+    # The chosen config stays within the statistical batch budget.
+    dp = 8 // (sp * tp)
+    assert dp * bsz * (acc + 1) <= 16 * sp * tp
+
+
+def test_topology_respects_shard_limits():
+    fn = GoodputFunction(PERF_SP, GRAD_LONGCTX, 8)
+    *_, sp, tp = fn.optimize_topology(
+        1, 8, max_batch_size=16, atomic_bsz_range=(1, 4),
+        accumulation=True, max_seq_shards=2, max_model_shards=1,
+    )
+    assert sp <= 2 and tp == 1
+
+
+def test_topology_vectorized_matches_scalar():
+    fn = GoodputFunction(PERF_SP, GRAD_LONGCTX, 8)
+    nodes = np.array([1, 1, 2])
+    chips = np.array([4, 8, 16])
+    gv, bv, av, sv, tv = fn.optimize_topology(
+        nodes, chips, max_batch_size=64, atomic_bsz_range=(1, 8),
+        accumulation=True, max_seq_shards=4, max_model_shards=2,
+    )
+    for i in range(len(nodes)):
+        g, b, a, s, t = fn.optimize_topology(
+            int(nodes[i]), int(chips[i]), max_batch_size=64,
+            atomic_bsz_range=(1, 8), accumulation=True,
+            max_seq_shards=4, max_model_shards=2,
+        )
+        assert g == pytest.approx(gv[i])
+        assert (b, a, s, t) == (bv[i], av[i], sv[i], tv[i])
+
+
+def test_fit_recovers_ring_terms():
+    """Fit with sp>1 observations identifies the ring cost; without
+    them the ring terms get the ICI-latency prior, not zero."""
+    from adaptdl_tpu.goodput import (
+        _accum_time, _log_optim_time, _network_time,
+    )
+
+    rng = np.random.default_rng(2)
+    rows = []
+    for sp in (1, 2, 4):
+        for b in (32, 64, 128):
+            rows.append((1, 4, sp, b))
+    nodes = np.array([r[0] for r in rows], dtype=float)
+    replicas = np.array([r[1] for r in rows], dtype=float)
+    sps = np.array([r[2] for r in rows], dtype=float)
+    bsz = np.array([r[3] for r in rows], dtype=float)
+    t_acc = _accum_time(np, PERF_SP, bsz, sps, 1)
+    t_net = _network_time(np, PERF_SP, nodes, replicas)
+    t_opt = np.exp(_log_optim_time(np, PERF_SP, t_acc, t_net))
+    noise = rng.lognormal(0.0, 0.01, t_acc.shape)
+    fitted = fit_perf_params(
+        nodes, replicas, bsz, t_acc * noise, t_opt * noise,
+        seq_shards=sps,
+    )
+    # Predicted accum times at sp in/beyond the envelope track truth.
+    for sp, b in [(2, 64), (4, 128), (8, 64)]:
+        pred = _accum_time(np, fitted, b, sp, 1)
+        true = _accum_time(np, PERF_SP, b, sp, 1)
+        assert pred == pytest.approx(true, rel=0.2), (sp, b)
+
+    # No sp observations -> ICI prior keeps sharding non-free.
+    mask = sps == 1
+    fitted0 = fit_perf_params(
+        nodes[mask], replicas[mask], bsz[mask],
+        (t_acc * noise)[mask], (t_opt * noise)[mask],
+    )
+    assert fitted0.alpha_sp >= fitted0.alpha_r - 1e-12
+    assert fitted0.alpha_sp > 0

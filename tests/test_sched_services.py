@@ -137,3 +137,41 @@ def test_k8s_manifest_rendering():
     assert container["resources"]["limits"]["google.com/tpu"] == 1
     env = {e["name"]: e["value"] for e in container["env"]}
     assert env["ADAPTDL_CHECKPOINT_PATH"].endswith("default-myjob")
+
+
+def test_allocator_publishes_topology_for_seq_parallel_job():
+    """A job advertising maxSeqShards gets its chosen dp x sp
+    factorization published on the JobRecord, ready for the launcher
+    to export as ADAPTDL_SEQ_SHARDS."""
+    hints = dict(
+        HINTS,
+        initBatchSize=8,
+        maxBatchSize=16,
+        localBszBounds=[1, 4],
+        maxProfiledReplicas=4,
+        maxSeqShards=8,
+        gradParams={"sqr": 0.01, "var": 0.001},
+        perfParams={
+            "alpha_c": 0.02,
+            "beta_c": 0.004,
+            "alpha_n": 0.2,
+            "beta_n": 0.01,
+            "alpha_r": 0.05,
+            "beta_r": 0.02,
+            "gamma": 1.5,
+            "alpha_sp": 0.005,
+            "beta_sp": 0.0005,
+        },
+    )
+    state = ClusterState()
+    state.create_job("ns/lctx", spec={"max_replicas": 8})
+    state.update("ns/lctx", hints=hints)
+    nodes = {"slice-0": NodeInfo(resources={"tpu": 8})}
+    allocator = Allocator(
+        state, nodes, policy=PolluxPolicy(pop_size=16, generations=10)
+    )
+    alloc = allocator.optimize_once()["ns/lctx"]
+    record = state.get_job("ns/lctx")
+    assert record.topology is not None
+    assert record.topology["seqShards"] > 1
+    assert len(alloc) % record.topology["seqShards"] == 0

@@ -1,6 +1,9 @@
 """Orbax-backed sharded checkpoint: save on one mesh, restore onto
 another (the TPU rescale path the reference cannot do)."""
 
+import os
+import pickle
+
 import numpy as np
 import optax
 import pytest
@@ -78,3 +81,58 @@ def test_sharded_save_restore_across_meshes(tmp_path, monkeypatch):
         restored, t8.shard_batch({k: v[idx] for k, v in data.items()})
     )
     assert np.isfinite(float(m["loss"]))
+
+
+def test_second_save_never_clobbers_previous_payload(
+    tmp_path, monkeypatch
+):
+    """Each save writes a fresh versioned payload dir: a crash during
+    (or after) the orbax write of save N+1 leaves checkpoint N's
+    payload untouched, and a *completed* save prunes everything it
+    superseded."""
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    t = _trainer(2)
+    holder = {"state": t.init_state()}
+    ck = ShardedTrainerCheckpoint(
+        "st",
+        t,
+        lambda: holder["state"],
+        lambda s: holder.__setitem__("state", s),
+    )
+    checkpoint.save_all_states()
+    first_payload = ck._last_payload_dir
+    assert os.path.isdir(first_payload)
+
+    # Simulate a crash mid-second-save: the orbax payload is written
+    # but the process dies before the registry rename. The previous
+    # complete checkpoint must still reference an intact payload.
+    ck.sync()
+    second_payload = ck._last_payload_dir
+    assert second_payload != first_payload
+    assert os.path.isdir(first_payload)
+    latest = checkpoint.latest_checkpoint_dir()
+    with open(os.path.join(latest, "st"), "rb") as f:
+        meta = pickle.load(f)
+    assert meta["payload_dir"] == first_payload
+
+    # A new incarnation restoring now gets the first checkpoint back.
+    ck.unregister()
+    t2 = _trainer(2)
+    holder2 = {"state": t2.init_state()}
+    ck2 = ShardedTrainerCheckpoint(
+        "st",
+        t2,
+        lambda: holder2["state"],
+        lambda s: holder2.__setitem__("state", s),
+    )
+    assert checkpoint.load_state(ck2)
+
+    # Completing a save prunes every superseded payload dir, including
+    # the crashed save's orphan — disk growth is bounded.
+    checkpoint.save_all_states()
+    final_payload = ck2._last_payload_dir
+    sharded_root = os.path.join(str(tmp_path), "sharded")
+    assert os.listdir(sharded_root) == [
+        os.path.basename(final_payload)
+    ]
+    ck2.unregister()

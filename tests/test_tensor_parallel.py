@@ -94,3 +94,75 @@ def test_tp_training_matches_replicated():
     # The TP run's params really are sharded over the model axis.
     spec = s_tp.params["layer_0"]["ff_up"]["kernel"].sharding.spec
     assert "model" in str(spec)
+
+
+def test_trainer_checkpoint_restores_tp_sharded(tmp_path, monkeypatch):
+    """TrainerCheckpoint.load honors param_sharding_fn: params, their
+    optimizer moments, and the GNS prev-grad all come back laid out
+    over the model axis — never replicated (which would OOM a model
+    that only fits sharded)."""
+    from adaptdl_tpu import checkpoint
+
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    cfg = TransformerConfig(
+        vocab_size=64, num_layers=1, num_heads=4, d_model=32, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32, remat=False,
+    )
+    model, params = init_transformer(cfg, seq_len=16)
+    mesh = create_mesh({"data": 2, "model": 2}, devices=jax.devices()[:4])
+    tr = ElasticTrainer(
+        _loss_fn(model),
+        params,
+        optax.adam(1e-2),
+        8,
+        mesh=mesh,
+        param_sharding_fn=transformer_tp_specs,
+    )
+    holder = {"state": tr.init_state()}
+    ck = tr.make_checkpoint_state(
+        lambda: holder["state"],
+        lambda s: holder.__setitem__("state", s),
+        name="tp_trainer",
+    )
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 64, size=(8, 17), dtype=np.int32)
+    batch = {
+        "inputs": tokens[:, :-1].copy(),
+        "targets": tokens[:, 1:].copy(),
+    }
+    step = tr.train_step(4, 0)
+    holder["state"], _ = step(holder["state"], tr.shard_batch(batch))
+    w_before = np.asarray(
+        jax.device_get(holder["state"].params["layer_0"]["ff_up"]["kernel"])
+    )
+    checkpoint.save_all_states()
+
+    holder["state"] = None
+    assert checkpoint.load_state(ck)
+    restored = holder["state"]
+
+    def spec_of(leaf):
+        return str(leaf.sharding.spec)
+
+    assert "model" in spec_of(
+        restored.params["layer_0"]["ff_up"]["kernel"]
+    )
+    # Adam moments mirror the params' TP layout (matched by path
+    # suffix through state_spec_tree).
+    mu = restored.opt_state[0].mu["layer_0"]["ff_up"]["kernel"]
+    nu = restored.opt_state[0].nu["layer_0"]["ff_up"]["kernel"]
+    assert "model" in spec_of(mu) and "model" in spec_of(nu)
+    assert "model" in spec_of(
+        restored.gns.prev_grad["layer_0"]["ff_up"]["kernel"]
+    )
+    # Scalars stay replicated and values round-trip exactly.
+    assert spec_of(restored.progress) == "PartitionSpec()"
+    np.testing.assert_allclose(
+        np.asarray(
+            jax.device_get(restored.params["layer_0"]["ff_up"]["kernel"])
+        ),
+        w_before,
+    )
+    # Training continues from the restored sharded state.
+    s2, m = step(restored, tr.shard_batch(batch))
+    assert np.isfinite(float(m["loss"]))
