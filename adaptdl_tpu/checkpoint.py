@@ -76,19 +76,41 @@ def _reset_registry() -> None:
     _registry.clear()
 
 
-def _list_checkpoints(root: str) -> list[tuple[int, int, str]]:
-    """(restart_index, seq, path) for complete dirs, ascending."""
+def scan_versioned_dirs(
+    root: str, pattern: re.Pattern
+) -> list[tuple[int, int, str]]:
+    """(restart_index, save_seq, path) ascending for directories
+    matching ``pattern``: group 1 is the restart index, optional group
+    2 the per-incarnation save sequence (a bare name counts as seq 0).
+
+    The single implementation of the versioned-dir naming contract —
+    shared with the sharded-payload store (sharded_checkpoint.py) so
+    the crash-safety invariants (newest = max (restart, seq); prune
+    everything older only after a completed save) cannot drift between
+    the registry and its side payloads.
+    """
     found = []
     try:
         entries = os.listdir(root)
     except FileNotFoundError:
         return []
     for entry in entries:
-        m = _CKPT_DIR_PATTERN.match(entry)
+        m = pattern.match(entry)
         if m:
             seq = int(m.group(2)) if m.group(2) else 0
             found.append((int(m.group(1)), seq, os.path.join(root, entry)))
     return sorted(found)
+
+
+def next_save_seq(
+    entries: list[tuple[int, int, str]], restart: int
+) -> int:
+    """The seq for the next save within ``restart``'s incarnation."""
+    return max((s for r, s, _ in entries if r == restart), default=-1) + 1
+
+
+def _list_checkpoints(root: str) -> list[tuple[int, int, str]]:
+    return scan_versioned_dirs(root, _CKPT_DIR_PATTERN)
 
 
 def latest_checkpoint_dir(root: str | None = None) -> str | None:
@@ -117,9 +139,7 @@ def save_all_states() -> None:
         for state in _registry.values():
             with open(os.path.join(tmpdir, state.name), "wb") as f:
                 state.save(f)
-        seq = max(
-            (s for r, s, _ in existing if r == env.num_restarts()), default=-1
-        ) + 1
+        seq = next_save_seq(existing, env.num_restarts())
         final = os.path.join(
             root, f"checkpoint-{env.num_restarts()}.{seq}"
         )

@@ -22,7 +22,7 @@ from adaptdl_tpu.sched.policy import (
     PolluxPolicy,
     SpeedupFunction,
 )
-from adaptdl_tpu.sched.state import ClusterState
+from adaptdl_tpu.sched.state import ClusterState, normalize_topology
 
 LOG = logging.getLogger(__name__)
 
@@ -117,14 +117,23 @@ class Allocator:
                 continue
             # Publish the factorization behind this allocation's
             # speedup so the launcher can build the matching mesh.
+            # The incumbent factorization is kept unless the challenger
+            # clearly beats it (restart hysteresis): near-tie
+            # factorizations would otherwise flap across perf refits
+            # and restart the job every cycle.
             topology = None
             best_config = getattr(
-                jobs[key].speedup_fn, "best_config", None
+                jobs[key].speedup_fn, "best_config_with_hysteresis", None
             )
             if best_config is not None and alloc:
-                _, _, sp, tp = best_config(len(set(alloc)), len(alloc))
+                _, _, sp, tp = best_config(
+                    len(set(alloc)), len(alloc), record.topology
+                )
                 topology = {"seqShards": sp, "modelShards": tp}
-            if record.allocation != alloc or record.topology != topology:
+            changed = record.allocation != alloc or normalize_topology(
+                record.topology
+            ) != normalize_topology(topology)
+            if changed:
                 LOG.info("allocation %s: %s -> %s (topology %s)", key,
                          record.allocation, alloc, topology)
                 self._state.update(

@@ -187,12 +187,16 @@ class Operator:  # pragma: no cover - requires a live cluster
         launched with; any change — including a same-size allocation on
         different pools or a topology-only refit — must restart the
         group (reference analog: controller.py:310-318 compares pod
-        annotations against status.allocation)."""
+        annotations against status.allocation). Topology is normalized
+        so None and pure-DP {1,1} hash identically."""
         import hashlib
         import json
 
+        from adaptdl_tpu.sched.state import normalize_topology
+
         payload = json.dumps(
-            [list(record.allocation), record.topology], sort_keys=True
+            [list(record.allocation), normalize_topology(record.topology)],
+            sort_keys=True,
         )
         return hashlib.sha1(payload.encode()).hexdigest()[:12]
 
@@ -209,11 +213,17 @@ class Operator:  # pragma: no cover - requires a live cluster
             return int(pod.metadata.annotations.get("adaptdl/group", -1))
 
         fingerprint = self._launch_fingerprint(record)
-        drifted = any(
-            pod_group(p) != record.group
-            or p.metadata.annotations.get("adaptdl/config") != fingerprint
-            for p in live
-        )
+
+        def pod_drifted(pod) -> bool:
+            if pod_group(pod) != record.group:
+                return True
+            annotated = pod.metadata.annotations.get("adaptdl/config")
+            # Pods from before the config annotation existed: fall back
+            # to group-only drift instead of restarting the world on
+            # operator upgrade.
+            return annotated is not None and annotated != fingerprint
+
+        drifted = any(pod_drifted(p) for p in live)
         failed = []
         for pod in live:
             for status in pod.status.container_statuses or []:

@@ -35,7 +35,7 @@ import portpicker
 from adaptdl_tpu._signal import GRACEFUL_EXIT_CODE
 from adaptdl_tpu.sched.allocator import Allocator
 from adaptdl_tpu.sched.policy import NodeInfo, PolluxPolicy
-from adaptdl_tpu.sched.state import ClusterState
+from adaptdl_tpu.sched.state import ClusterState, normalize_topology
 from adaptdl_tpu.sched.supervisor import Supervisor
 
 LOG = logging.getLogger(__name__)
@@ -186,12 +186,14 @@ class LocalElasticRunner:
             current, cur_topology = self.state.get_launch_config(
                 self.job_name
             )
-            drifted = list(current) != list(allocation) or (
-                # Topology-only drift (same chips, new sp/tp): the
-                # running mesh no longer matches the scheduler's
-                # accounting, so rescale for it too.
-                cur_topology or {}
-            ) != (topology or {})
+            # Topology-only drift (same chips, new sp/tp) also needs a
+            # rescale; normalized so None == pure-DP {1,1} never
+            # triggers a spurious restart when hints first arrive.
+            drifted = list(current) != list(
+                allocation
+            ) or normalize_topology(cur_topology) != normalize_topology(
+                topology
+            )
             if not signalled and drifted:
                 LOG.info(
                     "drift %s/%s -> %s/%s: requesting graceful rescale",

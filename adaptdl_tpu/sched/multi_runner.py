@@ -31,7 +31,7 @@ import portpicker
 from adaptdl_tpu._signal import GRACEFUL_EXIT_CODE
 from adaptdl_tpu.sched.allocator import Allocator
 from adaptdl_tpu.sched.policy import NodeInfo, PolluxPolicy
-from adaptdl_tpu.sched.state import ClusterState
+from adaptdl_tpu.sched.state import ClusterState, normalize_topology
 from adaptdl_tpu.sched.supervisor import Supervisor
 from adaptdl_tpu.sched.validator import validate_job_spec
 
@@ -181,12 +181,14 @@ class MultiJobRunner:
             current, cur_topology = self.state.get_launch_config(
                 job.name
             )
-            drifted = list(current) != list(allocation) or (
-                # A topology-only change (same chips, new sp/tp) also
-                # requires a rescale: the running mesh no longer
-                # matches what the scheduler is accounting for.
-                cur_topology or {}
-            ) != (topology or {})
+            # A topology-only change (same chips, new sp/tp) also
+            # requires a rescale; normalized so None == pure-DP {1,1}
+            # never restarts a job just because hints arrived.
+            drifted = list(current) != list(
+                allocation
+            ) or normalize_topology(cur_topology) != normalize_topology(
+                topology
+            )
             if not signalled and drifted:
                 LOG.info(
                     "%s drift: %d -> %d replicas, topology %s -> %s",

@@ -68,6 +68,46 @@ class SpeedupFunction:
             (int(num_nodes), int(num_chips)), (0, 0, 1, 1)
         )
 
+    def best_config_with_hysteresis(
+        self,
+        num_nodes: int,
+        num_chips: int,
+        incumbent: dict | None,
+        threshold: float = 1.05,
+    ) -> tuple[int, int, int, int]:
+        """Like :meth:`best_config`, but keeps the job's incumbent
+        factorization unless the challenger beats it by ``threshold``
+        on the fitted model — a topology change costs a full
+        checkpoint-restart-recompile, so near-ties must not flap
+        across refits (same philosophy as the dataloader's 5%
+        batch-size threshold, reference: data.py:297-301)."""
+        bsz, accum, sp, tp = self.best_config(num_nodes, num_chips)
+        inc_sp = max(int((incumbent or {}).get("seqShards", 1)), 1)
+        inc_tp = max(int((incumbent or {}).get("modelShards", 1)), 1)
+        if (sp, tp) == (inc_sp, inc_tp):
+            return bsz, accum, sp, tp
+        group = inc_sp * inc_tp
+        dp = num_chips // group
+        if dp < 1 or dp * group != num_chips or dp < max(num_nodes, 1):
+            # Incumbent no longer fits this chip count; adopt the best.
+            return bsz, accum, sp, tp
+        inc_goodput, inc_bsz, inc_accum = self._goodput_fn.optimize(
+            max(num_nodes, 1),
+            dp,
+            max_batch_size=self._max_batch_size,
+            atomic_bsz_range=self._atomic_bsz_range,
+            accumulation=self._accumulation,
+            seq_shards=inc_sp,
+            model_shards=inc_tp,
+        )
+        best_goodput = (
+            self._cache.get((int(num_nodes), int(num_chips)), 0.0)
+            * self._base_goodput
+        )
+        if best_goodput > threshold * float(inc_goodput):
+            return bsz, accum, sp, tp
+        return int(inc_bsz), int(inc_accum), inc_sp, inc_tp
+
     def __call__(self, num_nodes, num_replicas):
         scalar = np.isscalar(num_nodes) and np.isscalar(num_replicas)
         nodes = np.atleast_1d(np.asarray(num_nodes, dtype=int))

@@ -18,6 +18,18 @@ from dataclasses import dataclass, field
 from typing import Any
 
 
+def normalize_topology(topology: dict | None) -> dict:
+    """Canonical form for launch-config comparisons: ``None`` and the
+    explicit pure-DP dict are the SAME configuration — treating them
+    as different would restart every job the first time it posts
+    hints."""
+    topology = topology or {}
+    return {
+        "seqShards": int(topology.get("seqShards", 1)),
+        "modelShards": int(topology.get("modelShards", 1)),
+    }
+
+
 @dataclass
 class JobRecord:
     key: str  # "namespace/name"

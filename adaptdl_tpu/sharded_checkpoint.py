@@ -49,22 +49,12 @@ def _payload_pattern(name: str) -> re.Pattern:
 
 
 def _list_payload_dirs(name: str) -> list[tuple[int, int, str]]:
-    """(restart, seq, path) for this state's payload dirs, ascending."""
-    root = _sharded_root()
-    pattern = _payload_pattern(name)
-    found = []
-    try:
-        entries = os.listdir(root)
-    except FileNotFoundError:
-        return []
-    for entry in entries:
-        m = pattern.match(entry)
-        if m:
-            seq = int(m.group(2)) if m.group(2) else 0
-            found.append(
-                (int(m.group(1)), seq, os.path.join(root, entry))
-            )
-    return sorted(found)
+    """(restart, seq, path) for this state's payload dirs, ascending
+    (same versioned-dir contract as the registry — one shared scanner,
+    checkpoint.scan_versioned_dirs)."""
+    return checkpoint.scan_versioned_dirs(
+        _sharded_root(), _payload_pattern(name)
+    )
 
 
 def _next_payload_dir(name: str) -> str:
@@ -80,9 +70,10 @@ def _next_payload_dir(name: str) -> str:
     registry write).
     """
     existing = _list_payload_dirs(name)
-    restart = env.num_restarts()
-    seq = max((s for r, s, _ in existing if r == restart), default=-1) + 1
-    return os.path.join(_sharded_root(), f"{name}-g{restart}.{seq}")
+    seq = checkpoint.next_save_seq(existing, env.num_restarts())
+    return os.path.join(
+        _sharded_root(), f"{name}-g{env.num_restarts()}.{seq}"
+    )
 
 
 class ShardedTrainerCheckpoint(checkpoint.State):
