@@ -79,20 +79,37 @@ class Allocator:
     def __init__(
         self,
         state: ClusterState,
-        nodes: dict[str, NodeInfo],
+        nodes,
         node_template: NodeInfo | None = None,
         policy: PolluxPolicy | None = None,
         interval: float = 60.0,
         expander=None,
     ):
+        """``nodes`` is the slice inventory: either a static dict or a
+        zero-arg callable returning one — a callable makes provisioned
+        capacity visible on the next cycle (the autoscaling feedback
+        loop; the reference re-lists k8s nodes every cycle,
+        allocator.py:149-179)."""
         self._state = state
         self._nodes = nodes
-        self._template = node_template or next(iter(nodes.values()))
+        if node_template is None:
+            inventory = self._current_nodes()
+            if not inventory:
+                raise ValueError(
+                    "node_template is required when the initial slice "
+                    "inventory is empty (scale-from-zero needs a "
+                    "template to describe a provisionable slice)"
+                )
+            node_template = next(iter(inventory.values()))
+        self._template = node_template
         self._policy = policy or PolluxPolicy()
         self._interval = interval
         self._expander = expander
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    def _current_nodes(self) -> dict[str, NodeInfo]:
+        return self._nodes() if callable(self._nodes) else self._nodes
 
     def optimize_once(self) -> dict[str, list[str]]:
         jobs = {}
@@ -105,9 +122,22 @@ class Allocator:
             )
             base[key] = list(record.allocation)
         if not jobs:
+            # No incomplete jobs: let the expander retire capacity
+            # (clamped to its min; shrink waits out the hysteresis).
+            if self._expander is not None:
+                self._expander.request(0)
+            return {}
+        nodes = self._current_nodes()
+        if not nodes:
+            # Scaled to zero with pending work: the policy cannot run
+            # on an empty inventory (it would report desired=0 and
+            # deadlock the cluster at zero forever) — bootstrap one
+            # slice and allocate on the next cycle.
+            if self._expander is not None:
+                self._expander.request(1)
             return {}
         allocations, desired = self._policy.optimize(
-            jobs, self._nodes, base, self._template
+            jobs, nodes, base, self._template
         )
         if self._expander is not None:
             self._expander.request(desired)

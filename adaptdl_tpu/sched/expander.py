@@ -17,6 +17,8 @@ import threading
 import time
 from typing import Callable, Protocol
 
+from adaptdl_tpu.sched.policy import NodeInfo
+
 LOG = logging.getLogger(__name__)
 
 
@@ -26,6 +28,107 @@ class SliceProvisioner(Protocol):
     def current_slices(self) -> int: ...
 
     def set_slices(self, count: int) -> None: ...
+
+
+class InMemorySliceProvisioner:
+    """Provisioner that also OWNS the slice inventory: resizes are
+    synchronous and the provisioned slices are visible to the
+    allocator as NodeInfos via :meth:`nodes` — the capacity-feedback
+    half of the autoscaling loop (the reference's allocator re-lists
+    k8s nodes each cycle; here the provisioner is the node source).
+    Used by the local runners and as the test fake for the
+    expander -> provisioner -> allocator round-trip.
+    """
+
+    def __init__(
+        self,
+        chips_per_slice: int = 8,
+        initial: int = 1,
+        prefix: str = "slice",
+        preemptible: bool = False,
+    ):
+        self._chips = chips_per_slice
+        self._count = initial
+        self._prefix = prefix
+        self._preemptible = preemptible
+        self.resize_calls: list[int] = []
+
+    def current_slices(self) -> int:
+        return self._count
+
+    def set_slices(self, count: int) -> None:
+        LOG.info("provisioning slices: %d -> %d", self._count, count)
+        self.resize_calls.append(int(count))
+        self._count = int(count)
+
+    def nodes(self) -> dict[str, NodeInfo]:
+        """The live slice inventory for the allocator."""
+        return {
+            f"{self._prefix}-{i}": NodeInfo(
+                resources={"tpu": self._chips},
+                preemptible=self._preemptible,
+            )
+            for i in range(self._count)
+        }
+
+    def node_template(self) -> NodeInfo:
+        return NodeInfo(
+            resources={"tpu": self._chips},
+            preemptible=self._preemptible,
+        )
+
+
+class GKENodePoolProvisioner:  # pragma: no cover - needs Cloud API
+    """Actuating provisioner: resizes a GKE TPU node pool through the
+    Cluster Manager API — the TPU-native replacement for the
+    reference's placeholder-pod dance (one anti-affinity busybox pod
+    per desired node so the k8s autoscaler reacts, reference:
+    sched/adaptdl_sched/cluster_expander.py:28-88). TPU slice pools
+    resize directly, so no placeholder machinery is needed.
+
+    ``nodes_per_slice`` maps slice counts to node counts (a multi-host
+    slice is several k8s nodes in one pool).
+    """
+
+    def __init__(
+        self,
+        project: str,
+        location: str,
+        cluster: str,
+        node_pool: str,
+        nodes_per_slice: int = 1,
+    ):
+        try:
+            from google.cloud import container_v1
+        except ImportError as exc:
+            raise RuntimeError(
+                "GKENodePoolProvisioner requires google-cloud-container "
+                "in the scheduler image"
+            ) from exc
+        self._client = container_v1.ClusterManagerClient()
+        self._name = (
+            f"projects/{project}/locations/{location}/clusters/"
+            f"{cluster}/nodePools/{node_pool}"
+        )
+        self._nodes_per_slice = max(int(nodes_per_slice), 1)
+        # get_node_pool only exposes the CREATION-time node count
+        # (initial_node_count), which goes stale the moment anything
+        # else resizes the pool — so track the size this provisioner
+        # last set and use the API value only before the first resize.
+        self._last_set: int | None = None
+
+    def current_slices(self) -> int:
+        if self._last_set is not None:
+            return self._last_set
+        pool = self._client.get_node_pool(name=self._name)
+        return pool.initial_node_count // self._nodes_per_slice
+
+    def set_slices(self, count: int) -> None:
+        self._client.set_node_pool_size(
+            name=self._name,
+            node_count=int(count) * self._nodes_per_slice,
+        )
+        self._last_set = int(count)
 
 
 class ClusterExpander:

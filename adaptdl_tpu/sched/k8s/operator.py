@@ -22,8 +22,10 @@ Lifecycle (mirrors the reference's semantics):
   a checkpoint volume, and ``google.com/tpu`` resource limits pinned
   to the slice's node pool.
 
-Requires ``kubernetes_asyncio`` (imported lazily; not present in the
-dev image, so this module is exercised on real clusters only).
+``kubernetes_asyncio`` is imported lazily and only by :meth:`Operator.run`;
+the reconcile state machine takes an injected API client, so the test
+suite drives it against an in-memory fake (tests/test_k8s_operator.py)
+without a cluster.
 """
 
 from __future__ import annotations
@@ -81,20 +83,31 @@ def _require_k8s():
     return client, config, watch
 
 
-class Operator:  # pragma: no cover - requires a live cluster
+class Operator:
     """Single-process operator hosting controller + allocator +
-    supervisor against one namespace."""
+    supervisor against one namespace.
 
-    def __init__(self, namespace: str | None = None):
+    The Kubernetes API surface it touches (list/create/delete pods,
+    list nodes, job watch events) is injected into the reconcile
+    methods, so the whole state machine runs in the plain test suite
+    against a fake client (tests/test_k8s_operator.py); only
+    :meth:`run` needs ``kubernetes_asyncio`` and a live cluster.
+    """
+
+    def __init__(
+        self, namespace: str | None = None, max_failures: int = 2
+    ):
         self.namespace = namespace or os.environ.get(
             "ADAPTDL_NAMESPACE", "default"
         )
+        self.max_failures = max_failures
         self.state = ClusterState()
         self.supervisor = Supervisor(
             self.state, host="0.0.0.0", port=8080
         )
         self.allocator: Allocator | None = None
         self.expander: ClusterExpander | None = None
+        self._slice_inventory: dict[str, NodeInfo] = {}
 
     async def run(self):
         client, config, watch = _require_k8s()
@@ -102,14 +115,19 @@ class Operator:  # pragma: no cover - requires a live cluster
         api = client.CustomObjectsApi()
         core = client.CoreV1Api()
         self.supervisor.start()
-        nodes = await self._discover_slices(core)
+        # Live slice inventory: refreshed every reconcile pass so
+        # capacity that appears after startup (expander growth, admin
+        # adding a pool) becomes schedulable without restarting the
+        # operator (the reference re-lists nodes each allocator cycle,
+        # allocator.py:149-179).
+        self._slice_inventory = await self._discover_slices(core)
         self.expander = ClusterExpander(
-            LoggingProvisioner(initial=len(nodes))
+            LoggingProvisioner(initial=len(self._slice_inventory))
         )
         self.allocator = Allocator(
             self.state,
-            nodes,
-            node_template=next(iter(nodes.values())),
+            lambda: dict(self._slice_inventory),
+            node_template=next(iter(self._slice_inventory.values())),
             expander=self.expander,
         )
         self.allocator.start()
@@ -148,32 +166,45 @@ class Operator:  # pragma: no cover - requires a live cluster
             self.namespace,
             PLURAL,
         ):
-            obj = event["object"]
-            key = f"{self.namespace}/{obj['metadata']['name']}"
-            if event["type"] == "DELETED":
-                self.state.remove_job(key)
-                continue
-            spec = obj.get("spec", {})
-            normalized = {
-                "resources": {"tpu": 1},
-                "min_replicas": spec.get("minReplicas", 0),
-                "max_replicas": spec.get("maxReplicas", 1),
-                "preemptible": spec.get("preemptible", True),
-                "template": spec.get("template", {}),
-            }
-            existing = self.state.get_job(key)
-            try:
-                if existing is None:
-                    validate_job_spec(normalized)
-                    self.state.create_job(key, spec=normalized)
-                else:
-                    # Scaling limits and template are immutable.
-                    validate_job_update(existing.spec, normalized)
-            except ValidationError as exc:
-                LOG.warning("rejecting %s: %s", key, exc)
+            self.handle_job_event(event)
+
+    def handle_job_event(self, event: dict) -> None:
+        """Apply one AdaptDLJob watch event to the cluster state
+        (factored out of the watch loop so the state machine is
+        testable without a cluster)."""
+        obj = event["object"]
+        key = f"{self.namespace}/{obj['metadata']['name']}"
+        if event["type"] == "DELETED":
+            self.state.remove_job(key)
+            return
+        spec = obj.get("spec", {})
+        normalized = {
+            "resources": {"tpu": 1},
+            "min_replicas": spec.get("minReplicas", 0),
+            "max_replicas": spec.get("maxReplicas", 1),
+            "preemptible": spec.get("preemptible", True),
+            "template": spec.get("template", {}),
+        }
+        existing = self.state.get_job(key)
+        try:
+            if existing is None:
+                validate_job_spec(normalized)
+                self.state.create_job(key, spec=normalized)
+            else:
+                # Scaling limits and template are immutable; mutable
+                # fields (preemptible) take effect by persisting the
+                # validated spec.
+                validate_job_update(existing.spec, normalized)
+                self.state.update(key, spec=normalized)
+        except ValidationError as exc:
+            LOG.warning("rejecting %s: %s", key, exc)
 
     async def _reconcile_loop(self, api, core, interval: float = 5.0):
         while True:
+            try:
+                self._slice_inventory = await self._discover_slices(core)
+            except Exception:  # noqa: BLE001
+                LOG.exception("slice discovery failed; keeping last")
             for key, record in self.state.jobs().items():
                 try:
                     await self._reconcile_job(api, core, key, record)
@@ -207,6 +238,12 @@ class Operator:  # pragma: no cover - requires a live cluster
             namespace, label_selector=selector
         )
         live = [p for p in pods.items if p.metadata.deletion_timestamp is None]
+        if record.status in ("Succeeded", "Failed"):
+            for pod in live:
+                await core.delete_namespaced_pod(
+                    pod.metadata.name, namespace
+                )
+            return
         desired = record.allocation
 
         def pod_group(pod):
@@ -224,31 +261,91 @@ class Operator:  # pragma: no cover - requires a live cluster
             return annotated is not None and annotated != fingerprint
 
         drifted = any(pod_drifted(p) for p in live)
-        failed = []
+
+        # Classify terminated workers PER POD (a pod may run several
+        # containers — e.g. a sidecar — and the success condition
+        # compares pod counts): completion, graceful rescale, eviction
+        # (node preempted under the pod), or real failure (reference:
+        # controller.py:262-308).
+        succeeded, graceful, evicted, failed = [], [], [], []
         for pod in live:
-            for status in pod.status.container_statuses or []:
-                term = status.state.terminated
-                if term and term.exit_code not in (0, GRACEFUL_EXIT):
-                    failed.append((pod.metadata.name, term.exit_code))
-        if failed:
-            LOG.warning("%s worker failures: %s", key, failed)
-        if drifted or failed or len(live) != len(desired):
-            # Stop everything; next pass recreates at the new group.
+            if (getattr(pod.status, "reason", None) or "") == "Evicted":
+                evicted.append(pod.metadata.name)
+                continue
+            statuses = pod.status.container_statuses or []
+            terms = [s.state.terminated for s in statuses]
+            codes = [t.exit_code for t in terms if t is not None]
+            if not codes:
+                continue  # nothing terminated yet
+            if any(c not in (0, GRACEFUL_EXIT) for c in codes):
+                bad = [c for c in codes if c not in (0, GRACEFUL_EXIT)]
+                failed.append((pod.metadata.name, bad[0]))
+            elif any(c == GRACEFUL_EXIT for c in codes):
+                graceful.append(pod.metadata.name)
+            elif len(codes) == len(terms):
+                # Every container terminated, all with exit 0.
+                succeeded.append(pod.metadata.name)
+
+        if (
+            live
+            and not drifted
+            and len(succeeded) == len(live) == len(desired)
+        ):
+            LOG.info("%s: all %d workers succeeded", key, len(live))
+            self.state.update(key, status="Succeeded")
             for pod in live:
                 await core.delete_namespaced_pod(
                     pod.metadata.name, namespace
                 )
+            return
+
+        if failed:
+            LOG.warning("%s worker failures: %s", key, failed)
+            failures = record.failures + 1
+            self.state.update(key, failures=failures)
+            if failures > self.max_failures:
+                LOG.error(
+                    "%s exceeded failure budget (%d > %d): Failed",
+                    key,
+                    failures,
+                    self.max_failures,
+                )
+                self.state.update(key, status="Failed")
+                for pod in live:
+                    await core.delete_namespaced_pod(
+                        pod.metadata.name, namespace
+                    )
+                return
+
+        if (
+            drifted
+            or failed
+            or graceful
+            or evicted
+            or len(live) != len(desired)
+        ):
+            # Stop everything; next pass recreates at the new group.
             if live:
+                self.state.update(key, status="Stopping")
+                for pod in live:
+                    await core.delete_namespaced_pod(
+                        pod.metadata.name, namespace
+                    )
                 return
             self.state.update(key, group=record.group + 1)
+            record = self.state.get_job(key)
             for rank, node in enumerate(desired):
                 await core.create_namespaced_pod(
                     namespace,
                     self._worker_pod(name, record, rank, node),
                 )
             self.state.update(
-                key, status="Running" if desired else "Pending"
+                key, status="Starting" if desired else "Pending"
             )
+        elif record.status == "Starting" and live:
+            # Full complement at the right config and nothing
+            # terminated: the group is running.
+            self.state.update(key, status="Running")
 
     def _worker_pod(self, name, record, rank, node_pool):
         template = dict(record.spec.get("template") or {})

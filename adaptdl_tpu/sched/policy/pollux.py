@@ -107,7 +107,7 @@ class PolluxPolicy:
                     base_state[j, node_index[node_key]] += 1
 
         problem = _Problem(job_list, node_list, base_state)
-        seeds = self._seed_population(jobs, nodes, base_state)
+        seeds = self._seed_population(jobs, nodes, base_state, node_list)
         population, F, front = nsga2.minimize(
             evaluate=problem.evaluate,
             initial=seeds,
@@ -142,10 +142,80 @@ class PolluxPolicy:
             allocations[key] = alloc
         return allocations, desired_nodes
 
-    def _seed_population(self, jobs, nodes, base_state):
+    @staticmethod
+    def _greedy_seed(job_list, node_list):
+        """Fair round-robin seed: every job first gets its
+        max(min_replicas, 1), then jobs grow one replica at a time up
+        to their max while capacity lasts, honoring the
+        one-multi-replica-job-per-slice ICI rule. Gives the GA a
+        dense, fair, feasible starting point — from an all-zeros cold
+        start, small populations can fail to discover even obvious
+        packings (and a job-ordered greedy seed starves late jobs)."""
+        num_columns = len(node_list)
+        num_jobs = len(job_list)
+        state = np.zeros((num_jobs, num_columns), dtype=int)
+        free = [dict(n.resources) for n in node_list]
+        owner: list[int | None] = [None] * num_columns  # multi-job claim
+
+        def capacity(j, s):
+            caps = [
+                free[s].get(r, 0) // amount
+                for r, amount in job_list[j].resources.items()
+                if amount > 0
+            ]
+            return min(caps) if caps else 0
+
+        def add_one(j):
+            becoming_multi = state[j].sum() + 1 > 1
+            # Prefer slices this job already occupies, then fresh ones.
+            order = sorted(
+                range(num_columns), key=lambda s: (state[j, s] == 0, s)
+            )
+            for s in order:
+                if capacity(j, s) <= 0:
+                    continue
+                if becoming_multi and owner[s] not in (None, j):
+                    continue
+                if becoming_multi:
+                    # Claim every slice the now-multi job occupies.
+                    for t in range(num_columns):
+                        if state[j, t] or t == s:
+                            if owner[t] not in (None, j):
+                                break
+                    else:
+                        for t in range(num_columns):
+                            if state[j, t] or t == s:
+                                owner[t] = j
+                        state[j, s] += 1
+                        for r, amount in job_list[j].resources.items():
+                            free[s][r] = free[s].get(r, 0) - amount
+                        return True
+                    continue
+                state[j, s] += 1
+                for r, amount in job_list[j].resources.items():
+                    free[s][r] = free[s].get(r, 0) - amount
+                return True
+            return False
+
+        targets = [max(job.min_replicas, 1) for job in job_list]
+        maxes = [max(job.max_replicas, 1) for job in job_list]
+        for phase_targets in (targets, maxes):
+            progress = True
+            while progress:
+                progress = False
+                for j in range(num_jobs):
+                    if state[j].sum() < phase_targets[j] and add_one(j):
+                        progress = True
+        return state.reshape(1, -1)
+
+    def _seed_population(self, jobs, nodes, base_state, node_list):
         """Warm start from the previous population, remapped across job
-        and node churn (reference: pollux.py:94-119)."""
-        flat_base = base_state.reshape(1, -1)
+        and node churn (reference: pollux.py:94-119), plus a greedy
+        first-fit seed."""
+        greedy = self._greedy_seed(list(jobs.values()), node_list)
+        flat_base = np.concatenate(
+            [base_state.reshape(1, -1), greedy], axis=0
+        )
         if self._prev_population is None:
             return flat_base
         prev = self._prev_population.reshape(

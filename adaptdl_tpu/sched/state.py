@@ -44,6 +44,9 @@ class JobRecord:
     # rank -> address ("host:port"), registered by running workers.
     workers: dict[int, str] = field(default_factory=dict)
     group: int = 0  # restart group; workers of older groups are stale
+    # Non-graceful worker failures so far (exit-143 rescales and
+    # evictions never count); the controller gives up past its budget.
+    failures: int = 0
     creation_timestamp: float = field(default_factory=time.time)
 
 

@@ -1,0 +1,342 @@
+"""k8s operator state machine, driven against an in-memory fake API
+client — no cluster, no kubernetes_asyncio (reference coverage target:
+sched/adaptdl_sched/controller.py:101-184,262-318 lifecycle +
+completion/failure semantics)."""
+
+import asyncio
+from types import SimpleNamespace
+
+import pytest
+
+from adaptdl_tpu.sched.k8s.operator import GRACEFUL_EXIT, Operator
+
+
+def _pod_from_manifest(namespace, manifest):
+    meta = manifest["metadata"]
+    return SimpleNamespace(
+        metadata=SimpleNamespace(
+            name=meta["name"],
+            namespace=namespace,
+            labels=dict(meta.get("labels", {})),
+            annotations=dict(meta.get("annotations", {})),
+            deletion_timestamp=None,
+        ),
+        status=SimpleNamespace(
+            reason=None, container_statuses=[], phase="Running"
+        ),
+        manifest=manifest,
+    )
+
+
+class FakeCore:
+    """The slice of CoreV1Api the operator touches."""
+
+    def __init__(self):
+        self.pods: dict[str, SimpleNamespace] = {}
+        self.nodes: list[SimpleNamespace] = []
+
+    async def list_namespaced_pod(self, namespace, label_selector=None):
+        items = list(self.pods.values())
+        if label_selector:
+            k, v = label_selector.split("=", 1)
+            items = [p for p in items if p.metadata.labels.get(k) == v]
+        return SimpleNamespace(items=items)
+
+    async def create_namespaced_pod(self, namespace, manifest):
+        pod = _pod_from_manifest(namespace, manifest)
+        self.pods[pod.metadata.name] = pod
+        return pod
+
+    async def delete_namespaced_pod(self, name, namespace):
+        self.pods.pop(name, None)
+
+    async def list_node(self):
+        return SimpleNamespace(items=self.nodes)
+
+    # -- test helpers ------------------------------------------------
+
+    def terminate(self, name, exit_code, total=1, done=None):
+        """Mark ``done`` of the pod's ``total`` containers terminated
+        with ``exit_code`` (rest still running)."""
+        done = total if done is None else done
+        self.pods[name].status.container_statuses = [
+            SimpleNamespace(
+                state=SimpleNamespace(
+                    terminated=(
+                        SimpleNamespace(exit_code=exit_code)
+                        if i < done
+                        else None
+                    )
+                )
+            )
+            for i in range(total)
+        ]
+
+    def evict(self, name):
+        self.pods[name].status.reason = "Evicted"
+
+    def add_node(self, name, pool, tpus):
+        self.nodes.append(
+            SimpleNamespace(
+                metadata=SimpleNamespace(
+                    name=name,
+                    labels={"cloud.google.com/gke-nodepool": pool},
+                ),
+                status=SimpleNamespace(
+                    allocatable={"google.com/tpu": tpus}
+                ),
+            )
+        )
+
+
+def _reconcile(op, core, key):
+    record = op.state.get_job(key)
+    asyncio.run(op._reconcile_job(None, core, key, record))
+
+
+@pytest.fixture
+def op():
+    operator = Operator(namespace="ns", max_failures=2)
+    operator.state.create_job(
+        "ns/job", spec={"max_replicas": 4, "template": {
+            "spec": {"containers": [{"name": "main", "image": "img"}]}
+        }}
+    )
+    operator.state.update("ns/job", allocation=["pool-a", "pool-a"])
+    return operator
+
+
+def test_pending_to_starting_to_running(op):
+    core = FakeCore()
+    _reconcile(op, core, "ns/job")
+    assert len(core.pods) == 2
+    assert op.state.get_job("ns/job").status == "Starting"
+    assert op.state.get_job("ns/job").group == 1
+    _reconcile(op, core, "ns/job")
+    assert op.state.get_job("ns/job").status == "Running"
+    # Steady state is idempotent.
+    before = dict(core.pods)
+    _reconcile(op, core, "ns/job")
+    assert core.pods == before
+
+
+def test_worker_pod_env_and_placement(op):
+    core = FakeCore()
+    op.state.update(
+        "ns/job", topology={"seqShards": 2, "modelShards": 1}
+    )
+    _reconcile(op, core, "ns/job")
+    pod = core.pods["job-1-0"]
+    container = pod.manifest["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in container["env"]}
+    assert env["ADAPTDL_NUM_REPLICAS"] == "2"
+    assert env["ADAPTDL_REPLICA_RANK"] == "0"
+    assert env["ADAPTDL_NUM_RESTARTS"] == "1"
+    assert env["ADAPTDL_SEQ_SHARDS"] == "2"
+    assert (
+        pod.manifest["spec"]["nodeSelector"][
+            "cloud.google.com/gke-nodepool"
+        ]
+        == "pool-a"
+    )
+    assert pod.metadata.annotations["adaptdl/group"] == "1"
+
+
+def test_allocation_drift_stops_then_restarts(op):
+    core = FakeCore()
+    _reconcile(op, core, "ns/job")
+    _reconcile(op, core, "ns/job")
+    assert op.state.get_job("ns/job").status == "Running"
+    # Allocator grows the job: same pods, new allocation.
+    op.state.update("ns/job", allocation=["pool-a"] * 3)
+    _reconcile(op, core, "ns/job")
+    assert op.state.get_job("ns/job").status == "Stopping"
+    assert core.pods == {}
+    _reconcile(op, core, "ns/job")
+    record = op.state.get_job("ns/job")
+    assert record.status == "Starting"
+    assert record.group == 2
+    assert len(core.pods) == 3
+    assert record.failures == 0
+
+
+def test_topology_only_drift_restarts(op):
+    core = FakeCore()
+    _reconcile(op, core, "ns/job")
+    _reconcile(op, core, "ns/job")
+    op.state.update(
+        "ns/job", topology={"seqShards": 2, "modelShards": 1}
+    )
+    _reconcile(op, core, "ns/job")
+    assert op.state.get_job("ns/job").status == "Stopping"
+
+
+def test_legacy_pod_without_config_annotation_not_drifted(op):
+    core = FakeCore()
+    _reconcile(op, core, "ns/job")
+    for pod in core.pods.values():
+        pod.metadata.annotations.pop("adaptdl/config")
+    _reconcile(op, core, "ns/job")
+    assert op.state.get_job("ns/job").status == "Running"
+    assert len(core.pods) == 2
+
+
+def test_graceful_exit_143_restarts_without_counting(op):
+    core = FakeCore()
+    _reconcile(op, core, "ns/job")
+    core.terminate("job-1-0", GRACEFUL_EXIT)
+    _reconcile(op, core, "ns/job")
+    assert op.state.get_job("ns/job").status == "Stopping"
+    _reconcile(op, core, "ns/job")
+    record = op.state.get_job("ns/job")
+    assert record.group == 2
+    assert record.failures == 0
+
+
+def test_eviction_tolerated(op):
+    core = FakeCore()
+    _reconcile(op, core, "ns/job")
+    core.evict("job-1-1")
+    _reconcile(op, core, "ns/job")
+    _reconcile(op, core, "ns/job")
+    record = op.state.get_job("ns/job")
+    assert record.failures == 0
+    assert record.group == 2
+    assert record.status == "Starting"
+
+
+def test_failure_budget_then_failed(op):
+    core = FakeCore()
+    _reconcile(op, core, "ns/job")
+    for expected_failures in (1, 2):
+        pod_name = f"job-{op.state.get_job('ns/job').group}-0"
+        core.terminate(pod_name, 1)
+        _reconcile(op, core, "ns/job")  # counts + stops
+        record = op.state.get_job("ns/job")
+        assert record.failures == expected_failures
+        assert record.status == "Stopping"
+        _reconcile(op, core, "ns/job")  # restarts
+        assert op.state.get_job("ns/job").status == "Starting"
+    pod_name = f"job-{op.state.get_job('ns/job').group}-0"
+    core.terminate(pod_name, 1)
+    _reconcile(op, core, "ns/job")
+    record = op.state.get_job("ns/job")
+    assert record.failures == 3
+    assert record.status == "Failed"
+    assert core.pods == {}
+    # Terminal states stay terminal and keep the cluster clean.
+    _reconcile(op, core, "ns/job")
+    assert op.state.get_job("ns/job").status == "Failed"
+
+
+def test_all_workers_succeed(op):
+    core = FakeCore()
+    _reconcile(op, core, "ns/job")
+    for name in list(core.pods):
+        core.terminate(name, 0)
+    _reconcile(op, core, "ns/job")
+    assert op.state.get_job("ns/job").status == "Succeeded"
+    assert core.pods == {}
+
+
+def test_multi_container_pods_counted_per_pod(op):
+    """A pod with a sidecar must count as ONE worker: success fires
+    when every container of every pod exits 0, not before (and not
+    never, which per-container counting caused)."""
+    core = FakeCore()
+    _reconcile(op, core, "ns/job")
+    names = list(core.pods)
+    # Main containers done, sidecars still running: not succeeded yet.
+    for name in names:
+        core.terminate(name, 0, total=2, done=1)
+    _reconcile(op, core, "ns/job")
+    assert op.state.get_job("ns/job").status != "Succeeded"
+    assert len(core.pods) == 2
+    for name in names:
+        core.terminate(name, 0, total=2, done=2)
+    _reconcile(op, core, "ns/job")
+    assert op.state.get_job("ns/job").status == "Succeeded"
+
+
+def test_scale_from_zero_bootstraps_one_slice():
+    """A cluster scaled to zero with pending work must request one
+    slice instead of deadlocking at desired=0 forever."""
+    from adaptdl_tpu.sched.allocator import Allocator
+    from adaptdl_tpu.sched.expander import (
+        ClusterExpander,
+        InMemorySliceProvisioner,
+    )
+    from adaptdl_tpu.sched.policy import PolluxPolicy
+    from adaptdl_tpu.sched.state import ClusterState
+
+    state = ClusterState()
+    state.create_job("ns/j", spec={"max_replicas": 4})
+    prov = InMemorySliceProvisioner(chips_per_slice=4, initial=0)
+    exp = ClusterExpander(
+        prov, min_slices=0, max_slices=4, scale_down_delay=100.0
+    )
+    allocator = Allocator(
+        state,
+        prov.nodes,
+        node_template=prov.node_template(),
+        policy=PolluxPolicy(pop_size=16, generations=10),
+        expander=exp,
+    )
+    assert allocator.optimize_once() == {}  # no capacity yet
+    assert exp.reconcile_once(now=0.0) == 1  # bootstrap actuates
+    alloc = allocator.optimize_once()
+    assert len(alloc["ns/j"]) >= 1
+
+
+def test_job_watch_events_validate_and_create():
+    operator = Operator(namespace="ns")
+    operator.handle_job_event(
+        {
+            "type": "ADDED",
+            "object": {
+                "metadata": {"name": "good"},
+                "spec": {"minReplicas": 1, "maxReplicas": 4},
+            },
+        }
+    )
+    assert operator.state.get_job("ns/good") is not None
+    # Invalid spec rejected at the boundary.
+    operator.handle_job_event(
+        {
+            "type": "ADDED",
+            "object": {
+                "metadata": {"name": "bad"},
+                "spec": {"minReplicas": 8, "maxReplicas": 2},
+            },
+        }
+    )
+    assert operator.state.get_job("ns/bad") is None
+    # Scaling limits are immutable on update.
+    operator.handle_job_event(
+        {
+            "type": "MODIFIED",
+            "object": {
+                "metadata": {"name": "good"},
+                "spec": {"minReplicas": 1, "maxReplicas": 16},
+            },
+        }
+    )
+    assert operator.state.get_job("ns/good").spec["max_replicas"] == 4
+    # Deletion removes the job.
+    operator.handle_job_event(
+        {"type": "DELETED", "object": {"metadata": {"name": "good"}}}
+    )
+    assert operator.state.get_job("ns/good") is None
+
+
+def test_discover_slices_groups_by_node_pool():
+    operator = Operator(namespace="ns")
+    core = FakeCore()
+    core.add_node("n0", "v5e-pool-a", 4)
+    core.add_node("n1", "v5e-pool-a", 4)
+    core.add_node("n2", "v5e-pool-b", 8)
+    core.add_node("cpu", "cpu-pool", 0)
+    nodes = asyncio.run(operator._discover_slices(core))
+    assert nodes["v5e-pool-a"].resources["tpu"] == 8
+    assert nodes["v5e-pool-b"].resources["tpu"] == 8
+    assert "cpu-pool" not in nodes

@@ -57,3 +57,66 @@ def test_expander_grows_immediately_shrinks_with_delay():
     # Bounds clamp.
     exp.request(99)
     assert exp.reconcile_once(now=120.0) == 8
+
+
+def test_autoscaling_round_trip_under_churn():
+    """VERDICT r1 item 4's bar: desired-slice changes materialize as
+    provisioner resize calls, newly provisioned capacity is allocated
+    on the next cycle, and job completion shrinks the cluster only
+    after the hysteresis delay."""
+    from adaptdl_tpu.sched.allocator import Allocator
+    from adaptdl_tpu.sched.expander import InMemorySliceProvisioner
+    from adaptdl_tpu.sched.policy import PolluxPolicy
+    from adaptdl_tpu.sched.state import ClusterState
+
+    hints = {
+        "initBatchSize": 128,
+        "localBszBounds": [64, 256],
+        "maxBatchSize": 1280,
+        "maxProfiledReplicas": 8,
+        "gradientAccumulation": True,
+        "gradParams": {"sqr": 0.00136, "var": 0.000502},
+        "perfParams": {
+            "alpha_c": 0.121,
+            "beta_c": 0.00568,
+            "alpha_n": 0.0236,
+            "beta_n": 0.00634,
+            "alpha_r": 0.0118,
+            "beta_r": 0.00317,
+            "gamma": 1.14,
+        },
+    }
+    state = ClusterState()
+    for i in range(3):
+        state.create_job(f"ns/j{i}", spec={"max_replicas": 8})
+        state.update(f"ns/j{i}", hints=dict(hints))
+    prov = InMemorySliceProvisioner(chips_per_slice=4, initial=1)
+    exp = ClusterExpander(
+        prov, min_slices=1, max_slices=8, scale_down_delay=100.0
+    )
+    allocator = Allocator(
+        state,
+        prov.nodes,
+        node_template=prov.node_template(),
+        policy=PolluxPolicy(pop_size=16, generations=10),
+        expander=exp,
+    )
+    first = allocator.optimize_once()
+    used_first = {n for alloc in first.values() for n in alloc}
+    assert used_first <= {"slice-0"}  # only provisioned capacity
+    # The allocator's desired-slice request reaches the provisioner.
+    assert exp.reconcile_once(now=0.0) > 1
+    assert prov.resize_calls, "expansion must actuate"
+    grown = prov.current_slices()
+    # New capacity is allocated on the next cycle.
+    second = allocator.optimize_once()
+    used_second = {n for alloc in second.values() for n in alloc}
+    assert len(used_second) > len(used_first), (first, second)
+    total_chips = sum(len(a) for a in second.values())
+    assert total_chips > sum(len(a) for a in first.values())
+    # Churn: jobs finish; desire drops but shrink waits out the delay.
+    for i in range(3):
+        state.update(f"ns/j{i}", status="Succeeded")
+    allocator.optimize_once()
+    assert exp.reconcile_once(now=10.0) == grown  # hysteresis holds
+    assert exp.reconcile_once(now=200.0) == 1  # then shrink actuates
