@@ -113,7 +113,15 @@ def _cmd_logs(args) -> int:
 
 
 def _cmd_cp(args) -> int:
-    shutil.copy2(args.src, args.dst)
+    import os
+
+    if os.path.isdir(args.src):
+        # Whole checkpoint dirs are the common case (the reference's
+        # cp pulls them off the PVC via a helper pod, pvc.py:81-128;
+        # locally it is a recursive copy).
+        shutil.copytree(args.src, args.dst, dirs_exist_ok=True)
+    else:
+        shutil.copy2(args.src, args.dst)
     return 0
 
 
@@ -173,7 +181,16 @@ def main(argv=None) -> int:
     p.set_defaults(fn=_cmd_tensorboard)
 
     args = parser.parse_args(argv)
-    return args.fn(args)
+    from adaptdl_tpu.sched.validator import ValidationError
+
+    try:
+        return args.fn(args)
+    except ValidationError as exc:
+        print(f"invalid job spec: {exc}", file=sys.stderr)
+        return 2
+    except BrokenPipeError:
+        # stdout went away (e.g. piped into `head`); not an error.
+        return 0
 
 
 if __name__ == "__main__":

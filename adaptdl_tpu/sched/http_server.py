@@ -1,0 +1,73 @@
+"""Threaded aiohttp server shell shared by the scheduler's REST faces.
+
+The supervisor (rendezvous + hints) and the admission webhook
+(validator) both need the same thing: an aiohttp app served from a
+background thread with its own event loop, so synchronous code (the
+local runner, trainers, tests) can start/stop them without an async
+main. One implementation here; subclasses provide ``build_app``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+from aiohttp import web
+
+
+class ThreadedHttpServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._thread: threading.Thread | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._started = threading.Event()
+        self._error: BaseException | None = None
+
+    def build_app(self) -> web.Application:  # pragma: no cover
+        raise NotImplementedError
+
+    def start(self) -> str:
+        """Start in a background thread; returns the base URL."""
+
+        def run():
+            try:
+                self._loop = asyncio.new_event_loop()
+                asyncio.set_event_loop(self._loop)
+                runner = web.AppRunner(self.build_app())
+                self._loop.run_until_complete(runner.setup())
+                site = web.TCPSite(runner, self._host, self._port)
+                self._loop.run_until_complete(site.start())
+                self._port = site._server.sockets[0].getsockname()[1]
+            except BaseException as exc:  # noqa: BLE001
+                self._error = exc
+                self._started.set()
+                return
+            self._started.set()
+            self._loop.run_forever()
+            self._loop.run_until_complete(runner.cleanup())
+
+        self._error = None
+        self._thread = threading.Thread(
+            target=run, name=type(self).__name__, daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError(
+                f"{type(self).__name__} failed to start within 30s"
+            )
+        if self._error is not None:
+            raise RuntimeError(
+                f"{type(self).__name__} failed to start: {self._error!r}"
+            ) from self._error
+        return self.url
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self._port}"
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=10)

@@ -19,11 +19,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import threading
 
 from aiohttp import web
 
 from adaptdl_tpu import sched_hints
+from adaptdl_tpu.sched.http_server import ThreadedHttpServer
 from adaptdl_tpu.sched.state import ClusterState
 
 LOG = logging.getLogger(__name__)
@@ -32,14 +32,10 @@ _POLL_INTERVAL = 0.25
 _DISCOVER_TIMEOUT = 300.0
 
 
-class Supervisor:
+class Supervisor(ThreadedHttpServer):
     def __init__(self, state: ClusterState, host="127.0.0.1", port=0):
+        super().__init__(host=host, port=port)
         self._state = state
-        self._host = host
-        self._port = port
-        self._thread: threading.Thread | None = None
-        self._loop: asyncio.AbstractEventLoop | None = None
-        self._started = threading.Event()
 
     # -- handlers -----------------------------------------------------
 
@@ -134,7 +130,7 @@ class Supervisor:
 
     # -- lifecycle ----------------------------------------------------
 
-    def _build_app(self) -> web.Application:
+    def build_app(self) -> web.Application:
         app = web.Application()
         app.add_routes(
             [
@@ -153,45 +149,3 @@ class Supervisor:
         )
         return app
 
-    def start(self) -> str:
-        """Start in a background thread; returns the base URL."""
-
-        def run():
-            try:
-                self._loop = asyncio.new_event_loop()
-                asyncio.set_event_loop(self._loop)
-                runner = web.AppRunner(self._build_app())
-                self._loop.run_until_complete(runner.setup())
-                site = web.TCPSite(runner, self._host, self._port)
-                self._loop.run_until_complete(site.start())
-                self._port = site._server.sockets[0].getsockname()[1]
-            except BaseException as exc:  # noqa: BLE001
-                self._error = exc
-                self._started.set()
-                return
-            self._started.set()
-            self._loop.run_forever()
-            self._loop.run_until_complete(runner.cleanup())
-
-        self._error: BaseException | None = None
-        self._thread = threading.Thread(
-            target=run, name="adaptdl-supervisor", daemon=True
-        )
-        self._thread.start()
-        if not self._started.wait(timeout=30):
-            raise RuntimeError("supervisor failed to start within 30s")
-        if self._error is not None:
-            raise RuntimeError(
-                f"supervisor failed to start: {self._error!r}"
-            ) from self._error
-        return self.url
-
-    @property
-    def url(self) -> str:
-        return f"http://{self._host}:{self._port}"
-
-    def stop(self) -> None:
-        if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
-        if self._thread is not None:
-            self._thread.join(timeout=10)

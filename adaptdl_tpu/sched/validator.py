@@ -1,18 +1,45 @@
-"""Job-spec validation (the admission-webhook logic).
+"""Job-spec validation + the admission webhook server.
 
-The reference validates AdaptDLJobs in a mutating/validating webhook:
-dry-run pod template creation, maxReplicas >= minReplicas, spec
-immutability on update (reference:
-sched/adaptdl_sched/validator.py:70-113). The core checks live here as
-plain functions — used by the local runner and CLI directly, and by
-the k8s webhook handler when deployed with the operator.
+The reference validates AdaptDLJobs in a validating webhook: dry-run
+pod template creation, maxReplicas >= minReplicas, spec immutability on
+update (reference: sched/adaptdl_sched/validator.py:70-134, deployed by
+helm/adaptdl-sched/templates/validator-webhook.yaml). The core checks
+live here as plain functions — used by the local runner and CLI
+directly — and :class:`AdmissionWebhook` serves them over HTTP in the
+k8s AdmissionReview wire format, so a bad job is rejected at the
+cluster boundary before any pod exists. The reference's dry-run pod
+creation (its way of checking the template) is replaced by structural
+template validation: the operator injects env/annotations into the
+template verbatim, so the webhook checks the invariants that injection
+and scheduling depend on.
 """
 
 from __future__ import annotations
 
 from typing import Any
 
+from adaptdl_tpu.sched.http_server import ThreadedHttpServer
+
 IMMUTABLE_FIELDS = ("template", "min_replicas", "max_replicas")
+
+# Env vars the operator injects into every worker container
+# (operator.py _worker_pod): a template that sets these would be
+# silently overridden per-replica, so the webhook rejects them. Vars
+# like ADAPTDL_CHECKPOINT_PATH are legitimately template-provided.
+OPERATOR_INJECTED_ENV = frozenset(
+    {
+        "ADAPTDL_JOB_ID",
+        "ADAPTDL_REPLICA_RANK",
+        "ADAPTDL_PROCESS_RANK",
+        "ADAPTDL_NUM_REPLICAS",
+        "ADAPTDL_NUM_PROCESSES",
+        "ADAPTDL_NUM_NODES",
+        "ADAPTDL_NUM_RESTARTS",
+        "ADAPTDL_SUPERVISOR_URL",
+        "ADAPTDL_SEQ_SHARDS",
+        "ADAPTDL_MODEL_SHARDS",
+    }
+)
 
 
 class ValidationError(ValueError):
@@ -40,6 +67,44 @@ def validate_job_spec(spec: dict[str, Any]) -> None:
             )
 
 
+def validate_pod_template(template: dict[str, Any]) -> None:
+    """Structural stand-in for the reference's dry-run pod creation
+    (validator.py:70-113): the worker-pod builder extends
+    ``spec.containers[*].env`` and overwrites restartPolicy and
+    nodeSelector, so those must exist in injectable shape."""
+    if not template:
+        return  # templates are optional for the local backends
+    spec = template.get("spec")
+    if not isinstance(spec, dict):
+        raise ValidationError("template.spec must be an object")
+    containers = spec.get("containers")
+    if not isinstance(containers, list) or not containers:
+        raise ValidationError(
+            "template.spec.containers must be a non-empty list"
+        )
+    for i, container in enumerate(containers):
+        if not isinstance(container, dict):
+            raise ValidationError(f"containers[{i}] must be an object")
+        if not container.get("name"):
+            raise ValidationError(f"containers[{i}].name is required")
+        if not container.get("image"):
+            raise ValidationError(f"containers[{i}].image is required")
+        env = container.get("env", [])
+        if not isinstance(env, list):
+            raise ValidationError(f"containers[{i}].env must be a list")
+        for entry in env:
+            name = isinstance(entry, dict) and entry.get("name")
+            if not name:
+                raise ValidationError(
+                    f"containers[{i}].env entries need a name"
+                )
+            if str(name) in OPERATOR_INJECTED_ENV:
+                raise ValidationError(
+                    f"containers[{i}].env sets reserved variable "
+                    f"{name!r} (injected per-replica by the operator)"
+                )
+
+
 def validate_job_update(
     old_spec: dict[str, Any], new_spec: dict[str, Any]
 ) -> None:
@@ -50,3 +115,79 @@ def validate_job_update(
     for field in IMMUTABLE_FIELDS:
         if old_spec.get(field) != new_spec.get(field):
             raise ValidationError(f"spec.{field} is immutable")
+
+
+def _normalize_crd_spec(obj: dict[str, Any]) -> dict[str, Any]:
+    """AdaptDLJob CRD spec (camelCase wire form) -> internal spec."""
+    spec = obj.get("spec") or {}
+    return {
+        "min_replicas": spec.get("minReplicas", 0),
+        "max_replicas": spec.get("maxReplicas", 1),
+        "preemptible": spec.get("preemptible", True),
+        "template": spec.get("template", {}),
+    }
+
+
+class AdmissionWebhook(ThreadedHttpServer):
+    """The validating-webhook server: POST /validate takes a k8s
+    AdmissionReview and answers allowed/denied with a message.
+
+    Served from the scheduler deployment next to the supervisor (the
+    reference runs it as its own container behind
+    validator-webhook.yaml); same threaded aiohttp shell.
+    """
+
+    def build_app(self):
+        from aiohttp import web
+
+        app = web.Application()
+        app.add_routes([web.post("/validate", self._handle_validate)])
+        return app
+
+    def review(self, request: dict[str, Any]) -> tuple[bool, str]:
+        """Evaluate one AdmissionReview request dict. Any failure to
+        make sense of the object is a denial, never an exception — a
+        webhook 500 either blocks ALL job writes (failurePolicy=Fail)
+        or silently admits the malformed job (Ignore)."""
+        try:
+            obj = request.get("object") or {}
+            operation = request.get("operation", "CREATE")
+            new_spec = _normalize_crd_spec(obj)
+            if operation == "UPDATE":
+                old_spec = _normalize_crd_spec(
+                    request.get("oldObject") or {}
+                )
+                validate_job_update(old_spec, new_spec)
+            else:
+                validate_job_spec(new_spec)
+            validate_pod_template(new_spec.get("template") or {})
+        except ValidationError as exc:
+            return False, str(exc)
+        except Exception as exc:  # noqa: BLE001 - malformed object
+            return False, f"malformed AdaptDLJob object: {exc!r}"
+        return True, ""
+
+    async def _handle_validate(self, request):
+        from aiohttp import web
+
+        try:
+            review = await request.json()
+        except Exception:  # noqa: BLE001
+            return web.json_response(
+                {"error": "body must be an AdmissionReview"}, status=400
+            )
+        req = (review or {}).get("request") or {}
+        allowed, message = self.review(req)
+        response: dict[str, Any] = {
+            "uid": req.get("uid", ""),
+            "allowed": allowed,
+        }
+        if not allowed:
+            response["status"] = {"message": message}
+        return web.json_response(
+            {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "response": response,
+            }
+        )

@@ -63,6 +63,34 @@ class TrainState(NamedTuple):
     rng: jax.Array
 
 
+def _materialize(x, sharding) -> jax.Array:
+    """Place a host/device value onto a (possibly multi-process) mesh.
+
+    ``jax.device_put`` only accepts shardings whose devices are all
+    addressable from this process; on a multi-host mesh each process
+    must instead supply its local shards via
+    ``jax.make_array_from_callback``. PRNG key arrays round-trip
+    through their raw key data (callbacks produce plain arrays).
+    """
+    if isinstance(x, jax.Array) and jax.dtypes.issubdtype(
+        x.dtype, jax.dtypes.prng_key
+    ):
+        data = jax.random.key_data(x)
+        placed = _materialize(np.asarray(jax.device_get(data)), sharding)
+        return jax.random.wrap_key_data(placed)
+    if sharding.is_fully_addressable:
+        if isinstance(x, jax.Array):
+            # Copy: device_put aliases buffers whose sharding already
+            # matches, and the donated train step would then delete
+            # the caller's array out from under them.
+            x = jnp.array(x, copy=True)
+        return jax.device_put(x, sharding)
+    host = np.asarray(jax.device_get(x))
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx]
+    )
+
+
 def _find_adam_nu(opt_state) -> Any | None:
     """Locate Adam's second-moment tree inside an optax state."""
     if isinstance(opt_state, optax.ScaleByAdamState):
@@ -224,14 +252,7 @@ class ElasticTrainer:
         ``param_sharding_fn``."""
 
         def put(x, spec):
-            # Copy: device_put aliases buffers whose sharding already
-            # matches, and the donated train step would then delete the
-            # caller's initial params out from under a second trainer.
-            if isinstance(x, jax.Array) and not jax.dtypes.issubdtype(
-                x.dtype, jax.dtypes.prng_key
-            ):
-                x = jnp.array(x, copy=True)
-            return jax.device_put(x, NamedSharding(self.mesh, spec))
+            return _materialize(x, NamedSharding(self.mesh, spec))
 
         specs = self._param_spec_tree(self._init_params)
         params = jax.tree.map(put, self._init_params, specs)
@@ -665,7 +686,7 @@ class TrainerCheckpoint(checkpoint.State):
         specs = trainer.state_spec_tree(host_state)
         self._set_state(
             jax.tree.map(
-                lambda x, s: jax.device_put(
+                lambda x, s: _materialize(
                     x, NamedSharding(trainer.mesh, s)
                 ),
                 host_state,

@@ -1,0 +1,273 @@
+"""CLI verb tests (reference surface: cli/bin/adaptdl:133-396) plus
+the admission webhook over real HTTP (reference:
+sched/adaptdl_sched/validator.py:70-134 behind its webhook service)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+import requests
+
+from adaptdl_tpu.cli import main
+
+TRAIN_SCRIPT = """
+import os
+os.environ.setdefault("ADAPTDL_FIT_INTERVAL", "2")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np, optax
+import jax.numpy as jnp
+import adaptdl_tpu
+from adaptdl_tpu import checkpoint, env, epoch, metrics
+from adaptdl_tpu.data import AdaptiveDataLoader
+from adaptdl_tpu.trainer import ElasticTrainer
+
+adaptdl_tpu.initialize_job()
+rng = np.random.default_rng(0)
+data = {"x": rng.normal(size=(64, 4)).astype(np.float32),
+        "y": rng.normal(size=64).astype(np.float32)}
+def loss_fn(params, batch, _rng):
+    return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2)
+trainer = ElasticTrainer(loss_fn, {"w": jnp.zeros(4)}, optax.sgd(0.05), 16)
+holder = {"state": trainer.init_state()}
+ck = trainer.make_checkpoint_state(
+    lambda: holder["state"], lambda s: holder.__setitem__("state", s))
+checkpoint.load_state(ck)
+metrics.ensure_checkpoint_registered()
+loader = AdaptiveDataLoader(data, batch_size=16)
+for e in epoch.remaining_epochs_until(4):
+    for batch in loader:
+        holder["state"], m = trainer.run_step(holder["state"], batch, loader)
+print("cli-job done", flush=True)
+"""
+
+
+def test_submit_runs_job_to_completion(tmp_path, capfd, monkeypatch):
+    """`submit` against the live local runner: the job trains, prints,
+    and the CLI returns its exit code."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        os.pathsep.join(
+            filter(None, [repo_root, os.environ.get("PYTHONPATH")])
+        ),
+    )
+    script = tmp_path / "train.py"
+    script.write_text(TRAIN_SCRIPT)
+    rc = main(
+        [
+            "submit",
+            str(script),
+            "--checkpoint-dir",
+            str(tmp_path / "ckpt"),
+            "--chips",
+            "2",
+            "--max-replicas",
+            "2",
+        ]
+    )
+    assert rc == 0
+    out, _ = capfd.readouterr()
+    assert "cli-job done" in out
+
+
+def test_submit_rejects_invalid_spec(tmp_path, capsys):
+    script = tmp_path / "train.py"
+    script.write_text("print('hi')")
+    rc = main(
+        [
+            "submit",
+            str(script),
+            "--checkpoint-dir",
+            str(tmp_path / "ckpt"),
+            "--min-replicas",
+            "8",
+            "--max-replicas",
+            "2",
+        ]
+    )
+    assert rc == 2
+    assert "invalid job spec" in capsys.readouterr().err
+
+
+def test_submit_k8s_dry_run_renders_manifest(tmp_path, capsys):
+    import yaml
+
+    script = tmp_path / "train.py"
+    script.write_text("pass")
+    rc = main(
+        [
+            "submit",
+            str(script),
+            "--backend",
+            "k8s",
+            "--name",
+            "myjob",
+            "--max-replicas",
+            "16",
+            "--dry-run",
+        ]
+    )
+    assert rc == 0
+    manifest = yaml.safe_load(capsys.readouterr().out)
+    assert manifest["kind"] == "AdaptDLJob"
+    assert manifest["spec"]["maxReplicas"] == 16
+
+
+def test_ls_and_hints_against_live_supervisor(capsys):
+    from adaptdl_tpu.sched.state import ClusterState
+    from adaptdl_tpu.sched.supervisor import Supervisor
+
+    state = ClusterState()
+    state.create_job("ns/job", spec={"max_replicas": 4})
+    state.update(
+        "ns/job",
+        allocation=["slice-0"] * 2,
+        hints={"initBatchSize": 64},
+    )
+    supervisor = Supervisor(state)
+    url = supervisor.start()
+    try:
+        assert main(["ls", "--supervisor", url]) == 0
+        out = capsys.readouterr().out
+        assert 'adaptdl_job_replicas{job="ns/job"} 2' in out
+        assert main(["hints", "ns/job", "--supervisor", url]) == 0
+        hints = json.loads(capsys.readouterr().out)
+        assert hints["initBatchSize"] == 64
+    finally:
+        supervisor.stop()
+
+
+def test_logs_and_cp(tmp_path, capfd):
+    log = tmp_path / "job.log"
+    log.write_text("".join(f"line-{i}\n" for i in range(100)))
+    rc = main(["logs", "--log-file", str(log), "-n", "5"])
+    assert rc == 0
+    out, _ = capfd.readouterr()
+    assert "line-99" in out and "line-95" in out
+    assert "line-94" not in out
+
+    src = tmp_path / "checkpoint-0.0"
+    src.mkdir()
+    (src / "model").write_bytes(b"weights")
+    dst = tmp_path / "out"
+    assert main(["cp", str(src), str(dst)]) == 0
+    assert (dst / "model").read_bytes() == b"weights"
+    assert main(["cp", str(src / "model"), str(tmp_path / "m.bin")]) == 0
+    assert (tmp_path / "m.bin").read_bytes() == b"weights"
+
+
+# ---- admission webhook over HTTP -----------------------------------
+
+
+def _review(url, obj, operation="CREATE", old=None):
+    body = {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": "test-uid",
+            "operation": operation,
+            "object": obj,
+            "oldObject": old,
+        },
+    }
+    return requests.post(f"{url}/validate", json=body, timeout=10).json()
+
+
+def test_admission_webhook_over_http():
+    from adaptdl_tpu.sched.validator import AdmissionWebhook
+
+    webhook = AdmissionWebhook()
+    url = webhook.start()
+    try:
+        good = {
+            "spec": {
+                "minReplicas": 1,
+                "maxReplicas": 4,
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {"name": "main", "image": "img:1"}
+                        ]
+                    }
+                },
+            }
+        }
+        resp = _review(url, good)
+        assert resp["response"]["allowed"] is True
+        assert resp["response"]["uid"] == "test-uid"
+
+        bad = {"spec": {"minReplicas": 8, "maxReplicas": 2}}
+        resp = _review(url, bad)
+        assert resp["response"]["allowed"] is False
+        assert "max_replicas" in resp["response"]["status"]["message"]
+
+        # Template problems are rejected before any pod exists.
+        no_image = {
+            "spec": {
+                "maxReplicas": 2,
+                "template": {
+                    "spec": {"containers": [{"name": "main"}]}
+                },
+            }
+        }
+        resp = _review(url, no_image)
+        assert resp["response"]["allowed"] is False
+        assert "image" in resp["response"]["status"]["message"]
+
+        reserved = {
+            "spec": {
+                "maxReplicas": 2,
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "main",
+                                "image": "img",
+                                "env": [
+                                    {
+                                        "name": "ADAPTDL_NUM_REPLICAS",
+                                        "value": "9",
+                                    }
+                                ],
+                            }
+                        ]
+                    }
+                },
+            }
+        }
+        resp = _review(url, reserved)
+        assert resp["response"]["allowed"] is False
+        assert "reserved" in resp["response"]["status"]["message"]
+
+        # Immutability on UPDATE.
+        changed = json.loads(json.dumps(good))
+        changed["spec"]["maxReplicas"] = 8
+        resp = _review(url, changed, operation="UPDATE", old=good)
+        assert resp["response"]["allowed"] is False
+        assert "immutable" in resp["response"]["status"]["message"]
+
+        same = _review(url, good, operation="UPDATE", old=good)
+        assert same["response"]["allowed"] is True
+
+        # Malformed objects are denials, never handler crashes (a 500
+        # would block or silently admit depending on failurePolicy).
+        resp = _review(url, {"spec": {"maxReplicas": 2, "template": "x"}})
+        assert resp["response"]["allowed"] is False
+
+        # The project's own k8s submit manifests must be admitted.
+        import yaml
+
+        from adaptdl_tpu.sched.k8s import render_job_manifest
+
+        manifest = yaml.safe_load(
+            render_job_manifest(
+                "myjob", "train.py", "img:1", max_replicas=4
+            )
+        )
+        resp = _review(url, manifest)
+        assert resp["response"]["allowed"] is True, resp
+    finally:
+        webhook.stop()
