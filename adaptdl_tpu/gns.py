@@ -47,27 +47,31 @@ VAR_FLOOR = 1e-6
 
 class GNSState(NamedTuple):
     """EMA state for the two gradient statistics (+ differenced-mode
-    carry). ``prev_grad`` always has the params' structure so the state
+    carry). The statistics are PER PARAM GROUP — shape ``(G,)`` vectors
+    (G=1 when no groups are declared), matching the reference's
+    per-optimizer-param-group arrays (reference:
+    gradient_noise_scale.py:66-73) so multi-LR recipes get per-group
+    gains. ``prev_grad`` always has the params' structure so the state
     pytree is identical across every (replicas, accum) configuration —
     that is what lets a checkpoint from a 1-chip incarnation restore
     into a 64-chip one."""
 
-    sqr_biased: jnp.ndarray
-    sqr_unbias: jnp.ndarray
-    var_biased: jnp.ndarray
-    var_unbias: jnp.ndarray
+    sqr_biased: jnp.ndarray  # (G,)
+    sqr_unbias: jnp.ndarray  # (G,)
+    var_biased: jnp.ndarray  # (G,)
+    var_unbias: jnp.ndarray  # (G,)
     ema_is_biased: jnp.ndarray  # bool: EMAs hold differenced estimates
     prev_grad: Any
     prev_grad_valid: jnp.ndarray  # bool
 
 
-def init(params: Any) -> GNSState:
+def init(params: Any, num_groups: int = 1) -> GNSState:
     # Distinct buffers per field: aliased leaves break jit donation.
     return GNSState(
-        sqr_biased=jnp.zeros((), jnp.float32),
-        sqr_unbias=jnp.zeros((), jnp.float32),
-        var_biased=jnp.zeros((), jnp.float32),
-        var_unbias=jnp.zeros((), jnp.float32),
+        sqr_biased=jnp.zeros((num_groups,), jnp.float32),
+        sqr_unbias=jnp.zeros((num_groups,), jnp.float32),
+        var_biased=jnp.zeros((num_groups,), jnp.float32),
+        var_unbias=jnp.zeros((num_groups,), jnp.float32),
         ema_is_biased=jnp.zeros((), bool),
         prev_grad=jax.tree.map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params
@@ -76,27 +80,74 @@ def init(params: Any) -> GNSState:
     )
 
 
-def sqr_avg(state: GNSState) -> jnp.ndarray:
-    """Debiased estimate of |E g|^2 (>= 0)."""
+def normalize_groups(state: GNSState, num_groups: int) -> GNSState:
+    """Adapt a (possibly pre-grouping, scalar-stat) GNSState to ``G``
+    groups: scalars and 1-vectors broadcast (an old checkpoint's global
+    statistic seeds every group), anything else must already match."""
+    import numpy as np
+
+    def fix(x):
+        arr = np.asarray(x, dtype=np.float32)
+        if arr.ndim == 0 or arr.shape == (1,):
+            return np.full((num_groups,), float(arr.reshape(-1)[0] if arr.ndim else arr), np.float32)
+        if arr.shape != (num_groups,):
+            raise ValueError(
+                f"GNS statistics have {arr.shape[0]} groups; trainer "
+                f"declares {num_groups}"
+            )
+        return arr
+
+    return state._replace(
+        sqr_biased=fix(state.sqr_biased),
+        sqr_unbias=fix(state.sqr_unbias),
+        var_biased=fix(state.var_biased),
+        var_unbias=fix(state.var_unbias),
+    )
+
+
+def raw_sqr_avg(state: GNSState) -> jnp.ndarray:
+    """Per-group debiased estimates of |E g|^2, shape (G,)."""
     avg = jnp.where(
         state.sqr_unbias > 0, state.sqr_biased / state.sqr_unbias, 0.0
     )
     return jnp.maximum(avg, 0.0)
 
 
-def var_avg(state: GNSState) -> jnp.ndarray:
-    """Debiased estimate of tr(Var g) (floored away from 0)."""
+def raw_var_avg(state: GNSState) -> jnp.ndarray:
+    """Per-group debiased estimates of tr(Var g), shape (G,)."""
     avg = jnp.where(
         state.var_unbias > 0, state.var_biased / state.var_unbias, VAR_FLOOR
     )
     return jnp.maximum(avg, VAR_FLOOR)
 
 
+def sqr_avg(state: GNSState) -> jnp.ndarray:
+    """Debiased estimate of total |E g|^2 (>= 0): sum over groups
+    (reference: gradient_noise_scale.py:118-124 sums its array)."""
+    return jnp.sum(raw_sqr_avg(state))
+
+
+def var_avg(state: GNSState) -> jnp.ndarray:
+    """Debiased estimate of total tr(Var g) (floored away from 0)."""
+    return jnp.sum(raw_var_avg(state))
+
+
 def gain(state: GNSState, scale) -> jnp.ndarray:
     """Statistical speedup of training at ``scale`` x the initial batch
-    size: in [1, scale]."""
+    size: in [1, scale]. Computed from the TOTAL signal/noise (the
+    progress metric is global; per-group gains are
+    :func:`per_group_gain`)."""
     var = var_avg(state)
     sqr = sqr_avg(state)
+    return (var + sqr) / (var / scale + sqr)
+
+
+def per_group_gain(state: GNSState, scale) -> jnp.ndarray:
+    """Per-group gain ratios, shape (G,) — what AdaScale applies to
+    each param group's learning rate (reference:
+    scaling_rules.py:119-125)."""
+    var = raw_var_avg(state)
+    sqr = raw_sqr_avg(state)
     return (var + sqr) / (var / scale + sqr)
 
 
@@ -114,14 +165,43 @@ def normsqr(tree: Any, precond: Any = None) -> jnp.ndarray:
     return jnp.asarray(sum(terms))
 
 
+def group_normsqr(
+    tree: Any,
+    group_ids: tuple[int, ...],
+    num_groups: int,
+    precond: Any = None,
+) -> jnp.ndarray:
+    """Per-group sums of squared entries, shape (G,). ``group_ids``
+    aligns with ``jax.tree.leaves(tree)`` and is static, so the
+    grouping compiles into the same fused reduction as the global sum."""
+    leaves = jax.tree.leaves(tree)
+    pre = (
+        jax.tree.leaves(precond) if precond is not None else [None] * len(leaves)
+    )
+    terms: list[Any] = [0.0] * num_groups
+    for gid, g, p in zip(group_ids, leaves, pre):
+        sq = (
+            jnp.sum(jnp.square(g.astype(jnp.float32)))
+            if p is None
+            else jnp.sum(jnp.square(g.astype(jnp.float32) / p))
+        )
+        terms[gid] = terms[gid] + sq
+    return jnp.stack([jnp.asarray(t, jnp.float32) for t in terms])
+
+
 def _ema_update(biased, unbias, value, theta):
     return theta * biased + (1 - theta) * value, theta * unbias + (1 - theta)
 
 
 def _apply_estimates(state, grad_sqr, grad_var, theta, now_biased):
     """Push one (grad_sqr, grad_var) sample into the EMAs, resetting
-    them when switching from differenced (biased) to real estimates."""
-    finite = jnp.isfinite(grad_sqr) & jnp.isfinite(grad_var)
+    them when switching from differenced (biased) to real estimates.
+    Estimates are (G,) vectors; a non-finite value in ANY group skips
+    the whole sample (the reference's AMP nan/inf guard,
+    gradient_noise_scale.py:234-241)."""
+    finite = jnp.all(
+        jnp.isfinite(grad_sqr) & jnp.isfinite(grad_var)
+    )
     reset = state.ema_is_biased & ~now_biased
     sqr_b = jnp.where(reset, 0.0, state.sqr_biased)
     sqr_u = jnp.where(reset, 0.0, state.sqr_unbias)
@@ -148,6 +228,8 @@ def update(
     num_microbatches: int,
     smoothing: float = 0.999,
     precond: Any = None,
+    group_ids: tuple[int, ...] | None = None,
+    num_groups: int = 1,
 ) -> GNSState:
     """One GNS update after a synchronized optimizer step.
 
@@ -155,18 +237,28 @@ def update(
       state: current GNSState.
       grads_mean: the fully averaged gradient (over replicas and
         microbatches) — the same tree the optimizer consumes.
-      local_sqr_mean: mean over all ``count`` microbatch gradients of
-        the preconditioned squared norm (pmean over the data axis of
-        the per-replica scan average).
+      local_sqr_mean: per-group mean over all ``count`` microbatch
+        gradients of the preconditioned squared norm, shape (G,)
+        (pmean over the data axis of the per-replica scan average).
       count: num_replicas * num_microbatches (static).
       accum_scale: num_replicas * atomic_bsz / init_batch_size (static).
       num_microbatches: accum_steps + 1 (static).
       smoothing: per-unit-scale EMA retention.
       precond: optional preconditioner tree (Adam second moments).
+      group_ids: static leaf-aligned param-group assignment (default:
+        everything in group 0).
+      num_groups: G.
     """
+    if group_ids is None:
+        group_ids = tuple([0] * len(jax.tree.leaves(grads_mean)))
+    local_sqr_mean = jnp.reshape(
+        jnp.asarray(local_sqr_mean, jnp.float32), (num_groups,)
+    )
     scale = accum_scale * num_microbatches
     if count > 1:
-        total_sqr = normsqr(grads_mean, precond)
+        total_sqr = group_normsqr(
+            grads_mean, group_ids, num_groups, precond
+        )
         grad_sqr = (count * total_sqr - local_sqr_mean) / (count - 1)
         grad_var = (local_sqr_mean - total_sqr) * scale / (count - 1)
         theta = smoothing**scale
@@ -178,10 +270,12 @@ def update(
 
     # Single-sample configuration: difference consecutive gradients.
     prev = state.prev_grad
-    curr_sqr = normsqr(grads_mean, precond)
-    pair_local = (normsqr(prev, precond) + curr_sqr) / 2
+    curr_sqr = group_normsqr(grads_mean, group_ids, num_groups, precond)
+    pair_local = (
+        group_normsqr(prev, group_ids, num_groups, precond) + curr_sqr
+    ) / 2
     pair_mean = jax.tree.map(lambda a, b: (a + b) / 2, prev, grads_mean)
-    pair_total = normsqr(pair_mean, precond)
+    pair_total = group_normsqr(pair_mean, group_ids, num_groups, precond)
     d_scale = 2 * accum_scale
     grad_sqr = 2 * pair_total - pair_local
     grad_var = (pair_local - pair_total) * d_scale

@@ -29,4 +29,5 @@ from adaptdl_tpu.models.transformer import (  # noqa: F401
     TransformerConfig,
     init_transformer,
     lm_loss_fn,
+    mlm_loss_fn,
 )

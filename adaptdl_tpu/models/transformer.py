@@ -45,6 +45,10 @@ class TransformerConfig:
     # parallelism): positions become global and attention defaults to
     # ring attention over this axis.
     seq_axis: str | None = None
+    # causal=False gives bidirectional (encoder / BERT-style)
+    # attention — the MLM families (reference: examples/BERT/) — for
+    # both the plain and the ring attention paths.
+    causal: bool = True
 
 
 def rope(x: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
@@ -66,8 +70,9 @@ def rope(x: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
     return rotated.reshape(x.shape)
 
 
-def causal_attention(q, k, v, axis_name=None):
-    """Plain causal attention; q/k/v: [batch, heads, seq, head_dim]."""
+def causal_attention(q, k, v, axis_name=None, causal=True):
+    """Plain attention; q/k/v: [batch, heads, seq, head_dim].
+    ``causal=False`` attends bidirectionally (encoder-style)."""
     del axis_name
     seq_len = q.shape[2]
     scale = q.shape[-1] ** -0.5
@@ -75,8 +80,9 @@ def causal_attention(q, k, v, axis_name=None):
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     )
     logits = logits * scale
-    mask = jnp.tril(jnp.ones((seq_len, seq_len), bool))
-    logits = jnp.where(mask[None, None], logits, -1e30)
+    if causal:
+        mask = jnp.tril(jnp.ones((seq_len, seq_len), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
@@ -108,9 +114,13 @@ class Attention(nn.Module):
                     make_ring_attention,
                 )
 
-                attn = make_ring_attention(cfg.seq_axis)
+                attn = make_ring_attention(
+                    cfg.seq_axis, causal=cfg.causal
+                )
             else:
-                attn = causal_attention
+                from functools import partial
+
+                attn = partial(causal_attention, causal=cfg.causal)
         out = attn(q, k, v)  # [b, h, s, d]
         out = jnp.swapaxes(out, 1, 2).reshape(
             x.shape[:-1] + (cfg.d_model,)
@@ -198,6 +208,35 @@ def init_transformer(config: TransformerConfig, rng=None, seq_len=None):
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     params = init_model.init(rng, dummy, train=False)["params"]
     return model, params
+
+
+def mlm_loss_fn(
+    model: TransformerLM, mask_token: int, mask_rate: float = 0.15
+):
+    """Masked-LM cross-entropy (the reference's BERT-family objective,
+    examples/BERT/mlm_task_adaptdl.py): each step masks ``mask_rate``
+    of tokens (fresh mask per step from the step rng) and scores only
+    the masked positions. Use with ``TransformerConfig(causal=False)``
+    so attention is bidirectional. batch = {"tokens": [b, s] int32}.
+    """
+
+    def loss_fn(params, batch, rng):
+        tokens = batch["tokens"]
+        mask_rng = jax.random.fold_in(rng, 0x3A5)
+        mask = jax.random.uniform(mask_rng, tokens.shape) < mask_rate
+        inputs = jnp.where(mask, mask_token, tokens)
+        logits = model.apply(
+            {"params": params}, inputs, train=True, rng=rng
+        )
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits, tokens
+        )
+        weights = mask.astype(jnp.float32)
+        return jnp.sum(losses * weights) / jnp.maximum(
+            jnp.sum(weights), 1.0
+        )
+
+    return loss_fn
 
 
 def lm_loss_fn(model: TransformerLM):

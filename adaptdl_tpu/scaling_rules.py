@@ -41,12 +41,28 @@ class ScalingRule:
     """Base: no scaling (factor 1)."""
 
     def lr_factor(self, ctx: RuleContext) -> jnp.ndarray:
+        """Scalar factor (logging / single-group application)."""
         return jnp.ones(())
+
+    def lr_factor_groups(self, ctx: RuleContext) -> jnp.ndarray:
+        """Per-param-group factors, shape (G,). Default: the scalar
+        factor broadcast — rules that are pure functions of ``scale``
+        scale every group identically, while noise-aware rules
+        override with per-group statistics (the reference applies
+        ``scale_lr``'s vector to each optimizer param group's lr,
+        scaling_rules.py:78-83)."""
+        num_groups = ctx.gns_state.sqr_biased.shape[0]
+        return jnp.broadcast_to(self.lr_factor(ctx), (num_groups,))
 
 
 class AdaScale(ScalingRule):
     def lr_factor(self, ctx: RuleContext) -> jnp.ndarray:
         return gns.gain(ctx.gns_state, ctx.scale)
+
+    def lr_factor_groups(self, ctx: RuleContext) -> jnp.ndarray:
+        # Each group's gain from ITS OWN signal/noise ratio
+        # (reference: scaling_rules.py:119-125 raw per-group arrays).
+        return gns.per_group_gain(ctx.gns_state, ctx.scale)
 
 
 class AdamScale(AdaScale):
@@ -55,6 +71,9 @@ class AdamScale(AdaScale):
 
     def lr_factor(self, ctx: RuleContext) -> jnp.ndarray:
         return super().lr_factor(ctx) ** self.power
+
+    def lr_factor_groups(self, ctx: RuleContext) -> jnp.ndarray:
+        return super().lr_factor_groups(ctx) ** self.power
 
 
 class LinearScale(ScalingRule):

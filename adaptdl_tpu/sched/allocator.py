@@ -26,7 +26,7 @@ from adaptdl_tpu.sched.state import ClusterState, normalize_topology
 
 LOG = logging.getLogger(__name__)
 
-FINISHED = ("Succeeded", "Failed")
+FINISHED = ("Succeeded", "Failed", "Stopped")
 
 
 def job_info_from_hints(

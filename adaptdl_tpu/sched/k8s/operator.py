@@ -238,7 +238,9 @@ class Operator:
             namespace, label_selector=selector
         )
         live = [p for p in pods.items if p.metadata.deletion_timestamp is None]
-        if record.status in ("Succeeded", "Failed"):
+        from adaptdl_tpu.sched.allocator import FINISHED
+
+        if record.status in FINISHED:
             for pod in live:
                 await core.delete_namespaced_pod(
                     pod.metadata.name, namespace

@@ -86,6 +86,20 @@ class MultiJobRunner:
         self.restart_counts: dict[str, int] = {
             job.name: 0 for job in jobs
         }
+        self._stopped: set[str] = set()
+
+    def stop_job(self, name: str) -> None:
+        """Externally terminate a job (e.g. a tuning trial that lost
+        its rung): its allocation is withdrawn, the supervising thread
+        SIGTERMs it for a graceful checkpoint, and it is not
+        relaunched (status Stopped, exit code 143 recorded). Status
+        flips terminal SYNCHRONOUSLY — the allocator skips FINISHED
+        jobs, so it can never re-grant chips to a stopped job in the
+        window before the supervising thread notices."""
+        self._stopped.add(name)
+        self.state.update(
+            name, allocation=[], topology=None, status="Stopped"
+        )
 
     # -- per-job lifecycle (one thread each) --------------------------
 
@@ -122,6 +136,12 @@ class MultiJobRunner:
     def _run_job(self, job: JobSpec) -> None:
         failures = 0
         while True:
+            if job.name in self._stopped:
+                self.state.update(job.name, status="Stopped")
+                self.exit_codes.setdefault(
+                    job.name, GRACEFUL_EXIT_CODE
+                )
+                return
             allocation, topology = self.state.get_launch_config(
                 job.name
             )

@@ -148,9 +148,29 @@ class ElasticTrainer:
         seed: int = 0,
         has_aux: bool = False,
         param_sharding_fn: Callable | None = None,
+        param_group_fn: Callable | None = None,
     ):
         self.has_aux = has_aux
         self.param_sharding_fn = param_sharding_fn
+        # Param groups: ``param_group_fn(path, leaf) -> int`` assigns
+        # each leaf to a group; GNS statistics and the noise-aware
+        # scaling rules are then tracked/applied per group (the optax
+        # analog of the reference's optimizer param_groups,
+        # gradient_noise_scale.py:66-73) — one LR recipe per group.
+        if param_group_fn is None:
+            leaf_count = len(jax.tree.leaves(params))
+            self._group_ids = tuple([0] * leaf_count)
+        else:
+            flat = jax.tree_util.tree_flatten_with_path(params)[0]
+            self._group_ids = tuple(
+                int(param_group_fn(path, leaf)) for path, leaf in flat
+            )
+        self.num_param_groups = max(self._group_ids, default=0) + 1
+        if set(self._group_ids) != set(range(self.num_param_groups)):
+            raise ValueError(
+                "param_group_fn must assign contiguous group ids "
+                f"0..G-1; got {sorted(set(self._group_ids))}"
+            )
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.init_batch_size = init_batch_size
@@ -259,7 +279,7 @@ class ElasticTrainer:
         # Optimizer moments follow the params' layout: eager
         # zeros_like on a sharded array preserves its sharding.
         opt_state = self.optimizer.init(params)
-        gns_state = gns.init(params)
+        gns_state = gns.init(params, self.num_param_groups)
         gns_state = gns_state._replace(
             prev_grad=jax.tree.map(put, gns_state.prev_grad, specs),
             sqr_biased=put(gns_state.sqr_biased, P()),
@@ -371,7 +391,12 @@ class ElasticTrainer:
                     grad = jax.lax.pmean(grad, SEQ_AXIS)
                     loss = jax.lax.pmean(loss, SEQ_AXIS)
                 grad_sum = jax.tree.map(jnp.add, grad_sum, grad)
-                lsqr_sum = lsqr_sum + gns.normsqr(grad, precond_v)
+                lsqr_sum = lsqr_sum + gns.group_normsqr(
+                    grad,
+                    self._group_ids,
+                    self.num_param_groups,
+                    precond_v,
+                )
                 return (grad_sum, lsqr_sum, loss_sum + loss), None
 
             zeros = jax.tree.map(
@@ -382,7 +407,11 @@ class ElasticTrainer:
             # (With sequence parallelism the carry stays seq-UNvarying:
             # grad/loss are pmean'ed over the seq axis inside the body.)
             init = jax.lax.pcast(
-                (zeros, jnp.zeros(()), jnp.zeros(())),
+                (
+                    zeros,
+                    jnp.zeros((self.num_param_groups,)),
+                    jnp.zeros(()),
+                ),
                 DATA_AXIS,
                 to="varying",
             )
@@ -407,6 +436,8 @@ class ElasticTrainer:
                 num_microbatches=num_micro,
                 smoothing=self.smoothing,
                 precond=precond,
+                group_ids=self._group_ids,
+                num_groups=self.num_param_groups,
             )
             step_gain = gns.gain(new_gns, scale)
             ctx = RuleContext(
@@ -417,14 +448,22 @@ class ElasticTrainer:
                 progress=state.progress,
             )
             lr_factor = self.scaling_rule.lr_factor(ctx)
+            group_factors = self.scaling_rule.lr_factor_groups(ctx)
             updates, new_opt_state = self.optimizer.update(
                 grads, state.opt_state, params
             )
-            updates = jax.tree.map(
-                lambda u: (u.astype(jnp.float32) * lr_factor).astype(
+            # Each leaf's update scales by ITS group's factor (the
+            # reference multiplies scale_lr's vector into each
+            # optimizer param group's lr, scaling_rules.py:78-83).
+            flat_updates, treedef = jax.tree_util.tree_flatten(updates)
+            flat_updates = [
+                (u.astype(jnp.float32) * group_factors[gid]).astype(
                     u.dtype
-                ),
-                updates,
+                )
+                for u, gid in zip(flat_updates, self._group_ids)
+            ]
+            updates = jax.tree_util.tree_unflatten(
+                treedef, flat_updates
             )
             new_params = optax.apply_updates(params, updates)
             new_state = TrainState(
@@ -680,9 +719,16 @@ class TrainerCheckpoint(checkpoint.State):
     def load(self, fileobj):
         host_state = pickle.load(fileobj)
         host_state = host_state._replace(
-            rng=jax.random.wrap_key_data(jnp.asarray(host_state.rng))
+            rng=jax.random.wrap_key_data(jnp.asarray(host_state.rng)),
         )
         trainer = self._trainer
+        # Checkpoints from before per-group statistics (scalar stats)
+        # broadcast into the trainer's declared group count.
+        host_state = host_state._replace(
+            gns=gns.normalize_groups(
+                host_state.gns, trainer.num_param_groups
+            )
+        )
         specs = trainer.state_spec_tree(host_state)
         self._set_state(
             jax.tree.map(

@@ -1,0 +1,277 @@
+"""Elastic hyperparameter tuning: trials as co-scheduled elastic jobs.
+
+The reference's Tune integration wraps every Ray Tune trial in an
+AdaptDL job, re-invokes the Pollux allocator every N results, and
+rescales trials by checkpoint-clone through the object store
+(reference: ray/adaptdl_ray/tune/adaptdl_trial_sched.py:60-127,
+adaptdl_trial.py:79-173). The TPU-native design needs none of the
+clone machinery: a trial here is a subprocess job under the
+:class:`~adaptdl_tpu.sched.multi_runner.MultiJobRunner`, whose ONE
+shared Pollux allocator already re-optimizes every trial's chip
+allocation as its goodput hints evolve — a "rescale" is the ordinary
+checkpoint-restart the training library performs anyway, so PAUSE /
+clone / placement-group shuffling collapse into allocation changes.
+
+What this module adds on top of the runner:
+
+- the trial API inside the training script: :func:`get_trial_config`
+  (hyperparameters) and :func:`report` (stream metric results),
+- :class:`TrialScheduler`: samples configs from a search space, runs
+  all trials elastically on one slice, watches their reported metrics,
+  and early-stops losers by successive halving (the ASHA-style rung
+  rule standing in for the reference's PAUSE/STOP decisions).
+
+Usage, in the training script::
+
+    from adaptdl_tpu import tune
+    config = tune.get_trial_config()       # {"lr": 0.1, ...}
+    ...
+    tune.report(loss=float(loss))          # once per epoch
+
+and on the driver::
+
+    sched = tune.TrialScheduler(
+        "train.py", {"lr": [0.1, 0.01, 0.001]},
+        num_chips=8, metric="loss", mode="min")
+    best = sched.run()
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+LOG = logging.getLogger(__name__)
+
+_CONFIG_ENV = "ADAPTDL_TRIAL_CONFIG"
+_RESULT_ENV = "ADAPTDL_TRIAL_RESULT_FILE"
+
+
+# ---- the in-script trial API ----------------------------------------
+
+
+def get_trial_config() -> dict[str, Any]:
+    """This trial's hyperparameters (empty when not under the tuner)."""
+    raw = os.environ.get(_CONFIG_ENV)
+    return json.loads(raw) if raw else {}
+
+
+def report(**metrics: float) -> None:
+    """Stream one result row to the trial scheduler (appends a JSON
+    line; restarts simply keep appending, so results survive
+    rescales)."""
+    path = os.environ.get(_RESULT_ENV)
+    if not path:
+        return
+    with open(path, "a") as f:
+        f.write(json.dumps(metrics) + "\n")
+
+
+# ---- driver side ----------------------------------------------------
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: dict[str, Any]
+    result_file: str
+    status: str = "RUNNING"  # RUNNING | STOPPED | DONE
+    results: list[dict[str, float]] = field(default_factory=list)
+
+    def last(self, metric: str) -> float | None:
+        for row in reversed(self.results):
+            if metric in row:
+                return float(row[metric])
+        return None
+
+
+def sample_configs(
+    search_space: dict[str, list], num_samples: int | None, seed: int = 0
+) -> list[dict[str, Any]]:
+    """Grid of the space (sorted for determinism), optionally
+    subsampled to ``num_samples`` without replacement."""
+    keys = sorted(search_space)
+    grid = [
+        dict(zip(keys, values))
+        for values in itertools.product(*(search_space[k] for k in keys))
+    ]
+    if num_samples is not None and num_samples < len(grid):
+        grid = random.Random(seed).sample(grid, num_samples)
+    return grid
+
+
+class TrialScheduler:
+    """Run trials elastically on one slice with early stopping.
+
+    Args:
+      script: training script path (uses :func:`get_trial_config` /
+        :func:`report`).
+      search_space: {hyperparam: [values...]} grid.
+      num_chips: slice capacity shared by ALL trials (the Pollux
+        allocator splits it by fitted goodput).
+      metric / mode: what :func:`report` field ranks trials, and
+        whether bigger ("max") or smaller ("min") is better.
+      num_samples: cap on the number of grid points (random subset).
+      grace_results: results every surviving trial must post before a
+        halving decision (the ASHA rung size).
+      reduction_factor: keep ceil(n / reduction_factor) trials per rung.
+      checkpoint_root: directory for per-trial checkpoint dirs.
+      poll_interval: seconds between monitor passes.
+    """
+
+    def __init__(
+        self,
+        script: str,
+        search_space: dict[str, list],
+        num_chips: int,
+        metric: str,
+        mode: str = "min",
+        num_samples: int | None = None,
+        grace_results: int = 1,
+        reduction_factor: int = 2,
+        checkpoint_root: str = "/tmp/adaptdl-tune",
+        poll_interval: float = 1.0,
+        runner_kwargs: dict | None = None,
+    ):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.grace_results = max(int(grace_results), 1)
+        self.reduction_factor = max(int(reduction_factor), 2)
+        self.poll_interval = poll_interval
+        os.makedirs(checkpoint_root, exist_ok=True)
+        self.trials: dict[str, Trial] = {}
+        jobs = []
+        from adaptdl_tpu.sched.multi_runner import JobSpec
+
+        for i, config in enumerate(
+            sample_configs(search_space, num_samples)
+        ):
+            trial_id = f"trial-{i}"
+            result_file = os.path.join(
+                checkpoint_root, f"{trial_id}.results.jsonl"
+            )
+            open(result_file, "w").close()
+            self.trials[f"tune/{trial_id}"] = Trial(
+                trial_id, config, result_file
+            )
+            jobs.append(
+                JobSpec(
+                    name=f"tune/{trial_id}",
+                    script=script,
+                    checkpoint_dir=os.path.join(
+                        checkpoint_root, trial_id
+                    ),
+                    extra_env={
+                        _CONFIG_ENV: json.dumps(config),
+                        _RESULT_ENV: result_file,
+                    },
+                )
+            )
+        from adaptdl_tpu.sched.multi_runner import MultiJobRunner
+
+        self.runner = MultiJobRunner(
+            jobs, num_chips=num_chips, **(runner_kwargs or {})
+        )
+        self._next_rung = self.grace_results
+        self.stopped_trials: list[str] = []
+
+    # -- monitoring ---------------------------------------------------
+
+    def _refresh_results(self) -> None:
+        for key, trial in self.trials.items():
+            try:
+                with open(trial.result_file) as f:
+                    rows = [
+                        json.loads(line)
+                        for line in f
+                        if line.strip()
+                    ]
+            except FileNotFoundError:
+                rows = []
+            trial.results = rows
+            # Sync with the runner's lifecycle: a crashed or finished
+            # trial must leave the RUNNING pool immediately, or the
+            # halving rung waits forever on results that will never
+            # arrive.
+            record = self.runner.state.get_job(key)
+            if trial.status == "RUNNING" and record is not None:
+                if record.status == "Failed":
+                    trial.status = "FAILED"
+                elif record.status == "Succeeded":
+                    trial.status = "DONE"
+
+    def _maybe_halve(self) -> None:
+        """Successive halving: once every live trial has posted the
+        rung's worth of results, stop the worst trials (reference
+        decision point: adaptdl_trial_sched.py PAUSE/STOP on result)."""
+        live = [
+            (key, t)
+            for key, t in self.trials.items()
+            if t.status == "RUNNING"
+        ]
+        if len(live) <= 1:
+            return
+        scored = []
+        for key, trial in live:
+            if len(trial.results) < self._next_rung:
+                return  # rung not complete yet
+            scored.append((trial.last(self.metric), key))
+        if any(score is None for score, _ in scored):
+            return
+        reverse = self.mode == "max"
+        scored.sort(key=lambda kv: kv[0], reverse=reverse)
+        keep = -(-len(scored) // self.reduction_factor)  # ceil
+        for score, key in scored[keep:]:
+            LOG.info(
+                "halving: stopping %s (%s=%s)", key, self.metric, score
+            )
+            self.trials[key].status = "STOPPED"
+            self.stopped_trials.append(key)
+            self.runner.stop_job(key)
+        self._next_rung *= self.reduction_factor
+
+    def run(self) -> Trial:
+        """Run to completion; returns the best trial."""
+        import threading
+
+        exit_codes: dict[str, int] = {}
+
+        def run_jobs():
+            exit_codes.update(self.runner.run())
+
+        thread = threading.Thread(
+            target=run_jobs, name="tune-runner", daemon=True
+        )
+        thread.start()
+        while thread.is_alive():
+            thread.join(timeout=self.poll_interval)
+            self._refresh_results()
+            self._maybe_halve()
+        self._refresh_results()
+        for key, trial in self.trials.items():
+            if trial.status == "RUNNING":
+                trial.status = (
+                    "DONE" if exit_codes.get(key) == 0 else "FAILED"
+                )
+        return self.best_trial()
+
+    def best_trial(self) -> Trial:
+        def score(trial: Trial):
+            value = trial.last(self.metric)
+            if value is None:
+                return float("inf") if self.mode == "min" else -float("inf")
+            return value
+
+        candidates = sorted(
+            self.trials.values(),
+            key=score,
+            reverse=self.mode == "max",
+        )
+        return candidates[0]
