@@ -125,7 +125,65 @@ def _cmd_cp(args) -> int:
     return 0
 
 
+def _apply_or_print(manifest: str, dry_run: bool) -> int:
+    if shutil.which("kubectl") and not dry_run:
+        proc = subprocess.run(
+            ["kubectl", "apply", "-f", "-"], input=manifest.encode()
+        )
+        return proc.returncode
+    print(manifest)
+    return 0
+
+
+def _cmd_deploy(args) -> int:
+    """Render (and apply) the whole scheduler bundle — the
+    helm-install equivalent."""
+    from adaptdl_tpu.sched.k8s import render_scheduler_bundle
+
+    manifest = render_scheduler_bundle(
+        image=args.image,
+        namespace=args.namespace,
+        with_webhook=not args.no_webhook,
+        ca_bundle=args.ca_bundle,
+    )
+    return _apply_or_print(manifest, args.dry_run)
+
+
 def _cmd_tensorboard(args) -> int:
+    if args.backend == "k8s":
+        from adaptdl_tpu.sched.k8s import render_tensorboard_manifest
+
+        name = args.name or "default"
+        if args.action == "delete":
+            # Same explicit namespace as create: a label-selector
+            # delete in the kubeconfig's current namespace would miss
+            # objects created elsewhere and leak them.
+            cmd = [
+                "kubectl",
+                "delete",
+                "deployment,service",
+                "-n",
+                args.namespace,
+                "-l",
+                f"adaptdl/tensorboard={name}",
+            ]
+            if shutil.which("kubectl") and not args.dry_run:
+                return subprocess.call(cmd)
+            print("# " + " ".join(cmd))
+            return 0
+        manifest = render_tensorboard_manifest(
+            name,
+            logdir_claim=args.logdir_claim,
+            namespace=args.namespace,
+            port=args.port,
+        )
+        return _apply_or_print(manifest, args.dry_run)
+    if not args.logdir:
+        print(
+            "--logdir is required for the local backend",
+            file=sys.stderr,
+        )
+        return 2
     if shutil.which("tensorboard") is None:
         print(
             "tensorboard is not installed in this environment",
@@ -175,10 +233,38 @@ def main(argv=None) -> int:
     p.add_argument("dst")
     p.set_defaults(fn=_cmd_cp)
 
-    p = sub.add_parser("tensorboard", help="launch tensorboard")
-    p.add_argument("--logdir", required=True)
+    p = sub.add_parser(
+        "tensorboard",
+        help="launch tensorboard locally, or manage an in-cluster "
+        "instance (--backend k8s create/delete)",
+    )
+    p.add_argument("action", nargs="?", default="create",
+                   choices=("create", "delete"))
+    p.add_argument("--backend", choices=("local", "k8s"),
+                   default="local")
+    p.add_argument("--name")
+    p.add_argument("--logdir")
+    p.add_argument("--logdir-claim", default="adaptdl-checkpoints")
+    p.add_argument("--namespace", default="default")
     p.add_argument("--port", type=int, default=6006)
+    p.add_argument("--dry-run", action="store_true")
     p.set_defaults(fn=_cmd_tensorboard)
+
+    p = sub.add_parser(
+        "deploy",
+        help="render/apply the scheduler bundle (CRD, operator, "
+        "webhook, services) — the helm-install equivalent",
+    )
+    p.add_argument("--image", default="adaptdl-tpu:latest")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--no-webhook", action="store_true")
+    p.add_argument(
+        "--ca-bundle",
+        help="base64 CA bundle for the webhook serving cert; without "
+        "it the webhook is registered with failurePolicy Ignore",
+    )
+    p.add_argument("--dry-run", action="store_true")
+    p.set_defaults(fn=_cmd_deploy)
 
     args = parser.parse_args(argv)
     from adaptdl_tpu.sched.validator import ValidationError

@@ -22,11 +22,13 @@ from adaptdl_tpu.sched.policy import (
     PolluxPolicy,
     SpeedupFunction,
 )
-from adaptdl_tpu.sched.state import ClusterState, normalize_topology
+from adaptdl_tpu.sched.state import (
+    FINISHED,
+    ClusterState,
+    normalize_topology,
+)
 
 LOG = logging.getLogger(__name__)
-
-FINISHED = ("Succeeded", "Failed", "Stopped")
 
 
 def job_info_from_hints(

@@ -1,0 +1,119 @@
+"""Consolidated scheduler configuration.
+
+One module owning every scheduler-side env knob (the reference keeps
+them in sched/adaptdl_sched/config.py:19-73, wired through a
+Helm-managed ConfigMap); previously these were scattered. Trainer-side
+knobs stay in ``adaptdl_tpu.env`` (the ``ADAPTDL_*`` worker contract).
+
+All getters read the environment at call time so tests can
+monkeypatch; JSON-valued knobs fail loudly on malformed input.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+
+def namespace() -> str:
+    """Namespace the operator manages."""
+    return os.environ.get("ADAPTDL_NAMESPACE", "default")
+
+
+def job_image() -> str:
+    """Default worker image for rendered job manifests."""
+    return os.environ.get("ADAPTDL_JOB_IMAGE", "adaptdl-tpu:latest")
+
+
+def supervisor_url() -> str:
+    """Cluster-internal supervisor URL injected into worker pods."""
+    return os.environ.get(
+        "ADAPTDL_SUPERVISOR_URL", "http://adaptdl-supervisor:8080"
+    )
+
+
+def supervisor_port() -> int:
+    return int(os.environ.get("ADAPTDL_SUPERVISOR_PORT", "8080"))
+
+
+def webhook_port() -> int:
+    return int(os.environ.get("ADAPTDL_WEBHOOK_PORT", "8443"))
+
+
+def webhook_cert() -> str | None:
+    """Path to the webhook's TLS serving cert (the API server only
+    speaks HTTPS to webhooks)."""
+    return os.environ.get("ADAPTDL_WEBHOOK_CERT")
+
+
+def webhook_key() -> str | None:
+    return os.environ.get("ADAPTDL_WEBHOOK_KEY")
+
+
+def checkpoint_claim() -> str:
+    """RWX PVC mounted into workers for checkpoints."""
+    return os.environ.get(
+        "ADAPTDL_CHECKPOINT_CLAIM", "adaptdl-checkpoints"
+    )
+
+
+def allocator_interval() -> float:
+    """Seconds between full Pollux re-optimizations (reference: 60s,
+    allocator.py:108-134)."""
+    return float(os.environ.get("ADAPTDL_ALLOCATOR_INTERVAL", "60"))
+
+
+def max_worker_failures() -> int:
+    """Non-graceful worker failures tolerated before a job is Failed."""
+    return int(os.environ.get("ADAPTDL_MAX_FAILURES", "2"))
+
+
+def expander_min_slices() -> int:
+    return int(os.environ.get("ADAPTDL_MIN_SLICES", "0"))
+
+
+def expander_max_slices() -> int:
+    return int(os.environ.get("ADAPTDL_MAX_SLICES", "64"))
+
+
+def expander_scale_down_delay() -> float:
+    """Seconds a lower desired-slice count must persist before the
+    provisioner shrinks (slices take minutes to come up)."""
+    return float(os.environ.get("ADAPTDL_SCALE_DOWN_DELAY", "300"))
+
+
+def slice_template() -> dict[str, Any]:
+    """Shape of a provisionable slice (used when the live inventory is
+    empty, e.g. scale-from-zero): JSON resources dict."""
+    raw = os.environ.get("ADAPTDL_SLICE_TEMPLATE")
+    if not raw:
+        return {"tpu": 8}
+    return dict(json.loads(raw))
+
+
+def default_job_resources() -> dict[str, Any]:
+    """Per-replica resource requests injected when a job spec omits
+    them (reference: config.py's JSON default-resources knob)."""
+    raw = os.environ.get("ADAPTDL_DEFAULT_RESOURCES")
+    if not raw:
+        return {"tpu": 1}
+    return dict(json.loads(raw))
+
+
+def gke_node_pool() -> dict[str, str] | None:
+    """GKE autoscaling target as JSON: {"project": ..., "location":
+    ..., "cluster": ..., "node_pool": ...}; None disables actuation
+    (the expander then only logs desired sizes)."""
+    raw = os.environ.get("ADAPTDL_GKE_NODE_POOL")
+    if not raw:
+        return None
+    parsed = dict(json.loads(raw))
+    missing = {"project", "location", "cluster", "node_pool"} - set(
+        parsed
+    )
+    if missing:
+        raise ValueError(
+            f"ADAPTDL_GKE_NODE_POOL missing keys: {sorted(missing)}"
+        )
+    return parsed

@@ -16,9 +16,12 @@ from aiohttp import web
 
 
 class ThreadedHttpServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self, host: str = "127.0.0.1", port: int = 0, ssl_context=None
+    ):
         self._host = host
         self._port = port
+        self._ssl_context = ssl_context
         self._thread: threading.Thread | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._started = threading.Event()
@@ -36,7 +39,12 @@ class ThreadedHttpServer:
                 asyncio.set_event_loop(self._loop)
                 runner = web.AppRunner(self.build_app())
                 self._loop.run_until_complete(runner.setup())
-                site = web.TCPSite(runner, self._host, self._port)
+                site = web.TCPSite(
+                    runner,
+                    self._host,
+                    self._port,
+                    ssl_context=self._ssl_context,
+                )
                 self._loop.run_until_complete(site.start())
                 self._port = site._server.sockets[0].getsockname()[1]
             except BaseException as exc:  # noqa: BLE001

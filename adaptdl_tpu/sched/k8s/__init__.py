@@ -85,6 +85,222 @@ spec:
 """
 
 
+def render_scheduler_bundle(
+    image: str,
+    namespace: str = "default",
+    supervisor_port: int = 8080,
+    webhook_port: int = 8443,
+    with_webhook: bool = True,
+    ca_bundle: str | None = None,
+) -> str:
+    """The full scheduler deployment as one multi-document YAML — the
+    helm-chart equivalent (reference: helm/adaptdl-sched/templates/:
+    CRD, three-container Deployment, validator Deployment + webhook
+    config, supervisor + metrics Services), parameterized the way the
+    chart's values.yaml is. ``kubectl apply -f -`` ready.
+
+    Webhooks must be HTTPS from the API server's point of view:
+    ``ca_bundle`` is the base64 PEM bundle for the webhook's serving
+    cert (mount the cert into the webhook container and set
+    ADAPTDL_WEBHOOK_CERT/ADAPTDL_WEBHOOK_KEY). Without a bundle the
+    configuration is rendered with ``failurePolicy: Ignore`` so a
+    webhook the API server cannot reach can never block every
+    AdaptDLJob write in the cluster.
+    """
+    docs = [CRD_MANIFEST]
+    docs.append(
+        f"""\
+apiVersion: v1
+kind: ServiceAccount
+metadata:
+  name: adaptdl-sched
+  namespace: {namespace}
+"""
+    )
+    docs.append(
+        f"""\
+apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRole
+metadata:
+  name: adaptdl-sched
+rules:
+  - apiGroups: ["adaptdl.org"]
+    resources: [adaptdljobs, adaptdljobs/status]
+    verbs: [get, list, watch, update, patch]
+  - apiGroups: [""]
+    resources: [pods, nodes]
+    verbs: [get, list, watch, create, delete]
+"""
+    )
+    docs.append(
+        f"""\
+apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRoleBinding
+metadata:
+  name: adaptdl-sched
+roleRef:
+  apiGroup: rbac.authorization.k8s.io
+  kind: ClusterRole
+  name: adaptdl-sched
+subjects:
+  - kind: ServiceAccount
+    name: adaptdl-sched
+    namespace: {namespace}
+"""
+    )
+    webhook_container = (
+        f"""
+        - name: webhook
+          image: {image}
+          command: ["python", "-m", "adaptdl_tpu.sched.k8s.operator", "webhook"]
+          ports:
+            - containerPort: {webhook_port}"""
+        if with_webhook
+        else ""
+    )
+    docs.append(
+        f"""\
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: adaptdl-sched
+  namespace: {namespace}
+spec:
+  replicas: 1
+  selector:
+    matchLabels:
+      app: adaptdl-sched
+  template:
+    metadata:
+      labels:
+        app: adaptdl-sched
+    spec:
+      serviceAccountName: adaptdl-sched
+      containers:
+        - name: operator
+          image: {image}
+          command: ["python", "-m", "adaptdl_tpu.sched.k8s.operator", "controller"]
+          ports:
+            - containerPort: {supervisor_port}
+          env:
+            - name: ADAPTDL_NAMESPACE
+              value: {namespace}{webhook_container}
+"""
+    )
+    docs.append(
+        f"""\
+apiVersion: v1
+kind: Service
+metadata:
+  name: adaptdl-supervisor
+  namespace: {namespace}
+  labels:
+    app: adaptdl-sched
+spec:
+  selector:
+    app: adaptdl-sched
+  ports:
+    - name: supervisor
+      port: {supervisor_port}
+      targetPort: {supervisor_port}
+    - name: webhook
+      port: {webhook_port}
+      targetPort: {webhook_port}
+"""
+    )
+    if with_webhook:
+        failure_policy = "Fail" if ca_bundle else "Ignore"
+        ca_line = (
+            f"\n      caBundle: {ca_bundle}" if ca_bundle else ""
+        )
+        docs.append(
+            f"""\
+apiVersion: admissionregistration.k8s.io/v1
+kind: ValidatingWebhookConfiguration
+metadata:
+  name: adaptdl-validator
+webhooks:
+  - name: validator.adaptdl.org
+    admissionReviewVersions: [v1]
+    sideEffects: None
+    failurePolicy: {failure_policy}
+    rules:
+      - apiGroups: ["adaptdl.org"]
+        apiVersions: [v1]
+        operations: [CREATE, UPDATE]
+        resources: [adaptdljobs]
+    clientConfig:{ca_line}
+      service:
+        name: adaptdl-supervisor
+        namespace: {namespace}
+        path: /validate
+        port: {webhook_port}
+"""
+        )
+    return "---\n".join(docs)
+
+
+def render_tensorboard_manifest(
+    name: str,
+    logdir_claim: str,
+    namespace: str = "default",
+    image: str = "tensorflow/tensorflow:latest",
+    port: int = 6006,
+) -> str:
+    """A managed TensorBoard instance: Deployment + Service over the
+    shared logs PVC (reference: cli/adaptdl_cli/tensorboard.py:24-120
+    creates the same pair per instance; attach locally with
+    ``kubectl port-forward service/adaptdl-tb-{name} 6006``)."""
+    return f"""\
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: adaptdl-tb-{name}
+  namespace: {namespace}
+  labels:
+    adaptdl/tensorboard: "{name}"
+spec:
+  replicas: 1
+  selector:
+    matchLabels:
+      adaptdl/tensorboard: "{name}"
+  template:
+    metadata:
+      labels:
+        adaptdl/tensorboard: "{name}"
+    spec:
+      containers:
+        - name: tensorboard
+          image: {image}
+          command: ["tensorboard", "--logdir", "/adaptdl/logs",
+                    "--host", "0.0.0.0", "--port", "{port}"]
+          ports:
+            - containerPort: {port}
+          volumeMounts:
+            - name: logs
+              mountPath: /adaptdl/logs
+              readOnly: true
+      volumes:
+        - name: logs
+          persistentVolumeClaim:
+            claimName: {logdir_claim}
+---
+apiVersion: v1
+kind: Service
+metadata:
+  name: adaptdl-tb-{name}
+  namespace: {namespace}
+  labels:
+    adaptdl/tensorboard: "{name}"
+spec:
+  selector:
+    adaptdl/tensorboard: "{name}"
+  ports:
+    - port: {port}
+      targetPort: {port}
+"""
+
+
 def render_job_manifest(
     name: str,
     script: str,

@@ -95,15 +95,23 @@ class Operator:
     """
 
     def __init__(
-        self, namespace: str | None = None, max_failures: int = 2
+        self,
+        namespace: str | None = None,
+        max_failures: int | None = None,
     ):
-        self.namespace = namespace or os.environ.get(
-            "ADAPTDL_NAMESPACE", "default"
+        from adaptdl_tpu.sched import config as sched_config
+
+        self.namespace = namespace or sched_config.namespace()
+        self.max_failures = (
+            max_failures
+            if max_failures is not None
+            else sched_config.max_worker_failures()
         )
-        self.max_failures = max_failures
         self.state = ClusterState()
         self.supervisor = Supervisor(
-            self.state, host="0.0.0.0", port=8080
+            self.state,
+            host="0.0.0.0",
+            port=sched_config.supervisor_port(),
         )
         self.allocator: Allocator | None = None
         self.expander: ClusterExpander | None = None
@@ -115,20 +123,47 @@ class Operator:
         api = client.CustomObjectsApi()
         core = client.CoreV1Api()
         self.supervisor.start()
+        from adaptdl_tpu.sched import config as sched_config
+
         # Live slice inventory: refreshed every reconcile pass so
         # capacity that appears after startup (expander growth, admin
         # adding a pool) becomes schedulable without restarting the
         # operator (the reference re-lists nodes each allocator cycle,
         # allocator.py:149-179).
         self._slice_inventory = await self._discover_slices(core)
+        gke = sched_config.gke_node_pool()
+        if gke is not None:
+            from adaptdl_tpu.sched.expander import (
+                GKENodePoolProvisioner,
+            )
+
+            provisioner = GKENodePoolProvisioner(**gke)
+        else:
+            provisioner = LoggingProvisioner(
+                initial=len(self._slice_inventory)
+            )
         self.expander = ClusterExpander(
-            LoggingProvisioner(initial=len(self._slice_inventory))
+            provisioner,
+            min_slices=sched_config.expander_min_slices(),
+            max_slices=sched_config.expander_max_slices(),
+            scale_down_delay=sched_config.expander_scale_down_delay(),
         )
+        # Template for a provisionable slice: from the live inventory
+        # when one exists, else the configured shape — starting with
+        # zero free capacity (tenants holding every chip, or a
+        # scale-from-zero pool) must not crash the operator.
+        if self._slice_inventory:
+            template = next(iter(self._slice_inventory.values()))
+        else:
+            template = NodeInfo(
+                resources=sched_config.slice_template()
+            )
         self.allocator = Allocator(
             self.state,
             lambda: dict(self._slice_inventory),
-            node_template=next(iter(self._slice_inventory.values())),
+            node_template=template,
             expander=self.expander,
+            interval=sched_config.allocator_interval(),
         )
         self.allocator.start()
         self.expander.start()
@@ -139,13 +174,44 @@ class Operator:
 
     async def _discover_slices(self, core) -> dict[str, NodeInfo]:
         """TPU node pools -> slices: nodes sharing a pool label form
-        one schedulable slice whose capacity is its chip total."""
+        one schedulable slice whose capacity is its FREE chip total —
+        allocatable minus the requests of non-AdaptDL pods already
+        bound to the node (the reference's headroom math,
+        allocator.py:149-179 + resources.py:24-140). AdaptDL's own
+        workers don't count: their placement is what the policy is
+        re-deciding each cycle."""
+        from adaptdl_tpu.sched.k8s.resources import get_node_unrequested
+
         nodes = {}
         listing = await core.list_node()
+        by_node: dict[str, list] = {}
+        lister = getattr(core, "list_pod_for_all_namespaces", None)
+        if lister is not None:
+            pods = await lister()
+            for pod in pods.items:
+                labels = pod.metadata.labels or {}
+                if "adaptdl/job" in labels:
+                    continue
+                # Terminated pods stay bound until GC but the
+                # kube-scheduler no longer counts their requests; nor
+                # must we, or free capacity is under-reported.
+                phase = getattr(
+                    getattr(pod, "status", None), "phase", None
+                )
+                if phase in ("Succeeded", "Failed"):
+                    continue
+                spec = getattr(pod, "spec", None)
+                if isinstance(spec, dict):
+                    node_name = spec.get("nodeName")
+                else:
+                    node_name = getattr(spec, "node_name", None)
+                if node_name:
+                    by_node.setdefault(node_name, []).append(pod)
         for node in listing.items:
-            tpus = int(
-                (node.status.allocatable or {}).get("google.com/tpu", 0)
+            free = get_node_unrequested(
+                node, by_node.get(node.metadata.name, [])
             )
+            tpus = free.get("google.com/tpu", 0) // 1000
             if tpus <= 0:
                 continue
             pool = node.metadata.labels.get(
@@ -421,8 +487,19 @@ def main():  # pragma: no cover - requires a live cluster
     role = sys.argv[1] if len(sys.argv) > 1 else "controller"
     operator = Operator()
     if role == "supervisor":
-        operator.supervisor._port = 8080
         operator.supervisor.start()
+        asyncio.get_event_loop().run_forever()
+    elif role == "webhook":
+        from adaptdl_tpu.sched import config as sched_config
+        from adaptdl_tpu.sched.validator import AdmissionWebhook
+
+        webhook = AdmissionWebhook(
+            host="0.0.0.0",
+            port=sched_config.webhook_port(),
+            certfile=sched_config.webhook_cert(),
+            keyfile=sched_config.webhook_key(),
+        )
+        webhook.start()
         asyncio.get_event_loop().run_forever()
     else:
         asyncio.run(operator.run())

@@ -1,0 +1,130 @@
+"""Kubernetes resource accounting: quantities and free capacity.
+
+The reference computes each node's schedulable headroom as
+``allocatable - sum(requests of non-AdaptDL pods)`` with full k8s
+quantity-string parsing (reference:
+sched/adaptdl_sched/resources.py:24-140 and its consumption at
+allocator.py:149-179). Same math here, feeding the slice inventory:
+TPU chips that other workloads have already requested on a node pool
+must not be allocated to AdaptDL jobs.
+
+Quantities parse into integral *millis* of the base unit (the smallest
+granularity k8s itself uses for CPU), so "100m" cpu == 100,
+"1" cpu == 1000, "2Gi" memory == 2*1024^3*1000. Extended resources
+like google.com/tpu are integral counts (still stored in millis for
+uniformity; divide by 1000 at the slice boundary).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+# K8s quantity grammar: decimal exponents ("1e3", "12E2" — E/e
+# followed by digits) take precedence over the bare "E" (exa) suffix.
+_QUANTITY_RE = re.compile(
+    r"^(?P<sign>[+-]?)(?P<digits>\d+(?:\.\d*)?|\.\d+)"
+    r"(?:(?P<exponent>[eE][+-]?\d+)|(?P<suffix>[KMGTPE]i?|[numkh]|))$"
+)
+
+# Multipliers into MILLIS of the base unit.
+_SUFFIX_MILLIS = {
+    "": 1000,
+    "n": 1e-6,  # nano
+    "u": 1e-3,  # micro
+    "m": 1,  # milli
+    "k": 1000 * 1000,
+    "K": 1000 * 1000,
+    "M": 1000 * 1000**2,
+    "G": 1000 * 1000**3,
+    "T": 1000 * 1000**4,
+    "P": 1000 * 1000**5,
+    "E": 1000 * 1000**6,
+    "Ki": 1000 * 1024,
+    "Mi": 1000 * 1024**2,
+    "Gi": 1000 * 1024**3,
+    "Ti": 1000 * 1024**4,
+    "Pi": 1000 * 1024**5,
+    "Ei": 1000 * 1024**6,
+    "h": 100 * 1000,  # hecto (rare but legal)
+}
+
+
+def parse_quantity(value: Any) -> int:
+    """K8s quantity string (or number) -> integral millis.
+
+    "500m" -> 500, "2" -> 2000, "1Gi" -> 1073741824000.
+    Raises ValueError on malformed strings.
+    """
+    if isinstance(value, (int, float)):
+        return round(float(value) * 1000)
+    text = str(value).strip()
+    m = _QUANTITY_RE.match(text)
+    if not m:
+        raise ValueError(f"malformed k8s quantity: {value!r}")
+    magnitude = float(m.group("digits"))
+    if m.group("sign") == "-":
+        magnitude = -magnitude
+    if m.group("exponent"):
+        return round(
+            magnitude * 10 ** int(m.group("exponent")[1:]) * 1000
+        )
+    return round(magnitude * _SUFFIX_MILLIS[m.group("suffix") or ""])
+
+
+def get_pod_requests(pod) -> dict[str, int]:
+    """Sum of container resource requests (millis) for one pod.
+
+    Follows k8s effective-request semantics for init containers: the
+    pod's request per resource is max(max over init containers,
+    sum over app containers).
+    """
+    spec = getattr(pod, "spec", None) or {}
+
+    def containers(field):
+        if isinstance(spec, dict):
+            return spec.get(field) or []
+        return getattr(spec, field, None) or []
+
+    def requests_of(container) -> dict[str, int]:
+        if isinstance(container, dict):
+            resources = container.get("resources") or {}
+            raw = resources.get("requests") or {}
+        else:
+            resources = getattr(container, "resources", None)
+            raw = getattr(resources, "requests", None) or {}
+        return {
+            rtype: parse_quantity(amount)
+            for rtype, amount in dict(raw).items()
+        }
+
+    total: dict[str, int] = {}
+    for container in containers("containers"):
+        for rtype, millis in requests_of(container).items():
+            total[rtype] = total.get(rtype, 0) + millis
+    for container in containers("init_containers") or containers(
+        "initContainers"
+    ):
+        for rtype, millis in requests_of(container).items():
+            total[rtype] = max(total.get(rtype, 0), millis)
+    return total
+
+
+def get_node_unrequested(node, pods) -> dict[str, int]:
+    """allocatable - sum(requests of the given pods), in millis,
+    floored at zero (reference: resources.py's node headroom math).
+
+    Callers pass only the pods to be counted against the node —
+    typically every pod bound to it that is NOT an AdaptDL worker
+    (AdaptDL's own usage is what the policy is re-deciding).
+    """
+    allocatable = getattr(node.status, "allocatable", None) or {}
+    free = {
+        rtype: parse_quantity(amount)
+        for rtype, amount in dict(allocatable).items()
+    }
+    for pod in pods:
+        for rtype, millis in get_pod_requests(pod).items():
+            if rtype in free:
+                free[rtype] = free[rtype] - millis
+    return {rtype: max(millis, 0) for rtype, millis in free.items()}

@@ -153,6 +153,8 @@ class MultiJobRunner:
                 )
                 continue
             num_replicas = len(allocation)
+            if job.name in self._stopped:
+                continue  # stop_job raced the launch-config read
             LOG.info(
                 "starting %s: replicas=%d restarts=%d topology=%s",
                 job.name,
@@ -160,6 +162,8 @@ class MultiJobRunner:
                 self.restart_counts[job.name],
                 topology,
             )
+            # No-op if stop_job already made the status terminal
+            # (ClusterState keeps terminal statuses sticky).
             self.state.update(job.name, status="Running")
             proc = subprocess.Popen(
                 [sys.executable, job.script],

@@ -18,6 +18,12 @@ from dataclasses import dataclass, field
 from typing import Any
 
 
+# Terminal job statuses. Shared here (not in allocator) so every
+# consumer — allocator skip-list, operator cleanup, runner threads —
+# agrees on one definition.
+FINISHED = ("Succeeded", "Failed", "Stopped")
+
+
 def normalize_topology(topology: dict | None) -> dict:
     """Canonical form for launch-config comparisons: ``None`` and the
     explicit pure-DP dict are the SAME configuration — treating them
@@ -111,6 +117,16 @@ class ClusterState:
         with self._cond:
             record = self._jobs[key]
             for name, value in fields.items():
+                if (
+                    name == "status"
+                    and record.status in FINISHED
+                    and value not in FINISHED
+                ):
+                    # Terminal statuses are sticky: a supervising
+                    # thread racing a stop_job()/completion must not
+                    # resurrect the job (the allocator would re-grant
+                    # it chips).
+                    continue
                 setattr(record, name, value)
             self._cond.notify_all()
 

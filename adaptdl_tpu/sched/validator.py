@@ -134,8 +134,27 @@ class AdmissionWebhook(ThreadedHttpServer):
 
     Served from the scheduler deployment next to the supervisor (the
     reference runs it as its own container behind
-    validator-webhook.yaml); same threaded aiohttp shell.
+    validator-webhook.yaml); same threaded aiohttp shell. The API
+    server only speaks HTTPS to webhooks — pass ``certfile``/
+    ``keyfile`` (the serving cert whose CA goes into the rendered
+    configuration's caBundle) in-cluster; plain HTTP is for tests and
+    local use.
     """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        certfile: str | None = None,
+        keyfile: str | None = None,
+    ):
+        ssl_context = None
+        if certfile:
+            import ssl
+
+            ssl_context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_context.load_cert_chain(certfile, keyfile)
+        super().__init__(host=host, port=port, ssl_context=ssl_context)
 
     def build_app(self):
         from aiohttp import web

@@ -144,11 +144,22 @@ def main():
         max_seq_shards=max_sp,
         max_model_shards=min(config.num_heads, 8),
     )
+    # Optional TensorBoard export (native writer, no TF needed):
+    # active when ADAPTDL_SHARE_PATH points at a log directory.
+    from adaptdl_tpu.tensorboard import MetricsWriter
+
+    tb = MetricsWriter()
     for e in epoch.remaining_epochs_until(args.epochs):
         for batch in loader:
             holder["state"], m = trainer.run_step(
                 holder["state"], batch, loader
             )
+        # TB step = the trainer's optimizer-step counter: it restores
+        # from the checkpoint, so steps stay monotonic across elastic
+        # restarts (a process-local counter would reset and garble
+        # the charts).
+        tb.write(int(holder["state"].step), m, dataloader=loader)
+        tb.flush()
         print(
             f"epoch {e}: loss={float(m['loss']):.4f} "
             f"batch_size={loader.current_batch_size} "

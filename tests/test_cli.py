@@ -271,3 +271,85 @@ def test_admission_webhook_over_http():
         assert resp["response"]["allowed"] is True, resp
     finally:
         webhook.stop()
+
+
+def test_deploy_bundle_renders_all_objects(capsys):
+    rc = main(["deploy", "--image", "img:1", "--dry-run"])
+    assert rc == 0
+    import yaml
+
+    docs = [
+        d
+        for d in yaml.safe_load_all(capsys.readouterr().out)
+        if d is not None
+    ]
+    kinds = [d["kind"] for d in docs]
+    for kind in (
+        "CustomResourceDefinition",
+        "ServiceAccount",
+        "ClusterRole",
+        "ClusterRoleBinding",
+        "Deployment",
+        "Service",
+        "ValidatingWebhookConfiguration",
+    ):
+        assert kind in kinds, kinds
+    webhook = next(
+        d for d in docs if d["kind"] == "ValidatingWebhookConfiguration"
+    )
+    assert webhook["webhooks"][0]["clientConfig"]["service"]["path"] == (
+        "/validate"
+    )
+    deployment = next(d for d in docs if d["kind"] == "Deployment")
+    containers = deployment["spec"]["template"]["spec"]["containers"]
+    assert {c["name"] for c in containers} == {"operator", "webhook"}
+    # Webhook can be disabled (reference chart's validator toggle).
+    rc = main(["deploy", "--image", "img:1", "--dry-run", "--no-webhook"])
+    assert rc == 0
+    docs = [
+        d
+        for d in yaml.safe_load_all(capsys.readouterr().out)
+        if d is not None
+    ]
+    assert "ValidatingWebhookConfiguration" not in [
+        d["kind"] for d in docs
+    ]
+
+
+def test_tensorboard_k8s_management(capsys):
+    import yaml
+
+    rc = main(
+        [
+            "tensorboard",
+            "create",
+            "--backend",
+            "k8s",
+            "--name",
+            "exp1",
+            "--dry-run",
+        ]
+    )
+    assert rc == 0
+    docs = list(yaml.safe_load_all(capsys.readouterr().out))
+    assert [d["kind"] for d in docs] == ["Deployment", "Service"]
+    assert docs[0]["metadata"]["name"] == "adaptdl-tb-exp1"
+    rc = main(
+        [
+            "tensorboard",
+            "delete",
+            "--backend",
+            "k8s",
+            "--name",
+            "exp1",
+            "--dry-run",
+        ]
+    )
+    assert rc == 0
+    assert "adaptdl/tensorboard=exp1" in capsys.readouterr().out
+
+
+def test_tensorboard_local_requires_logdir(capsys):
+    rc = main(["tensorboard", "create"])
+    assert rc == 2
+    assert "--logdir" in capsys.readouterr().err

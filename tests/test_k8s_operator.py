@@ -34,6 +34,7 @@ class FakeCore:
     def __init__(self):
         self.pods: dict[str, SimpleNamespace] = {}
         self.nodes: list[SimpleNamespace] = []
+        self.foreign_pods: list[SimpleNamespace] = []
 
     async def list_namespaced_pod(self, namespace, label_selector=None):
         items = list(self.pods.values())
@@ -52,6 +53,11 @@ class FakeCore:
 
     async def list_node(self):
         return SimpleNamespace(items=self.nodes)
+
+    async def list_pod_for_all_namespaces(self):
+        return SimpleNamespace(
+            items=list(self.pods.values()) + self.foreign_pods
+        )
 
     # -- test helpers ------------------------------------------------
 
@@ -340,3 +346,49 @@ def test_discover_slices_groups_by_node_pool():
     assert nodes["v5e-pool-a"].resources["tpu"] == 8
     assert nodes["v5e-pool-b"].resources["tpu"] == 8
     assert "cpu-pool" not in nodes
+
+
+def test_discover_slices_subtracts_foreign_pod_requests():
+    """Chips already requested by non-AdaptDL workloads are not
+    schedulable; AdaptDL's own workers don't count (the policy is
+    re-deciding their placement)."""
+    operator = Operator(namespace="ns")
+    core = FakeCore()
+    core.add_node("n0", "v5e-pool-a", 4)
+    core.add_node("n1", "v5e-pool-b", 8)
+    core.foreign_pods.append(
+        SimpleNamespace(
+            metadata=SimpleNamespace(labels={}, name="tenant"),
+            spec={
+                "nodeName": "n0",
+                "containers": [
+                    {
+                        "resources": {
+                            "requests": {"google.com/tpu": "3"}
+                        }
+                    }
+                ],
+            },
+        )
+    )
+    # An AdaptDL worker on n1: ignored in headroom.
+    core.foreign_pods.append(
+        SimpleNamespace(
+            metadata=SimpleNamespace(
+                labels={"adaptdl/job": "j"}, name="worker"
+            ),
+            spec={
+                "nodeName": "n1",
+                "containers": [
+                    {
+                        "resources": {
+                            "requests": {"google.com/tpu": "8"}
+                        }
+                    }
+                ],
+            },
+        )
+    )
+    nodes = asyncio.run(operator._discover_slices(core))
+    assert nodes["v5e-pool-a"].resources["tpu"] == 1
+    assert nodes["v5e-pool-b"].resources["tpu"] == 8

@@ -1,0 +1,122 @@
+"""K8s quantity parsing + node headroom accounting (reference
+coverage: sched/adaptdl_sched/resources_test.py's 13 parsing cases and
+the allocator's free-resource math) and the consolidated scheduler
+config module."""
+
+from types import SimpleNamespace
+
+import pytest
+
+from adaptdl_tpu.sched.k8s.resources import (
+    get_node_unrequested,
+    get_pod_requests,
+    parse_quantity,
+)
+
+
+@pytest.mark.parametrize(
+    "text,expected",
+    [
+        ("100m", 100),
+        ("1", 1000),
+        ("2", 2000),
+        ("0.5", 500),
+        ("1.5", 1500),
+        ("1k", 1_000_000),
+        ("1K", 1_000_000),
+        ("1Ki", 1_024_000),
+        ("2Mi", 2 * 1024**2 * 1000),
+        ("1Gi", 1024**3 * 1000),
+        ("3G", 3 * 1000**3 * 1000),
+        ("-1", -1000),
+        (4, 4000),
+        (0.25, 250),
+        ("250u", 0),  # rounds to nearest milli
+        ("2500u", 2),
+    ],
+)
+def test_parse_quantity(text, expected):
+    assert parse_quantity(text) == expected
+
+
+@pytest.mark.parametrize("bad", ["", "abc", "1Zi", "--1", "1.2.3"])
+def test_parse_quantity_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_quantity(bad)
+
+
+def _pod(requests_list, init_requests=()):
+    return SimpleNamespace(
+        spec={
+            "containers": [
+                {"resources": {"requests": r}} for r in requests_list
+            ],
+            "initContainers": [
+                {"resources": {"requests": r}} for r in init_requests
+            ],
+        }
+    )
+
+
+def test_pod_requests_sum_and_init_max():
+    pod = _pod(
+        [{"cpu": "100m", "memory": "1Gi"}, {"cpu": "1"}],
+        init_requests=[{"cpu": "2"}],
+    )
+    requests = get_pod_requests(pod)
+    # App containers sum: 100m + 1 = 1.1 cpu; init max(2) wins.
+    assert requests["cpu"] == 2000
+    assert requests["memory"] == 1024**3 * 1000
+
+
+def test_node_unrequested_subtracts_and_floors():
+    node = SimpleNamespace(
+        status=SimpleNamespace(
+            allocatable={"google.com/tpu": "4", "cpu": "8"}
+        )
+    )
+    pods = [
+        _pod([{"google.com/tpu": "1", "cpu": "2"}]),
+        _pod([{"cpu": "10"}]),  # overcommit floors at 0
+    ]
+    free = get_node_unrequested(node, pods)
+    assert free["google.com/tpu"] == 3000  # 3 chips in millis
+    assert free["cpu"] == 0
+
+
+def test_sched_config_knobs(monkeypatch):
+    from adaptdl_tpu.sched import config
+
+    assert config.namespace() == "default"
+    assert config.default_job_resources() == {"tpu": 1}
+    assert config.gke_node_pool() is None
+    monkeypatch.setenv("ADAPTDL_NAMESPACE", "prod")
+    monkeypatch.setenv("ADAPTDL_ALLOCATOR_INTERVAL", "15")
+    monkeypatch.setenv(
+        "ADAPTDL_DEFAULT_RESOURCES", '{"tpu": 4}'
+    )
+    monkeypatch.setenv(
+        "ADAPTDL_GKE_NODE_POOL",
+        '{"project": "p", "location": "us-central2-b", '
+        '"cluster": "c", "node_pool": "tpus"}',
+    )
+    assert config.namespace() == "prod"
+    assert config.allocator_interval() == 15.0
+    assert config.default_job_resources() == {"tpu": 4}
+    assert config.gke_node_pool()["node_pool"] == "tpus"
+    monkeypatch.setenv("ADAPTDL_GKE_NODE_POOL", '{"project": "p"}')
+    with pytest.raises(ValueError):
+        config.gke_node_pool()
+
+
+@pytest.mark.parametrize(
+    "text,expected",
+    [
+        ("1e3", 1_000_000),
+        ("12E2", 1_200_000),
+        ("1e-3", 1),
+        ("1E", 1000 * 1000**6),  # bare E is exa, not exponent
+    ],
+)
+def test_parse_quantity_exponent_forms(text, expected):
+    assert parse_quantity(text) == expected
