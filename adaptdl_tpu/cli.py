@@ -178,6 +178,13 @@ def _cmd_tensorboard(args) -> int:
             port=args.port,
         )
         return _apply_or_print(manifest, args.dry_run)
+    if args.action == "delete":
+        print(
+            "tensorboard delete requires --backend k8s (the local "
+            "backend runs in the foreground; just stop it)",
+            file=sys.stderr,
+        )
+        return 2
     if not args.logdir:
         print(
             "--logdir is required for the local backend",

@@ -1,0 +1,12 @@
+"""Hand-written TPU kernels (Pallas) for the hot ops.
+
+The reference has no native/kernel layer at all (it is pure Python over
+torch's prebuilt CUDA kernels, SURVEY.md top note); here the compute
+path is JAX/XLA and the kernels that beat XLA's default lowering live
+in this package. Interpret mode makes every kernel testable on CPU.
+"""
+
+from adaptdl_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    make_flash_attention,
+)

@@ -148,13 +148,48 @@ subjects:
     namespace: {namespace}
 """
     )
+    # With a CA bundle the webhook must actually serve TLS: the
+    # serving cert arrives as a standard kubernetes.io/tls Secret
+    # (create it with cert-manager or `kubectl create secret tls
+    # adaptdl-webhook-tls ...`), mounted and pointed at via the
+    # ADAPTDL_WEBHOOK_CERT/KEY env the webhook process reads.
+    tls_env = (
+        f"""
+            - name: ADAPTDL_WEBHOOK_CERT
+              value: /etc/adaptdl/tls/tls.crt
+            - name: ADAPTDL_WEBHOOK_KEY
+              value: /etc/adaptdl/tls/tls.key"""
+        if ca_bundle
+        else ""
+    )
+    tls_mount = (
+        """
+          volumeMounts:
+            - name: webhook-tls
+              mountPath: /etc/adaptdl/tls
+              readOnly: true"""
+        if ca_bundle
+        else ""
+    )
+    tls_volume = (
+        """
+      volumes:
+        - name: webhook-tls
+          secret:
+            secretName: adaptdl-webhook-tls"""
+        if ca_bundle
+        else ""
+    )
     webhook_container = (
         f"""
         - name: webhook
           image: {image}
           command: ["python", "-m", "adaptdl_tpu.sched.k8s.operator", "webhook"]
           ports:
-            - containerPort: {webhook_port}"""
+            - containerPort: {webhook_port}
+          env:
+            - name: ADAPTDL_WEBHOOK_PORT
+              value: "{webhook_port}"{tls_env}{tls_mount}"""
         if with_webhook
         else ""
     )
@@ -184,7 +219,9 @@ spec:
             - containerPort: {supervisor_port}
           env:
             - name: ADAPTDL_NAMESPACE
-              value: {namespace}{webhook_container}
+              value: {namespace}
+            - name: ADAPTDL_SUPERVISOR_PORT
+              value: "{supervisor_port}"{webhook_container}{tls_volume}
 """
     )
     docs.append(

@@ -144,17 +144,24 @@ class EventFileWriter:
 def read_events(path: str) -> list[tuple[int, dict[str, float]]]:
     """Parse a tfevents file back into (step, {tag: value}) rows —
     used by tests and by ``adaptdl-tpu`` tooling to sanity-check
-    writer output; verifies every record's CRCs."""
+    writer output; verifies every complete record's CRCs. A truncated
+    TAIL record (a writer killed mid-write — this framework's normal
+    preemption mode) ends parsing cleanly, like stock TensorBoard;
+    corruption inside a complete record still raises."""
     rows = []
     with open(path, "rb") as f:
         data = f.read()
     pos = 0
     while pos < len(data):
+        if pos + 12 > len(data):
+            break  # truncated tail: header incomplete
         header = data[pos : pos + 8]
         (length,) = struct.unpack("<Q", header)
         (hcrc,) = struct.unpack("<I", data[pos + 8 : pos + 12])
         if hcrc != _masked_crc(header):
             raise ValueError("corrupt record header")
+        if pos + 16 + length > len(data):
+            break  # truncated tail: payload/CRC incomplete
         payload = data[pos + 12 : pos + 12 + length]
         (pcrc,) = struct.unpack(
             "<I", data[pos + 12 + length : pos + 16 + length]

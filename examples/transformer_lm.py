@@ -29,6 +29,10 @@ def main():
     # override for manual runs.
     parser.add_argument("--seq-shards", type=int, default=None)
     parser.add_argument("--tp-shards", type=int, default=None)
+    # Pallas flash-attention kernel for the within-chip attention
+    # (blocked online softmax, no [seq, seq] intermediate). Not
+    # composable with --seq-shards (ring attention owns that path).
+    parser.add_argument("--flash", action="store_true")
     parser.add_argument("--seq-len", type=int, default=None)
     args = parser.parse_args()
     if args.cpu:
@@ -54,6 +58,17 @@ def main():
     seq_len = args.seq_len or (32 if on_cpu else 512)
     assert seq_len % max(seq_shards, 1) == 0
 
+    attention_fn = None
+    if args.flash:
+        assert seq_shards <= 1, (
+            "--flash is the within-chip kernel; sequence sharding "
+            "uses ring attention"
+        )
+        from adaptdl_tpu.ops import make_flash_attention
+
+        attention_fn = make_flash_attention(
+            block_q=min(128, seq_len), block_k=min(128, seq_len)
+        )
     config = TransformerConfig(
         vocab_size=256 if on_cpu else 32000,
         num_layers=2 if on_cpu else 12,
@@ -64,6 +79,7 @@ def main():
         dtype=jnp.float32 if on_cpu else jnp.bfloat16,
         remat=True,
         seq_axis="seq" if seq_shards > 1 else None,
+        attention_fn=attention_fn,
     )
     model, params = init_transformer(config, seq_len=seq_len)
 
@@ -138,8 +154,12 @@ def main():
     # power-of-two factorizations, and a non-dividing choice would
     # assert on every restart), and TP up to the head count.
     max_sp = 1
-    while max_sp * 2 <= 8 and seq_len % (max_sp * 2) == 0:
-        max_sp *= 2
+    if not args.flash:
+        # --flash is the within-chip kernel: advertising seq shards
+        # would let the scheduler assign a topology the flash path
+        # asserts against, crash-looping every restart.
+        while max_sp * 2 <= 8 and seq_len % (max_sp * 2) == 0:
+            max_sp *= 2
     metrics.set_topology_config(
         max_seq_shards=max_sp,
         max_model_shards=min(config.num_heads, 8),

@@ -353,3 +353,50 @@ def test_tensorboard_local_requires_logdir(capsys):
     rc = main(["tensorboard", "create"])
     assert rc == 2
     assert "--logdir" in capsys.readouterr().err
+
+
+def test_deploy_with_ca_bundle_wires_webhook_tls(capsys):
+    import yaml
+
+    rc = main(
+        ["deploy", "--image", "img:1", "--dry-run", "--ca-bundle", "QUJD"]
+    )
+    assert rc == 0
+    docs = [
+        d
+        for d in yaml.safe_load_all(capsys.readouterr().out)
+        if d is not None
+    ]
+    webhook_cfg = next(
+        d for d in docs if d["kind"] == "ValidatingWebhookConfiguration"
+    )
+    assert webhook_cfg["webhooks"][0]["failurePolicy"] == "Fail"
+    assert webhook_cfg["webhooks"][0]["clientConfig"]["caBundle"] == "QUJD"
+    deployment = next(d for d in docs if d["kind"] == "Deployment")
+    spec = deployment["spec"]["template"]["spec"]
+    webhook = next(
+        c for c in spec["containers"] if c["name"] == "webhook"
+    )
+    env = {e["name"]: e["value"] for e in webhook["env"]}
+    assert env["ADAPTDL_WEBHOOK_CERT"] == "/etc/adaptdl/tls/tls.crt"
+    assert webhook["volumeMounts"][0]["mountPath"] == "/etc/adaptdl/tls"
+    assert spec["volumes"][0]["secret"]["secretName"] == (
+        "adaptdl-webhook-tls"
+    )
+    # Without a bundle: Ignore policy, no TLS plumbing.
+    rc = main(["deploy", "--image", "img:1", "--dry-run"])
+    docs = [
+        d
+        for d in yaml.safe_load_all(capsys.readouterr().out)
+        if d is not None
+    ]
+    webhook_cfg = next(
+        d for d in docs if d["kind"] == "ValidatingWebhookConfiguration"
+    )
+    assert webhook_cfg["webhooks"][0]["failurePolicy"] == "Ignore"
+
+
+def test_tensorboard_local_delete_rejected(capsys):
+    rc = main(["tensorboard", "delete", "--logdir", "/tmp/x"])
+    assert rc == 2
+    assert "k8s" in capsys.readouterr().err

@@ -105,3 +105,19 @@ def test_tfrecord_header_layout(tmp_path):
         (length,) = struct.unpack("<Q", header)
     assert 0 < length < 64
     writer.close()
+
+
+def test_truncated_tail_tolerated(tmp_path):
+    """A writer killed mid-record (preemption) leaves a partial tail;
+    complete records before it must still read."""
+    writer = EventFileWriter(str(tmp_path))
+    writer.add_scalars(1, {"x": 1.0})
+    writer.add_scalars(2, {"x": 2.0})
+    writer.flush()
+    writer.close()
+    with open(writer.path, "rb") as f:
+        data = f.read()
+    with open(writer.path, "wb") as f:
+        f.write(data[:-7])  # cut into the last record's CRC/payload
+    rows = read_events(writer.path)
+    assert rows == [(1, {"x": 1.0})]
