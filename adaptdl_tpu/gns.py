@@ -230,6 +230,7 @@ def update(
     precond: Any = None,
     group_ids: tuple[int, ...] | None = None,
     num_groups: int = 1,
+    stat_psum_axis: str | None = None,
 ) -> GNSState:
     """One GNS update after a synchronized optimizer step.
 
@@ -254,10 +255,19 @@ def update(
     local_sqr_mean = jnp.reshape(
         jnp.asarray(local_sqr_mean, jnp.float32), (num_groups,)
     )
+
+    def stat(x):
+        # Model-sharded gradients (pipeline stages): each device's
+        # squared norm covers only its parameter shard — the full
+        # gradient's norm is the psum over the sharding axis.
+        if stat_psum_axis is not None:
+            return jax.lax.psum(x, stat_psum_axis)
+        return x
+
     scale = accum_scale * num_microbatches
     if count > 1:
-        total_sqr = group_normsqr(
-            grads_mean, group_ids, num_groups, precond
+        total_sqr = stat(
+            group_normsqr(grads_mean, group_ids, num_groups, precond)
         )
         grad_sqr = (count * total_sqr - local_sqr_mean) / (count - 1)
         grad_var = (local_sqr_mean - total_sqr) * scale / (count - 1)
@@ -270,12 +280,17 @@ def update(
 
     # Single-sample configuration: difference consecutive gradients.
     prev = state.prev_grad
-    curr_sqr = group_normsqr(grads_mean, group_ids, num_groups, precond)
+    curr_sqr = stat(
+        group_normsqr(grads_mean, group_ids, num_groups, precond)
+    )
     pair_local = (
-        group_normsqr(prev, group_ids, num_groups, precond) + curr_sqr
+        stat(group_normsqr(prev, group_ids, num_groups, precond))
+        + curr_sqr
     ) / 2
     pair_mean = jax.tree.map(lambda a, b: (a + b) / 2, prev, grads_mean)
-    pair_total = group_normsqr(pair_mean, group_ids, num_groups, precond)
+    pair_total = stat(
+        group_normsqr(pair_mean, group_ids, num_groups, precond)
+    )
     d_scale = 2 * accum_scale
     grad_sqr = 2 * pair_total - pair_local
     grad_var = (pair_local - pair_total) * d_scale

@@ -1,3 +1,8 @@
 """Mesh construction and sharding utilities for elastic SPMD training."""
 
 from adaptdl_tpu.parallel.mesh import create_mesh  # noqa: F401
+from adaptdl_tpu.parallel.pipeline import (  # noqa: F401
+    gpipe,
+    gpipe_loss,
+    stack_stage_params,
+)

@@ -18,6 +18,7 @@ from jax.sharding import Mesh
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+STAGE_AXIS = "stage"
 
 
 def create_mesh(

@@ -1,0 +1,142 @@
+"""Pipeline parallelism: GPipe microbatch scheduling over a mesh axis.
+
+Layers split across a ``"stage"`` mesh axis; activations flow between
+neighboring stages with ``lax.ppermute`` (nearest-neighbor hops that
+ride ICI) while a ``lax.scan`` advances the schedule — the classic
+collective-permute pipeline. With M microbatches and S stages the
+schedule runs M + S - 1 ticks; every device runs its stage every tick
+(static shapes, no data-dependent control flow), and the bubble is the
+usual (S-1)/(M+S-1) fraction.
+
+The reference has no pipeline (or any non-data) parallelism
+(SURVEY.md §2.7); this is a capability extension like ring attention.
+Autodiff flows through ``ppermute`` (its transpose is the reverse
+permute), so the same pipelined callable is used for training inside
+the elastic trainer's ``shard_map`` — see
+``ElasticTrainer``'s ``stage``-axis support, which treats a stage
+group as ONE data-parallel replica whose parameters are sharded (not
+replicated) across the group.
+
+Convention: every parameter leaf is STACKED along a leading stage axis
+(``stack_stage_params``), sharded ``P("stage")``; inside the manual
+shard_map each device sees its own stage's slice with the leading axis
+dropped by indexing ``[0]``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from adaptdl_tpu.parallel.mesh import STAGE_AXIS
+
+
+def stack_stage_params(per_stage: list[Any]) -> Any:
+    """Stack S per-stage parameter pytrees into one tree whose leaves
+    have a leading stage axis (shard with ``P("stage")``)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
+
+
+def gpipe(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_params_local: Any,
+    micro_inputs: jnp.ndarray,
+    axis_name: str = STAGE_AXIS,
+) -> jnp.ndarray:
+    """Run the GPipe schedule inside a ``shard_map`` manual over
+    ``axis_name``.
+
+    Args:
+      stage_fn: one stage's forward, ``stage_fn(params, x) -> y`` with
+        ``y.shape == x.shape`` (uniform inter-stage activation shape —
+        the transformer-block case).
+      stage_params_local: THIS stage's parameters (the ``[0]``-indexed
+        slice of the stage-stacked tree).
+      micro_inputs: ``[num_micro, micro_batch, ...]`` microbatched
+        input, identical on every stage device (only stage 0 consumes
+        it).
+
+    Returns:
+      ``[num_micro, micro_batch, ...]`` final-stage outputs, valid on
+      the LAST stage (other stages hold garbage — combine with a
+      ``where``/psum keyed on ``lax.axis_index``).
+    """
+    stage = lax.axis_index(axis_name)
+    num_stages = lax.axis_size(axis_name)
+    num_micro = micro_inputs.shape[0]
+    ticks = num_micro + num_stages - 1
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    # The handoff carry is stage-varying (each device passes ITS
+    # stage's activations), while micro_inputs is replicated across
+    # the stage group — pcast the init so the scan carry types line up
+    # under shard_map's vma tracking.
+    zero_act = lax.pcast(
+        micro_inputs[0] * 0.0, axis_name, to="varying"
+    )
+
+    def tick(carry, t):
+        incoming = carry  # activation handed over by the previous stage
+        # Stage 0 feeds microbatch t (clamped; out-of-range ticks
+        # compute garbage that the output masking discards).
+        feed_idx = jnp.clip(t, 0, num_micro - 1)
+        first_in = lax.dynamic_index_in_dim(
+            micro_inputs, feed_idx, axis=0, keepdims=False
+        )
+        x = jnp.where(stage == 0, first_in, incoming)
+        y = stage_fn(stage_params_local, x)
+        handoff = lax.ppermute(y, axis_name, perm)
+        return handoff, y
+
+    _, per_tick = lax.scan(tick, zero_act, jnp.arange(ticks))
+    # The last stage emits microbatch m at tick m + (S - 1). Gather
+    # those M ticks; correct only on the last stage.
+    return lax.dynamic_slice_in_dim(
+        per_tick, num_stages - 1, num_micro, axis=0
+    )
+
+
+def gpipe_loss(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    loss_head: Callable[[jnp.ndarray, Any], jnp.ndarray],
+    num_micro: int,
+    axis_name: str = STAGE_AXIS,
+) -> Callable:
+    """Build an ElasticTrainer-compatible loss over a GPipe pipeline.
+
+    Args:
+      stage_fn: one stage's forward (see :func:`gpipe`).
+      loss_head: ``loss_head(final_activations, batch) -> scalar`` mean
+        loss, evaluated logically on the last stage; ``batch`` is the
+        UN-microbatched per-replica batch.
+      num_micro: pipeline microbatches per step (static; independent
+        of the trainer's gradient-accumulation microbatching).
+
+    Returns:
+      ``loss_fn(stage_params_local, batch, rng)`` where ``batch["x"]``
+      is ``[per_replica_batch, ...]`` and divisible by ``num_micro``.
+    """
+
+    def loss_fn(stage_params_local, batch, rng):
+        del rng
+        x = batch["x"]
+        assert x.shape[0] % num_micro == 0, (
+            f"per-replica batch {x.shape[0]} not divisible into "
+            f"{num_micro} pipeline microbatches"
+        )
+        micro = x.reshape((num_micro, -1) + x.shape[1:])
+        outs = gpipe(stage_fn, stage_params_local, micro, axis_name)
+        final = outs.reshape(x.shape)
+        loss = loss_head(final, batch)
+        stage = lax.axis_index(axis_name)
+        num_stages = lax.axis_size(axis_name)
+        # Only the last stage's loss is real; share it with the whole
+        # stage group (psum of a masked value == broadcast).
+        return lax.psum(
+            jnp.where(stage == num_stages - 1, loss, 0.0), axis_name
+        )
+
+    return loss_fn

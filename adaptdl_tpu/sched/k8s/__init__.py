@@ -177,7 +177,7 @@ subjects:
         - name: webhook-tls
           secret:
             secretName: adaptdl-webhook-tls"""
-        if ca_bundle
+        if (ca_bundle and with_webhook)
         else ""
     )
     webhook_container = (

@@ -44,6 +44,7 @@ from adaptdl_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
     SEQ_AXIS,
+    STAGE_AXIS,
     create_mesh,
 )
 from adaptdl_tpu.scaling_rules import RuleContext, ScalingRule
@@ -217,6 +218,15 @@ class ElasticTrainer:
     def seq_shards(self) -> int:
         return self.mesh.shape.get(SEQ_AXIS, 1)
 
+    @property
+    def stage_shards(self) -> int:
+        """Pipeline stages. A stage group is ONE data-parallel replica
+        whose parameters are sharded (stage-stacked leading axis, spec
+        P("stage") from param_sharding_fn) rather than replicated; the
+        loss_fn runs inside the manual shard_map and schedules
+        microbatches with adaptdl_tpu.parallel.pipeline.gpipe."""
+        return self.mesh.shape.get(STAGE_AXIS, 1)
+
     def _batch_spec(self, leaf) -> P:
         """Data axis on dim 0; with sequence parallelism, seq-sharded
         leaves (ndim >= 2, seq at dim 1 by contract) also split dim 1."""
@@ -265,6 +275,49 @@ class ElasticTrainer:
             return P()
 
         return jax.tree_util.tree_map_with_path(assign, state)
+
+    def _abstract_state(self) -> "TrainState":
+        """Shape/structure skeleton of the TrainState (no devices):
+        what spec-tree construction needs before any state exists."""
+
+        def build():
+            params = self._init_params
+            opt_state = self.optimizer.init(params)
+            gns_state = gns.init(params, self.num_param_groups)
+            return TrainState(
+                params=params,
+                opt_state=opt_state,
+                gns=gns_state,
+                progress=jnp.zeros(()),
+                step=jnp.zeros((), jnp.int32),
+                rng=jax.random.key(self._seed),
+            )
+
+        return jax.eval_shape(build)
+
+    @staticmethod
+    def _restrict_specs(specs, manual_axes: set):
+        """Keep only the shard_map's MANUAL axes in a spec tree:
+        pipeline-stage components stay (they are sharded inside the
+        step), model-axis components drop (GSPMD auto handles them)."""
+
+        def restrict(spec):
+            kept = tuple(
+                axis if axis in manual_axes else None
+                for axis in (spec or ())
+            )
+            while kept and kept[-1] is None:
+                kept = kept[:-1]
+            return P(*kept)
+
+        return jax.tree.map(
+            restrict, specs, is_leaf=lambda x: isinstance(x, P)
+        )
+
+    def _manual_state_specs(self, manual_axes: set):
+        return self._restrict_specs(
+            self.state_spec_tree(self._abstract_state()), manual_axes
+        )
 
     def init_state(self) -> TrainState:
         """Fresh TrainState on the mesh: data-parallel leaves
@@ -328,6 +381,7 @@ class ElasticTrainer:
     def _build_step(self, atomic_bsz: int, accum_steps: int):
         num_replicas = self.num_replicas
         seq_shards = self.seq_shards
+        stage_shards = self.stage_shards
         num_micro = accum_steps + 1
         count = num_replicas * num_micro
         accum_scale = num_replicas * atomic_bsz / self.init_batch_size
@@ -399,32 +453,48 @@ class ElasticTrainer:
                 )
                 return (grad_sum, lsqr_sum, loss_sum + loss), None
 
+            # Derive the grad accumulator from the params so it
+            # inherits their varying-axis types (stage-sharded leaves
+            # are stage-varying; a literal zeros array would be typed
+            # unvarying and fail the scan carry check), then add the
+            # data axis. The loss carry stays stage-UNvarying (a
+            # pipelined loss_fn psums over the stage axis); the lsqr
+            # carry follows the gradients.
             zeros = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params
+                lambda p: (p * 0.0).astype(jnp.float32), params
             )
-            # The carry accumulates per-replica values, so mark it as
-            # varying over the data axis for shard_map's vma tracking.
-            # (With sequence parallelism the carry stays seq-UNvarying:
-            # grad/loss are pmean'ed over the seq axis inside the body.)
-            init = jax.lax.pcast(
-                (
-                    zeros,
-                    jnp.zeros((self.num_param_groups,)),
-                    jnp.zeros(()),
-                ),
-                DATA_AXIS,
+            grad_init = jax.lax.pcast(zeros, DATA_AXIS, to="varying")
+            lsqr_axes = (
+                (DATA_AXIS, STAGE_AXIS)
+                if stage_shards > 1
+                else DATA_AXIS
+            )
+            lsqr_init = jax.lax.pcast(
+                jnp.zeros((self.num_param_groups,)),
+                lsqr_axes,
                 to="varying",
             )
+            loss_init = jax.lax.pcast(
+                jnp.zeros(()), DATA_AXIS, to="varying"
+            )
+            init = (grad_init, lsqr_init, loss_init)
             (grad_sum, lsqr_sum, loss_sum), _ = jax.lax.scan(
                 micro_step, init, (micro_batches, micro_rngs)
             )
             grads_local = jax.tree.map(lambda g: g / num_micro, grad_sum)
             # The gradient all-reduce: one fused pmean over ICI/DCN,
-            # with the two GNS scalars riding alongside.
+            # with the two GNS scalars riding alongside. Pipeline
+            # stages do NOT average gradients — each stage owns its
+            # parameter shard — but the gradient-norm statistics sum
+            # across the shards.
             grads = jax.lax.pmean(grads_local, DATA_AXIS)
             local_sqr_mean = jax.lax.pmean(
                 lsqr_sum / num_micro, DATA_AXIS
             )
+            if stage_shards > 1:
+                local_sqr_mean = jax.lax.psum(
+                    local_sqr_mean, STAGE_AXIS
+                )
             loss = jax.lax.pmean(loss_sum / num_micro, DATA_AXIS)
 
             new_gns = gns.update(
@@ -438,6 +508,9 @@ class ElasticTrainer:
                 precond=precond,
                 group_ids=self._group_ids,
                 num_groups=self.num_param_groups,
+                stat_psum_axis=(
+                    STAGE_AXIS if stage_shards > 1 else None
+                ),
             )
             step_gain = gns.gain(new_gns, scale)
             ctx = RuleContext(
@@ -488,22 +561,28 @@ class ElasticTrainer:
         batch_spec = (
             P(DATA_AXIS, SEQ_AXIS) if seq_shards > 1 else P(DATA_AXIS)
         )
+        manual = {DATA_AXIS}
+        if seq_shards > 1:
+            manual.add(SEQ_AXIS)
+        if stage_shards > 1:
+            manual.add(STAGE_AXIS)
         extra = {}
         if MODEL_AXIS in self.mesh.shape:
             # Partial-manual mode: collectives stay manual over the
-            # data (and seq) axes where the GNS needs per-device
+            # data/seq/stage axes where the GNS needs per-device
             # values; the model axis remains automatic so GSPMD
             # propagates the params' tensor-parallel shardings and
             # inserts the TP collectives itself.
-            manual = {DATA_AXIS}
-            if seq_shards > 1:
-                manual.add(SEQ_AXIS)
             extra["axis_names"] = manual
+        # State specs over the manual axes: replicated (P()) leaves in
+        # pure data parallelism; stage-sharded params (and their
+        # optimizer/GNS mirrors) under pipeline parallelism.
+        state_specs = self._manual_state_specs(manual)
         sharded = shard_map(
             per_replica_step,
             mesh=self.mesh,
-            in_specs=(P(), batch_spec, P()),
-            out_specs=(P(), P()),
+            in_specs=(state_specs, batch_spec, P()),
+            out_specs=(state_specs, P()),
             **extra,
         )
         jitted = jax.jit(sharded, donate_argnums=0)
@@ -564,6 +643,7 @@ class ElasticTrainer:
         fusion; see adaptdl_tpu.metrics)."""
 
         seq_shards = self.seq_shards
+        stage_shards = self.stage_shards
         varying_axes = (
             (DATA_AXIS, SEQ_AXIS) if seq_shards > 1 else DATA_AXIS
         )
@@ -577,21 +657,28 @@ class ElasticTrainer:
             total = gns.normsqr(grads) + loss
             if seq_shards > 1:
                 total = jax.lax.pmean(total, SEQ_AXIS)
+            if stage_shards > 1:
+                total = jax.lax.psum(total, STAGE_AXIS)
             return total[None]
 
         batch_spec = (
             P(DATA_AXIS, SEQ_AXIS) if seq_shards > 1 else P(DATA_AXIS)
         )
+        manual = {DATA_AXIS}
+        if seq_shards > 1:
+            manual.add(SEQ_AXIS)
+        if stage_shards > 1:
+            manual.add(STAGE_AXIS)
         extra = {}
         if MODEL_AXIS in self.mesh.shape:
-            manual = {DATA_AXIS}
-            if seq_shards > 1:
-                manual.add(SEQ_AXIS)
             extra["axis_names"] = manual
+        param_specs = self._restrict_specs(
+            self._param_spec_tree(self._init_params), manual
+        )
         sharded = shard_map(
             per_replica,
             mesh=self.mesh,
-            in_specs=(P(), batch_spec, P()),
+            in_specs=(param_specs, batch_spec, P()),
             out_specs=P(DATA_AXIS),
             **extra,
         )

@@ -162,7 +162,10 @@ def main():
             max_sp *= 2
     metrics.set_topology_config(
         max_seq_shards=max_sp,
-        max_model_shards=min(config.num_heads, 8),
+        # pallas_call is opaque to GSPMD: under a model axis the
+        # flash kernel's q/k/v would be all-gathered and attention
+        # recomputed per shard, so don't advertise TP with --flash.
+        max_model_shards=1 if args.flash else min(config.num_heads, 8),
     )
     # Optional TensorBoard export (native writer, no TF needed):
     # active when ADAPTDL_SHARE_PATH points at a log directory.
