@@ -189,6 +189,37 @@ def group_normsqr(
     return jnp.stack([jnp.asarray(t, jnp.float32) for t in terms])
 
 
+def sharded_group_normsqr(
+    tree: Any,
+    group_ids: tuple[int, ...],
+    num_groups: int,
+    leaf_psum_axes: tuple,
+    precond: Any = None,
+) -> jnp.ndarray:
+    """Per-group squared norms when SOME leaves are sharded over mesh
+    axes (pipeline stages / experts) and others are replicated across
+    those same devices: each sharded leaf's term psums over exactly
+    ITS axes, so replicated leaves — whose gradients are already
+    complete on every device — are never double-counted."""
+    leaves = jax.tree.leaves(tree)
+    pre = (
+        jax.tree.leaves(precond)
+        if precond is not None
+        else [None] * len(leaves)
+    )
+    terms: list[Any] = [0.0] * num_groups
+    for gid, axes, g, p in zip(group_ids, leaf_psum_axes, leaves, pre):
+        sq = (
+            jnp.sum(jnp.square(g.astype(jnp.float32)))
+            if p is None
+            else jnp.sum(jnp.square(g.astype(jnp.float32) / p))
+        )
+        if axes:
+            sq = jax.lax.psum(sq, axes)
+        terms[gid] = terms[gid] + sq
+    return jnp.stack([jnp.asarray(t, jnp.float32) for t in terms])
+
+
 def _ema_update(biased, unbias, value, theta):
     return theta * biased + (1 - theta) * value, theta * unbias + (1 - theta)
 
@@ -230,7 +261,8 @@ def update(
     precond: Any = None,
     group_ids: tuple[int, ...] | None = None,
     num_groups: int = 1,
-    stat_psum_axis: str | None = None,
+    stat_psum_axis=None,
+    normsqr_fn: Any = None,
 ) -> GNSState:
     """One GNS update after a synchronized optimizer step.
 
@@ -256,19 +288,22 @@ def update(
         jnp.asarray(local_sqr_mean, jnp.float32), (num_groups,)
     )
 
-    def stat(x):
-        # Model-sharded gradients (pipeline stages): each device's
-        # squared norm covers only its parameter shard — the full
-        # gradient's norm is the psum over the sharding axis.
-        if stat_psum_axis is not None:
-            return jax.lax.psum(x, stat_psum_axis)
-        return x
+    if normsqr_fn is None:
+
+        def normsqr_fn(tree, pre=None):
+            # Sharded gradients (pipeline stages, experts): each
+            # device's squared norm covers only its parameter shard —
+            # the full gradient's norm is the psum over the sharding
+            # axis. The trainer passes a per-leaf-aware closure when
+            # sharded and replicated leaves coexist.
+            out = group_normsqr(tree, group_ids, num_groups, pre)
+            if stat_psum_axis is not None:
+                out = jax.lax.psum(out, stat_psum_axis)
+            return out
 
     scale = accum_scale * num_microbatches
     if count > 1:
-        total_sqr = stat(
-            group_normsqr(grads_mean, group_ids, num_groups, precond)
-        )
+        total_sqr = normsqr_fn(grads_mean, precond)
         grad_sqr = (count * total_sqr - local_sqr_mean) / (count - 1)
         grad_var = (local_sqr_mean - total_sqr) * scale / (count - 1)
         theta = smoothing**scale
@@ -280,17 +315,10 @@ def update(
 
     # Single-sample configuration: difference consecutive gradients.
     prev = state.prev_grad
-    curr_sqr = stat(
-        group_normsqr(grads_mean, group_ids, num_groups, precond)
-    )
-    pair_local = (
-        stat(group_normsqr(prev, group_ids, num_groups, precond))
-        + curr_sqr
-    ) / 2
+    curr_sqr = normsqr_fn(grads_mean, precond)
+    pair_local = (normsqr_fn(prev, precond) + curr_sqr) / 2
     pair_mean = jax.tree.map(lambda a, b: (a + b) / 2, prev, grads_mean)
-    pair_total = stat(
-        group_normsqr(pair_mean, group_ids, num_groups, precond)
-    )
+    pair_total = normsqr_fn(pair_mean, precond)
     d_scale = 2 * accum_scale
     grad_sqr = 2 * pair_total - pair_local
     grad_var = (pair_local - pair_total) * d_scale

@@ -1,0 +1,164 @@
+"""Switch-style mixture-of-experts FFN with expert parallelism.
+
+Experts shard over an ``"expert"`` mesh axis (one expert per device in
+the simplest layout): within a replica group, each device owns an
+equal slice of the replica's tokens, routes them top-1 with a shared
+(replicated) router, exchanges token blocks with the devices that own
+the chosen experts via ``lax.all_to_all`` (the GShard dispatch), runs
+its expert's FFN on what arrives, and sends results back. Capacity is
+enforced per (source device, expert): overflow tokens pass through
+unchanged (the standard Switch residual behavior).
+
+The reference has no expert (or any non-data) parallelism
+(SURVEY.md §2.7) — like ring attention and the GPipe stage axis, this
+is a TPU-native capability extension. It plugs into the elastic
+trainer the same way the stage axis does: expert weights are sharded
+leaves (``param_sharding_fn`` returning ``P("expert")``), the router
+and any other weights stay replicated (their gradients auto-psum over
+the expert axis through shard_map's vma system), and the per-leaf
+gradient-norm statistics count each expert shard exactly once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from adaptdl_tpu.parallel.mesh import EXPERT_AXIS
+
+
+from adaptdl_tpu.parallel.mesh import stack_params as stack_expert_params  # noqa: E402,F401
+
+
+def _routing(x_local, router, num_experts, capacity):
+    """Top-1 dispatch/combine tensors for one device's token slice.
+
+    Returns (dispatch [s, E, C], combine [s, E, C], gate [s]).
+    """
+    logits = x_local @ router  # [s, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # [s]
+    gate = jnp.max(probs, axis=-1)  # [s]
+    onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.float32)
+    # Position of each token in its expert's queue (per source device).
+    position = jnp.einsum(
+        "se,se->s", jnp.cumsum(onehot, axis=0) - 1.0, onehot
+    )
+    keep = position < capacity
+    dispatch = (
+        onehot[:, :, None]
+        * jax.nn.one_hot(position.astype(jnp.int32), capacity)[:, None, :]
+        * keep[:, None, None]
+    )
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine, gate
+
+
+def switch_moe(
+    params: Any,
+    x: jnp.ndarray,
+    axis_name: str = EXPERT_AXIS,
+    capacity_factor: float = 2.0,
+    activation: Callable = jax.nn.gelu,
+) -> jnp.ndarray:
+    """Expert-parallel Switch FFN inside a shard_map manual over
+    ``axis_name``.
+
+    Args:
+      params: ``{"router": [d, E] (replicated), "w_up": [1, d, f],
+        "w_down": [1, f, d]}`` — the FFN leaves are THIS device's
+        slice of the expert-stacked tree (leading axis 1).
+      x: the replica group's batch ``[n, d]``, identical on every
+        device of the group; ``n`` must divide by the axis size. Each
+        device processes the slice it owns and the result is
+        re-assembled, so the return value is the full ``[n, d]``
+        MoE output (identical across the group).
+    """
+    my_rank = lax.axis_index(axis_name)
+    num_experts = lax.axis_size(axis_name)
+    n, dim = x.shape
+    assert n % num_experts == 0, (
+        f"batch {n} must divide across {num_experts} expert devices"
+    )
+    slice_len = n // num_experts
+    capacity = max(
+        int(capacity_factor * slice_len / num_experts), 1
+    )
+
+    x_local = lax.dynamic_slice_in_dim(
+        x, my_rank * slice_len, slice_len, axis=0
+    )  # [s, d]
+    dispatch, combine, _ = _routing(
+        x_local, params["router"], num_experts, capacity
+    )
+    # [E, C, d]: this device's tokens, binned by destination expert.
+    sent = jnp.einsum("sec,sd->ecd", dispatch, x_local)
+    # Exchange: row e goes to the device owning expert e; afterwards
+    # dim 0 indexes the SOURCE device of each [C, d] block.
+    recv = lax.all_to_all(
+        sent, axis_name, split_axis=0, concat_axis=0, tiled=True
+    )
+    # This device's expert, applied to everything that arrived.
+    hidden = activation(
+        jnp.einsum("ecd,df->ecf", recv, params["w_up"][0])
+    )
+    expert_out = jnp.einsum(
+        "ecf,fd->ecd", hidden, params["w_down"][0]
+    )
+    # Return trip: block from source device j goes back to j.
+    returned = lax.all_to_all(
+        expert_out, axis_name, split_axis=0, concat_axis=0, tiled=True
+    )
+    out_local = jnp.einsum("sec,ecd->sd", combine, returned)
+    # Overflow/unrouted tokens pass through (combine rows are zero).
+    routed = jnp.einsum("sec->s", combine) > 0
+    out_local = jnp.where(
+        routed[:, None], out_local, x_local.astype(out_local.dtype)
+    )
+    # Reassemble the replica's full batch; psum of disjoint slices is
+    # an all-gather that stays UNvarying over the expert axis, which
+    # is what downstream (loss carries, replicated-weight grads)
+    # expects.
+    full = jnp.zeros((n, dim), out_local.dtype)
+    full = lax.dynamic_update_slice_in_dim(
+        full, out_local, my_rank * slice_len, axis=0
+    )
+    return lax.psum(full, axis_name).astype(x.dtype)
+
+
+def dense_switch_moe(
+    router, expert_params_stacked, x, num_slices, capacity_factor=2.0,
+    activation: Callable = jax.nn.gelu,
+):
+    """Single-device reference with IDENTICAL routing math (same
+    per-slice capacity binning) — the equivalence target for tests."""
+    n, dim = x.shape
+    num_experts = expert_params_stacked["w_up"].shape[0]
+    slice_len = n // num_slices
+    capacity = max(int(capacity_factor * slice_len / num_experts), 1)
+    outs = []
+    for s in range(num_slices):
+        x_local = x[s * slice_len : (s + 1) * slice_len]
+        dispatch, combine, _ = _routing(
+            x_local, router, num_experts, capacity
+        )
+        sent = jnp.einsum("sec,sd->ecd", dispatch, x_local)
+        hidden = activation(
+            jnp.einsum(
+                "ecd,edf->ecf", sent, expert_params_stacked["w_up"]
+            )
+        )
+        expert_out = jnp.einsum(
+            "ecf,efd->ecd", hidden, expert_params_stacked["w_down"]
+        )
+        out_local = jnp.einsum("sec,ecd->sd", combine, expert_out)
+        routed = jnp.einsum("sec->s", combine) > 0
+        outs.append(
+            jnp.where(
+                routed[:, None], out_local, x_local.astype(out_local.dtype)
+            )
+        )
+    return jnp.concatenate(outs, axis=0).astype(x.dtype)

@@ -19,6 +19,24 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 STAGE_AXIS = "stage"
+EXPERT_AXIS = "expert"
+
+# Axes whose PARAMETERS are sharded inside the trainer's manual
+# shard_map (vs replicated over data/seq, or GSPMD-auto over model):
+# pipeline stages own their layers, expert-parallel devices own their
+# experts. Gradients stay local to these shards; gradient-norm
+# statistics psum across them.
+PARAM_SHARDED_AXES = (STAGE_AXIS, EXPERT_AXIS)
+
+
+def stack_params(per_shard: list) -> object:
+    """Stack per-shard parameter pytrees (one per pipeline stage or
+    per expert) into one tree whose leaves carry a leading shard axis
+    — the layout the trainer shards with P("stage") / P("expert")."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_shard)
 
 
 def create_mesh(

@@ -34,10 +34,7 @@ from jax import lax
 from adaptdl_tpu.parallel.mesh import STAGE_AXIS
 
 
-def stack_stage_params(per_stage: list[Any]) -> Any:
-    """Stack S per-stage parameter pytrees into one tree whose leaves
-    have a leading stage axis (shard with ``P("stage")``)."""
-    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
+from adaptdl_tpu.parallel.mesh import stack_params as stack_stage_params  # noqa: E402,F401
 
 
 def gpipe(
@@ -130,13 +127,18 @@ def gpipe_loss(
         micro = x.reshape((num_micro, -1) + x.shape[1:])
         outs = gpipe(stage_fn, stage_params_local, micro, axis_name)
         final = outs.reshape(x.shape)
-        loss = loss_head(final, batch)
         stage = lax.axis_index(axis_name)
         num_stages = lax.axis_size(axis_name)
+        is_last = stage == num_stages - 1
+        # Non-final stages hold garbage intermediates here. Replace
+        # them with ones BEFORE loss_head: a head with a
+        # partial-domain op (log, division) would otherwise produce
+        # NaN whose cotangent survives the 0-mask below (0 * NaN is
+        # NaN) and poisons every stage's gradients.
+        final = jnp.where(is_last, final, jnp.ones_like(final))
+        loss = loss_head(final, batch)
         # Only the last stage's loss is real; share it with the whole
         # stage group (psum of a masked value == broadcast).
-        return lax.psum(
-            jnp.where(stage == num_stages - 1, loss, 0.0), axis_name
-        )
+        return lax.psum(jnp.where(is_last, loss, 0.0), axis_name)
 
     return loss_fn

@@ -43,6 +43,7 @@ from adaptdl_tpu import checkpoint, gns
 from adaptdl_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
+    PARAM_SHARDED_AXES,
     SEQ_AXIS,
     STAGE_AXIS,
     create_mesh,
@@ -227,6 +228,19 @@ class ElasticTrainer:
         microbatches with adaptdl_tpu.parallel.pipeline.gpipe."""
         return self.mesh.shape.get(STAGE_AXIS, 1)
 
+    @property
+    def sharded_param_axes(self) -> tuple[str, ...]:
+        """Manual mesh axes whose parameters are SHARDED inside the
+        step (pipeline stages, expert parallelism): gradients stay
+        local per shard, gradient-norm statistics psum across them,
+        and the loss_fn is responsible for any cross-shard exchange
+        (ppermute pipelines, all_to_all expert dispatch)."""
+        return tuple(
+            axis
+            for axis in PARAM_SHARDED_AXES
+            if self.mesh.shape.get(axis, 1) > 1
+        )
+
     def _batch_spec(self, leaf) -> P:
         """Data axis on dim 0; with sequence parallelism, seq-sharded
         leaves (ndim >= 2, seq at dim 1 by contract) also split dim 1."""
@@ -302,12 +316,24 @@ class ElasticTrainer:
         step), model-axis components drop (GSPMD auto handles them)."""
 
         def restrict(spec):
-            kept = tuple(
-                axis if axis in manual_axes else None
-                for axis in (spec or ())
-            )
+            kept = []
+            for part in spec or ():
+                if part is None:
+                    kept.append(None)
+                    continue
+                # A dim may be sharded over SEVERAL axes at once
+                # (tuple entry, e.g. ("stage", "model")): filter
+                # inside it rather than dropping the whole entry.
+                axes = (part,) if isinstance(part, str) else tuple(part)
+                axes = tuple(a for a in axes if a in manual_axes)
+                if not axes:
+                    kept.append(None)
+                elif len(axes) == 1:
+                    kept.append(axes[0])
+                else:
+                    kept.append(axes)
             while kept and kept[-1] is None:
-                kept = kept[:-1]
+                kept.pop()
             return P(*kept)
 
         return jax.tree.map(
@@ -381,12 +407,44 @@ class ElasticTrainer:
     def _build_step(self, atomic_bsz: int, accum_steps: int):
         num_replicas = self.num_replicas
         seq_shards = self.seq_shards
-        stage_shards = self.stage_shards
+        sharded_axes = self.sharded_param_axes
         num_micro = accum_steps + 1
         count = num_replicas * num_micro
         accum_scale = num_replicas * atomic_bsz / self.init_batch_size
         scale = accum_scale * num_micro
         batch_size = num_replicas * num_micro * atomic_bsz
+
+        # Per-leaf psum axes for gradient-norm statistics: a leaf
+        # sharded over stage/expert contributes a psum'd term; a
+        # replicated leaf's gradient is already complete on every
+        # device (vma auto-psums its cotangents over those axes) and
+        # must not be double-counted.
+        param_manual_specs = self._restrict_specs(
+            self._param_spec_tree(self._init_params), set(sharded_axes)
+        )
+        leaf_psum_axes = tuple(
+            tuple(
+                axis
+                for part in (spec or ())
+                if part is not None
+                for axis in (
+                    (part,) if isinstance(part, str) else tuple(part)
+                )
+                if axis in sharded_axes
+            )
+            for spec in jax.tree.leaves(
+                param_manual_specs, is_leaf=lambda x: isinstance(x, P)
+            )
+        )
+
+        def stat_normsqr(tree, pre=None):
+            return gns.sharded_group_normsqr(
+                tree,
+                self._group_ids,
+                self.num_param_groups,
+                leaf_psum_axes,
+                pre,
+            )
 
         def per_replica_step(state: TrainState, local_batch, aux):
             # Differentiate wrt a per-replica *varying* view of the
@@ -445,12 +503,7 @@ class ElasticTrainer:
                     grad = jax.lax.pmean(grad, SEQ_AXIS)
                     loss = jax.lax.pmean(loss, SEQ_AXIS)
                 grad_sum = jax.tree.map(jnp.add, grad_sum, grad)
-                lsqr_sum = lsqr_sum + gns.group_normsqr(
-                    grad,
-                    self._group_ids,
-                    self.num_param_groups,
-                    precond_v,
-                )
+                lsqr_sum = lsqr_sum + stat_normsqr(grad, precond_v)
                 return (grad_sum, lsqr_sum, loss_sum + loss), None
 
             # Derive the grad accumulator from the params so it
@@ -464,14 +517,11 @@ class ElasticTrainer:
                 lambda p: (p * 0.0).astype(jnp.float32), params
             )
             grad_init = jax.lax.pcast(zeros, DATA_AXIS, to="varying")
-            lsqr_axes = (
-                (DATA_AXIS, STAGE_AXIS)
-                if stage_shards > 1
-                else DATA_AXIS
-            )
+            # lsqr is already psum'd over the sharded axes inside
+            # stat_normsqr, so the carry varies over data only.
             lsqr_init = jax.lax.pcast(
                 jnp.zeros((self.num_param_groups,)),
-                lsqr_axes,
+                DATA_AXIS,
                 to="varying",
             )
             loss_init = jax.lax.pcast(
@@ -491,10 +541,6 @@ class ElasticTrainer:
             local_sqr_mean = jax.lax.pmean(
                 lsqr_sum / num_micro, DATA_AXIS
             )
-            if stage_shards > 1:
-                local_sqr_mean = jax.lax.psum(
-                    local_sqr_mean, STAGE_AXIS
-                )
             loss = jax.lax.pmean(loss_sum / num_micro, DATA_AXIS)
 
             new_gns = gns.update(
@@ -508,9 +554,7 @@ class ElasticTrainer:
                 precond=precond,
                 group_ids=self._group_ids,
                 num_groups=self.num_param_groups,
-                stat_psum_axis=(
-                    STAGE_AXIS if stage_shards > 1 else None
-                ),
+                normsqr_fn=stat_normsqr,
             )
             step_gain = gns.gain(new_gns, scale)
             ctx = RuleContext(
@@ -561,18 +605,16 @@ class ElasticTrainer:
         batch_spec = (
             P(DATA_AXIS, SEQ_AXIS) if seq_shards > 1 else P(DATA_AXIS)
         )
-        manual = {DATA_AXIS}
+        manual = {DATA_AXIS, *sharded_axes}
         if seq_shards > 1:
             manual.add(SEQ_AXIS)
-        if stage_shards > 1:
-            manual.add(STAGE_AXIS)
         extra = {}
         if MODEL_AXIS in self.mesh.shape:
             # Partial-manual mode: collectives stay manual over the
-            # data/seq/stage axes where the GNS needs per-device
-            # values; the model axis remains automatic so GSPMD
-            # propagates the params' tensor-parallel shardings and
-            # inserts the TP collectives itself.
+            # data/seq/stage/expert axes where the GNS needs
+            # per-device values; the model axis remains automatic so
+            # GSPMD propagates the params' tensor-parallel shardings
+            # and inserts the TP collectives itself.
             extra["axis_names"] = manual
         # State specs over the manual axes: replicated (P()) leaves in
         # pure data parallelism; stage-sharded params (and their
@@ -643,7 +685,7 @@ class ElasticTrainer:
         fusion; see adaptdl_tpu.metrics)."""
 
         seq_shards = self.seq_shards
-        stage_shards = self.stage_shards
+        sharded_axes = self.sharded_param_axes
         varying_axes = (
             (DATA_AXIS, SEQ_AXIS) if seq_shards > 1 else DATA_AXIS
         )
@@ -657,18 +699,16 @@ class ElasticTrainer:
             total = gns.normsqr(grads) + loss
             if seq_shards > 1:
                 total = jax.lax.pmean(total, SEQ_AXIS)
-            if stage_shards > 1:
-                total = jax.lax.psum(total, STAGE_AXIS)
+            if sharded_axes:
+                total = jax.lax.psum(total, sharded_axes)
             return total[None]
 
         batch_spec = (
             P(DATA_AXIS, SEQ_AXIS) if seq_shards > 1 else P(DATA_AXIS)
         )
-        manual = {DATA_AXIS}
+        manual = {DATA_AXIS, *sharded_axes}
         if seq_shards > 1:
             manual.add(SEQ_AXIS)
-        if stage_shards > 1:
-            manual.add(STAGE_AXIS)
         extra = {}
         if MODEL_AXIS in self.mesh.shape:
             extra["axis_names"] = manual
