@@ -232,8 +232,16 @@ class AdaptiveDataLoader:
         # of the comparison, and the atomic-bsz memory ceiling scales
         # with the shard group (each chip holds 1/(sp*tp) of a
         # microbatch's activations).
-        sp, tp = metrics.active_topology()
+        sp, tp, ss = metrics.active_topology()
+        # Memory-ceiling group: sp/tp shard each microbatch's
+        # activations; pipeline stages do NOT (in-flight microbatches
+        # keep per-chip activation memory ~constant).
         group = sp * tp
+        pipeline_micro = (
+            metrics.current_state().pipeline_microbatches
+            if ss > 1
+            else 1
+        )
         # The restored config may be infeasible at the new replica
         # count (e.g. global batch beyond max_batch_size after growing
         # the job); then the optimizer's choice is adopted outright.
@@ -255,6 +263,8 @@ class AdaptiveDataLoader:
                 self._accum_steps,
                 seq_shards=sp,
                 model_shards=tp,
+                stage_shards=ss,
+                pipeline_micro=pipeline_micro,
             )
             if current_feasible
             else 0.0
@@ -267,6 +277,8 @@ class AdaptiveDataLoader:
             accumulation=self._gradient_accumulation,
             seq_shards=sp,
             model_shards=tp,
+            stage_shards=ss,
+            pipeline_micro=pipeline_micro,
         )
         atomic_bsz = bucket_atomic_bsz(int(atomic_bsz))
         if self._local_bsz_bounds is not None:
@@ -284,6 +296,8 @@ class AdaptiveDataLoader:
             int(accum_steps),
             seq_shards=sp,
             model_shards=tp,
+            stage_shards=ss,
+            pipeline_micro=pipeline_micro,
         )
         if candidate_goodput > SPEEDUP_THRESHOLD * current_goodput:
             return atomic_bsz, int(accum_steps)

@@ -91,6 +91,11 @@ def model_shards() -> int:
     return _get_int("ADAPTDL_MODEL_SHARDS", 1)
 
 
+def stage_shards() -> int:
+    """Pipeline stages per replica group (GPipe stage axis)."""
+    return _get_int("ADAPTDL_STAGE_SHARDS", 1)
+
+
 def num_nodes() -> int:
     """Number of slices (the reference's "nodes").
 

@@ -79,6 +79,13 @@ class PerfParams(NamedTuple):
     beta_sp: float = 0.0
     alpha_tp: float = 0.0
     beta_tp: float = 0.0
+    # Pipeline handoff cost per schedule tick (one ppermute of one
+    # microbatch's activations between neighboring stages). The
+    # pipeline BUBBLE needs no fitted parameter — it is structural:
+    # a GPipe schedule with M microbatches over S stages runs
+    # (M + S - 1) ticks of per-stage work, an (M+S-1)/M stretch.
+    alpha_pp: float = 0.0
+    beta_pp: float = 0.0
 
 
 class GradParams(NamedTuple):
@@ -94,14 +101,24 @@ class GradParams(NamedTuple):
 # fitting).
 
 
-def _accum_time(xp, params, atomic_bsz, seq_shards=1, model_shards=1):
+def _accum_time(
+    xp,
+    params,
+    atomic_bsz,
+    seq_shards=1,
+    model_shards=1,
+    stage_shards=1,
+    pipeline_micro=1,
+):
     """Forward+backward time of one microbatch on one chip.
 
-    Compute divides across the replica group's sp x tp chips; the ring
-    and TP collective terms are the price of that division (zero when
-    the corresponding axis is unsharded).
+    Compute divides across the replica group's sp x tp x ss chips;
+    the ring/TP collective terms are the price of the sp/tp division,
+    and the pipeline pays a structural (M+S-1)/M bubble stretch plus a
+    fitted per-tick handoff cost (zero when the corresponding axis is
+    unsharded).
     """
-    shards = seq_shards * model_shards
+    shards = seq_shards * model_shards * stage_shards
     compute = params[0] + params[1] * atomic_bsz / shards
     ring = ((seq_shards - 1) / xp.maximum(seq_shards, 1)) * (
         params[7] + params[8] * atomic_bsz / model_shards
@@ -109,7 +126,16 @@ def _accum_time(xp, params, atomic_bsz, seq_shards=1, model_shards=1):
     tp = ((model_shards - 1) / xp.maximum(model_shards, 1)) * (
         params[9] + params[10] * atomic_bsz / seq_shards
     )
-    return compute + ring + tp
+    base = compute + ring + tp
+    # Degenerates exactly to `base` at stage_shards == 1 (ticks == M,
+    # stretch == 1, zero hops).
+    ticks = pipeline_micro + stage_shards - 1
+    stretch = ticks / xp.maximum(pipeline_micro, 1)
+    has_hops = (stage_shards - 1) / xp.maximum(stage_shards - 1, 1)
+    hop_cost = params[11] + params[12] * atomic_bsz / xp.maximum(
+        pipeline_micro, 1
+    )
+    return base * stretch + has_hops * ticks * hop_cost
 
 
 def _network_time(xp, params, num_nodes, num_replicas):
@@ -152,6 +178,8 @@ class GoodputFunction:
         accum_steps,
         seq_shards=1,
         model_shards=1,
+        stage_shards=1,
+        pipeline_micro=1,
     ):
         return self.evaluate(
             num_nodes,
@@ -160,6 +188,8 @@ class GoodputFunction:
             accum_steps,
             seq_shards=seq_shards,
             model_shards=model_shards,
+            stage_shards=stage_shards,
+            pipeline_micro=pipeline_micro,
         )
 
     def evaluate(
@@ -170,11 +200,13 @@ class GoodputFunction:
         accum_steps,
         seq_shards=1,
         model_shards=1,
+        stage_shards=1,
+        pipeline_micro=1,
     ):
         """num_replicas counts *data-parallel* replica groups; each
-        group spans seq_shards*model_shards chips. sp/tp leave the
-        statistical batch size untouched — they divide the sample, not
-        multiply the samples."""
+        group spans seq_shards*model_shards*stage_shards chips.
+        sp/tp/ss leave the statistical batch size untouched — they
+        divide the sample/model, not multiply the samples."""
         batch_size = num_replicas * atomic_bsz * (accum_steps + 1)
         assert np.all(batch_size >= self._init_batch_size)
         return self.throughput(
@@ -184,6 +216,8 @@ class GoodputFunction:
             accum_steps,
             seq_shards=seq_shards,
             model_shards=model_shards,
+            stage_shards=stage_shards,
+            pipeline_micro=pipeline_micro,
         ) * self.efficiency(batch_size)
 
     def throughput(
@@ -194,11 +228,16 @@ class GoodputFunction:
         accum_steps,
         seq_shards=1,
         model_shards=1,
+        stage_shards=1,
+        pipeline_micro=1,
     ):
         """Samples/second: an iteration is accum_steps silent accumulation
         micro-steps plus one optim step that includes the gradient sync."""
         p = self._perf_params
-        t_acc = _accum_time(np, p, atomic_bsz, seq_shards, model_shards)
+        t_acc = _accum_time(
+            np, p, atomic_bsz, seq_shards, model_shards,
+            stage_shards, pipeline_micro,
+        )
         t_net = _network_time(np, p, num_nodes, num_replicas)
         t_opt = np.exp(_log_optim_time(np, p, t_acc, t_net))
         iter_time = accum_steps * t_acc + t_opt
@@ -223,9 +262,11 @@ class GoodputFunction:
         num_candidates: int = 50,
         seq_shards: int = 1,
         model_shards: int = 1,
+        stage_shards: int = 1,
+        pipeline_micro: int = 1,
     ):
         """Best (goodput, atomic_bsz, accum_steps) per allocation, at a
-        *fixed* (seq_shards, model_shards) topology.
+        *fixed* (seq_shards, model_shards, stage_shards) topology.
 
         Vectorized over broadcastable ``num_nodes``/``num_replicas``:
         candidate global batch sizes are sampled geometrically between
@@ -245,6 +286,11 @@ class GoodputFunction:
         min_atomic, max_atomic = atomic_bsz_range or (None, None)
         min_atomic = min_atomic or 1
         max_atomic = max_atomic or max_batch_size
+        # Memory ceiling: sp/tp split each microbatch's activations
+        # across the group, so the per-replica atomic ceiling scales
+        # with them. STAGE does not — GPipe stages hold ~M in-flight
+        # microbatch activations, so per-chip activation memory is
+        # roughly unchanged by pipeline depth.
         group = seq_shards * model_shards
         if group > 1:
             max_atomic = max_atomic * group
@@ -288,6 +334,8 @@ class GoodputFunction:
             accum_steps,
             seq_shards=seq_shards,
             model_shards=model_shards,
+            stage_shards=stage_shards,
+            pipeline_micro=pipeline_micro,
         )
         best = np.argmax(goodput, axis=0)
         cols = np.arange(goodput.shape[1])
@@ -308,6 +356,8 @@ class GoodputFunction:
         num_candidates: int = 50,
         max_seq_shards: int = 1,
         max_model_shards: int = 1,
+        max_stage_shards: int = 1,
+        pipeline_micro: int = 4,
     ):
         """Best configuration over (data, seq, model) factorizations.
 
@@ -324,7 +374,10 @@ class GoodputFunction:
         sequence/model shards instead of more replicas.
 
         Returns ``(goodput, atomic_bsz, accum_steps, seq_shards,
-        model_shards)``, vectorized like :meth:`optimize`.
+        model_shards, stage_shards)``, vectorized like
+        :meth:`optimize`. ``pipeline_micro`` is the GPipe microbatch
+        count assumed when scoring stage factorizations (the bubble is
+        (M+S-1)/M).
         """
         num_nodes = np.asarray(num_nodes)
         num_chips = np.asarray(num_chips)
@@ -341,13 +394,14 @@ class GoodputFunction:
             return out
 
         factorizations = [
-            (sp, tp)
+            (sp, tp, ss)
             for sp in pow2s(max(int(max_seq_shards), 1))
             for tp in pow2s(max(int(max_model_shards), 1))
+            for ss in pow2s(max(int(max_stage_shards), 1))
         ]
         results = []
-        for sp, tp in factorizations:
-            group = sp * tp
+        for sp, tp, ss in factorizations:
+            group = sp * tp * ss
             dp = chips // group
             valid = (dp * group == chips) & (dp >= np.maximum(nodes, 1))
             # Placeholder dp=1 keeps optimize()'s vectorized call well
@@ -363,10 +417,12 @@ class GoodputFunction:
                 num_candidates=num_candidates,
                 seq_shards=sp,
                 model_shards=tp,
+                stage_shards=ss,
+                pipeline_micro=pipeline_micro if ss > 1 else 1,
             )
             g = np.where(valid, np.atleast_1d(g), 0.0)
             results.append(
-                (g, np.atleast_1d(ab), np.atleast_1d(ac), sp, tp)
+                (g, np.atleast_1d(ab), np.atleast_1d(ac), sp, tp, ss)
             )
         all_g = np.stack([r[0] for r in results])
         best = np.argmax(all_g, axis=0)
@@ -380,6 +436,7 @@ class GoodputFunction:
         ].reshape(shape)
         sps = np.array([r[3] for r in results])[best].reshape(shape)
         tps = np.array([r[4] for r in results])[best].reshape(shape)
+        sss = np.array([r[5] for r in results])[best].reshape(shape)
         if scalar_out:
             return (
                 goodput.item(),
@@ -387,8 +444,9 @@ class GoodputFunction:
                 accum_steps.item(),
                 sps.item(),
                 tps.item(),
+                sss.item(),
             )
-        return goodput, atomic_bsz, accum_steps, sps, tps
+        return goodput, atomic_bsz, accum_steps, sps, tps, sss
 
 
 def _fit_objective(
@@ -399,6 +457,8 @@ def _fit_objective(
     atomic_bsz,
     seq_shards,
     model_shards,
+    stage_shards,
+    pipeline_micro,
     accum_time,
     optim_time,
     weight,
@@ -407,7 +467,10 @@ def _fit_objective(
     priors. ``weight`` masks padding rows (inputs are padded to bucket
     sizes so the jitted objective compiles once per bucket, not once
     per new profile entry)."""
-    pred_acc = _accum_time(jnp, params, atomic_bsz, seq_shards, model_shards)
+    pred_acc = _accum_time(
+        jnp, params, atomic_bsz, seq_shards, model_shards,
+        stage_shards, pipeline_micro,
+    )
     pred_net = _network_time(jnp, params, num_nodes, num_replicas)
     pred_log_opt = _log_optim_time(jnp, params, pred_acc, pred_net)
     total = jnp.sum(weight)
@@ -458,6 +521,8 @@ def fit_perf_params(
     optim_step_time,
     seq_shards=None,
     model_shards=None,
+    stage_shards=None,
+    pipeline_micro=None,
 ) -> PerfParams:
     """Fit PerfParams to profiled timings via L-BFGS-B + jax.grad.
 
@@ -482,15 +547,22 @@ def fit_perf_params(
         seq_shards = np.ones_like(num_nodes)
     if model_shards is None:
         model_shards = np.ones_like(num_nodes)
+    if stage_shards is None:
+        stage_shards = np.ones_like(num_nodes)
+    if pipeline_micro is None:
+        pipeline_micro = np.ones_like(num_nodes)
     seq_shards = np.asarray(seq_shards, dtype=float)
     model_shards = np.asarray(model_shards, dtype=float)
+    stage_shards = np.asarray(stage_shards, dtype=float)
+    pipeline_micro = np.asarray(pipeline_micro, dtype=float)
 
     init = np.array(
         [1e-1, 1e-2, 1e-1, 1e-2, 1e-1, 1e-2, 1.0 + 1e-3]
         + [1e-2, 1e-3, 1e-2, 1e-3]
+        + [1e-2, 1e-3]
     )
-    lower = np.array([1e-8] * 6 + [1.0] + [1e-8] * 4)
-    upper = np.array([np.inf] * 6 + [10.0] + [np.inf] * 4)
+    lower = np.array([1e-8] * 6 + [1.0] + [1e-8] * 6)
+    upper = np.array([np.inf] * 6 + [10.0] + [np.inf] * 6)
 
     if len(np.unique(atomic_bsz)) == 1:
         # One observed batch size can't separate the constant and linear
@@ -507,12 +579,16 @@ def fit_perf_params(
         init[5] = upper[5] = lower[5]
     sp_observed = bool(np.any(seq_shards > 1))
     tp_observed = bool(np.any(model_shards > 1))
+    ss_observed = bool(np.any(stage_shards > 1))
     if not sp_observed:
         init[7] = upper[7] = lower[7]  # ring terms unidentifiable
         init[8] = upper[8] = lower[8]
     if not tp_observed:
         init[9] = upper[9] = lower[9]  # TP terms unidentifiable
         init[10] = upper[10] = lower[10]
+    if not ss_observed:
+        init[11] = upper[11] = lower[11]  # pipeline hop unidentifiable
+        init[12] = upper[12] = lower[12]
 
     # Pad observations to the next power-of-two bucket: the jitted
     # objective then compiles once per bucket instead of once per new
@@ -536,6 +612,8 @@ def fit_perf_params(
                 _pad(atomic_bsz, 1),
                 _pad(seq_shards, 1),
                 _pad(model_shards, 1),
+                _pad(stage_shards, 1),
+                _pad(pipeline_micro, 1),
                 _pad(accum_step_time, 1),
                 _pad(optim_step_time, 1),
                 weight,
@@ -569,4 +647,8 @@ def fit_perf_params(
         params[7] = max(params[7], params[4])
     if not tp_observed:
         params[9] = max(params[9], params[4])
+    if not ss_observed:
+        # A pipeline handoff costs at least the fitted ICI latency
+        # (the structural bubble already tempers over-optimism).
+        params[11] = max(params[11], params[4])
     return PerfParams(*params)

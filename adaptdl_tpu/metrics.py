@@ -65,12 +65,13 @@ class MetricsState:
     """Everything the adaptation engine knows about this job so far.
 
     Profile keys are ``(num_nodes, num_replicas, seq_shards,
-    model_shards, atomic_bsz)`` — the reference's (nodes, replicas,
-    bsz) keying (reference: _metrics.py:29-66) extended with the two
-    sharding axes so the fit can identify the ring/TP collective terms.
+    model_shards, stage_shards, atomic_bsz)`` — the reference's
+    (nodes, replicas, bsz) keying (reference: _metrics.py:29-66)
+    extended with the sharding axes so the fit can identify the
+    ring/TP collective and pipeline-hop terms.
     """
 
-    profile: dict[tuple[int, int, int, int, int], _ProfileEntry] = field(
+    profile: dict[tuple[int, int, int, int, int, int], _ProfileEntry] = field(
         default_factory=lambda: defaultdict(_ProfileEntry)
     )
     perf_params: PerfParams | None = None
@@ -82,6 +83,8 @@ class MetricsState:
     max_profiled_replicas: int = 0
     max_seq_shards: int = 1
     max_model_shards: int = 1
+    max_stage_shards: int = 1
+    pipeline_microbatches: int = 4
     progress: float = 0.0
 
 
@@ -89,7 +92,7 @@ _state = MetricsState()
 _last_fit_time: float | None = None
 _profile_lock = threading.Lock()
 _fit_thread: threading.Thread | None = None
-_active_topology: tuple[int, int] | None = None
+_active_topology: tuple[int, int, int] | None = None
 
 
 def _reset_state() -> None:
@@ -103,7 +106,9 @@ def _reset_state() -> None:
     _active_topology = None
 
 
-def set_active_topology(seq_shards: int, model_shards: int) -> None:
+def set_active_topology(
+    seq_shards: int, model_shards: int, stage_shards: int = 1
+) -> None:
     """Registered by the trainer with the (sp, tp) its mesh actually
     has. Profiles and batch decisions key on THIS, never on the
     scheduler's requested ADAPTDL_SEQ_SHARDS — a job is free to build
@@ -114,15 +119,17 @@ def set_active_topology(seq_shards: int, model_shards: int) -> None:
     _active_topology = (
         max(int(seq_shards), 1),
         max(int(model_shards), 1),
+        max(int(stage_shards), 1),
     )
 
 
-def active_topology() -> tuple[int, int]:
-    """The training process's live (seq_shards, model_shards):
-    whatever the trainer registered, else the scheduler's request."""
+def active_topology() -> tuple[int, int, int]:
+    """The training process's live (seq_shards, model_shards,
+    stage_shards): whatever the trainer registered, else the
+    scheduler's request."""
     if _active_topology is not None:
         return _active_topology
-    return (env.seq_shards(), env.model_shards())
+    return (env.seq_shards(), env.model_shards(), env.stage_shards())
 
 
 def current_state() -> MetricsState:
@@ -142,19 +149,25 @@ def set_batch_size_config(
 
 
 def set_topology_config(
-    max_seq_shards: int = 1, max_model_shards: int = 1
+    max_seq_shards: int = 1,
+    max_model_shards: int = 1,
+    max_stage_shards: int = 1,
+    pipeline_microbatches: int = 4,
 ) -> None:
-    """Advertise how far this job can shard each sample (sequence
-    shards need ring attention in the model; model shards need a
-    param_sharding_fn). The scheduler's topology search stays within
-    these limits."""
+    """Advertise how far this job can shard each sample/model
+    (sequence shards need ring attention; model shards need a
+    param_sharding_fn; stage shards need a gpipe_loss with
+    ``pipeline_microbatches`` microbatches). The scheduler's topology
+    search stays within these limits."""
     _state.max_seq_shards = max(int(max_seq_shards), 1)
     _state.max_model_shards = max(int(max_model_shards), 1)
+    _state.max_stage_shards = max(int(max_stage_shards), 1)
+    _state.pipeline_microbatches = max(int(pipeline_microbatches), 1)
 
 
-def _profile_key(atomic_bsz: int) -> tuple[int, int, int, int, int]:
-    sp, tp = active_topology()
-    return (env.num_nodes(), env.num_replicas(), sp, tp, atomic_bsz)
+def _profile_key(atomic_bsz: int) -> tuple[int, int, int, int, int, int]:
+    sp, tp, ss = active_topology()
+    return (env.num_nodes(), env.num_replicas(), sp, tp, ss, atomic_bsz)
 
 
 def profile_accum_time(atomic_bsz: int, accum_time: float) -> None:
@@ -191,9 +204,10 @@ def profile_step(
         # profiled coverage must count chips too: a dp=1 x sp=8 run has
         # profiled 8 chips, not 1 replica — otherwise sp-factorized
         # jobs would be permanently capped at 2 chips.
-        sp, tp = active_topology()
+        sp, tp, ss = active_topology()
         _state.max_profiled_replicas = max(
-            _state.max_profiled_replicas, env.num_replicas() * sp * tp
+            _state.max_profiled_replicas,
+            env.num_replicas() * sp * tp * ss,
         )
     _maybe_fit_and_report()
 
@@ -209,14 +223,14 @@ def update_progress(progress: float) -> None:
 
 def _fit() -> PerfParams | None:
     nodes, replicas, bszs = [], [], []
-    sps, tps = [], []
+    sps, tps, sss = [], [], []
     accum_times, optim_times = [], []
     with _profile_lock:
         snapshot = [
             (key, _ProfileEntry(**vars(entry)))
             for key, entry in _state.profile.items()
         ]
-    for (n, r, sp, tp, bsz), entry in snapshot:
+    for (n, r, sp, tp, ss, bsz), entry in snapshot:
         if entry.optim_count == 0:
             continue
         # A missing calibration falls back to the optim time, which
@@ -229,11 +243,13 @@ def _fit() -> PerfParams | None:
         replicas.append(r)
         sps.append(sp)
         tps.append(tp)
+        sss.append(ss)
         bszs.append(bsz)
         accum_times.append(accum)
         optim_times.append(entry.optim_time_sum / entry.optim_count)
     if not nodes:
         return None
+    micro = _state.pipeline_microbatches
     return fit_perf_params(
         nodes,
         replicas,
@@ -242,6 +258,8 @@ def _fit() -> PerfParams | None:
         optim_times,
         seq_shards=sps,
         model_shards=tps,
+        stage_shards=sss,
+        pipeline_micro=[micro if ss > 1 else 1 for ss in sss],
     )
 
 
@@ -304,6 +322,8 @@ def fit_and_report_now() -> None:
     hints["gradientAccumulation"] = _state.gradient_accumulation
     hints["maxSeqShards"] = _state.max_seq_shards
     hints["maxModelShards"] = _state.max_model_shards
+    hints["maxStageShards"] = _state.max_stage_shards
+    hints["pipelineMicrobatches"] = _state.pipeline_microbatches
     if _state.grad_params is not None:
         hints["gradParams"] = dict(_state.grad_params._asdict())
     if _state.perf_params is not None:
@@ -351,6 +371,8 @@ class _MetricsCheckpoint(checkpoint.State):
             "max_profiled_replicas": _state.max_profiled_replicas,
             "max_seq_shards": _state.max_seq_shards,
             "max_model_shards": _state.max_model_shards,
+            "max_stage_shards": _state.max_stage_shards,
+            "pipeline_microbatches": _state.pipeline_microbatches,
             "progress": _state.progress,
         }
         pickle.dump(payload, fileobj)
@@ -361,7 +383,10 @@ class _MetricsCheckpoint(checkpoint.State):
         for key, entry in payload["profile"].items():
             if len(key) == 3:  # pre-sp/tp checkpoint: (n, r, bsz)
                 n, r, bsz = key
-                key = (n, r, 1, 1, bsz)
+                key = (n, r, 1, 1, 1, bsz)
+            elif len(key) == 5:  # pre-stage: (n, r, sp, tp, bsz)
+                n, r, sp, tp, bsz = key
+                key = (n, r, sp, tp, 1, bsz)
             profile[key] = entry
         _state.profile = profile
         _state.perf_params = payload["perf_params"]
@@ -373,6 +398,10 @@ class _MetricsCheckpoint(checkpoint.State):
         _state.max_profiled_replicas = payload["max_profiled_replicas"]
         _state.max_seq_shards = payload.get("max_seq_shards", 1)
         _state.max_model_shards = payload.get("max_model_shards", 1)
+        _state.max_stage_shards = payload.get("max_stage_shards", 1)
+        _state.pipeline_microbatches = payload.get(
+            "pipeline_microbatches", 4
+        )
         _state.progress = payload["progress"]
 
 

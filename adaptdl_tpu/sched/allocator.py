@@ -56,6 +56,8 @@ def job_info_from_hints(
             accumulation=bool(hints.get("gradientAccumulation")),
             max_seq_shards=int(hints.get("maxSeqShards") or 1),
             max_model_shards=int(hints.get("maxModelShards") or 1),
+            max_stage_shards=int(hints.get("maxStageShards") or 1),
+            pipeline_micro=int(hints.get("pipelineMicrobatches") or 4),
         )
         profiled = int(hints.get("maxProfiledReplicas") or 1)
         # Profiling gates scale-up: at most double what was measured.
@@ -158,10 +160,14 @@ class Allocator:
                 jobs[key].speedup_fn, "best_config_with_hysteresis", None
             )
             if best_config is not None and alloc:
-                _, _, sp, tp = best_config(
+                _, _, sp, tp, ss = best_config(
                     len(set(alloc)), len(alloc), record.topology
                 )
-                topology = {"seqShards": sp, "modelShards": tp}
+                topology = {
+                    "seqShards": sp,
+                    "modelShards": tp,
+                    "stageShards": ss,
+                }
             changed = record.allocation != alloc or normalize_topology(
                 record.topology
             ) != normalize_topology(topology)

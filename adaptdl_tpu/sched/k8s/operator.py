@@ -458,6 +458,12 @@ class Operator:
                     (record.topology or {}).get("modelShards", 1)
                 ),
             },
+            {
+                "name": "ADAPTDL_STAGE_SHARDS",
+                "value": str(
+                    (record.topology or {}).get("stageShards", 1)
+                ),
+            },
         ]
         for container in containers:
             container.setdefault("env", []).extend(env)

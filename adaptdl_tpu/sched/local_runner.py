@@ -109,6 +109,7 @@ class LocalElasticRunner:
         topology = topology or {}
         env["ADAPTDL_SEQ_SHARDS"] = str(topology.get("seqShards", 1))
         env["ADAPTDL_MODEL_SHARDS"] = str(topology.get("modelShards", 1))
+        env["ADAPTDL_STAGE_SHARDS"] = str(topology.get("stageShards", 1))
         return env
 
     def run(self) -> int:

@@ -131,6 +131,7 @@ class MultiJobRunner:
         env["ADAPTDL_MODEL_SHARDS"] = str(
             topology.get("modelShards", 1)
         )
+        env["ADAPTDL_STAGE_SHARDS"] = str(topology.get("stageShards", 1))
         return env
 
     def _run_job(self, job: JobSpec) -> None:

@@ -33,6 +33,7 @@ def normalize_topology(topology: dict | None) -> dict:
     return {
         "seqShards": int(topology.get("seqShards", 1)),
         "modelShards": int(topology.get("modelShards", 1)),
+        "stageShards": int(topology.get("stageShards", 1)),
     }
 
 

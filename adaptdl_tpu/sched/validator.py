@@ -38,6 +38,7 @@ OPERATOR_INJECTED_ENV = frozenset(
         "ADAPTDL_SUPERVISOR_URL",
         "ADAPTDL_SEQ_SHARDS",
         "ADAPTDL_MODEL_SHARDS",
+        "ADAPTDL_STAGE_SHARDS",
     }
 )
 

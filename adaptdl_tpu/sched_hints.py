@@ -40,6 +40,8 @@ SCHED_HINTS_KEYS = (
     "perfParams",
     "maxSeqShards",
     "maxModelShards",
+    "maxStageShards",
+    "pipelineMicrobatches",
 )
 
 

@@ -198,7 +198,9 @@ class ElasticTrainer:
         from adaptdl_tpu import metrics as metrics_mod
 
         metrics_mod.set_active_topology(
-            self.seq_shards, self.mesh.shape.get(MODEL_AXIS, 1)
+            self.seq_shards,
+            self.mesh.shape.get(MODEL_AXIS, 1),
+            self.mesh.shape.get(STAGE_AXIS, 1),
         )
         self._init_params = params
         self._step_cache: dict[tuple[int, int], Callable] = {}

@@ -191,11 +191,11 @@ def test_topology_matches_fixed_optimize_when_dp_only():
         1, 8, max_batch_size=4096, atomic_bsz_range=(32, 256),
         accumulation=True,
     )
-    gt, bszt, acct, sp, tp = fn.optimize_topology(
+    gt, bszt, acct, sp, tp, ss = fn.optimize_topology(
         1, 8, max_batch_size=4096, atomic_bsz_range=(32, 256),
         accumulation=True, max_seq_shards=1, max_model_shards=1,
     )
-    assert sp == 1 and tp == 1
+    assert sp == 1 and tp == 1 and ss == 1
     assert gt == pytest.approx(g)
     assert bszt == bsz and acct == acc
 
@@ -208,7 +208,7 @@ def test_topology_search_prefers_seq_shards_for_long_context():
         1, 8, max_batch_size=16, atomic_bsz_range=(1, 4),
         accumulation=True,
     )
-    g, bsz, acc, sp, tp = fn.optimize_topology(
+    g, bsz, acc, sp, tp, _ = fn.optimize_topology(
         1, 8, max_batch_size=16, atomic_bsz_range=(1, 4),
         accumulation=True, max_seq_shards=8,
     )
@@ -221,29 +221,30 @@ def test_topology_search_prefers_seq_shards_for_long_context():
 
 def test_topology_respects_shard_limits():
     fn = GoodputFunction(PERF_SP, GRAD_LONGCTX, 8)
-    *_, sp, tp = fn.optimize_topology(
+    *_, sp, tp, ss = fn.optimize_topology(
         1, 8, max_batch_size=16, atomic_bsz_range=(1, 4),
         accumulation=True, max_seq_shards=2, max_model_shards=1,
     )
-    assert sp <= 2 and tp == 1
+    assert sp <= 2 and tp == 1 and ss == 1
 
 
 def test_topology_vectorized_matches_scalar():
     fn = GoodputFunction(PERF_SP, GRAD_LONGCTX, 8)
     nodes = np.array([1, 1, 2])
     chips = np.array([4, 8, 16])
-    gv, bv, av, sv, tv = fn.optimize_topology(
+    gv, bv, av, sv, tv, ssv = fn.optimize_topology(
         nodes, chips, max_batch_size=64, atomic_bsz_range=(1, 8),
         accumulation=True, max_seq_shards=4, max_model_shards=2,
+        max_stage_shards=2,
     )
     for i in range(len(nodes)):
-        g, b, a, s, t = fn.optimize_topology(
+        g, b, a, s, t, stg = fn.optimize_topology(
             int(nodes[i]), int(chips[i]), max_batch_size=64,
             atomic_bsz_range=(1, 8), accumulation=True,
-            max_seq_shards=4, max_model_shards=2,
+            max_seq_shards=4, max_model_shards=2, max_stage_shards=2,
         )
         assert g == pytest.approx(gv[i])
-        assert (b, a, s, t) == (bv[i], av[i], sv[i], tv[i])
+        assert (b, a, s, t, stg) == (bv[i], av[i], sv[i], tv[i], ssv[i])
 
 
 def test_fit_recovers_ring_terms():
@@ -284,3 +285,59 @@ def test_fit_recovers_ring_terms():
     )
     assert fitted0.alpha_sp >= fitted0.alpha_r - 1e-12
     assert fitted0.alpha_sp > 0
+
+
+# ---- pipeline (stage) factorizations -----------------------------------
+
+
+def test_topology_search_picks_pipeline_when_allreduce_dominates():
+    """A job whose ICI all-reduce retrogression makes wide DP painful
+    (heavy beta_r) and that cannot shard sequences should spend chips
+    on pipeline stages: fewer replicas to sync, bubble notwithstanding."""
+    perf = PerfParams(
+        0.02, 0.01, 0.5, 0.05, 0.01, 0.1, 1.5,
+        alpha_pp=0.001, beta_pp=0.0001,
+    )
+    fn = GoodputFunction(perf, GRAD_LONGCTX, 8)
+    pure_dp, _, _ = fn.optimize(
+        1, 8, max_batch_size=16, atomic_bsz_range=(1, 4),
+        accumulation=True,
+    )
+    g, bsz, acc, sp, tp, ss = fn.optimize_topology(
+        1, 8, max_batch_size=16, atomic_bsz_range=(1, 4),
+        accumulation=True, max_stage_shards=4, pipeline_micro=4,
+    )
+    assert ss > 1, (sp, tp, ss)
+    assert g > pure_dp
+
+
+def test_pipeline_bubble_is_priced():
+    """Stage sharding is never free: at equal chips the modelled accum
+    time with stages includes the (M+S-1)/M stretch."""
+    perf = PerfParams(
+        0.02, 0.01, 0.5, 0.05, 0.01, 0.001, 1.5,
+        alpha_pp=0.0, beta_pp=0.0,
+    )
+    from adaptdl_tpu.goodput import _accum_time
+
+    t1 = _accum_time(np, perf, 8, 1, 1, 1, 1)
+    t2 = _accum_time(np, perf, 8, 1, 1, 2, 4)
+    # 2 stages halve per-chip compute but stretch by (4+1)/4.
+    ideal_half = (perf.alpha_c + perf.beta_c * 8 / 2)
+    assert t2 == pytest.approx(ideal_half * 5 / 4)
+    assert t2 > ideal_half  # the bubble is visible
+    assert t1 == pytest.approx(perf.alpha_c + perf.beta_c * 8)
+
+
+def test_fit_pins_pipeline_hop_prior_when_unobserved():
+    nodes = np.ones(6, dtype=int)
+    replicas = np.array([1, 2, 2, 4, 4, 8])
+    bsz = np.array([64, 64, 128, 128, 256, 256])
+    from adaptdl_tpu.goodput import _log_optim_time, _network_time
+
+    t_acc = PERF.alpha_c + PERF.beta_c * bsz
+    t_net = _network_time(np, PERF, nodes, replicas)
+    t_opt = np.exp(_log_optim_time(np, PERF, t_acc, t_net))
+    fitted = fit_perf_params(nodes, replicas, bsz, t_acc, t_opt)
+    assert fitted.alpha_pp >= fitted.alpha_r - 1e-12
+    assert fitted.alpha_pp > 0

@@ -172,7 +172,7 @@ def test_policy_allocates_dp_sp_mesh_for_long_context():
     # bsz 1 -- but its efficiency is ~1/scale, so the marginal speedup
     # of replicas past ~2 is tiny; the sp factorization keeps scaling.
     assert chips >= 4, allocations
-    bsz, accum, sp, tp = sp_fn.best_config(1, chips)
+    bsz, accum, sp, tp, _ss = sp_fn.best_config(1, chips)
     assert sp > 1, "allocation should factorize as dp x sp"
     # The chosen factorization beats pure DP on the fitted model.
     pure_dp, _, _ = goodput_fn.optimize(
@@ -188,6 +188,6 @@ def test_policy_allocates_dp_sp_mesh_for_long_context():
 
 def test_speedup_best_config_pure_dp_defaults():
     fn = _speedup_fn()
-    bsz, accum, sp, tp = fn.best_config(1, 4)
-    assert sp == 1 and tp == 1
+    bsz, accum, sp, tp, ss = fn.best_config(1, 4)
+    assert sp == 1 and tp == 1 and ss == 1
     assert bsz >= 64
