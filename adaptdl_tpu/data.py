@@ -232,16 +232,13 @@ class AdaptiveDataLoader:
         # of the comparison, and the atomic-bsz memory ceiling scales
         # with the shard group (each chip holds 1/(sp*tp) of a
         # microbatch's activations).
-        sp, tp, ss = metrics.active_topology()
+        sp, tp, ss, ep, pipeline_micro = metrics.active_topology()
         # Memory-ceiling group: sp/tp shard each microbatch's
-        # activations; pipeline stages do NOT (in-flight microbatches
-        # keep per-chip activation memory ~constant).
+        # activations; pipeline stages and expert shards do NOT
+        # (in-flight microbatches / replicated group batches keep
+        # per-chip activation memory ~constant).
         group = sp * tp
-        pipeline_micro = (
-            metrics.current_state().pipeline_microbatches
-            if ss > 1
-            else 1
-        )
+        pipeline_micro = pipeline_micro if ss > 1 else 1
         # The restored config may be infeasible at the new replica
         # count (e.g. global batch beyond max_batch_size after growing
         # the job); then the optimizer's choice is adopted outright.
@@ -265,6 +262,7 @@ class AdaptiveDataLoader:
                 model_shards=tp,
                 stage_shards=ss,
                 pipeline_micro=pipeline_micro,
+                expert_shards=ep,
             )
             if current_feasible
             else 0.0
@@ -279,6 +277,7 @@ class AdaptiveDataLoader:
             model_shards=tp,
             stage_shards=ss,
             pipeline_micro=pipeline_micro,
+            expert_shards=ep,
         )
         atomic_bsz = bucket_atomic_bsz(int(atomic_bsz))
         if self._local_bsz_bounds is not None:
@@ -298,6 +297,7 @@ class AdaptiveDataLoader:
             model_shards=tp,
             stage_shards=ss,
             pipeline_micro=pipeline_micro,
+            expert_shards=ep,
         )
         if candidate_goodput > SPEEDUP_THRESHOLD * current_goodput:
             return atomic_bsz, int(accum_steps)

@@ -71,8 +71,30 @@ def replica_rank() -> int:
 
 
 def num_replicas() -> int:
-    """Total data-parallel replicas (== total chips in this design)."""
+    """Chips granted to this job at launch.
+
+    The scheduler always exports the job's CHIP count here. Under a
+    sharded topology (seq/model/stage/expert shards > 1) the
+    data-parallel replica count is ``chips // (sp * tp * ss * ep)`` —
+    use :func:`data_parallel_replicas` for that derived value (the
+    examples rewrite ADAPTDL_NUM_REPLICAS to it before building the
+    trainer, e.g. examples/transformer_lm.py). With every shard axis
+    at 1 (the reference's only case) chips == replicas and the value
+    can be used directly.
+    """
     return _get_int("ADAPTDL_NUM_REPLICAS", 1)
+
+
+def data_parallel_replicas() -> int:
+    """Data-parallel replica groups: chips divided by the sharded-axes
+    group size. Falls back to the raw chip count if it doesn't divide
+    evenly (a misconfigured topology is surfaced by the mesh builder,
+    not hidden here)."""
+    group = seq_shards() * model_shards() * stage_shards() * expert_shards()
+    chips = num_replicas()
+    if group > 1 and chips % group == 0:
+        return max(chips // group, 1)
+    return chips
 
 
 def seq_shards() -> int:
@@ -94,6 +116,23 @@ def model_shards() -> int:
 def stage_shards() -> int:
     """Pipeline stages per replica group (GPipe stage axis)."""
     return _get_int("ADAPTDL_STAGE_SHARDS", 1)
+
+
+def expert_shards() -> int:
+    """Expert-parallel shards per replica group (MoE all_to_all)."""
+    return _get_int("ADAPTDL_EXPERT_SHARDS", 1)
+
+
+def pipeline_micro() -> int:
+    """Scheduler-chosen GPipe microbatch count M for the stage axis.
+
+    Meaningful only when ``stage_shards() > 1``; the goodput topology
+    search co-optimizes M with the factorization and publishes it
+    here so ``gpipe_loss`` runs the schedule the model was priced at.
+    """
+    return _get_int(
+        "ADAPTDL_PIPELINE_MICRO", 4 if stage_shards() > 1 else 1
+    )
 
 
 def num_nodes() -> int:

@@ -86,6 +86,14 @@ class PerfParams(NamedTuple):
     # (M + S - 1) ticks of per-stage work, an (M+S-1)/M stretch.
     alpha_pp: float = 0.0
     beta_pp: float = 0.0
+    # Expert-parallel all_to_all cost (the GShard dispatch + return
+    # exchange per microbatch). Fitted from observations at
+    # expert_shards > 1; also absorbs whatever expert sharding does
+    # NOT divide (e.g. redundantly-computed attention within the
+    # expert group), since the compute term optimistically divides by
+    # every shard axis.
+    alpha_ep: float = 0.0
+    beta_ep: float = 0.0
 
 
 class GradParams(NamedTuple):
@@ -109,16 +117,17 @@ def _accum_time(
     model_shards=1,
     stage_shards=1,
     pipeline_micro=1,
+    expert_shards=1,
 ):
     """Forward+backward time of one microbatch on one chip.
 
-    Compute divides across the replica group's sp x tp x ss chips;
-    the ring/TP collective terms are the price of the sp/tp division,
-    and the pipeline pays a structural (M+S-1)/M bubble stretch plus a
-    fitted per-tick handoff cost (zero when the corresponding axis is
-    unsharded).
+    Compute divides across the replica group's sp x tp x ss x ep
+    chips; the ring/TP/expert collective terms are the price of the
+    sp/tp/ep division, and the pipeline pays a structural (M+S-1)/M
+    bubble stretch plus a fitted per-tick handoff cost (zero when the
+    corresponding axis is unsharded).
     """
-    shards = seq_shards * model_shards * stage_shards
+    shards = seq_shards * model_shards * stage_shards * expert_shards
     compute = params[0] + params[1] * atomic_bsz / shards
     ring = ((seq_shards - 1) / xp.maximum(seq_shards, 1)) * (
         params[7] + params[8] * atomic_bsz / model_shards
@@ -126,7 +135,13 @@ def _accum_time(
     tp = ((model_shards - 1) / xp.maximum(model_shards, 1)) * (
         params[9] + params[10] * atomic_bsz / seq_shards
     )
-    base = compute + ring + tp
+    # Two all_to_alls (dispatch + return) per microbatch; volume is
+    # this device's token slice of the replica's batch.
+    ep = ((expert_shards - 1) / xp.maximum(expert_shards, 1)) * (
+        params[13]
+        + params[14] * atomic_bsz / (seq_shards * model_shards)
+    )
+    base = compute + ring + tp + ep
     # Degenerates exactly to `base` at stage_shards == 1 (ticks == M,
     # stretch == 1, zero hops).
     ticks = pipeline_micro + stage_shards - 1
@@ -180,6 +195,7 @@ class GoodputFunction:
         model_shards=1,
         stage_shards=1,
         pipeline_micro=1,
+        expert_shards=1,
     ):
         return self.evaluate(
             num_nodes,
@@ -190,6 +206,7 @@ class GoodputFunction:
             model_shards=model_shards,
             stage_shards=stage_shards,
             pipeline_micro=pipeline_micro,
+            expert_shards=expert_shards,
         )
 
     def evaluate(
@@ -202,11 +219,12 @@ class GoodputFunction:
         model_shards=1,
         stage_shards=1,
         pipeline_micro=1,
+        expert_shards=1,
     ):
         """num_replicas counts *data-parallel* replica groups; each
-        group spans seq_shards*model_shards*stage_shards chips.
-        sp/tp/ss leave the statistical batch size untouched — they
-        divide the sample/model, not multiply the samples."""
+        group spans seq_shards*model_shards*stage_shards*expert_shards
+        chips. sp/tp/ss/ep leave the statistical batch size untouched —
+        they divide the sample/model, not multiply the samples."""
         batch_size = num_replicas * atomic_bsz * (accum_steps + 1)
         assert np.all(batch_size >= self._init_batch_size)
         return self.throughput(
@@ -218,6 +236,7 @@ class GoodputFunction:
             model_shards=model_shards,
             stage_shards=stage_shards,
             pipeline_micro=pipeline_micro,
+            expert_shards=expert_shards,
         ) * self.efficiency(batch_size)
 
     def throughput(
@@ -230,13 +249,14 @@ class GoodputFunction:
         model_shards=1,
         stage_shards=1,
         pipeline_micro=1,
+        expert_shards=1,
     ):
         """Samples/second: an iteration is accum_steps silent accumulation
         micro-steps plus one optim step that includes the gradient sync."""
         p = self._perf_params
         t_acc = _accum_time(
             np, p, atomic_bsz, seq_shards, model_shards,
-            stage_shards, pipeline_micro,
+            stage_shards, pipeline_micro, expert_shards,
         )
         t_net = _network_time(np, p, num_nodes, num_replicas)
         t_opt = np.exp(_log_optim_time(np, p, t_acc, t_net))
@@ -264,9 +284,11 @@ class GoodputFunction:
         model_shards: int = 1,
         stage_shards: int = 1,
         pipeline_micro: int = 1,
+        expert_shards: int = 1,
     ):
         """Best (goodput, atomic_bsz, accum_steps) per allocation, at a
-        *fixed* (seq_shards, model_shards, stage_shards) topology.
+        *fixed* (seq_shards, model_shards, stage_shards, expert_shards)
+        topology.
 
         Vectorized over broadcastable ``num_nodes``/``num_replicas``:
         candidate global batch sizes are sampled geometrically between
@@ -327,6 +349,10 @@ class GoodputFunction:
             )
         atomic_bsz = np.clip(atomic_bsz, min_atomic, max_atomic).astype(int)
 
+        # A pipeline microbatch cannot be smaller than one sample:
+        # clamp the schedule's M to the candidate's atomic batch so
+        # tiny-batch candidates are priced at a feasible M.
+        micro_eff = np.minimum(pipeline_micro, np.maximum(atomic_bsz, 1))
         goodput = self.evaluate(
             nodes,
             replicas,
@@ -335,7 +361,8 @@ class GoodputFunction:
             seq_shards=seq_shards,
             model_shards=model_shards,
             stage_shards=stage_shards,
-            pipeline_micro=pipeline_micro,
+            pipeline_micro=micro_eff,
+            expert_shards=expert_shards,
         )
         best = np.argmax(goodput, axis=0)
         cols = np.arange(goodput.shape[1])
@@ -357,27 +384,28 @@ class GoodputFunction:
         max_seq_shards: int = 1,
         max_model_shards: int = 1,
         max_stage_shards: int = 1,
-        pipeline_micro: int = 4,
+        max_pipeline_micro: int = 8,
+        max_expert_shards: int = 1,
     ):
-        """Best configuration over (data, seq, model) factorizations.
+        """Best configuration over (data, seq, model, stage, expert)
+        factorizations AND the pipeline microbatch count.
 
         ``num_chips`` counts total chips in the allocation; every
-        power-of-two factorization ``chips = dp * sp * tp`` with
-        ``sp <= max_seq_shards``, ``tp <= max_model_shards`` and at
-        least one replica group per spanned slice is scored with
-        :meth:`optimize` and the argmax wins. This is the search the
-        reference never needed — its only axis is data parallelism
-        (reference: adaptdl/adaptdl/goodput.py:88-148 searches batch
-        geometry at fixed parallelism) — and it is what lets a
-        long-context job with a tight ``max_batch_size`` keep using
-        chips past its statistical-efficiency cliff: extra chips go to
-        sequence/model shards instead of more replicas.
+        power-of-two factorization ``chips = dp * sp * tp * ss * ep``
+        with each axis within its advertised limit and at least one
+        replica group per spanned slice is scored with :meth:`optimize`
+        and the argmax wins. Stage factorizations are additionally
+        scored at every power-of-two GPipe microbatch count M up to
+        ``max_pipeline_micro``: more microbatches shrink the structural
+        (M+S-1)/M bubble but pay the per-tick handoff (alpha_pp) more
+        often, so M is a real decision variable, not an assumption.
+        This is the search the reference never needed — its only axis
+        is data parallelism (reference: adaptdl/adaptdl/goodput.py:
+        88-148 searches batch geometry at fixed parallelism).
 
         Returns ``(goodput, atomic_bsz, accum_steps, seq_shards,
-        model_shards, stage_shards)``, vectorized like
-        :meth:`optimize`. ``pipeline_micro`` is the GPipe microbatch
-        count assumed when scoring stage factorizations (the bubble is
-        (M+S-1)/M).
+        model_shards, stage_shards, expert_shards, pipeline_micro)``,
+        vectorized like :meth:`optimize`.
         """
         num_nodes = np.asarray(num_nodes)
         num_chips = np.asarray(num_chips)
@@ -393,15 +421,19 @@ class GoodputFunction:
                 v *= 2
             return out
 
+        micro_candidates = pow2s(max(int(max_pipeline_micro), 1))
         factorizations = [
-            (sp, tp, ss)
+            (sp, tp, ss, ep, micro)
             for sp in pow2s(max(int(max_seq_shards), 1))
             for tp in pow2s(max(int(max_model_shards), 1))
             for ss in pow2s(max(int(max_stage_shards), 1))
+            for ep in pow2s(max(int(max_expert_shards), 1))
+            # M only matters with a pipeline; ss == 1 pins M = 1.
+            for micro in (micro_candidates if ss > 1 else [1])
         ]
         results = []
-        for sp, tp, ss in factorizations:
-            group = sp * tp * ss
+        for sp, tp, ss, ep, micro in factorizations:
+            group = sp * tp * ss * ep
             dp = chips // group
             valid = (dp * group == chips) & (dp >= np.maximum(nodes, 1))
             # Placeholder dp=1 keeps optimize()'s vectorized call well
@@ -418,11 +450,13 @@ class GoodputFunction:
                 seq_shards=sp,
                 model_shards=tp,
                 stage_shards=ss,
-                pipeline_micro=pipeline_micro if ss > 1 else 1,
+                pipeline_micro=micro,
+                expert_shards=ep,
             )
             g = np.where(valid, np.atleast_1d(g), 0.0)
             results.append(
-                (g, np.atleast_1d(ab), np.atleast_1d(ac), sp, tp, ss)
+                (g, np.atleast_1d(ab), np.atleast_1d(ac),
+                 sp, tp, ss, ep, micro)
             )
         all_g = np.stack([r[0] for r in results])
         best = np.argmax(all_g, axis=0)
@@ -437,6 +471,11 @@ class GoodputFunction:
         sps = np.array([r[3] for r in results])[best].reshape(shape)
         tps = np.array([r[4] for r in results])[best].reshape(shape)
         sss = np.array([r[5] for r in results])[best].reshape(shape)
+        eps_ = np.array([r[6] for r in results])[best].reshape(shape)
+        micros = np.array([r[7] for r in results])[best].reshape(shape)
+        # Report the M actually schedulable at the chosen atomic batch
+        # (optimize() clamps internally the same way).
+        micros = np.minimum(micros, np.maximum(atomic_bsz, 1))
         if scalar_out:
             return (
                 goodput.item(),
@@ -445,8 +484,12 @@ class GoodputFunction:
                 sps.item(),
                 tps.item(),
                 sss.item(),
+                eps_.item(),
+                micros.item(),
             )
-        return goodput, atomic_bsz, accum_steps, sps, tps, sss
+        return (
+            goodput, atomic_bsz, accum_steps, sps, tps, sss, eps_, micros
+        )
 
 
 def _fit_objective(
@@ -459,6 +502,7 @@ def _fit_objective(
     model_shards,
     stage_shards,
     pipeline_micro,
+    expert_shards,
     accum_time,
     optim_time,
     weight,
@@ -469,7 +513,7 @@ def _fit_objective(
     per new profile entry)."""
     pred_acc = _accum_time(
         jnp, params, atomic_bsz, seq_shards, model_shards,
-        stage_shards, pipeline_micro,
+        stage_shards, pipeline_micro, expert_shards,
     )
     pred_net = _network_time(jnp, params, num_nodes, num_replicas)
     pred_log_opt = _log_optim_time(jnp, params, pred_acc, pred_net)
@@ -523,6 +567,7 @@ def fit_perf_params(
     model_shards=None,
     stage_shards=None,
     pipeline_micro=None,
+    expert_shards=None,
 ) -> PerfParams:
     """Fit PerfParams to profiled timings via L-BFGS-B + jax.grad.
 
@@ -551,18 +596,22 @@ def fit_perf_params(
         stage_shards = np.ones_like(num_nodes)
     if pipeline_micro is None:
         pipeline_micro = np.ones_like(num_nodes)
+    if expert_shards is None:
+        expert_shards = np.ones_like(num_nodes)
     seq_shards = np.asarray(seq_shards, dtype=float)
     model_shards = np.asarray(model_shards, dtype=float)
     stage_shards = np.asarray(stage_shards, dtype=float)
     pipeline_micro = np.asarray(pipeline_micro, dtype=float)
+    expert_shards = np.asarray(expert_shards, dtype=float)
 
     init = np.array(
         [1e-1, 1e-2, 1e-1, 1e-2, 1e-1, 1e-2, 1.0 + 1e-3]
         + [1e-2, 1e-3, 1e-2, 1e-3]
         + [1e-2, 1e-3]
+        + [1e-2, 1e-3]
     )
-    lower = np.array([1e-8] * 6 + [1.0] + [1e-8] * 6)
-    upper = np.array([np.inf] * 6 + [10.0] + [np.inf] * 6)
+    lower = np.array([1e-8] * 6 + [1.0] + [1e-8] * 8)
+    upper = np.array([np.inf] * 6 + [10.0] + [np.inf] * 8)
 
     if len(np.unique(atomic_bsz)) == 1:
         # One observed batch size can't separate the constant and linear
@@ -580,6 +629,7 @@ def fit_perf_params(
     sp_observed = bool(np.any(seq_shards > 1))
     tp_observed = bool(np.any(model_shards > 1))
     ss_observed = bool(np.any(stage_shards > 1))
+    ep_observed = bool(np.any(expert_shards > 1))
     if not sp_observed:
         init[7] = upper[7] = lower[7]  # ring terms unidentifiable
         init[8] = upper[8] = lower[8]
@@ -589,6 +639,9 @@ def fit_perf_params(
     if not ss_observed:
         init[11] = upper[11] = lower[11]  # pipeline hop unidentifiable
         init[12] = upper[12] = lower[12]
+    if not ep_observed:
+        init[13] = upper[13] = lower[13]  # all_to_all unidentifiable
+        init[14] = upper[14] = lower[14]
 
     # Pad observations to the next power-of-two bucket: the jitted
     # objective then compiles once per bucket instead of once per new
@@ -614,6 +667,7 @@ def fit_perf_params(
                 _pad(model_shards, 1),
                 _pad(stage_shards, 1),
                 _pad(pipeline_micro, 1),
+                _pad(expert_shards, 1),
                 _pad(accum_step_time, 1),
                 _pad(optim_step_time, 1),
                 weight,
@@ -651,4 +705,7 @@ def fit_perf_params(
         # A pipeline handoff costs at least the fitted ICI latency
         # (the structural bubble already tempers over-optimism).
         params[11] = max(params[11], params[4])
+    if not ep_observed:
+        # An expert all_to_all costs at least the fitted ICI latency.
+        params[13] = max(params[13], params[4])
     return PerfParams(*params)

@@ -65,15 +65,17 @@ class MetricsState:
     """Everything the adaptation engine knows about this job so far.
 
     Profile keys are ``(num_nodes, num_replicas, seq_shards,
-    model_shards, stage_shards, atomic_bsz)`` — the reference's
-    (nodes, replicas, bsz) keying (reference: _metrics.py:29-66)
-    extended with the sharding axes so the fit can identify the
-    ring/TP collective and pipeline-hop terms.
+    model_shards, stage_shards, expert_shards, pipeline_micro,
+    atomic_bsz)`` — the reference's (nodes, replicas, bsz) keying
+    (reference: _metrics.py:29-66) extended with the sharding axes and
+    the GPipe microbatch count so the fit can identify the
+    ring/TP/expert collective and pipeline-hop terms from timings that
+    actually ran them.
     """
 
-    profile: dict[tuple[int, int, int, int, int, int], _ProfileEntry] = field(
-        default_factory=lambda: defaultdict(_ProfileEntry)
-    )
+    profile: dict[
+        tuple[int, int, int, int, int, int, int, int], _ProfileEntry
+    ] = field(default_factory=lambda: defaultdict(_ProfileEntry))
     perf_params: PerfParams | None = None
     grad_params: GradParams | None = None
     init_batch_size: int | None = None
@@ -84,7 +86,12 @@ class MetricsState:
     max_seq_shards: int = 1
     max_model_shards: int = 1
     max_stage_shards: int = 1
+    max_expert_shards: int = 1
+    # Default/current GPipe M (overridden per-run by the scheduler's
+    # ADAPTDL_PIPELINE_MICRO via the trainer's active topology) and the
+    # largest M the job's data layer supports (the search's cap).
     pipeline_microbatches: int = 4
+    max_pipeline_micro: int = 8
     progress: float = 0.0
 
 
@@ -92,7 +99,7 @@ _state = MetricsState()
 _last_fit_time: float | None = None
 _profile_lock = threading.Lock()
 _fit_thread: threading.Thread | None = None
-_active_topology: tuple[int, int, int] | None = None
+_active_topology: tuple[int, int, int, int, int] | None = None
 
 
 def _reset_state() -> None:
@@ -107,29 +114,47 @@ def _reset_state() -> None:
 
 
 def set_active_topology(
-    seq_shards: int, model_shards: int, stage_shards: int = 1
+    seq_shards: int,
+    model_shards: int,
+    stage_shards: int = 1,
+    expert_shards: int = 1,
+    pipeline_micro: int | None = None,
 ) -> None:
-    """Registered by the trainer with the (sp, tp) its mesh actually
-    has. Profiles and batch decisions key on THIS, never on the
-    scheduler's requested ADAPTDL_SEQ_SHARDS — a job is free to build
-    a different mesh (e.g. CLI flags), and mis-keyed timings would
-    teach the fit ring/TP terms from measurements that never ran
-    those collectives."""
+    """Registered by the trainer with the (sp, tp, ss, ep, M) its mesh
+    actually has. Profiles and batch decisions key on THIS, never on
+    the scheduler's requested ADAPTDL_SEQ_SHARDS — a job is free to
+    build a different mesh (e.g. CLI flags), and mis-keyed timings
+    would teach the fit ring/TP/expert terms from measurements that
+    never ran those collectives."""
     global _active_topology
+    stage_shards = max(int(stage_shards), 1)
+    if pipeline_micro is None:
+        pipeline_micro = (
+            _state.pipeline_microbatches if stage_shards > 1 else 1
+        )
     _active_topology = (
         max(int(seq_shards), 1),
         max(int(model_shards), 1),
-        max(int(stage_shards), 1),
+        stage_shards,
+        max(int(expert_shards), 1),
+        max(int(pipeline_micro), 1),
     )
 
 
-def active_topology() -> tuple[int, int, int]:
+def active_topology() -> tuple[int, int, int, int, int]:
     """The training process's live (seq_shards, model_shards,
-    stage_shards): whatever the trainer registered, else the
-    scheduler's request."""
+    stage_shards, expert_shards, pipeline_micro): whatever the trainer
+    registered, else the scheduler's request."""
     if _active_topology is not None:
         return _active_topology
-    return (env.seq_shards(), env.model_shards(), env.stage_shards())
+    ss = env.stage_shards()
+    return (
+        env.seq_shards(),
+        env.model_shards(),
+        ss,
+        env.expert_shards(),
+        env.pipeline_micro() if ss > 1 else 1,
+    )
 
 
 def current_state() -> MetricsState:
@@ -153,21 +178,39 @@ def set_topology_config(
     max_model_shards: int = 1,
     max_stage_shards: int = 1,
     pipeline_microbatches: int = 4,
+    max_expert_shards: int = 1,
+    max_pipeline_micro: int | None = None,
 ) -> None:
     """Advertise how far this job can shard each sample/model
     (sequence shards need ring attention; model shards need a
-    param_sharding_fn; stage shards need a gpipe_loss with
-    ``pipeline_microbatches`` microbatches). The scheduler's topology
-    search stays within these limits."""
+    param_sharding_fn; stage shards need a gpipe_loss built with
+    ``env.pipeline_micro()``; expert shards need an expert-sharded
+    MoE). The scheduler's topology search stays within these limits;
+    ``max_pipeline_micro`` caps the GPipe M it may pick (defaults to
+    the larger of 8 and the job's default M)."""
     _state.max_seq_shards = max(int(max_seq_shards), 1)
     _state.max_model_shards = max(int(max_model_shards), 1)
     _state.max_stage_shards = max(int(max_stage_shards), 1)
+    _state.max_expert_shards = max(int(max_expert_shards), 1)
     _state.pipeline_microbatches = max(int(pipeline_microbatches), 1)
+    if max_pipeline_micro is None:
+        max_pipeline_micro = max(8, _state.pipeline_microbatches)
+    _state.max_pipeline_micro = max(int(max_pipeline_micro), 1)
 
 
-def _profile_key(atomic_bsz: int) -> tuple[int, int, int, int, int, int]:
-    sp, tp, ss = active_topology()
-    return (env.num_nodes(), env.num_replicas(), sp, tp, ss, atomic_bsz)
+def _topology_suffix() -> tuple[int, int, int, int, int]:
+    sp, tp, ss, ep, micro = active_topology()
+    return (sp, tp, ss, ep, micro if ss > 1 else 1)
+
+
+def _profile_key(
+    atomic_bsz: int,
+) -> tuple[int, int, int, int, int, int, int, int]:
+    sp, tp, ss, ep, micro = _topology_suffix()
+    return (
+        env.num_nodes(), env.num_replicas(), sp, tp, ss, ep, micro,
+        atomic_bsz,
+    )
 
 
 def profile_accum_time(atomic_bsz: int, accum_time: float) -> None:
@@ -204,10 +247,10 @@ def profile_step(
         # profiled coverage must count chips too: a dp=1 x sp=8 run has
         # profiled 8 chips, not 1 replica — otherwise sp-factorized
         # jobs would be permanently capped at 2 chips.
-        sp, tp, ss = active_topology()
+        sp, tp, ss, ep, _micro = active_topology()
         _state.max_profiled_replicas = max(
             _state.max_profiled_replicas,
-            env.num_replicas() * sp * tp * ss,
+            env.num_replicas() * sp * tp * ss * ep,
         )
     _maybe_fit_and_report()
 
@@ -223,14 +266,14 @@ def update_progress(progress: float) -> None:
 
 def _fit() -> PerfParams | None:
     nodes, replicas, bszs = [], [], []
-    sps, tps, sss = [], [], []
+    sps, tps, sss, eps, micros = [], [], [], [], []
     accum_times, optim_times = [], []
     with _profile_lock:
         snapshot = [
             (key, _ProfileEntry(**vars(entry)))
             for key, entry in _state.profile.items()
         ]
-    for (n, r, sp, tp, ss, bsz), entry in snapshot:
+    for (n, r, sp, tp, ss, ep, micro, bsz), entry in snapshot:
         if entry.optim_count == 0:
             continue
         # A missing calibration falls back to the optim time, which
@@ -244,12 +287,13 @@ def _fit() -> PerfParams | None:
         sps.append(sp)
         tps.append(tp)
         sss.append(ss)
+        eps.append(ep)
+        micros.append(micro)
         bszs.append(bsz)
         accum_times.append(accum)
         optim_times.append(entry.optim_time_sum / entry.optim_count)
     if not nodes:
         return None
-    micro = _state.pipeline_microbatches
     return fit_perf_params(
         nodes,
         replicas,
@@ -259,7 +303,8 @@ def _fit() -> PerfParams | None:
         seq_shards=sps,
         model_shards=tps,
         stage_shards=sss,
-        pipeline_micro=[micro if ss > 1 else 1 for ss in sss],
+        pipeline_micro=micros,
+        expert_shards=eps,
     )
 
 
@@ -323,7 +368,9 @@ def fit_and_report_now() -> None:
     hints["maxSeqShards"] = _state.max_seq_shards
     hints["maxModelShards"] = _state.max_model_shards
     hints["maxStageShards"] = _state.max_stage_shards
-    hints["pipelineMicrobatches"] = _state.pipeline_microbatches
+    hints["maxExpertShards"] = _state.max_expert_shards
+    hints["maxPipelineMicro"] = _state.max_pipeline_micro
+    hints["pipelineMicrobatches"] = _topology_suffix()[4]
     if _state.grad_params is not None:
         hints["gradParams"] = dict(_state.grad_params._asdict())
     if _state.perf_params is not None:
@@ -372,21 +419,31 @@ class _MetricsCheckpoint(checkpoint.State):
             "max_seq_shards": _state.max_seq_shards,
             "max_model_shards": _state.max_model_shards,
             "max_stage_shards": _state.max_stage_shards,
+            "max_expert_shards": _state.max_expert_shards,
             "pipeline_microbatches": _state.pipeline_microbatches,
+            "max_pipeline_micro": _state.max_pipeline_micro,
             "progress": _state.progress,
         }
         pickle.dump(payload, fileobj)
 
     def load(self, fileobj):
         payload = pickle.load(fileobj)
+        old_micro = max(int(payload.get("pipeline_microbatches", 4)), 1)
         profile = defaultdict(_ProfileEntry)
         for key, entry in payload["profile"].items():
             if len(key) == 3:  # pre-sp/tp checkpoint: (n, r, bsz)
                 n, r, bsz = key
-                key = (n, r, 1, 1, 1, bsz)
+                key = (n, r, 1, 1, 1, 1, 1, bsz)
             elif len(key) == 5:  # pre-stage: (n, r, sp, tp, bsz)
                 n, r, sp, tp, bsz = key
-                key = (n, r, sp, tp, 1, bsz)
+                key = (n, r, sp, tp, 1, 1, 1, bsz)
+            elif len(key) == 6:  # pre-expert/micro: (n,r,sp,tp,ss,bsz)
+                n, r, sp, tp, ss, bsz = key
+                # Old checkpoints ran stage schedules at the state's
+                # default M.
+                key = (
+                    n, r, sp, tp, ss, 1, old_micro if ss > 1 else 1, bsz
+                )
             profile[key] = entry
         _state.profile = profile
         _state.perf_params = payload["perf_params"]
@@ -399,8 +456,10 @@ class _MetricsCheckpoint(checkpoint.State):
         _state.max_seq_shards = payload.get("max_seq_shards", 1)
         _state.max_model_shards = payload.get("max_model_shards", 1)
         _state.max_stage_shards = payload.get("max_stage_shards", 1)
-        _state.pipeline_microbatches = payload.get(
-            "pipeline_microbatches", 4
+        _state.max_expert_shards = payload.get("max_expert_shards", 1)
+        _state.pipeline_microbatches = old_micro
+        _state.max_pipeline_micro = payload.get(
+            "max_pipeline_micro", max(8, old_micro)
         )
         _state.progress = payload["progress"]
 

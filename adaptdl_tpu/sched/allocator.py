@@ -57,7 +57,13 @@ def job_info_from_hints(
             max_seq_shards=int(hints.get("maxSeqShards") or 1),
             max_model_shards=int(hints.get("maxModelShards") or 1),
             max_stage_shards=int(hints.get("maxStageShards") or 1),
-            pipeline_micro=int(hints.get("pipelineMicrobatches") or 4),
+            max_expert_shards=int(hints.get("maxExpertShards") or 1),
+            # Older jobs only post their running M; treat it as the cap.
+            max_pipeline_micro=int(
+                hints.get("maxPipelineMicro")
+                or hints.get("pipelineMicrobatches")
+                or 8
+            ),
         )
         profiled = int(hints.get("maxProfiledReplicas") or 1)
         # Profiling gates scale-up: at most double what was measured.
@@ -160,13 +166,15 @@ class Allocator:
                 jobs[key].speedup_fn, "best_config_with_hysteresis", None
             )
             if best_config is not None and alloc:
-                _, _, sp, tp, ss = best_config(
+                _, _, sp, tp, ss, ep, micro = best_config(
                     len(set(alloc)), len(alloc), record.topology
                 )
                 topology = {
                     "seqShards": sp,
                     "modelShards": tp,
                     "stageShards": ss,
+                    "expertShards": ep,
+                    "pipelineMicro": micro,
                 }
             changed = record.allocation != alloc or normalize_topology(
                 record.topology

@@ -464,6 +464,28 @@ class Operator:
                     (record.topology or {}).get("stageShards", 1)
                 ),
             },
+            {
+                "name": "ADAPTDL_EXPERT_SHARDS",
+                "value": str(
+                    (record.topology or {}).get("expertShards", 1)
+                ),
+            },
+            {
+                "name": "ADAPTDL_PIPELINE_MICRO",
+                # Default matches normalize_topology: pre-M-search
+                # records ran stage schedules at the old fixed M=4.
+                "value": str(
+                    (record.topology or {}).get(
+                        "pipelineMicro",
+                        4
+                        if int(
+                            (record.topology or {}).get("stageShards", 1)
+                        )
+                        > 1
+                        else 1,
+                    )
+                ),
+            },
         ]
         for container in containers:
             container.setdefault("env", []).extend(env)

@@ -132,6 +132,15 @@ class MultiJobRunner:
             topology.get("modelShards", 1)
         )
         env["ADAPTDL_STAGE_SHARDS"] = str(topology.get("stageShards", 1))
+        env["ADAPTDL_EXPERT_SHARDS"] = str(
+            topology.get("expertShards", 1)
+        )
+        # Default matches normalize_topology: records that predate the
+        # M search ran stage schedules at the old fixed M=4.
+        default_micro = 4 if int(topology.get("stageShards", 1)) > 1 else 1
+        env["ADAPTDL_PIPELINE_MICRO"] = str(
+            topology.get("pipelineMicro", default_micro)
+        )
         return env
 
     def _run_job(self, job: JobSpec) -> None:

@@ -33,7 +33,8 @@ class SpeedupFunction:
         max_seq_shards: int = 1,
         max_model_shards: int = 1,
         max_stage_shards: int = 1,
-        pipeline_micro: int = 4,
+        max_expert_shards: int = 1,
+        max_pipeline_micro: int = 8,
     ):
         self._goodput_fn = goodput_fn
         self._max_batch_size = max_batch_size
@@ -42,12 +43,14 @@ class SpeedupFunction:
         self._max_seq_shards = max(int(max_seq_shards or 1), 1)
         self._max_model_shards = max(int(max_model_shards or 1), 1)
         self._max_stage_shards = max(int(max_stage_shards or 1), 1)
-        self._pipeline_micro = max(int(pipeline_micro or 1), 1)
+        self._max_expert_shards = max(int(max_expert_shards or 1), 1)
+        self._max_pipeline_micro = max(int(max_pipeline_micro or 1), 1)
         # Base goodput: one chip on one slice.
         base, *_ = self._optimize(np.array([1]), np.array([1]))
         self._base_goodput = float(np.atleast_1d(base)[0])
         self._cache: dict[tuple[int, int], float] = {(0, 0): 0.0}
-        # (nodes, chips) -> (atomic_bsz, accum_steps, sp, tp, ss)
+        # (nodes, chips) ->
+        #   (atomic_bsz, accum_steps, sp, tp, ss, ep, micro)
         self._config: dict[tuple[int, int], tuple] = {}
 
     def _optimize(self, nodes, chips):
@@ -60,19 +63,22 @@ class SpeedupFunction:
             max_seq_shards=self._max_seq_shards,
             max_model_shards=self._max_model_shards,
             max_stage_shards=self._max_stage_shards,
-            pipeline_micro=self._pipeline_micro,
+            max_expert_shards=self._max_expert_shards,
+            max_pipeline_micro=self._max_pipeline_micro,
         )
 
     def best_config(
         self, num_nodes: int, num_chips: int
-    ) -> tuple[int, int, int, int, int]:
+    ) -> tuple[int, int, int, int, int, int, int]:
         """(atomic_bsz, accum_steps, seq_shards, model_shards,
-        stage_shards) behind the speedup at this allocation — what the
-        controller exports as ADAPTDL_SEQ_SHARDS /
-        ADAPTDL_MODEL_SHARDS / ADAPTDL_STAGE_SHARDS."""
+        stage_shards, expert_shards, pipeline_micro) behind the
+        speedup at this allocation — what the controller exports as
+        ADAPTDL_SEQ_SHARDS / ADAPTDL_MODEL_SHARDS /
+        ADAPTDL_STAGE_SHARDS / ADAPTDL_EXPERT_SHARDS /
+        ADAPTDL_PIPELINE_MICRO."""
         self(num_nodes, num_chips)  # warm the cache
         return self._config.get(
-            (int(num_nodes), int(num_chips)), (0, 0, 1, 1, 1)
+            (int(num_nodes), int(num_chips)), (0, 0, 1, 1, 1, 1, 1)
         )
 
     def best_config_with_hysteresis(
@@ -81,24 +87,36 @@ class SpeedupFunction:
         num_chips: int,
         incumbent: dict | None,
         threshold: float = 1.05,
-    ) -> tuple[int, int, int, int, int]:
+    ) -> tuple[int, int, int, int, int, int, int]:
         """Like :meth:`best_config`, but keeps the job's incumbent
         factorization unless the challenger beats it by ``threshold``
         on the fitted model — a topology change costs a full
         checkpoint-restart-recompile, so near-ties must not flap
         across refits (same philosophy as the dataloader's 5%
-        batch-size threshold, reference: data.py:297-301)."""
-        bsz, accum, sp, tp, ss = self.best_config(num_nodes, num_chips)
-        inc_sp = max(int((incumbent or {}).get("seqShards", 1)), 1)
-        inc_tp = max(int((incumbent or {}).get("modelShards", 1)), 1)
-        inc_ss = max(int((incumbent or {}).get("stageShards", 1)), 1)
-        if (sp, tp, ss) == (inc_sp, inc_tp, inc_ss):
-            return bsz, accum, sp, tp, ss
-        group = inc_sp * inc_tp * inc_ss
+        batch-size threshold, reference: data.py:297-301). A change
+        in the pipeline microbatch count alone also restarts (the
+        gpipe_loss is rebuilt), so M is part of the incumbent."""
+        bsz, accum, sp, tp, ss, ep, micro = self.best_config(
+            num_nodes, num_chips
+        )
+        inc = incumbent or {}
+        inc_sp = max(int(inc.get("seqShards", 1)), 1)
+        inc_tp = max(int(inc.get("modelShards", 1)), 1)
+        inc_ss = max(int(inc.get("stageShards", 1)), 1)
+        inc_ep = max(int(inc.get("expertShards", 1)), 1)
+        inc_micro = max(
+            int(inc.get("pipelineMicro", 1 if inc_ss == 1 else 4)), 1
+        )
+        if inc_ss == 1:
+            inc_micro = 1
+        challenger = (sp, tp, ss, ep, micro)
+        if challenger == (inc_sp, inc_tp, inc_ss, inc_ep, inc_micro):
+            return bsz, accum, sp, tp, ss, ep, micro
+        group = inc_sp * inc_tp * inc_ss * inc_ep
         dp = num_chips // group
         if dp < 1 or dp * group != num_chips or dp < max(num_nodes, 1):
             # Incumbent no longer fits this chip count; adopt the best.
-            return bsz, accum, sp, tp, ss
+            return bsz, accum, sp, tp, ss, ep, micro
         inc_goodput, inc_bsz, inc_accum = self._goodput_fn.optimize(
             max(num_nodes, 1),
             dp,
@@ -108,15 +126,22 @@ class SpeedupFunction:
             seq_shards=inc_sp,
             model_shards=inc_tp,
             stage_shards=inc_ss,
-            pipeline_micro=self._pipeline_micro if inc_ss > 1 else 1,
+            pipeline_micro=inc_micro,
+            expert_shards=inc_ep,
         )
         best_goodput = (
             self._cache.get((int(num_nodes), int(num_chips)), 0.0)
             * self._base_goodput
         )
         if best_goodput > threshold * float(inc_goodput):
-            return bsz, accum, sp, tp, ss
-        return int(inc_bsz), int(inc_accum), inc_sp, inc_tp, inc_ss
+            return bsz, accum, sp, tp, ss, ep, micro
+        # The kept M must be schedulable at the re-optimized atomic
+        # batch (optimize() prices it clamped the same way).
+        inc_micro = min(inc_micro, max(int(inc_bsz), 1))
+        return (
+            int(inc_bsz), int(inc_accum),
+            inc_sp, inc_tp, inc_ss, inc_ep, inc_micro,
+        )
 
     def __call__(self, num_nodes, num_replicas):
         scalar = np.isscalar(num_nodes) and np.isscalar(num_replicas)
@@ -136,8 +161,8 @@ class SpeedupFunction:
         if missing:
             m_nodes = np.array([k[0] for k in missing])
             m_chips = np.array([k[1] for k in missing])
-            goodput, bsz, accum, sps, tps, sss = self._optimize(
-                np.maximum(m_nodes, 1), m_chips
+            goodput, bsz, accum, sps, tps, sss, eps, micros = (
+                self._optimize(np.maximum(m_nodes, 1), m_chips)
             )
             goodput = np.atleast_1d(goodput)
             bsz = np.atleast_1d(bsz)
@@ -145,6 +170,8 @@ class SpeedupFunction:
             sps = np.atleast_1d(sps)
             tps = np.atleast_1d(tps)
             sss = np.atleast_1d(sss)
+            eps = np.atleast_1d(eps)
+            micros = np.atleast_1d(micros)
             for i, key in enumerate(missing):
                 self._cache[key] = float(goodput[i]) / self._base_goodput
                 self._config[key] = (
@@ -153,6 +180,8 @@ class SpeedupFunction:
                     int(sps[i]),
                     int(tps[i]),
                     int(sss[i]),
+                    int(eps[i]),
+                    int(micros[i]),
                 )
         for i, key in enumerate(keys):
             out[i] = self._cache.get(key, 0.0)

@@ -30,10 +30,18 @@ def normalize_topology(topology: dict | None) -> dict:
     as different would restart every job the first time it posts
     hints."""
     topology = topology or {}
+    stage_shards = int(topology.get("stageShards", 1))
     return {
         "seqShards": int(topology.get("seqShards", 1)),
         "modelShards": int(topology.get("modelShards", 1)),
-        "stageShards": int(topology.get("stageShards", 1)),
+        "stageShards": stage_shards,
+        "expertShards": int(topology.get("expertShards", 1)),
+        # M is only meaningful with a pipeline; canonicalize to 1
+        # otherwise so adding the key never restarts a pure-DP job.
+        "pipelineMicro": (
+            int(topology.get("pipelineMicro", 4)) if stage_shards > 1
+            else 1
+        ),
     }
 
 

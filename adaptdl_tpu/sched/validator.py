@@ -39,6 +39,8 @@ OPERATOR_INJECTED_ENV = frozenset(
         "ADAPTDL_SEQ_SHARDS",
         "ADAPTDL_MODEL_SHARDS",
         "ADAPTDL_STAGE_SHARDS",
+        "ADAPTDL_EXPERT_SHARDS",
+        "ADAPTDL_PIPELINE_MICRO",
     }
 )
 

@@ -27,9 +27,12 @@ PERF_PARAMS_REQUIRED = tuple(
 GRAD_PARAMS_KEYS = tuple(GradParams._fields)
 
 # Hint keys: camelCase on the wire, matching the reference schema and
-# the AdaptDLJob CRD's status.train field; maxSeqShards/maxModelShards
+# the AdaptDLJob CRD's status.train field; the max*Shards keys
 # advertise the job's sharding limits for the topology search (no
-# reference analog — the reference has no sp/tp axes).
+# reference analog — the reference has no sp/tp/ss/ep axes).
+# maxPipelineMicro caps the GPipe microbatch count the scheduler may
+# choose (data-layer divisibility); pipelineMicrobatches reports the
+# M currently running, for dashboards and the fit.
 SCHED_HINTS_KEYS = (
     "initBatchSize",
     "localBszBounds",
@@ -41,6 +44,8 @@ SCHED_HINTS_KEYS = (
     "maxSeqShards",
     "maxModelShards",
     "maxStageShards",
+    "maxExpertShards",
+    "maxPipelineMicro",
     "pipelineMicrobatches",
 )
 

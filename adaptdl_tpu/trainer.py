@@ -42,6 +42,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from adaptdl_tpu import checkpoint, gns
 from adaptdl_tpu.parallel.mesh import (
     DATA_AXIS,
+    EXPERT_AXIS,
     MODEL_AXIS,
     PARAM_SHARDED_AXES,
     SEQ_AXIS,
@@ -151,6 +152,7 @@ class ElasticTrainer:
         has_aux: bool = False,
         param_sharding_fn: Callable | None = None,
         param_group_fn: Callable | None = None,
+        pipeline_micro: int | None = None,
     ):
         self.has_aux = has_aux
         self.param_sharding_fn = param_sharding_fn
@@ -192,15 +194,26 @@ class ElasticTrainer:
         self.precondition = precondition
         self.smoothing = smoothing
         self._seed = seed
-        # Register the mesh's true (sp, tp) so profiling keys and the
-        # dataloader's goodput decisions reflect the topology that is
-        # actually running, not the scheduler's request.
+        # Register the mesh's true (sp, tp, ss, ep, M) so profiling
+        # keys and the dataloader's goodput decisions reflect the
+        # topology that is actually running, not the scheduler's
+        # request. ``pipeline_micro`` is the GPipe M the loss_fn was
+        # built with (defaults to the scheduler's published choice,
+        # ADAPTDL_PIPELINE_MICRO).
+        from adaptdl_tpu import env as env_mod
         from adaptdl_tpu import metrics as metrics_mod
 
+        if pipeline_micro is None:
+            pipeline_micro = (
+                env_mod.pipeline_micro() if self.stage_shards > 1 else 1
+            )
+        self.pipeline_micro = max(int(pipeline_micro), 1)
         metrics_mod.set_active_topology(
             self.seq_shards,
             self.mesh.shape.get(MODEL_AXIS, 1),
             self.mesh.shape.get(STAGE_AXIS, 1),
+            self.mesh.shape.get(EXPERT_AXIS, 1),
+            self.pipeline_micro,
         )
         self._init_params = params
         self._step_cache: dict[tuple[int, int], Callable] = {}
@@ -229,6 +242,15 @@ class ElasticTrainer:
         loss_fn runs inside the manual shard_map and schedules
         microbatches with adaptdl_tpu.parallel.pipeline.gpipe."""
         return self.mesh.shape.get(STAGE_AXIS, 1)
+
+    @property
+    def expert_shards(self) -> int:
+        """Expert-parallel devices per replica group. Like a stage
+        group, an expert group is ONE data-parallel replica whose
+        expert parameters are sharded (P("expert") from
+        param_sharding_fn); the loss_fn exchanges tokens with
+        all_to_all (adaptdl_tpu.models.moe.switch_moe)."""
+        return self.mesh.shape.get(EXPERT_AXIS, 1)
 
     @property
     def sharded_param_axes(self) -> tuple[str, ...]:

@@ -91,9 +91,10 @@ def main():
             logits, batch["targets"]
         ).mean()
 
-    # ADAPTDL_NUM_REPLICAS counts *data-parallel* replicas; a
-    # seq- or tensor-sharded group of chips forms one replica, so the
-    # chips of this allocation divide between the axes.
+    # ADAPTDL_NUM_REPLICAS counts CHIPS at launch; a seq- or
+    # tensor-sharded group of chips forms one data-parallel replica,
+    # so rewrite it to the derived dp count (env.data_parallel_replicas
+    # divides by every shard axis the scheduler assigned).
     tp_shards = (
         args.tp_shards if args.tp_shards is not None else env.model_shards()
     )
@@ -101,8 +102,9 @@ def main():
     if group > 1:
         import os
 
-        chips = int(os.environ["ADAPTDL_NUM_REPLICAS"])
-        data_shards = max(chips // group, 1)
+        os.environ["ADAPTDL_SEQ_SHARDS"] = str(seq_shards)
+        os.environ["ADAPTDL_MODEL_SHARDS"] = str(tp_shards)
+        data_shards = env.data_parallel_replicas()
         os.environ["ADAPTDL_NUM_REPLICAS"] = str(data_shards)
     else:
         data_shards = env.num_replicas()

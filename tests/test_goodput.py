@@ -191,11 +191,11 @@ def test_topology_matches_fixed_optimize_when_dp_only():
         1, 8, max_batch_size=4096, atomic_bsz_range=(32, 256),
         accumulation=True,
     )
-    gt, bszt, acct, sp, tp, ss = fn.optimize_topology(
+    gt, bszt, acct, sp, tp, ss, ep, micro = fn.optimize_topology(
         1, 8, max_batch_size=4096, atomic_bsz_range=(32, 256),
         accumulation=True, max_seq_shards=1, max_model_shards=1,
     )
-    assert sp == 1 and tp == 1 and ss == 1
+    assert sp == 1 and tp == 1 and ss == 1 and ep == 1 and micro == 1
     assert gt == pytest.approx(g)
     assert bszt == bsz and acct == acc
 
@@ -208,7 +208,7 @@ def test_topology_search_prefers_seq_shards_for_long_context():
         1, 8, max_batch_size=16, atomic_bsz_range=(1, 4),
         accumulation=True,
     )
-    g, bsz, acc, sp, tp, _ = fn.optimize_topology(
+    g, bsz, acc, sp, tp, _, _, _ = fn.optimize_topology(
         1, 8, max_batch_size=16, atomic_bsz_range=(1, 4),
         accumulation=True, max_seq_shards=8,
     )
@@ -221,30 +221,32 @@ def test_topology_search_prefers_seq_shards_for_long_context():
 
 def test_topology_respects_shard_limits():
     fn = GoodputFunction(PERF_SP, GRAD_LONGCTX, 8)
-    *_, sp, tp, ss = fn.optimize_topology(
+    _, _, _, sp, tp, ss, ep, _ = fn.optimize_topology(
         1, 8, max_batch_size=16, atomic_bsz_range=(1, 4),
         accumulation=True, max_seq_shards=2, max_model_shards=1,
     )
-    assert sp <= 2 and tp == 1 and ss == 1
+    assert sp <= 2 and tp == 1 and ss == 1 and ep == 1
 
 
 def test_topology_vectorized_matches_scalar():
     fn = GoodputFunction(PERF_SP, GRAD_LONGCTX, 8)
     nodes = np.array([1, 1, 2])
     chips = np.array([4, 8, 16])
-    gv, bv, av, sv, tv, ssv = fn.optimize_topology(
+    gv, bv, av, sv, tv, ssv, epv, mv = fn.optimize_topology(
         nodes, chips, max_batch_size=64, atomic_bsz_range=(1, 8),
         accumulation=True, max_seq_shards=4, max_model_shards=2,
         max_stage_shards=2,
     )
     for i in range(len(nodes)):
-        g, b, a, s, t, stg = fn.optimize_topology(
+        g, b, a, s, t, stg, e, m = fn.optimize_topology(
             int(nodes[i]), int(chips[i]), max_batch_size=64,
             atomic_bsz_range=(1, 8), accumulation=True,
             max_seq_shards=4, max_model_shards=2, max_stage_shards=2,
         )
         assert g == pytest.approx(gv[i])
-        assert (b, a, s, t, stg) == (bv[i], av[i], sv[i], tv[i], ssv[i])
+        assert (b, a, s, t, stg, e, m) == (
+            bv[i], av[i], sv[i], tv[i], ssv[i], epv[i], mv[i]
+        )
 
 
 def test_fit_recovers_ring_terms():
@@ -303,9 +305,9 @@ def test_topology_search_picks_pipeline_when_allreduce_dominates():
         1, 8, max_batch_size=16, atomic_bsz_range=(1, 4),
         accumulation=True,
     )
-    g, bsz, acc, sp, tp, ss = fn.optimize_topology(
+    g, bsz, acc, sp, tp, ss, ep, micro = fn.optimize_topology(
         1, 8, max_batch_size=16, atomic_bsz_range=(1, 4),
-        accumulation=True, max_stage_shards=4, pipeline_micro=4,
+        accumulation=True, max_stage_shards=4, max_pipeline_micro=4,
     )
     assert ss > 1, (sp, tp, ss)
     assert g > pure_dp
@@ -341,3 +343,127 @@ def test_fit_pins_pipeline_hop_prior_when_unobserved():
     fitted = fit_perf_params(nodes, replicas, bsz, t_acc, t_opt)
     assert fitted.alpha_pp >= fitted.alpha_r - 1e-12
     assert fitted.alpha_pp > 0
+    # Expert all_to_all terms get the same ICI prior when unobserved.
+    assert fitted.alpha_ep >= fitted.alpha_r - 1e-12
+    assert fitted.alpha_ep > 0
+
+
+# ---- pipeline microbatch (M) search -------------------------------------
+
+
+def test_topology_search_raises_micro_when_bubble_dominates():
+    """With a cheap per-tick handoff, more microbatches shrink the
+    (M+S-1)/M bubble — the search must prefer a larger M than the
+    old fixed assumption; with an expensive handoff it must not."""
+    cheap_hop = PerfParams(
+        0.02, 0.01, 0.5, 0.05, 0.01, 0.1, 1.5,
+        alpha_pp=1e-5, beta_pp=1e-6,
+    )
+    fn = GoodputFunction(cheap_hop, GRAD_LONGCTX, 8)
+    *_, ss, ep, micro = fn.optimize_topology(
+        1, 8, max_batch_size=64, atomic_bsz_range=(1, 32),
+        accumulation=True, max_stage_shards=4, max_pipeline_micro=16,
+    )
+    assert ss > 1
+    assert micro > 4, micro  # bubble dominates -> deepest feasible M
+
+    pricey_hop = PerfParams(
+        0.02, 0.01, 0.5, 0.05, 0.01, 0.1, 1.5,
+        alpha_pp=0.05, beta_pp=0.0,
+    )
+    fn2 = GoodputFunction(pricey_hop, GRAD_LONGCTX, 8)
+    g1 = fn2.optimize(
+        1, 2, max_batch_size=16, atomic_bsz_range=(1, 4),
+        accumulation=True, stage_shards=4, pipeline_micro=2,
+    )[0]
+    g2 = fn2.optimize(
+        1, 2, max_batch_size=16, atomic_bsz_range=(1, 4),
+        accumulation=True, stage_shards=4, pipeline_micro=16,
+    )[0]
+    # Expensive per-tick handoff: deeper M pays alpha_pp more often.
+    assert g1 > g2
+
+
+def test_micro_clamped_to_atomic_bsz():
+    fn = GoodputFunction(PERF_SP, GRAD_LONGCTX, 8)
+    *_, ss, _ep, micro = fn.optimize_topology(
+        1, 8, max_batch_size=16, atomic_bsz_range=(1, 4),
+        accumulation=True, max_stage_shards=2, max_pipeline_micro=64,
+    )
+    if ss > 1:
+        # atomic_bsz is capped at 4 here: M can never exceed samples.
+        assert micro <= 4
+
+
+# ---- expert (MoE) factorizations ----------------------------------------
+
+
+def test_topology_search_picks_expert_parallelism():
+    """A MoE job with a tight statistical batch budget and a cheap
+    all_to_all should spend chips on the expert axis: compute divides
+    without inflating the batch."""
+    perf = PerfParams(
+        0.02, 0.01, 0.5, 0.05, 0.01, 0.1, 1.5,
+        alpha_ep=1e-4, beta_ep=1e-5,
+    )
+    fn = GoodputFunction(perf, GRAD_LONGCTX, 8)
+    pure_dp, _, _ = fn.optimize(
+        1, 8, max_batch_size=16, atomic_bsz_range=(1, 4),
+        accumulation=True,
+    )
+    g, bsz, acc, sp, tp, ss, ep, micro = fn.optimize_topology(
+        1, 8, max_batch_size=16, atomic_bsz_range=(1, 4),
+        accumulation=True, max_expert_shards=8,
+    )
+    assert ep > 1, (sp, tp, ss, ep)
+    assert g > pure_dp
+
+
+def test_expert_exchange_is_priced():
+    """Expert sharding is never free: the all_to_all term appears in
+    the accum time whenever ep > 1."""
+    perf = PerfParams(
+        0.02, 0.01, 0.5, 0.05, 0.01, 0.001, 1.5,
+        alpha_ep=0.005, beta_ep=0.001,
+    )
+    from adaptdl_tpu.goodput import _accum_time
+
+    t1 = _accum_time(np, perf, 8)
+    t2 = _accum_time(np, perf, 8, 1, 1, 1, 1, 2)
+    ideal_half = perf.alpha_c + perf.beta_c * 8 / 2
+    expected_exchange = 0.5 * (perf.alpha_ep + perf.beta_ep * 8)
+    assert t2 == pytest.approx(ideal_half + expected_exchange)
+    assert t1 == pytest.approx(perf.alpha_c + perf.beta_c * 8)
+
+
+def test_fit_recovers_expert_terms():
+    """Observations at ep>1 identify the all_to_all cost."""
+    from adaptdl_tpu.goodput import (
+        _accum_time, _log_optim_time, _network_time,
+    )
+
+    true = PerfParams(
+        0.12, 0.0057, 0.024, 0.0063, 0.012, 0.0032, 1.14,
+        alpha_ep=0.02, beta_ep=0.002,
+    )
+    rng = np.random.default_rng(5)
+    rows = []
+    for ep in (1, 2, 4):
+        for b in (32, 64, 128):
+            rows.append((1, 4, ep, b))
+    nodes = np.array([r[0] for r in rows], dtype=float)
+    replicas = np.array([r[1] for r in rows], dtype=float)
+    eps = np.array([r[2] for r in rows], dtype=float)
+    bsz = np.array([r[3] for r in rows], dtype=float)
+    t_acc = _accum_time(np, true, bsz, 1, 1, 1, 1, eps)
+    t_net = _network_time(np, true, nodes, replicas)
+    t_opt = np.exp(_log_optim_time(np, true, t_acc, t_net))
+    noise = rng.lognormal(0.0, 0.01, t_acc.shape)
+    fitted = fit_perf_params(
+        nodes, replicas, bsz, t_acc * noise, t_opt * noise,
+        expert_shards=eps,
+    )
+    for ep, b in [(2, 64), (4, 128), (8, 64)]:
+        pred = _accum_time(np, fitted, b, 1, 1, 1, 1, ep)
+        want = _accum_time(np, true, b, 1, 1, 1, 1, ep)
+        assert pred == pytest.approx(want, rel=0.2), (ep, b)
