@@ -1,13 +1,25 @@
 """Switch-style mixture-of-experts FFN with expert parallelism.
 
-Experts shard over an ``"expert"`` mesh axis (one expert per device in
-the simplest layout): within a replica group, each device owns an
-equal slice of the replica's tokens, routes them top-1 with a shared
-(replicated) router, exchanges token blocks with the devices that own
-the chosen experts via ``lax.all_to_all`` (the GShard dispatch), runs
-its expert's FFN on what arrives, and sends results back. Capacity is
+Experts shard over an ``"expert"`` mesh axis (``E_total / ep`` experts
+per device): within a replica group, each device owns an equal slice
+of the replica's tokens, routes them top-k with a shared (replicated)
+router, exchanges token blocks with the devices that own the chosen
+experts via ``lax.all_to_all`` (the GShard dispatch), runs its
+experts' FFNs on what arrives, and sends results back. Capacity is
 enforced per (source device, expert): overflow tokens pass through
 unchanged (the standard Switch residual behavior).
+
+Routing:
+
+- top-1 (Switch) by default: each token goes to its argmax expert at
+  the raw router probability.
+- ``top_k=2`` (GShard): the two highest-probability experts, gates
+  renormalized over the chosen two.
+- The Switch **load-balancing auxiliary loss** ``E * sum_e f_e * P_e``
+  (f_e = fraction of tokens whose first choice is expert e, P_e = mean
+  router probability of e) is returned alongside the output when
+  ``return_aux=True`` — without it, real training collapses the router
+  onto one expert.
 
 The reference has no expert (or any non-data) parallelism
 (SURVEY.md §2.7) — like ring attention and the GPipe stage axis, this
@@ -33,28 +45,61 @@ from adaptdl_tpu.parallel.mesh import EXPERT_AXIS
 from adaptdl_tpu.parallel.mesh import stack_params as stack_expert_params  # noqa: E402,F401
 
 
-def _routing(x_local, router, num_experts, capacity):
-    """Top-1 dispatch/combine tensors for one device's token slice.
+def _routing(x_local, router, num_experts, capacity, top_k=1):
+    """Top-k dispatch/combine tensors for one device's token slice.
 
-    Returns (dispatch [s, E, C], combine [s, E, C], gate [s]).
+    Returns (dispatch [s, E, C], combine [s, E, C], aux scalar). The
+    aux term is the Switch load-balancing loss over THIS slice; its
+    minimum (1.0) is achieved by a uniform router.
     """
-    logits = x_local @ router  # [s, E]
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert = jnp.argmax(probs, axis=-1)  # [s]
-    gate = jnp.max(probs, axis=-1)  # [s]
-    onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.float32)
-    # Position of each token in its expert's queue (per source device).
-    position = jnp.einsum(
-        "se,se->s", jnp.cumsum(onehot, axis=0) - 1.0, onehot
+    logits = x_local.astype(jnp.float32) @ router.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)  # [s, E]
+
+    dispatches, gates = [], []
+    counts = jnp.zeros((num_experts,), jnp.float32)  # queued per expert
+    remaining = probs
+    first_choice = None
+    for _ in range(top_k):
+        expert = jnp.argmax(remaining, axis=-1)  # [s]
+        if first_choice is None:
+            first_choice = expert
+        gate = jnp.max(remaining, axis=-1)
+        onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.float32)
+        # Position of each token in its expert's queue (per source
+        # device), offset by tokens queued in earlier choices.
+        position = (
+            jnp.einsum("se,se->s", jnp.cumsum(onehot, axis=0) - 1.0, onehot)
+            + onehot @ counts
+        )
+        counts = counts + onehot.sum(axis=0)
+        keep = position < capacity
+        dispatches.append(
+            onehot[:, :, None]
+            * jax.nn.one_hot(position.astype(jnp.int32), capacity)[:, None, :]
+            * keep[:, None, None]
+        )
+        gates.append(gate)
+        remaining = remaining * (1.0 - onehot)
+
+    if top_k > 1:
+        # GShard: gates renormalized over the chosen k.
+        denom = sum(gates) + 1e-9
+        combine = sum(
+            d * (g / denom)[:, None, None]
+            for d, g in zip(dispatches, gates)
+        )
+    else:
+        combine = dispatches[0] * gates[0][:, None, None]
+    dispatch = sum(dispatches)
+
+    # Switch aux loss: E * sum_e f_e * P_e over this slice.
+    f = jnp.mean(
+        jax.nn.one_hot(first_choice, num_experts, dtype=jnp.float32),
+        axis=0,
     )
-    keep = position < capacity
-    dispatch = (
-        onehot[:, :, None]
-        * jax.nn.one_hot(position.astype(jnp.int32), capacity)[:, None, :]
-        * keep[:, None, None]
-    )
-    combine = dispatch * gate[:, None, None]
-    return dispatch, combine, gate
+    p = jnp.mean(probs, axis=0)
+    aux = num_experts * jnp.sum(f * p)
+    return dispatch, combine, aux
 
 
 def switch_moe(
@@ -63,55 +108,74 @@ def switch_moe(
     axis_name: str = EXPERT_AXIS,
     capacity_factor: float = 2.0,
     activation: Callable = jax.nn.gelu,
-) -> jnp.ndarray:
-    """Expert-parallel Switch FFN inside a shard_map manual over
-    ``axis_name``.
+    top_k: int = 1,
+    return_aux: bool = False,
+):
+    """Expert-parallel Switch/GShard FFN inside a shard_map manual
+    over ``axis_name``.
 
     Args:
-      params: ``{"router": [d, E] (replicated), "w_up": [1, d, f],
-        "w_down": [1, f, d]}`` — the FFN leaves are THIS device's
-        slice of the expert-stacked tree (leading axis 1).
+      params: ``{"router": [d, E_total] (replicated), "w_up":
+        [k, d, f], "w_down": [k, f, d]}`` — the FFN leaves are THIS
+        device's slice of the expert-stacked tree (``k = E_total /
+        axis_size`` experts per device; expert ``e`` lives on device
+        ``e // k`` at local index ``e % k``).
       x: the replica group's batch ``[n, d]``, identical on every
         device of the group; ``n`` must divide by the axis size. Each
         device processes the slice it owns and the result is
         re-assembled, so the return value is the full ``[n, d]``
         MoE output (identical across the group).
+      return_aux: also return the load-balancing auxiliary loss
+        (pmean'd over the group — a replicated scalar).
     """
     my_rank = lax.axis_index(axis_name)
-    num_experts = lax.axis_size(axis_name)
-    n, dim = x.shape
-    assert n % num_experts == 0, (
-        f"batch {n} must divide across {num_experts} expert devices"
+    num_devices = lax.axis_size(axis_name)
+    local_e = params["w_up"].shape[0]
+    num_experts = num_devices * local_e
+    assert params["router"].shape[-1] == num_experts, (
+        f"router has {params['router'].shape[-1]} experts but the "
+        f"sharded tree implies {num_experts}"
     )
-    slice_len = n // num_experts
+    n, dim = x.shape
+    assert n % num_devices == 0, (
+        f"batch {n} must divide across {num_devices} expert devices"
+    )
+    slice_len = n // num_devices
     capacity = max(
-        int(capacity_factor * slice_len / num_experts), 1
+        int(capacity_factor * top_k * slice_len / num_experts), 1
     )
 
     x_local = lax.dynamic_slice_in_dim(
         x, my_rank * slice_len, slice_len, axis=0
     )  # [s, d]
-    dispatch, combine, _ = _routing(
-        x_local, params["router"], num_experts, capacity
+    dispatch, combine, aux = _routing(
+        x_local, params["router"], num_experts, capacity, top_k
     )
-    # [E, C, d]: this device's tokens, binned by destination expert.
-    sent = jnp.einsum("sec,sd->ecd", dispatch, x_local)
-    # Exchange: row e goes to the device owning expert e; afterwards
-    # dim 0 indexes the SOURCE device of each [C, d] block.
+    # [E, C, d]: this device's tokens, binned by destination expert,
+    # then grouped by destination DEVICE for the exchange.
+    sent = jnp.einsum(
+        "sec,sd->ecd", dispatch, x_local.astype(jnp.float32)
+    )
+    sent = sent.reshape(num_devices, local_e, capacity, dim)
+    # Exchange: block g goes to device g; afterwards dim 0 indexes the
+    # SOURCE device of each [local_e, C, d] block.
     recv = lax.all_to_all(
         sent, axis_name, split_axis=0, concat_axis=0, tiled=True
     )
-    # This device's expert, applied to everything that arrived.
+    # This device's experts, applied to everything that arrived.
     hidden = activation(
-        jnp.einsum("ecd,df->ecf", recv, params["w_up"][0])
+        jnp.einsum(
+            "gkcd,kdf->gkcf", recv, params["w_up"].astype(jnp.float32)
+        )
     )
     expert_out = jnp.einsum(
-        "ecf,fd->ecd", hidden, params["w_down"][0]
+        "gkcf,kfd->gkcd", hidden, params["w_down"].astype(jnp.float32)
     )
-    # Return trip: block from source device j goes back to j.
+    # Return trip: block from source device g goes back to g.
     returned = lax.all_to_all(
         expert_out, axis_name, split_axis=0, concat_axis=0, tiled=True
     )
+    returned = returned.reshape(num_experts, capacity, dim)
     out_local = jnp.einsum("sec,ecd->sd", combine, returned)
     # Overflow/unrouted tokens pass through (combine rows are zero).
     routed = jnp.einsum("sec->s", combine) > 0
@@ -126,34 +190,40 @@ def switch_moe(
     full = lax.dynamic_update_slice_in_dim(
         full, out_local, my_rank * slice_len, axis=0
     )
-    return lax.psum(full, axis_name).astype(x.dtype)
+    out = lax.psum(full, axis_name).astype(x.dtype)
+    if return_aux:
+        return out, lax.pmean(aux, axis_name)
+    return out
 
 
 def dense_switch_moe(
     router, expert_params_stacked, x, num_slices, capacity_factor=2.0,
     activation: Callable = jax.nn.gelu,
+    top_k: int = 1,
+    return_aux: bool = False,
 ):
     """Single-device reference with IDENTICAL routing math (same
-    per-slice capacity binning) — the equivalence target for tests."""
+    per-slice capacity binning) — the equivalence target for tests and
+    the compute path when no expert mesh axis exists."""
     n, dim = x.shape
     num_experts = expert_params_stacked["w_up"].shape[0]
     slice_len = n // num_slices
-    capacity = max(int(capacity_factor * slice_len / num_experts), 1)
-    outs = []
+    capacity = max(
+        int(capacity_factor * top_k * slice_len / num_experts), 1
+    )
+    outs, auxes = [], []
+    w_up = expert_params_stacked["w_up"].astype(jnp.float32)
+    w_down = expert_params_stacked["w_down"].astype(jnp.float32)
     for s in range(num_slices):
         x_local = x[s * slice_len : (s + 1) * slice_len]
-        dispatch, combine, _ = _routing(
-            x_local, router, num_experts, capacity
+        dispatch, combine, aux = _routing(
+            x_local, router, num_experts, capacity, top_k
         )
-        sent = jnp.einsum("sec,sd->ecd", dispatch, x_local)
-        hidden = activation(
-            jnp.einsum(
-                "ecd,edf->ecf", sent, expert_params_stacked["w_up"]
-            )
+        sent = jnp.einsum(
+            "sec,sd->ecd", dispatch, x_local.astype(jnp.float32)
         )
-        expert_out = jnp.einsum(
-            "ecf,efd->ecd", hidden, expert_params_stacked["w_down"]
-        )
+        hidden = activation(jnp.einsum("ecd,edf->ecf", sent, w_up))
+        expert_out = jnp.einsum("ecf,efd->ecd", hidden, w_down)
         out_local = jnp.einsum("sec,ecd->sd", combine, expert_out)
         routed = jnp.einsum("sec->s", combine) > 0
         outs.append(
@@ -161,4 +231,8 @@ def dense_switch_moe(
                 routed[:, None], out_local, x_local.astype(out_local.dtype)
             )
         )
-    return jnp.concatenate(outs, axis=0).astype(x.dtype)
+        auxes.append(aux)
+    out = jnp.concatenate(outs, axis=0).astype(x.dtype)
+    if return_aux:
+        return out, jnp.mean(jnp.stack(auxes))
+    return out

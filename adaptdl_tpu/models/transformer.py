@@ -49,6 +49,25 @@ class TransformerConfig:
     # attention — the MLM families (reference: examples/BERT/) — for
     # both the plain and the ring attention paths.
     causal: bool = True
+    # Mixture-of-experts: every ``moe_every_n``-th block (1-indexed;
+    # 0 disables) replaces its dense FFN with a Switch/GShard MoE of
+    # ``moe_num_experts`` experts. With ``moe_axis`` set the experts
+    # shard over that mesh axis (all_to_all dispatch inside the
+    # trainer's shard_map); otherwise they run densely on-device.
+    # The load-balancing auxiliary loss is sown into the
+    # "moe_losses" collection — lm_loss_fn/mlm_loss_fn add it with
+    # weight ``moe_aux_weight`` (without it the router collapses onto
+    # one expert).
+    moe_every_n: int = 0
+    moe_num_experts: int = 0
+    moe_axis: str | None = None
+    moe_capacity_factor: float = 2.0
+    moe_top_k: int = 1
+    moe_aux_weight: float = 1e-2
+    # Test/equivalence knob: the dense (moe_axis=None) path bins
+    # token slices as if the batch were split across this many
+    # devices, matching an expert-parallel run's per-device capacity.
+    moe_dense_slices: int = 1
 
 
 def rope(x: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
@@ -130,8 +149,79 @@ class Attention(nn.Module):
         )(out)
 
 
+class MoEFFN(nn.Module):
+    """Switch/GShard FFN: expert-stacked parameters (leading axis =
+    experts) so the trainer shards them ``P("expert")``; under the
+    trainer's manual shard_map each device sees its local slice and
+    ``switch_moe`` exchanges tokens with all_to_all. The aux
+    load-balancing loss is sown into the "moe_losses" collection."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        from adaptdl_tpu.models.moe import dense_switch_moe, switch_moe
+
+        cfg = self.config
+        num_experts = cfg.moe_num_experts
+        router = self.param(
+            "router",
+            nn.initializers.normal(0.02),
+            (cfg.d_model, num_experts),
+            jnp.float32,
+        )
+        # Expert-stacked leaves: full [E, d, f] at init (moe_axis is
+        # None there — init_transformer strips it); inside the
+        # trainer's shard_map this module sees the device's local
+        # [E/ep, d, f] slice, so declare THAT shape (flax validates
+        # declared vs received shapes at apply time).
+        local_experts = num_experts
+        if cfg.moe_axis is not None:
+            ep = jax.lax.axis_size(cfg.moe_axis)
+            assert num_experts % ep == 0, (
+                f"{num_experts} experts cannot shard over {ep} devices"
+                " (each shard owns a whole number of experts)"
+            )
+            local_experts = num_experts // ep
+        w_up = self.param(
+            "w_up",
+            nn.initializers.variance_scaling(1.0, "fan_in", "normal"),
+            (local_experts, cfg.d_model, cfg.d_ff),
+            jnp.float32,
+        )
+        w_down = self.param(
+            "w_down",
+            nn.initializers.variance_scaling(1.0, "fan_in", "normal"),
+            (local_experts, cfg.d_ff, cfg.d_model),
+            jnp.float32,
+        )
+        flat = x.reshape(-1, cfg.d_model)
+        if cfg.moe_axis is not None:
+            out, aux = switch_moe(
+                {"router": router, "w_up": w_up, "w_down": w_down},
+                flat,
+                axis_name=cfg.moe_axis,
+                capacity_factor=cfg.moe_capacity_factor,
+                top_k=cfg.moe_top_k,
+                return_aux=True,
+            )
+        else:
+            out, aux = dense_switch_moe(
+                router,
+                {"w_up": w_up, "w_down": w_down},
+                flat,
+                num_slices=cfg.moe_dense_slices,
+                capacity_factor=cfg.moe_capacity_factor,
+                top_k=cfg.moe_top_k,
+                return_aux=True,
+            )
+        self.sow("moe_losses", "aux", aux)
+        return out.reshape(x.shape).astype(cfg.dtype)
+
+
 class Block(nn.Module):
     config: TransformerConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, positions, dropout_rng=None):
@@ -144,13 +234,17 @@ class Block(nn.Module):
             )
         x = x + y
         y = nn.LayerNorm(dtype=cfg.dtype, use_bias=False)(x)
-        y = nn.Dense(
-            cfg.d_ff, dtype=cfg.dtype, use_bias=False, name="ff_up"
-        )(y)
-        y = nn.gelu(y)
-        y = nn.Dense(
-            cfg.d_model, dtype=cfg.dtype, use_bias=False, name="ff_down"
-        )(y)
+        if self.use_moe:
+            y = MoEFFN(cfg, name="moe")(y)
+        else:
+            y = nn.Dense(
+                cfg.d_ff, dtype=cfg.dtype, use_bias=False, name="ff_up"
+            )(y)
+            y = nn.gelu(y)
+            y = nn.Dense(
+                cfg.d_model, dtype=cfg.dtype, use_bias=False,
+                name="ff_down",
+            )(y)
         return x + y
 
 
@@ -185,7 +279,12 @@ class TransformerLM(nn.Module):
                 if (train and rng is not None and cfg.dropout_rate > 0)
                 else None
             )
-            x = block_cls(cfg, name=f"layer_{layer}")(
+            use_moe = (
+                cfg.moe_every_n > 0
+                and cfg.moe_num_experts > 0
+                and (layer + 1) % cfg.moe_every_n == 0
+            )
+            x = block_cls(cfg, use_moe=use_moe, name=f"layer_{layer}")(
                 x, positions, dropout_rng
             )
         x = nn.LayerNorm(dtype=cfg.dtype, use_bias=False)(x)
@@ -199,15 +298,47 @@ def init_transformer(config: TransformerConfig, rng=None, seq_len=None):
 
     model = TransformerLM(config)
     # Parameter shapes don't depend on the parallelism config, and the
-    # mapped seq axis doesn't exist outside shard_map — init unsharded.
+    # mapped seq/expert axes don't exist outside shard_map — init
+    # unsharded (expert leaves come out full-stacked [E, ...]).
     init_model = TransformerLM(
-        dataclasses.replace(config, seq_axis=None, attention_fn=None)
+        dataclasses.replace(
+            config, seq_axis=None, attention_fn=None, moe_axis=None
+        )
     )
     rng = rng if rng is not None else jax.random.key(0)
     seq_len = seq_len or min(config.max_seq_len, 128)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     params = init_model.init(rng, dummy, train=False)["params"]
     return model, params
+
+
+def apply_with_moe_aux(model: TransformerLM, params, inputs, rng):
+    """model.apply that also returns the weighted MoE load-balancing
+    aux loss (0.0 for dense models) from the "moe_losses" collection —
+    the building block for custom losses over MoE configs (the
+    lm/mlm loss factories below use it; example:
+    examples/transformer_lm.py).
+    """
+    cfg = model.config
+    if cfg.moe_every_n > 0 and cfg.moe_num_experts > 0:
+        logits, mutated = model.apply(
+            {"params": params},
+            inputs,
+            train=True,
+            rng=rng,
+            mutable=["moe_losses"],
+        )
+        auxes = jax.tree.leaves(mutated.get("moe_losses", {}))
+        aux = (
+            cfg.moe_aux_weight * sum(jnp.mean(a) for a in auxes)
+            if auxes
+            else jnp.zeros(())
+        )
+        return logits, aux
+    logits = model.apply(
+        {"params": params}, inputs, train=True, rng=rng
+    )
+    return logits, jnp.zeros(())
 
 
 def mlm_loss_fn(
@@ -225,31 +356,51 @@ def mlm_loss_fn(
         mask_rng = jax.random.fold_in(rng, 0x3A5)
         mask = jax.random.uniform(mask_rng, tokens.shape) < mask_rate
         inputs = jnp.where(mask, mask_token, tokens)
-        logits = model.apply(
-            {"params": params}, inputs, train=True, rng=rng
-        )
+        logits, aux = apply_with_moe_aux(model, params, inputs, rng)
         losses = optax.softmax_cross_entropy_with_integer_labels(
             logits, tokens
         )
         weights = mask.astype(jnp.float32)
-        return jnp.sum(losses * weights) / jnp.maximum(
-            jnp.sum(weights), 1.0
+        return (
+            jnp.sum(losses * weights)
+            / jnp.maximum(jnp.sum(weights), 1.0)
+            + aux
         )
 
     return loss_fn
 
 
 def lm_loss_fn(model: TransformerLM):
-    """Next-token cross-entropy; batch = {"tokens": [b, s+1] int32}."""
+    """Next-token cross-entropy (+ weighted MoE aux loss when the
+    config enables experts); batch = {"tokens": [b, s+1] int32}."""
 
     def loss_fn(params, batch, rng):
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits = model.apply(
-            {"params": params}, inputs, train=True, rng=rng
+        logits, aux = apply_with_moe_aux(model, params, inputs, rng)
+        return (
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            ).mean()
+            + aux
         )
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, targets
-        ).mean()
 
     return loss_fn
+
+
+def moe_param_sharding_fn(path, leaf):
+    """``param_sharding_fn`` for expert-parallel MoE transformers:
+    expert-stacked leaves (under a ``moe`` module, except the
+    replicated router) shard over the expert mesh axis; everything
+    else replicates.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from adaptdl_tpu.parallel.mesh import EXPERT_AXIS
+
+    keys = tuple(
+        str(p.key) if hasattr(p, "key") else str(p) for p in path
+    )
+    if "moe" in keys and keys[-1] in ("w_up", "w_down"):
+        return P(EXPERT_AXIS)
+    return P()

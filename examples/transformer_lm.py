@@ -34,6 +34,11 @@ def main():
     # composable with --seq-shards (ring attention owns that path).
     parser.add_argument("--flash", action="store_true")
     parser.add_argument("--seq-len", type=int, default=None)
+    # Mixture-of-experts: every 2nd block's FFN becomes a Switch/
+    # GShard MoE with this many experts; the expert axis shards over
+    # the scheduler's chosen expertShards (ADAPTDL_EXPERT_SHARDS).
+    parser.add_argument("--moe-experts", type=int, default=0)
+    parser.add_argument("--moe-top-k", type=int, default=1)
     args = parser.parse_args()
     if args.cpu:
         force_cpu_devices()
@@ -69,6 +74,9 @@ def main():
         attention_fn = make_flash_attention(
             block_q=min(128, seq_len), block_k=min(128, seq_len)
         )
+    # Expert parallelism: scheduler-chosen (ADAPTDL_EXPERT_SHARDS);
+    # only meaningful when the model actually has experts.
+    expert_shards = env.expert_shards() if args.moe_experts > 0 else 1
     config = TransformerConfig(
         vocab_size=256 if on_cpu else 32000,
         num_layers=2 if on_cpu else 12,
@@ -80,30 +88,40 @@ def main():
         remat=True,
         seq_axis="seq" if seq_shards > 1 else None,
         attention_fn=attention_fn,
+        moe_every_n=2 if args.moe_experts > 0 else 0,
+        moe_num_experts=args.moe_experts,
+        moe_axis="expert" if expert_shards > 1 else None,
+        moe_top_k=args.moe_top_k,
     )
     model, params = init_transformer(config, seq_len=seq_len)
 
-    def loss_fn(params, batch, rng):
-        logits = model.apply(
-            {"params": params}, batch["inputs"], train=True, rng=rng
-        )
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, batch["targets"]
-        ).mean()
+    from adaptdl_tpu.models.transformer import apply_with_moe_aux
 
-    # ADAPTDL_NUM_REPLICAS counts CHIPS at launch; a seq- or
-    # tensor-sharded group of chips forms one data-parallel replica,
+    def loss_fn(params, batch, rng):
+        logits, aux = apply_with_moe_aux(
+            model, params, batch["inputs"], rng
+        )
+        return (
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["targets"]
+            ).mean()
+            + aux
+        )
+
+    # ADAPTDL_NUM_REPLICAS counts CHIPS at launch; a seq-, tensor- or
+    # expert-sharded group of chips forms one data-parallel replica,
     # so rewrite it to the derived dp count (env.data_parallel_replicas
     # divides by every shard axis the scheduler assigned).
     tp_shards = (
         args.tp_shards if args.tp_shards is not None else env.model_shards()
     )
-    group = seq_shards * tp_shards
+    group = seq_shards * tp_shards * expert_shards
     if group > 1:
         import os
 
         os.environ["ADAPTDL_SEQ_SHARDS"] = str(seq_shards)
         os.environ["ADAPTDL_MODEL_SHARDS"] = str(tp_shards)
+        os.environ["ADAPTDL_EXPERT_SHARDS"] = str(expert_shards)
         data_shards = env.data_parallel_replicas()
         os.environ["ADAPTDL_NUM_REPLICAS"] = str(data_shards)
     else:
@@ -114,6 +132,8 @@ def main():
         mesh_axes["seq"] = seq_shards
     if tp_shards > 1:
         mesh_axes["model"] = tp_shards
+    if expert_shards > 1:
+        mesh_axes["expert"] = expert_shards
     mesh = create_mesh(mesh_axes, devices=jax.devices()[:num_devices])
     param_sharding_fn = None
     if tp_shards > 1:
@@ -122,6 +142,20 @@ def main():
         )
 
         param_sharding_fn = transformer_tp_specs
+    if expert_shards > 1:
+        from adaptdl_tpu.models.transformer import (
+            moe_param_sharding_fn,
+        )
+
+        tp_fn = param_sharding_fn
+
+        def param_sharding_fn(path, leaf):  # noqa: F811
+            from jax.sharding import PartitionSpec as P
+
+            spec = moe_param_sharding_fn(path, leaf)
+            if spec != P():
+                return spec
+            return tp_fn(path, leaf) if tp_fn is not None else P()
     trainer = ElasticTrainer(
         loss_fn=loss_fn,
         params=params,
@@ -168,6 +202,14 @@ def main():
         # flash kernel's q/k/v would be all-gathered and attention
         # recomputed per shard, so don't advertise TP with --flash.
         max_model_shards=1 if args.flash else min(config.num_heads, 8),
+        # Expert shards must divide the expert count (a shard owns
+        # E/ep whole experts) and the scheduler only picks powers of
+        # two — advertise the largest power of two dividing E.
+        max_expert_shards=(
+            (args.moe_experts & -args.moe_experts)
+            if args.moe_experts > 0
+            else 1
+        ),
     )
     # Optional TensorBoard export (native writer, no TF needed):
     # active when ADAPTDL_SHARE_PATH points at a log directory.

@@ -165,3 +165,164 @@ def test_trainer_dp_x_expert_trains_and_matches_dense_grads():
     assert str(ep_state.params["router"].sharding.spec) == (
         "PartitionSpec()"
     )
+
+
+def test_top2_routing_matches_dense_and_uses_two_experts():
+    rng = np.random.default_rng(3)
+    router, stacked = _params(rng)
+    x = jnp.asarray(rng.normal(size=(32, D)).astype(np.float32))
+    mesh = create_mesh({EXPERT_AXIS: E}, devices=jax.devices()[:E])
+    params = {"router": router, **stacked}
+    piped, aux = shard_map(
+        lambda p, xx: switch_moe(
+            p, xx, top_k=2, return_aux=True
+        ),
+        mesh=mesh,
+        in_specs=(
+            {
+                "router": P(),
+                "w_up": P(EXPERT_AXIS),
+                "w_down": P(EXPERT_AXIS),
+            },
+            P(),
+        ),
+        out_specs=(P(), P()),
+    )(params, x)
+    want, want_aux = dense_switch_moe(
+        router, stacked, x, num_slices=E, top_k=2, return_aux=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(piped), np.asarray(want), atol=1e-5, rtol=1e-5
+    )
+    assert float(aux) == pytest.approx(float(want_aux), rel=1e-5)
+    # top-2 output differs from top-1 (the second expert contributes).
+    top1 = dense_switch_moe(router, stacked, x, num_slices=E)
+    assert not np.allclose(np.asarray(want), np.asarray(top1))
+
+
+def test_multi_expert_per_device_matches_dense():
+    """E=4 experts over ep=2 devices (2 experts per device)."""
+    rng = np.random.default_rng(4)
+    router, stacked = _params(rng)
+    x = jnp.asarray(rng.normal(size=(32, D)).astype(np.float32))
+    mesh = create_mesh({EXPERT_AXIS: 2}, devices=jax.devices()[:2])
+    params = {"router": router, **stacked}
+    piped = shard_map(
+        lambda p, xx: switch_moe(p, xx),
+        mesh=mesh,
+        in_specs=(
+            {
+                "router": P(),
+                "w_up": P(EXPERT_AXIS),
+                "w_down": P(EXPERT_AXIS),
+            },
+            P(),
+        ),
+        out_specs=P(),
+    )(params, x)
+    want = dense_switch_moe(router, stacked, x, num_slices=2)
+    np.testing.assert_allclose(
+        np.asarray(piped), np.asarray(want), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_aux_loss_balances_uniform_and_collapsed_routers():
+    """The Switch aux loss is ~1 for a uniform router and larger for a
+    collapsed one — the signal that keeps experts alive."""
+    rng = np.random.default_rng(5)
+    # Positive inputs so a dominant router column wins for EVERY token.
+    x = jnp.asarray(
+        np.abs(rng.normal(size=(64, D))).astype(np.float32)
+    )
+    stacked = _params(rng)[1]
+    uniform_router = jnp.zeros((D, E), jnp.float32)
+    _, aux_uniform = dense_switch_moe(
+        uniform_router, stacked, x, num_slices=1, return_aux=True
+    )
+    collapsed_router = (
+        jnp.zeros((D, E), jnp.float32).at[:, 0].set(50.0)
+    )
+    _, aux_collapsed = dense_switch_moe(
+        collapsed_router, stacked, x, num_slices=1, return_aux=True
+    )
+    # Collapse: f_0 = P_0 = 1 -> aux = E; uniform: f·P = 1/E each -> 1.
+    assert float(aux_collapsed) == pytest.approx(E, rel=1e-3)
+    assert float(aux_uniform) == pytest.approx(1.0, rel=1e-3)
+
+
+def test_moe_transformer_expert_parallel_matches_dense():
+    """A MoE *transformer* (every 2nd block Switch-MoE) trains under
+    dp x expert with the same loss as the dense-equivalent model —
+    the VERDICT r2 'dryrun a MoE transformer' integration, test-sized.
+    """
+    import dataclasses
+
+    import optax
+
+    from adaptdl_tpu.models.transformer import (
+        TransformerConfig,
+        init_transformer,
+        lm_loss_fn,
+        moe_param_sharding_fn,
+    )
+    from adaptdl_tpu.trainer import ElasticTrainer
+
+    cfg = TransformerConfig(
+        vocab_size=64,
+        num_layers=2,
+        num_heads=2,
+        d_model=16,
+        d_ff=32,
+        max_seq_len=16,
+        dtype=jnp.float32,
+        remat=False,
+        moe_every_n=2,
+        moe_num_experts=2,
+        moe_axis=EXPERT_AXIS,
+        moe_dense_slices=2,
+    )
+    model, params = init_transformer(cfg, seq_len=16)
+    assert "moe" in params["layer_1"], list(params["layer_1"])
+    rng = np.random.default_rng(6)
+    tokens = rng.integers(0, 64, size=(32, 17)).astype(np.int32)
+
+    ep_trainer = ElasticTrainer(
+        lm_loss_fn(model),
+        params,
+        optax.sgd(0.1),
+        8,
+        mesh=create_mesh(
+            {"data": 2, EXPERT_AXIS: 2}, devices=jax.devices()[:4]
+        ),
+        param_sharding_fn=moe_param_sharding_fn,
+    )
+    ep_state = ep_trainer.init_state()
+    ep_step = ep_trainer.train_step(4, 0)
+
+    dense_model = type(model)(
+        dataclasses.replace(cfg, moe_axis=None)
+    )
+    dp_trainer = ElasticTrainer(
+        lm_loss_fn(dense_model),
+        params,
+        optax.sgd(0.1),
+        8,
+        mesh=create_mesh({"data": 2}, devices=jax.devices()[:2]),
+    )
+    dp_state = dp_trainer.init_state()
+    dp_step = dp_trainer.train_step(4, 0)
+
+    losses = []
+    for step_idx in range(3):
+        batch = {"tokens": tokens[rng.integers(0, 32, size=8)]}
+        ep_state, ep_m = ep_step(ep_state, ep_trainer.shard_batch(batch))
+        dp_state, dp_m = dp_step(dp_state, dp_trainer.shard_batch(batch))
+        assert float(ep_m["loss"]) == pytest.approx(
+            float(dp_m["loss"]), rel=1e-4
+        ), step_idx
+        losses.append(float(ep_m["loss"]))
+    # Expert weights sharded, router replicated, and training moves.
+    moe_params = ep_state.params["layer_1"]["moe"]
+    assert "expert" in str(moe_params["w_up"].sharding.spec)
+    assert str(moe_params["router"].sharding.spec) == "PartitionSpec()"
+    assert losses[-1] < losses[0]
