@@ -8,16 +8,25 @@ keeps the same verb surface with two backends:
 - **local** (default, fully functional): jobs run under the
   :class:`~adaptdl_tpu.sched.local_runner.LocalElasticRunner` on this
   machine's chips; job state is queried from the runner's supervisor.
-- **k8s** (rendering): ``submit --backend k8s`` emits an AdaptDLJob
-  manifest for the GKE operator (see adaptdl_tpu/sched/k8s/) and
-  applies it with kubectl when available — no in-cluster docker
-  registry dance; images come from Artifact Registry.
+- **k8s**: ``submit --backend k8s`` emits an AdaptDLJob manifest for
+  the GKE operator (see adaptdl_tpu/sched/k8s/) and applies it with
+  kubectl when available — no in-cluster docker registry dance;
+  images come from Artifact Registry. The data-plane verbs ride
+  kubectl too: ``logs JOB`` streams every pod of a job by the
+  operator's label selector, ``cp ns/job:path dst`` extracts files
+  from the checkpoint PVC through a short-lived helper pod, and
+  ``tensorboard attach`` port-forwards a managed instance locally
+  (reference: cli/bin/adaptdl:234-318, cli/adaptdl_cli/
+  tensorboard.py:24-120).
 
 Usage:
     adaptdl-tpu submit train.py --checkpoint-dir /ckpt [--chips N]
     adaptdl-tpu ls --supervisor http://HOST:PORT
-    adaptdl-tpu logs --log-file /ckpt/job.log
+    adaptdl-tpu logs default/my-job -f        # cluster pods
+    adaptdl-tpu logs --log-file /ckpt/job.log # local file
+    adaptdl-tpu cp default/my-job:checkpoint-3.0 ./out   # from PVC
     adaptdl-tpu cp /ckpt/checkpoint-3.0/model ./model.bin
+    adaptdl-tpu tensorboard attach --name exp1 --port 6006
     adaptdl-tpu tensorboard --logdir /shared
 """
 
@@ -104,7 +113,55 @@ def _cmd_hints(args) -> int:
     return 0
 
 
+def _split_job(job: str, default_namespace: str) -> tuple[str, str]:
+    """'namespace/name' or bare 'name' -> (namespace, name)."""
+    if "/" in job:
+        namespace, name = job.split("/", 1)
+        return namespace, name
+    return default_namespace, job
+
+
+def _require_kubectl() -> bool:
+    if shutil.which("kubectl") is None:
+        print("kubectl is not installed", file=sys.stderr)
+        return False
+    return True
+
+
 def _cmd_logs(args) -> int:
+    if args.job:
+        # Cluster data path: stream every pod of the job by the
+        # operator's label selector (reference: cli/bin/adaptdl:306-318
+        # drives `kubectl logs -l` the same way).
+        namespace, name = _split_job(args.job, args.namespace)
+        cmd = [
+            "kubectl",
+            "logs",
+            "-n",
+            namespace,
+            "-l",
+            f"adaptdl/job={name}",
+            "--all-containers",
+            "--prefix",
+            "--tail",
+            str(args.lines),
+            # kubectl caps selector follows at 5 streams by default;
+            # elastic jobs routinely run more pods than that.
+            "--max-log-requests",
+            "64",
+        ]
+        if args.follow:
+            cmd.append("-f")
+        if not _require_kubectl():
+            return 1
+        return subprocess.call(cmd)
+    if not args.log_file:
+        print(
+            "either a JOB (k8s backend) or --log-file (local backend) "
+            "is required",
+            file=sys.stderr,
+        )
+        return 2
     cmd = ["tail"]
     if args.follow:
         cmd.append("-f")
@@ -115,6 +172,75 @@ def _cmd_logs(args) -> int:
 def _cmd_cp(args) -> int:
     import os
 
+    if ":" in args.src:
+        # Cluster data path: '<namespace>/<job>:<path>' extracts from
+        # the job's checkpoint PVC via a short-lived helper pod
+        # (reference: cli/bin/adaptdl:234-303 + pvc.py:81-128). The
+        # path is relative to the job's checkpoint dir
+        # (/adaptdl/checkpoints/<ns>-<name>, the mount the job
+        # manifest sets up) unless absolute.
+        job, _, path = args.src.partition(":")
+        namespace, name = _split_job(job, args.namespace)
+        if not _require_kubectl():
+            return 1
+        from adaptdl_tpu.sched.k8s import render_copy_pod_manifest
+
+        # Unique per invocation: concurrent cp runs against the same
+        # job must not share (and tear down) one helper pod.
+        import uuid
+
+        suffix = uuid.uuid4().hex[:6]
+        helper = f"adaptdl-cp-{name}"[:56] + f"-{suffix}"
+        manifest = render_copy_pod_manifest(
+            helper,
+            checkpoint_claim=args.checkpoint_claim,
+            namespace=namespace,
+        )
+        if not path.startswith("/"):
+            path = f"/adaptdl/checkpoints/{namespace}-{name}/{path}"
+        apply = subprocess.run(
+            ["kubectl", "apply", "-n", namespace, "-f", "-"],
+            input=manifest.encode(),
+        )
+        if apply.returncode != 0:
+            return apply.returncode
+        try:
+            wait = subprocess.run(
+                [
+                    "kubectl",
+                    "wait",
+                    "-n",
+                    namespace,
+                    "--for=condition=Ready",
+                    f"pod/{helper}",
+                    "--timeout=120s",
+                ]
+            )
+            if wait.returncode != 0:
+                return wait.returncode
+            return subprocess.call(
+                [
+                    "kubectl",
+                    "cp",
+                    f"{namespace}/{helper}:{path}",
+                    args.dst,
+                ]
+            )
+        finally:
+            # --wait=false: the pod traps TERM, but the CLI need not
+            # block on kubelet teardown either way.
+            subprocess.call(
+                [
+                    "kubectl",
+                    "delete",
+                    "pod",
+                    "-n",
+                    namespace,
+                    helper,
+                    "--ignore-not-found",
+                    "--wait=false",
+                ]
+            )
     if os.path.isdir(args.src):
         # Whole checkpoint dirs are the common case (the reference's
         # cp pulls them off the PVC via a helper pod, pvc.py:81-128;
@@ -150,6 +276,32 @@ def _cmd_deploy(args) -> int:
 
 
 def _cmd_tensorboard(args) -> int:
+    if args.action == "attach":
+        # Proxy a managed in-cluster instance to a local port
+        # (reference: cli/adaptdl_cli/tensorboard.py:24-120 +
+        # proxy.py:29-119 tunnel through the apiserver; port-forward
+        # is the kubectl-native equivalent).
+        name = args.name or "default"
+        if not _require_kubectl():
+            return 1
+        # The service's port is whatever `create --port` set; default
+        # to the local --port so `create --port 7007` + `attach --port
+        # 7007` just works, with --remote-port for asymmetric setups.
+        remote = (
+            args.remote_port
+            if args.remote_port is not None
+            else args.port
+        )
+        return subprocess.call(
+            [
+                "kubectl",
+                "port-forward",
+                "-n",
+                args.namespace,
+                f"service/adaptdl-tb-{name}",
+                f"{args.port}:{remote}",
+            ]
+        )
     if args.backend == "k8s":
         from adaptdl_tpu.sched.k8s import render_tensorboard_manifest
 
@@ -229,24 +381,40 @@ def main(argv=None) -> int:
     p.add_argument("--supervisor", required=True)
     p.set_defaults(fn=_cmd_hints)
 
-    p = sub.add_parser("logs", help="tail a local job's log file")
-    p.add_argument("--log-file", required=True)
+    p = sub.add_parser(
+        "logs",
+        help="stream a cluster job's pod logs by label selector "
+        "(JOB), or tail a local job's log file (--log-file)",
+    )
+    p.add_argument(
+        "job", nargs="?", default=None, help="namespace/name or name"
+    )
+    p.add_argument("--log-file")
+    p.add_argument("--namespace", default="default")
     p.add_argument("-f", "--follow", action="store_true")
     p.add_argument("-n", "--lines", type=int, default=50)
     p.set_defaults(fn=_cmd_logs)
 
-    p = sub.add_parser("cp", help="copy a file out of a checkpoint dir")
+    p = sub.add_parser(
+        "cp",
+        help="copy files out of a job's checkpoint storage: local "
+        "paths, or 'namespace/job:path' to extract from the cluster "
+        "PVC via a helper pod",
+    )
     p.add_argument("src")
     p.add_argument("dst")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--checkpoint-claim", default="adaptdl-checkpoints")
     p.set_defaults(fn=_cmd_cp)
 
     p = sub.add_parser(
         "tensorboard",
-        help="launch tensorboard locally, or manage an in-cluster "
-        "instance (--backend k8s create/delete)",
+        help="launch tensorboard locally, manage an in-cluster "
+        "instance (--backend k8s create/delete), or attach to one "
+        "(attach port-forwards it locally)",
     )
     p.add_argument("action", nargs="?", default="create",
-                   choices=("create", "delete"))
+                   choices=("create", "delete", "attach"))
     p.add_argument("--backend", choices=("local", "k8s"),
                    default="local")
     p.add_argument("--name")
@@ -254,6 +422,13 @@ def main(argv=None) -> int:
     p.add_argument("--logdir-claim", default="adaptdl-checkpoints")
     p.add_argument("--namespace", default="default")
     p.add_argument("--port", type=int, default=6006)
+    p.add_argument(
+        "--remote-port",
+        type=int,
+        default=None,
+        help="service port of the in-cluster instance (attach); "
+        "defaults to --port",
+    )
     p.add_argument("--dry-run", action="store_true")
     p.set_defaults(fn=_cmd_tensorboard)
 

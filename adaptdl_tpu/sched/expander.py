@@ -78,7 +78,7 @@ class InMemorySliceProvisioner:
         )
 
 
-class GKENodePoolProvisioner:  # pragma: no cover - needs Cloud API
+class GKENodePoolProvisioner:
     """Actuating provisioner: resizes a GKE TPU node pool through the
     Cluster Manager API — the TPU-native replacement for the
     reference's placeholder-pod dance (one anti-affinity busybox pod
@@ -87,7 +87,9 @@ class GKENodePoolProvisioner:  # pragma: no cover - needs Cloud API
     resize directly, so no placeholder machinery is needed.
 
     ``nodes_per_slice`` maps slice counts to node counts (a multi-host
-    slice is several k8s nodes in one pool).
+    slice is several k8s nodes in one pool). ``client`` injects a
+    Cluster Manager client (tests use a fake; production constructs
+    the real one, which needs google-cloud-container in the image).
     """
 
     def __init__(
@@ -97,15 +99,18 @@ class GKENodePoolProvisioner:  # pragma: no cover - needs Cloud API
         cluster: str,
         node_pool: str,
         nodes_per_slice: int = 1,
+        client=None,
     ):
-        try:
-            from google.cloud import container_v1
-        except ImportError as exc:
-            raise RuntimeError(
-                "GKENodePoolProvisioner requires google-cloud-container "
-                "in the scheduler image"
-            ) from exc
-        self._client = container_v1.ClusterManagerClient()
+        if client is None:  # pragma: no cover - needs Cloud API
+            try:
+                from google.cloud import container_v1
+            except ImportError as exc:
+                raise RuntimeError(
+                    "GKENodePoolProvisioner requires "
+                    "google-cloud-container in the scheduler image"
+                ) from exc
+            client = container_v1.ClusterManagerClient()
+        self._client = client
         self._name = (
             f"projects/{project}/locations/{location}/clusters/"
             f"{cluster}/nodePools/{node_pool}"
@@ -115,6 +120,10 @@ class GKENodePoolProvisioner:  # pragma: no cover - needs Cloud API
         # (initial_node_count), which goes stale the moment anything
         # else resizes the pool — so track the size this provisioner
         # last set and use the API value only before the first resize.
+        # CAVEAT: this diverges if anything else (a human, another
+        # autoscaler) resizes the pool after ours; this provisioner
+        # must be the pool's only writer
+        # (tests/test_validator_expander.py pins the divergence).
         self._last_set: int | None = None
 
     def current_slices(self) -> int:

@@ -72,7 +72,8 @@ class ThreadedHttpServer:
 
     @property
     def url(self) -> str:
-        return f"http://{self._host}:{self._port}"
+        scheme = "https" if self._ssl_context is not None else "http"
+        return f"{scheme}://{self._host}:{self._port}"
 
     def stop(self) -> None:
         if self._loop is not None:

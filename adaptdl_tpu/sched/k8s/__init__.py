@@ -381,3 +381,47 @@ spec:
           persistentVolumeClaim:
             claimName: {checkpoint_claim}
 """
+
+
+def render_copy_pod_manifest(
+    name: str,
+    checkpoint_claim: str,
+    namespace: str = "default",
+    image: str = "busybox:stable",
+    timeout_seconds: int = 600,
+) -> str:
+    """A short-lived helper pod mounting the checkpoint PVC read-only,
+    so ``adaptdl-tpu cp`` can extract files from a running (or
+    finished) job's storage with ``kubectl cp`` (reference pattern:
+    cli/adaptdl_cli/pvc.py:81-128 creates the same copy pod and the
+    CLI execs tar through it). The pod sleeps for ``timeout_seconds``
+    and then exits on its own, so a crashed CLI can never leak it
+    forever; activeDeadlineSeconds backstops the sleep."""
+    return f"""\
+apiVersion: v1
+kind: Pod
+metadata:
+  name: {name}
+  namespace: {namespace}
+  labels:
+    adaptdl/copy-pod: "true"
+spec:
+  restartPolicy: Never
+  activeDeadlineSeconds: {timeout_seconds + 60}
+  containers:
+    - name: copy
+      image: {image}
+      # Trap TERM around the sleep: a bare `sleep` as PID 1 ignores
+      # SIGTERM and every delete would stall out the full grace
+      # period before the kubelet SIGKILLs it.
+      command: ["sh", "-c",
+                "trap 'exit 0' TERM; sleep {timeout_seconds} & wait"]
+      volumeMounts:
+        - name: checkpoints
+          mountPath: /adaptdl/checkpoints
+          readOnly: true
+  volumes:
+    - name: checkpoints
+      persistentVolumeClaim:
+        claimName: {checkpoint_claim}
+"""

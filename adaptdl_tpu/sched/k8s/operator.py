@@ -314,6 +314,15 @@ class Operator:
             return
         desired = record.allocation
 
+        if not live and not desired:
+            # Allocation withdrawn to empty and every pod is gone: the
+            # job goes back to Pending until chips are re-granted
+            # (without this a zero-allocation job reports Stopping
+            # forever — no later branch fires at live == desired == []).
+            if record.status != "Pending":
+                self.state.update(key, status="Pending")
+            return
+
         def pod_group(pod):
             return int(pod.metadata.annotations.get("adaptdl/group", -1))
 
@@ -368,9 +377,24 @@ class Operator:
             return
 
         if failed:
-            LOG.warning("%s worker failures: %s", key, failed)
-            failures = record.failures + 1
-            self.state.update(key, failures=failures)
+            # Count each crashed pod once, ever: a failed pod stays
+            # visible across reconcile passes (deletion latency, a
+            # failed delete call), and one worker crash must consume
+            # one failure-budget unit, not one per pass.
+            fresh = [
+                (n, c)
+                for n, c in failed
+                if n not in record.counted_failures
+            ]
+            failures = record.failures + len(fresh)
+            if fresh:
+                LOG.warning("%s worker failures: %s", key, fresh)
+                self.state.update(
+                    key,
+                    failures=failures,
+                    counted_failures=record.counted_failures
+                    + [n for n, _ in fresh],
+                )
             if failures > self.max_failures:
                 LOG.error(
                     "%s exceeded failure budget (%d > %d): Failed",

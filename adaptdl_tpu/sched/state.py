@@ -62,6 +62,12 @@ class JobRecord:
     # Non-graceful worker failures so far (exit-143 rescales and
     # evictions never count); the controller gives up past its budget.
     failures: int = 0
+    # Pod names already counted against the failure budget: a failed
+    # pod stays visible for several reconcile passes (delete latency,
+    # delete errors), and re-counting it each pass would burn the
+    # whole budget on one crash. Names embed the restart group, so no
+    # reset on group bump is needed.
+    counted_failures: list[str] = field(default_factory=list)
     creation_timestamp: float = field(default_factory=time.time)
 
 
@@ -71,6 +77,13 @@ class ClusterState:
     def __init__(self):
         self._jobs: dict[str, JobRecord] = {}
         self._cond = threading.Condition()
+        # Lifecycle metrics (reference: the controller's Prometheus
+        # submission Counter and completion-time Summary,
+        # sched/adaptdl_sched/controller.py:35-41): monotonic across
+        # job deletion, served by the supervisor's /metrics.
+        self._submitted_total = 0
+        # final status -> (count, sum_of_completion_seconds)
+        self._completions: dict[str, tuple[int, float]] = {}
 
     def create_job(self, key: str, spec: dict | None = None) -> JobRecord:
         with self._cond:
@@ -78,8 +91,17 @@ class ClusterState:
                 raise ValueError(f"job exists: {key}")
             record = JobRecord(key=key, spec=dict(spec or {}))
             self._jobs[key] = record
+            self._submitted_total += 1
             self._cond.notify_all()
             return record
+
+    def lifecycle_metrics(self) -> dict:
+        """Snapshot: submissions counter + completion-time summary."""
+        with self._cond:
+            return {
+                "submitted_total": self._submitted_total,
+                "completions": dict(self._completions),
+            }
 
     def get_job(self, key: str) -> JobRecord | None:
         with self._cond:
@@ -136,6 +158,23 @@ class ClusterState:
                     # resurrect the job (the allocator would re-grant
                     # it chips).
                     continue
+                if (
+                    name == "status"
+                    and value in FINISHED
+                    and record.status not in FINISHED
+                ):
+                    # First transition into a terminal status: record
+                    # the completion time for the lifecycle summary.
+                    count, total = self._completions.get(
+                        value, (0, 0.0)
+                    )
+                    self._completions[value] = (
+                        count + 1,
+                        total
+                        + max(
+                            time.time() - record.creation_timestamp, 0.0
+                        ),
+                    )
                 setattr(record, name, value)
             self._cond.notify_all()
 

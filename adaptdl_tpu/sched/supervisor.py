@@ -98,11 +98,28 @@ class Supervisor(ThreadedHttpServer):
         """Prometheus text exposition (reference exports job counters
         from the controller on :9091, controller.py:35-41; here the
         supervisor serves cluster-visible gauges directly)."""
+        lifecycle = self._state.lifecycle_metrics()
         lines = [
             "# TYPE adaptdl_jobs gauge",
             "# TYPE adaptdl_job_replicas gauge",
             "# TYPE adaptdl_job_batch_size gauge",
+            "# TYPE adaptdl_job_submissions_total counter",
+            f"adaptdl_job_submissions_total "
+            f"{lifecycle['submitted_total']}",
+            "# TYPE adaptdl_job_completion_seconds summary",
         ]
+        for status, (count, total) in sorted(
+            lifecycle["completions"].items()
+        ):
+            label = f'status="{status}"'
+            lines.append(
+                f"adaptdl_job_completion_seconds_count{{{label}}} "
+                f"{count}"
+            )
+            lines.append(
+                f"adaptdl_job_completion_seconds_sum{{{label}}} "
+                f"{total:.3f}"
+            )
         jobs = self._state.jobs()
         by_status: dict[str, int] = {}
         for record in jobs.values():

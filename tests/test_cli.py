@@ -400,3 +400,127 @@ def test_tensorboard_local_delete_rejected(capsys):
     rc = main(["tensorboard", "delete", "--logdir", "/tmp/x"])
     assert rc == 2
     assert "k8s" in capsys.readouterr().err
+
+
+# ---- k8s data-plane verbs against a fake kubectl ------------------------
+
+
+@pytest.fixture
+def fake_kubectl(tmp_path, monkeypatch):
+    """A kubectl shim on PATH that records every invocation (one JSON
+    line per call, argv + stdin) and exits 0 — the same fake-client
+    philosophy as the operator tests, at the subprocess boundary."""
+    log = tmp_path / "kubectl_calls.jsonl"
+    script = tmp_path / "bin" / "kubectl"
+    script.parent.mkdir()
+    script.write_text(
+        "#!/usr/bin/env python3\n"
+        "import json, sys\n"
+        "stdin = '' if sys.stdin.isatty() else sys.stdin.read()\n"
+        f"with open({str(log)!r}, 'a') as f:\n"
+        "    f.write(json.dumps({'argv': sys.argv[1:], 'stdin': stdin})"
+        " + '\\n')\n"
+    )
+    script.chmod(0o755)
+    monkeypatch.setenv(
+        "PATH", f"{script.parent}:{os.environ['PATH']}"
+    )
+
+    def calls():
+        if not log.exists():
+            return []
+        return [
+            json.loads(line)
+            for line in log.read_text().splitlines()
+            if line
+        ]
+
+    return calls
+
+
+def test_logs_streams_cluster_pods_by_label(fake_kubectl):
+    assert main(["logs", "prod/bert-job", "-f", "-n", "7"]) == 0
+    (call,) = fake_kubectl()
+    argv = call["argv"]
+    assert argv[0] == "logs"
+    assert argv[argv.index("-n") + 1] == "prod"
+    assert "adaptdl/job=bert-job" in argv
+    assert "--all-containers" in argv and "--prefix" in argv
+    assert argv[argv.index("--tail") + 1] == "7"
+    assert argv[-1] == "-f"
+
+
+def test_logs_requires_job_or_log_file(capsys):
+    assert main(["logs"]) == 2
+    assert "JOB" in capsys.readouterr().err
+
+
+def test_cp_extracts_from_pvc_via_helper_pod(fake_kubectl, tmp_path):
+    dst = str(tmp_path / "out")
+    assert main(["cp", "prod/bert-job:checkpoint-3.0", dst]) == 0
+    calls = fake_kubectl()
+    verbs = [c["argv"][0] for c in calls]
+    assert verbs == ["apply", "wait", "cp", "delete"]
+    apply, wait, cp, delete = calls
+    # The helper pod mounts the checkpoint claim read-only in prod;
+    # its name carries a per-invocation suffix (concurrent cp runs
+    # must not share a pod).
+    assert "adaptdl-cp-bert-job-" in apply["stdin"]
+    assert "claimName: adaptdl-checkpoints" in apply["stdin"]
+    assert "readOnly: true" in apply["stdin"]
+    assert apply["argv"][apply["argv"].index("-n") + 1] == "prod"
+    assert wait["argv"][-2].startswith("pod/adaptdl-cp-bert-job-")
+    helper = wait["argv"][-2].removeprefix("pod/")
+    # Relative paths resolve under the job's checkpoint dir.
+    assert cp["argv"][1] == (
+        f"prod/{helper}:"
+        "/adaptdl/checkpoints/prod-bert-job/checkpoint-3.0"
+    )
+    assert cp["argv"][2] == dst
+    assert helper in delete["argv"]
+
+
+def test_cp_helper_pod_deleted_even_when_wait_fails(
+    fake_kubectl, tmp_path, monkeypatch
+):
+    # Make the shim fail the `wait` call only.
+    calls_before = fake_kubectl
+    import pathlib
+
+    shim = None
+    for p in os.environ["PATH"].split(":"):
+        cand = pathlib.Path(p) / "kubectl"
+        if cand.exists():
+            shim = cand
+            break
+    text = shim.read_text()
+    shim.write_text(
+        text + "sys.exit(1 if sys.argv[1] == 'wait' else 0)\n"
+    )
+    rc = main(["cp", "prod/bert-job:model.bin", str(tmp_path / "o")])
+    assert rc == 1
+    verbs = [c["argv"][0] for c in calls_before()]
+    assert verbs == ["apply", "wait", "delete"]  # no cp, but cleanup ran
+
+
+def test_tensorboard_attach_port_forwards_service(fake_kubectl):
+    assert main(
+        [
+            "tensorboard",
+            "attach",
+            "--name",
+            "exp1",
+            "--namespace",
+            "ml",
+            "--port",
+            "7007",
+        ]
+    ) == 0
+    (call,) = fake_kubectl()
+    argv = call["argv"]
+    assert argv[0] == "port-forward"
+    assert argv[argv.index("-n") + 1] == "ml"
+    assert "service/adaptdl-tb-exp1" in argv
+    # Remote defaults to the local port (create --port sets the
+    # service port, so symmetric create/attach just works).
+    assert "7007:7007" in argv

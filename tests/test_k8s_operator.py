@@ -392,3 +392,45 @@ def test_discover_slices_subtracts_foreign_pod_requests():
     nodes = asyncio.run(operator._discover_slices(core))
     assert nodes["v5e-pool-a"].resources["tpu"] == 1
     assert nodes["v5e-pool-b"].resources["tpu"] == 8
+
+
+def test_failed_pod_counted_once_across_passes(op):
+    """A failed pod that stays visible (delete latency / delete error)
+    must consume ONE failure-budget unit, not one per reconcile pass."""
+    core = FakeCore()
+    _reconcile(op, core, "ns/job")
+    core.terminate("job-1-0", 1)
+
+    # Make deletes fail so the pod stays visible across passes.
+    deleted = []
+
+    async def failing_delete(name, namespace):
+        deleted.append(name)
+        raise RuntimeError("apiserver hiccup")
+
+    core.delete_namespaced_pod = failing_delete
+    for _ in range(3):
+        try:
+            _reconcile(op, core, "ns/job")
+        except RuntimeError:
+            pass
+    record = op.state.get_job("ns/job")
+    assert record.failures == 1  # not 3
+    assert record.status != "Failed"
+
+
+def test_zero_allocation_job_returns_to_pending(op):
+    """Allocation withdrawn to empty: once the pods are gone the job
+    reports Pending (not Stopping forever) until chips come back."""
+    core = FakeCore()
+    _reconcile(op, core, "ns/job")
+    op.state.update("ns/job", allocation=[])
+    _reconcile(op, core, "ns/job")  # drift -> Stopping + deletes
+    assert op.state.get_job("ns/job").status == "Stopping"
+    assert core.pods == {}
+    _reconcile(op, core, "ns/job")
+    assert op.state.get_job("ns/job").status == "Pending"
+    # Chips re-granted: the job starts again.
+    op.state.update("ns/job", allocation=["pool-a"])
+    _reconcile(op, core, "ns/job")
+    assert op.state.get_job("ns/job").status == "Starting"

@@ -118,6 +118,30 @@ def test_metrics_exposition(cluster):
     assert 'adaptdl_jobs{status="Pending"} 1' in text
     assert 'adaptdl_job_replicas{job="test/job"} 3' in text
     assert 'adaptdl_job_batch_size{job="test/job"} 128' in text
+    # Lifecycle counters (reference: controller.py:35-41 exports a
+    # submission counter + completion-time summary).
+    assert "adaptdl_job_submissions_total 1" in text
+
+
+def test_lifecycle_metrics_track_submissions_and_completions(cluster):
+    state, url = cluster
+    state.create_job("test/other")
+    state.update("test/other", status="Succeeded")
+    # Sticky-terminal double transition must not double-count.
+    state.update("test/other", status="Succeeded")
+    state.create_job("test/bad")
+    state.update("test/bad", status="Failed")
+    text = requests.get(f"{url}/metrics", timeout=5).text
+    assert "adaptdl_job_submissions_total 3" in text
+    assert (
+        'adaptdl_job_completion_seconds_count{status="Succeeded"} 1'
+        in text
+    )
+    assert (
+        'adaptdl_job_completion_seconds_count{status="Failed"} 1'
+        in text
+    )
+    assert 'adaptdl_job_completion_seconds_sum{status="Succeeded"}' in text
 
 
 def test_k8s_manifest_rendering():
