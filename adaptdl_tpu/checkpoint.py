@@ -17,6 +17,7 @@ different slice sizes.)
 
 from __future__ import annotations
 
+import logging
 import os
 import re
 import shutil
@@ -24,6 +25,8 @@ import tempfile
 from typing import IO
 
 from adaptdl_tpu import env
+
+LOG = logging.getLogger(__name__)
 
 # Dir names are checkpoint-{num_restarts}.{seq}; seq increments on each
 # save within one incarnation so a new save never deletes or overwrites
@@ -74,6 +77,7 @@ class State:
 def _reset_registry() -> None:
     """Clear all registered states (test isolation only)."""
     _registry.clear()
+    _bad_dirs.clear()
 
 
 def scan_versioned_dirs(
@@ -158,14 +162,64 @@ def save_all_states() -> None:
         state.commit()
 
 
+# Checkpoint dirs found unreadable by ANY state this process: every
+# later load skips them, so all states restore from the same surviving
+# version (mixing payloads across versions would silently diverge —
+# e.g. epoch counters from checkpoint-2.3 with weights from 2.2).
+_bad_dirs: set[str] = set()
+
+
+class CheckpointUnreadableError(RuntimeError):
+    """Checkpoints exist on disk but none could be restored.
+
+    Raised instead of returning False so a job never silently
+    cold-starts over recoverable data — the first save of a
+    cold-started incarnation would PRUNE the existing dirs.
+    """
+
+
 def load_state(state: State) -> bool:
-    """Restore one state from the newest checkpoint; False if absent."""
-    ckpt = latest_checkpoint_dir()
-    if ckpt is None:
+    """Restore one state from the newest checkpoint; False if absent.
+
+    Recovery is versioned: if the newest complete checkpoint dir is
+    unreadable (truncated/garbage payload — storage bit-rot, a bad
+    external copy, a dying writer), loading falls back to the next
+    older dir rather than crash-looping the job on a checkpoint that
+    will never load. The next successful save prunes the damaged dir.
+    A dir found unreadable poisons it for every subsequent load in
+    this process (version consistency across states), and "the state
+    exists somewhere but nowhere readable" raises
+    :class:`CheckpointUnreadableError` rather than masquerading as a
+    fresh start.
+    """
+    root = env.checkpoint_path()
+    if root is None:
         return False
-    path = os.path.join(ckpt, state.name)
-    if not os.path.isfile(path):
-        return False
-    with open(path, "rb") as f:
-        state.load(f)
-    return True
+    attempted = False
+    for _, _, ckpt in reversed(_list_checkpoints(root)):
+        if ckpt in _bad_dirs:
+            continue
+        path = os.path.join(ckpt, state.name)
+        if not os.path.isfile(path):
+            continue
+        try:
+            with open(path, "rb") as f:
+                state.load(f)
+            return True
+        except Exception:  # noqa: BLE001 - any unreadable payload
+            attempted = True
+            _bad_dirs.add(ckpt)
+            LOG.warning(
+                "checkpoint %s is unreadable for state %r; falling "
+                "back to an older checkpoint",
+                ckpt,
+                state.name,
+                exc_info=True,
+            )
+    if attempted:
+        raise CheckpointUnreadableError(
+            f"state {state.name!r} exists in checkpoint dirs under "
+            f"{root} but none could be restored; refusing to "
+            "cold-start (which would prune them on the next save)"
+        )
+    return False

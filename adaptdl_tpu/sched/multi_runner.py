@@ -87,6 +87,9 @@ class MultiJobRunner:
             job.name: 0 for job in jobs
         }
         self._stopped: set[str] = set()
+        # Live worker process per job (soak/fault-injection harnesses
+        # SIGKILL through this; entries go stale after exit).
+        self.procs: dict[str, subprocess.Popen] = {}
 
     def stop_job(self, name: str) -> None:
         """Externally terminate a job (e.g. a tuning trial that lost
@@ -179,6 +182,7 @@ class MultiJobRunner:
                 [sys.executable, job.script],
                 env=self._job_env(job, num_replicas, topology),
             )
+            self.procs[job.name] = proc
             code, signalled = self._supervise(
                 proc, job, allocation, topology
             )

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Fault-injection soak: repeat the chaos cycle (kill -9 mid-step +
+# corrupted-newest-checkpoint) N times (default 5), collecting each
+# run's soak log as an artifact. Complements soak_local.sh (random
+# churn) the way the reference's testworkload.sh loop complements its
+# unit suite (reference: tests/testworkload.sh:20-36).
+set -euo pipefail
+N="${1:-5}"
+OUT="${2:-$(mktemp -d)/soak-faults}"
+mkdir -p "$OUT"
+cd "$(dirname "$0")/../.."
+for i in $(seq 1 "$N"); do
+  echo "=== soak cycle $i/$N ==="
+  python -m pytest tests/test_soak.py -x -q -s \
+    | tee "$OUT/cycle-$i.log"
+done
+echo "soak artifacts in $OUT"
