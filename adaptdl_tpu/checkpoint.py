@@ -78,6 +78,7 @@ def _reset_registry() -> None:
     """Clear all registered states (test isolation only)."""
     _registry.clear()
     _bad_dirs.clear()
+    _loaded_from.clear()
 
 
 def scan_versioned_dirs(
@@ -167,6 +168,11 @@ def save_all_states() -> None:
 # version (mixing payloads across versions would silently diverge —
 # e.g. epoch counters from checkpoint-2.3 with weights from 2.2).
 _bad_dirs: set[str] = set()
+# State name -> dir it successfully restored from, so poisoning a dir
+# can retroactively re-load states that had already restored from it
+# (version consistency must hold regardless of load ORDER: the state
+# that trips over the corruption is not necessarily the first loader).
+_loaded_from: dict[str, str] = {}
 
 
 class CheckpointUnreadableError(RuntimeError):
@@ -205,10 +211,8 @@ def load_state(state: State) -> bool:
         try:
             with open(path, "rb") as f:
                 state.load(f)
-            return True
         except Exception:  # noqa: BLE001 - any unreadable payload
             attempted = True
-            _bad_dirs.add(ckpt)
             LOG.warning(
                 "checkpoint %s is unreadable for state %r; falling "
                 "back to an older checkpoint",
@@ -216,6 +220,10 @@ def load_state(state: State) -> bool:
                 state.name,
                 exc_info=True,
             )
+            _poison_dir(ckpt)
+            continue
+        _loaded_from[state.name] = ckpt
+        return True
     if attempted:
         raise CheckpointUnreadableError(
             f"state {state.name!r} exists in checkpoint dirs under "
@@ -223,3 +231,35 @@ def load_state(state: State) -> bool:
             "cold-start (which would prune them on the next save)"
         )
     return False
+
+
+def _poison_dir(ckpt: str) -> None:
+    """Mark ``ckpt`` unreadable and re-load any states that already
+    restored from it, so every state ends on the same surviving
+    version no matter which one tripped over the corruption first
+    (e.g. weights load fine from checkpoint-2.3, then the epoch file
+    in 2.3 turns out truncated: the weights must drop back to 2.2
+    alongside the epoch counter, not keep 2.3's payload)."""
+    _bad_dirs.add(ckpt)
+    stale = [
+        name for name, d in _loaded_from.items() if d == ckpt
+    ]
+    for name in stale:
+        del _loaded_from[name]
+        other = _registry.get(name)
+        if other is None:  # unregistered since; nothing to heal
+            continue
+        LOG.warning(
+            "re-loading state %r from an older checkpoint for "
+            "version consistency with poisoned %s",
+            name,
+            ckpt,
+        )
+        if not load_state(other):
+            # No older dir holds it: the state keeps a payload from
+            # the poisoned dir while others fall back — refuse to
+            # continue with mixed versions.
+            raise CheckpointUnreadableError(
+                f"state {name!r} was restored from {ckpt} which later "
+                "proved unreadable, and no older checkpoint holds it"
+            )

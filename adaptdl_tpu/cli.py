@@ -48,13 +48,57 @@ def _cmd_submit(args) -> int:
             "max_replicas": args.max_replicas or 8,
         }
     )
+    if args.build is not None and args.backend != "k8s":
+        print(
+            "--build requires --backend k8s (local submit runs the "
+            "script in place; no image is involved)",
+            file=sys.stderr,
+        )
+        return 1
     if args.backend == "k8s":
         from adaptdl_tpu.sched.k8s import render_job_manifest
+
+        image = args.image
+        if args.build is not None:
+            # One command from source tree to running job (reference:
+            # cli/bin/adaptdl:133-231): build the context, push it,
+            # and digest-pin the manifest.
+            if not args.registry:
+                print(
+                    "--build requires --registry (e.g. "
+                    "us-docker.pkg.dev/PROJECT/REPO)",
+                    file=sys.stderr,
+                )
+                return 1
+            from adaptdl_tpu.sched.k8s.images import (
+                build_and_push,
+                planned_ref,
+            )
+
+            if args.dry_run:
+                # A dry run mutates NOTHING (no build, no push, no
+                # registry state) — render with the content-addressed
+                # ref the real submit would produce.
+                image = planned_ref(
+                    args.build,
+                    args.registry,
+                    args.name or "adaptdl-job",
+                    dockerfile=args.dockerfile,
+                )
+                print(f"dry run: would push {image}", file=sys.stderr)
+            else:
+                image = build_and_push(
+                    args.build,
+                    args.registry,
+                    args.name or "adaptdl-job",
+                    dockerfile=args.dockerfile,
+                )
+                print(f"pushed {image}", file=sys.stderr)
 
         manifest = render_job_manifest(
             name=args.name or "adaptdl-job",
             script=args.script,
-            image=args.image,
+            image=image,
             min_replicas=args.min_replicas,
             max_replicas=args.max_replicas or 8,
             checkpoint_claim=args.checkpoint_claim,
@@ -263,16 +307,84 @@ def _apply_or_print(manifest: str, dry_run: bool) -> int:
 
 def _cmd_deploy(args) -> int:
     """Render (and apply) the whole scheduler bundle — the
-    helm-install equivalent."""
+    helm-install equivalent. ``--values`` takes a helm-style YAML
+    values file (reference surface: helm/adaptdl-sched/values.yaml);
+    explicit flags win over file values, which win over defaults."""
     from adaptdl_tpu.sched.k8s import render_scheduler_bundle
 
-    manifest = render_scheduler_bundle(
-        image=args.image,
-        namespace=args.namespace,
-        with_webhook=not args.no_webhook,
-        ca_bundle=args.ca_bundle,
+    # The deploy flags use None/False sentinels, so "user did not pass
+    # it" is directly observable — no shadow table of argparse
+    # defaults to drift out of sync.
+    kwargs = {
+        "image": args.image,
+        "namespace": args.namespace,
+        "with_webhook": False if args.no_webhook else None,
+        "ca_bundle": args.ca_bundle,
+    }
+    if args.values:
+        try:
+            import yaml
+        except ModuleNotFoundError:
+            print(
+                "--values needs pyyaml: pip install adaptdl-tpu[k8s]",
+                file=sys.stderr,
+            )
+            return 1
+        with open(args.values) as f:
+            values = yaml.safe_load(f) or {}
+        overrides, unknown = _values_overrides(values)
+        for key, value in overrides.items():
+            # Explicit CLI flags win; an unset flag (sentinel) yields
+            # to the values file.
+            if kwargs.get(key) is None:
+                kwargs[key] = value
+        if unknown:
+            print(
+                f"warning: unrecognized values keys {sorted(unknown)}",
+                file=sys.stderr,
+            )
+    resolved = {
+        "image": "adaptdl-tpu:latest",
+        "namespace": "default",
+        "with_webhook": True,
+        "ca_bundle": None,
+    }
+    resolved.update(
+        {k: v for k, v in kwargs.items() if v is not None}
     )
+    manifest = render_scheduler_bundle(**resolved)
     return _apply_or_print(manifest, args.dry_run)
+
+
+def _values_overrides(values: dict) -> tuple[dict, list[str]]:
+    """Flatten a helm-style values mapping onto
+    ``render_scheduler_bundle`` kwargs; returns (overrides, unknown
+    keys) so typos fail loudly instead of silently deploying
+    defaults."""
+    overrides: dict = {}
+    unknown: list[str] = []
+    for key, value in values.items():
+        if key in ("image", "namespace"):
+            overrides[key] = value
+        elif key == "supervisor" and isinstance(value, dict):
+            for sub, v in value.items():
+                if sub == "port":
+                    overrides["supervisor_port"] = v
+                else:
+                    unknown.append(f"supervisor.{sub}")
+        elif key == "webhook" and isinstance(value, dict):
+            for sub, v in value.items():
+                if sub == "port":
+                    overrides["webhook_port"] = v
+                elif sub == "enabled":
+                    overrides["with_webhook"] = bool(v)
+                elif sub == "caBundle":
+                    overrides["ca_bundle"] = v
+                else:
+                    unknown.append(f"webhook.{sub}")
+        else:
+            unknown.append(str(key))
+    return overrides, unknown
 
 
 def _cmd_tensorboard(args) -> int:
@@ -368,6 +480,25 @@ def main(argv=None) -> int:
     p.add_argument("--max-replicas", type=int, default=None)
     p.add_argument("--log-file")
     p.add_argument("--image", default="adaptdl-tpu:latest")
+    p.add_argument(
+        "--build",
+        metavar="CONTEXT_DIR",
+        default=None,
+        help="build+push the image from this source tree and "
+        "digest-pin the manifest (k8s backend; needs --registry)",
+    )
+    p.add_argument(
+        "--registry",
+        default=None,
+        help="image registry for --build, e.g. "
+        "us-docker.pkg.dev/PROJECT/REPO",
+    )
+    p.add_argument(
+        "--dockerfile",
+        default=None,
+        help="Dockerfile for --build (default: CONTEXT/Dockerfile, "
+        "generated if absent)",
+    )
     p.add_argument("--checkpoint-claim", default="adaptdl-checkpoints")
     p.add_argument("--dry-run", action="store_true")
     p.set_defaults(fn=_cmd_submit)
@@ -437,13 +568,22 @@ def main(argv=None) -> int:
         help="render/apply the scheduler bundle (CRD, operator, "
         "webhook, services) — the helm-install equivalent",
     )
-    p.add_argument("--image", default="adaptdl-tpu:latest")
-    p.add_argument("--namespace", default="default")
+    # None = not passed (sentinel): lets a --values file apply, with
+    # the real defaults resolved in _cmd_deploy after the merge.
+    p.add_argument("--image", default=None)
+    p.add_argument("--namespace", default=None)
     p.add_argument("--no-webhook", action="store_true")
     p.add_argument(
         "--ca-bundle",
         help="base64 CA bundle for the webhook serving cert; without "
         "it the webhook is registered with failurePolicy Ignore",
+    )
+    p.add_argument(
+        "--values",
+        default=None,
+        help="helm-style YAML values file (image, namespace, "
+        "supervisor.port, webhook.{enabled,port,caBundle}); explicit "
+        "flags win",
     )
     p.add_argument("--dry-run", action="store_true")
     p.set_defaults(fn=_cmd_deploy)

@@ -1,0 +1,131 @@
+"""Model-FLOPs accounting and MFU (model FLOPs utilization).
+
+The reference framework never reports hardware utilization — its
+throughput story is samples/s from torch hooks (reference:
+adaptdl/adaptdl/torch/_metrics.py). On TPU the honest headline number
+is MFU: achieved model FLOPs per second over the chip's peak bf16
+FLOPs. This module implements the standard matmul-only accounting
+(the PaLM-appendix convention): 2 FLOPs per multiply-accumulate,
+backward pass costed at 2x forward, attention scored causally (half
+the full [seq, seq] rectangle when ``causal``).
+
+Used by ``bench.py`` for the flagship-transformer MFU line and
+available to user code for their own reporting.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# Peak dense bf16 FLOP/s per chip by TPU generation. Keyed by
+# substrings of ``jax.Device.device_kind`` (e.g. "TPU v5 lite").
+# Public figures: v2 45T, v3 123T (2 cores), v4 275T, v5e ("v5 lite")
+# 197T, v5p 459T, v6e ("Trillium") 918T.
+_PEAK_BF16: tuple[tuple[str, float], ...] = (
+    ("v6 lite", 918e12),
+    ("v6e", 918e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v4 lite", 138e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def device_peak_flops(device) -> float | None:
+    """Peak dense bf16 FLOP/s for a ``jax.Device``; None when unknown
+    (CPU, new TPU generations, GPU)."""
+    kind = getattr(device, "device_kind", "").lower()
+    if "tpu" not in kind and getattr(device, "platform", "") != "tpu":
+        return None
+    for needle, peak in _PEAK_BF16:
+        if needle in kind:
+            return peak
+    return None
+
+
+@dataclass(frozen=True)
+class FlopsBreakdown:
+    """Per-train-step model FLOPs, split for reporting."""
+
+    matmul: float  # projections + FFN + LM head (fwd+bwd)
+    attention: float  # QK^T and PV contractions (fwd+bwd)
+
+    @property
+    def total(self) -> float:
+        return self.matmul + self.attention
+
+
+def transformer_train_flops(
+    config, batch_size: int, seq_len: int
+) -> FlopsBreakdown:
+    """Model FLOPs for ONE optimizer step (forward + backward) of the
+    flagship ``TransformerConfig`` LM at the given batch/sequence.
+
+    Matmul-only accounting; layernorms, softmax, RoPE, and residual
+    adds are ignored (sub-percent at real widths). MoE blocks cost
+    ``top_k`` expert FFNs plus the router per token — the capacity
+    padding all_to_all moves is communication, not model FLOPs.
+    """
+    d = config.d_model
+    d_ff = config.d_ff
+    tokens = batch_size * seq_len
+
+    dense_ffn = 2 * (2 * d * d_ff)  # up + down projections, per token
+    moe_every = getattr(config, "moe_every_n", 0) or 0
+    num_moe = (
+        sum(
+            1
+            for i in range(1, config.num_layers + 1)
+            if i % moe_every == 0
+        )
+        if moe_every
+        else 0
+    )
+    num_dense = config.num_layers - num_moe
+    top_k = max(getattr(config, "moe_top_k", 1), 1)
+    moe_ffn = top_k * dense_ffn + 2 * d * max(
+        getattr(config, "moe_num_experts", 0), 0
+    )
+
+    proj = 2 * (4 * d * d)  # fused QKV (3 d^2) + output (d^2), per token
+    head = 2 * d * config.vocab_size  # LM head, per token
+    fwd_matmul = tokens * (
+        config.num_layers * proj
+        + num_dense * dense_ffn
+        + num_moe * moe_ffn
+        + head
+    )
+
+    # Attention contractions: QK^T and PV are each 2*S*d_model FLOPs
+    # per token (summed over heads); the causal mask discards half the
+    # rectangle, and backward recomputes both contractions twice.
+    attn_per_token = 2 * (2 * seq_len * d)
+    if getattr(config, "causal", True):
+        attn_per_token /= 2
+    fwd_attn = tokens * config.num_layers * attn_per_token
+
+    return FlopsBreakdown(
+        matmul=3.0 * fwd_matmul, attention=3.0 * fwd_attn
+    )
+
+
+def mfu(
+    flops_per_step: float,
+    step_time_s: float,
+    num_devices: int = 1,
+    device=None,
+    peak_flops: float | None = None,
+) -> float | None:
+    """Achieved model FLOPs / peak; None off-TPU (no honest peak)."""
+    if peak_flops is None:
+        if device is None:
+            import jax
+
+            device = jax.devices()[0]
+        peak_flops = device_peak_flops(device)
+    if not peak_flops or step_time_s <= 0:
+        return None
+    return flops_per_step / (step_time_s * num_devices * peak_flops)

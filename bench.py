@@ -116,21 +116,29 @@ def _steady_state_time(state, step_fn, batch, steps: int):
     return state, elapsed / steps, m
 
 
-def _bench_transformer_tokens(on_tpu: bool, full: bool) -> float | None:
-    """Steady-state causal-LM training throughput in tokens/s."""
+def _bench_transformer_tokens(on_tpu: bool, full: bool) -> dict | None:
+    """Steady-state causal-LM training throughput: tokens/s and MFU.
+
+    Full mode runs a GPT-2-medium-class shape (d=1024, 8 layers,
+    seq 1024) — big enough that the MXU, not dispatch overhead, sets
+    the step time, so the MFU figure means something.
+    """
+    import jax
     import jax.numpy as jnp
     import optax
 
+    from adaptdl_tpu.flops import mfu as mfu_fn
+    from adaptdl_tpu.flops import transformer_train_flops
     from adaptdl_tpu.models import TransformerConfig, init_transformer
     from adaptdl_tpu.trainer import ElasticTrainer
 
-    seq_len = 512 if full else 32
+    seq_len = 1024 if full else 32
     cfg = TransformerConfig(
         vocab_size=32000 if full else 256,
-        num_layers=6 if full else 2,
-        num_heads=8 if full else 2,
-        d_model=512 if full else 32,
-        d_ff=2048 if full else 64,
+        num_layers=8 if full else 2,
+        num_heads=16 if full else 2,
+        d_model=1024 if full else 32,
+        d_ff=4096 if full else 64,
         max_seq_len=seq_len,
         dtype=jnp.bfloat16 if on_tpu else jnp.float32,
         remat=True,
@@ -152,7 +160,7 @@ def _bench_transformer_tokens(on_tpu: bool, full: bool) -> float | None:
         init_batch_size=8,
     )
     state = trainer.init_state()
-    bsz = 16 if full else 8
+    bsz = 8
     rng = np.random.default_rng(3)
     tokens = rng.integers(0, cfg.vocab_size, size=(bsz, seq_len + 1))
     batch = trainer.shard_batch(
@@ -165,11 +173,76 @@ def _bench_transformer_tokens(on_tpu: bool, full: bool) -> float | None:
     steps = 20 if full else 3
     _, t_step, _ = _steady_state_time(state, step_fn, batch, steps)
     tokens_per_s = bsz * seq_len / t_step
+    flops = transformer_train_flops(cfg, bsz, seq_len)
+    mfu_val = mfu_fn(
+        flops.total, t_step, num_devices=len(jax.devices())
+    )
     _log(
         f"transformer: seq={seq_len} bsz={bsz} step={t_step*1e3:.1f}ms "
-        f"tokens/s={tokens_per_s:.0f}"
+        f"tokens/s={tokens_per_s:.0f} "
+        f"model_tflops/step={flops.total/1e12:.2f} "
+        f"mfu={mfu_val if mfu_val is None else round(mfu_val, 4)}"
     )
-    return tokens_per_s
+    out = {"transformer_tokens_per_s": round(tokens_per_s, 1)}
+    if mfu_val is not None:
+        out["transformer_mfu"] = round(mfu_val, 4)
+    return out
+
+
+def _bench_flash_attention(on_tpu: bool, full: bool) -> dict | None:
+    """Compiled flash-attention vs XLA dense attention, fwd+bwd step
+    time at the shape where the kernel matters (long seq, bf16).
+
+    Off-TPU the Pallas kernel runs in interpret mode (Python speed) —
+    timing it would be meaningless, so this phase is TPU-only.
+    """
+    if not on_tpu:
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    from adaptdl_tpu.models.transformer import causal_attention
+    from adaptdl_tpu.ops.flash_attention import flash_attention
+
+    B, H, S, D = (4, 8, 2048, 64) if full else (1, 2, 256, 64)
+    rng = np.random.default_rng(5)
+    qkv = [
+        jnp.asarray(
+            rng.normal(size=(B, H, S, D)), dtype=jnp.bfloat16
+        )
+        for _ in range(3)
+    ]
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v).astype(jnp.float32).sum()
+
+    def loss_dense(q, k, v):
+        return causal_attention(q, k, v).astype(jnp.float32).sum()
+
+    def timed(loss):
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        jax.block_until_ready(g(*qkv))  # compile + warmup
+        n = 10 if full else 3
+        start = time.monotonic()
+        for _ in range(n):
+            out = g(*qkv)
+        jax.block_until_ready(out)
+        return (time.monotonic() - start) / n
+
+    t_flash = timed(loss_flash)
+    if _remaining() < 45:
+        _log("flash bench: budget pressure — skipping dense arm")
+        return {"flash_attn_ms": round(t_flash * 1e3, 3)}
+    t_dense = timed(loss_dense)
+    speedup = t_dense / t_flash
+    _log(
+        f"flash attn: seq={S} flash={t_flash*1e3:.2f}ms "
+        f"dense={t_dense*1e3:.2f}ms speedup={speedup:.3f}x"
+    )
+    return {
+        "flash_attn_ms": round(t_flash * 1e3, 3),
+        "flash_attn_speedup_vs_xla": round(speedup, 3),
+    }
 
 
 def _bench_rescale_latency(trainer_factory, dataset, init_bsz) -> float | None:
@@ -385,14 +458,21 @@ def main(quick: bool = False):
         "platform": platform if on_tpu else "cpu-fallback",
     }
 
-    # ---- optional depth: transformer tokens/s, rescale p50 ----------
-    tokens_per_s = None
+    # ---- optional depth: transformer tokens/s + MFU, flash kernel,
+    # rescale p50. Ordered by verdict priority (MFU first).
+    transformer_stats = None
+    flash_stats = None
     rescale_p50 = None
     try:
-        if _remaining() > 90:
-            tokens_per_s = _bench_transformer_tokens(on_tpu, full)
+        if _remaining() > 120:
+            transformer_stats = _bench_transformer_tokens(on_tpu, full)
     except Exception as exc:  # noqa: BLE001 - optional metric
         _log(f"transformer bench failed: {exc}")
+    try:
+        if _remaining() > 90:
+            flash_stats = _bench_flash_attention(on_tpu, full)
+    except Exception as exc:  # noqa: BLE001 - optional metric
+        _log(f"flash bench failed: {exc}")
     try:
         if _remaining() > 60:
             metrics._reset_state()
@@ -403,8 +483,11 @@ def main(quick: bool = False):
         _log(f"rescale bench failed: {exc}")
 
     result = dict(_PRIMARY_RESULT)
-    if tokens_per_s is not None:
-        result["transformer_tokens_per_s"] = round(tokens_per_s, 1)
+    result["device_kind"] = jax.devices()[0].device_kind
+    if transformer_stats:
+        result.update(transformer_stats)
+    if flash_stats:
+        result.update(flash_stats)
     if rescale_p50 is not None:
         result["rescale_p50_s"] = round(rescale_p50, 3)
     print(json.dumps(result))

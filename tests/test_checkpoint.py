@@ -128,6 +128,51 @@ def test_unreadable_dir_poisoned_for_all_states(tmp_path, monkeypatch):
     assert b.value == 10, "b must restore from the SAME (older) dir"
 
 
+def test_poisoning_heals_states_loaded_earlier(tmp_path, monkeypatch):
+    """Order-independence: a state that already restored from a dir
+    which LATER proves unreadable for a sibling is re-loaded from the
+    surviving older dir — no mixed-version process state."""
+    import pickle as _pickle
+
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    a = DictState("a", 1)
+    b = DictState("b", 10)
+    checkpoint.save_all_states()  # checkpoint-0.0 (good, a=1 b=10)
+    newest = tmp_path / "checkpoint-0.1"
+    newest.mkdir()
+    (newest / "a").write_bytes(_pickle.dumps(2))  # readable, newer
+    (newest / "b").write_bytes(b"\x00garbage")  # corrupt
+    a.value = b.value = None
+    assert checkpoint.load_state(a)  # succeeds from 0.1
+    assert a.value == 2
+    assert checkpoint.load_state(b)  # poisons 0.1, heals a
+    assert b.value == 10
+    assert a.value == 1, "a must be re-loaded to match b's version"
+
+
+def test_poisoning_with_no_older_copy_raises(tmp_path, monkeypatch):
+    """If a state restored from a dir that later proves unreadable and
+    no older dir holds it, the load raises instead of leaving the
+    process with payloads from two different versions."""
+    import pickle as _pickle
+
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    a = DictState("a", 1)
+    b = DictState("b", 10)
+    checkpoint.save_all_states()  # checkpoint-0.0 holds a AND b
+    # A newer dir where only `a` exists (readable) and `b` is corrupt;
+    # then remove `a` from the OLD dir so no older copy survives.
+    newest = tmp_path / "checkpoint-0.1"
+    newest.mkdir()
+    (newest / "a").write_bytes(_pickle.dumps(2))
+    (newest / "b").write_bytes(b"\x00garbage")
+    os.remove(tmp_path / "checkpoint-0.0" / "a")
+    a.value = b.value = None
+    assert checkpoint.load_state(a)
+    with pytest.raises(checkpoint.CheckpointUnreadableError):
+        checkpoint.load_state(b)
+
+
 def test_all_checkpoints_unreadable_raises_not_cold_start(
     tmp_path, monkeypatch
 ):

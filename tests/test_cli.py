@@ -524,3 +524,170 @@ def test_tensorboard_attach_port_forwards_service(fake_kubectl):
     # Remote defaults to the local port (create --port sets the
     # service port, so symmetric create/attach just works).
     assert "7007:7007" in argv
+
+
+# ---- submit --build (image build/push) and deploy --values ---------------
+
+
+@pytest.fixture
+def fake_docker(tmp_path, monkeypatch):
+    """A docker shim on PATH: records calls, answers `inspect` with a
+    digest-pinned reference (what a real push records)."""
+    log = tmp_path / "docker_calls.jsonl"
+    script = tmp_path / "dbin" / "docker"
+    script.parent.mkdir()
+    script.write_text(
+        "#!/usr/bin/env python3\n"
+        "import json, sys\n"
+        f"with open({str(log)!r}, 'a') as f:\n"
+        "    f.write(json.dumps({'argv': sys.argv[1:]}) + '\\n')\n"
+        "if sys.argv[1] == 'inspect':\n"
+        "    ref = sys.argv[-1].rsplit(':', 1)[0]\n"
+        "    print(ref + '@sha256:' + 'ab' * 32)\n"
+    )
+    script.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{script.parent}:{os.environ['PATH']}")
+
+    def calls():
+        if not log.exists():
+            return []
+        return [
+            json.loads(line)
+            for line in log.read_text().splitlines()
+            if line
+        ]
+
+    return calls
+
+
+def _make_context(tmp_path):
+    ctx = tmp_path / "src"
+    ctx.mkdir()
+    (ctx / "train.py").write_text("print('hi')\n")
+    return ctx
+
+
+def test_submit_build_pushes_and_digest_pins(
+    fake_docker, fake_kubectl, tmp_path, capsys
+):
+    ctx = _make_context(tmp_path)
+    rc = main(
+        [
+            "submit",
+            "train.py",
+            "--backend",
+            "k8s",
+            "--name",
+            "bert",
+            "--build",
+            str(ctx),
+            "--registry",
+            "us-docker.pkg.dev/proj/repo",
+        ]
+    )
+    assert rc == 0
+    verbs = [c["argv"][0] for c in fake_docker()]
+    assert verbs == ["build", "push", "inspect"]
+    build_argv = fake_docker()[0]["argv"]
+    tag = build_argv[build_argv.index("-t") + 1]
+    assert tag.startswith("us-docker.pkg.dev/proj/repo/bert:")
+    # The applied manifest carries the pushed DIGEST, not the tag.
+    (apply_call,) = fake_kubectl()
+    assert "@sha256:" + "ab" * 32 in apply_call["stdin"]
+    # A generated Dockerfile landed in the context (none was present).
+    assert (ctx / "Dockerfile.adaptdl").exists()
+
+
+def test_submit_build_requires_registry(tmp_path, capsys):
+    ctx = _make_context(tmp_path)
+    rc = main(
+        ["submit", "t.py", "--backend", "k8s", "--build", str(ctx)]
+    )
+    assert rc == 1
+    assert "--registry" in capsys.readouterr().err
+
+
+def test_content_tag_deterministic_and_content_addressed(tmp_path):
+    from adaptdl_tpu.sched.k8s.images import content_tag
+
+    ctx = _make_context(tmp_path)
+    first = content_tag(str(ctx))
+    assert content_tag(str(ctx)) == first  # mtime-independent
+    (ctx / "train.py").write_text("print('changed')\n")
+    assert content_tag(str(ctx)) != first
+
+
+def test_deploy_values_file_overrides_defaults(tmp_path, capsys):
+    values = tmp_path / "values.yaml"
+    values.write_text(
+        "image: gcr.io/proj/sched:v2\n"
+        "namespace: ml\n"
+        "webhook:\n"
+        "  enabled: false\n"
+        "typoKey: 1\n"
+    )
+    rc = main(["deploy", "--dry-run", "--values", str(values)])
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert "gcr.io/proj/sched:v2" in captured.out
+    assert "namespace: ml" in captured.out
+    assert "ValidatingWebhookConfiguration" not in captured.out
+    assert "typoKey" in captured.err  # unknown keys warned
+
+
+def test_deploy_explicit_flag_beats_values_file(tmp_path, capsys):
+    values = tmp_path / "values.yaml"
+    values.write_text("namespace: ml\n")
+    rc = main(
+        [
+            "deploy",
+            "--dry-run",
+            "--namespace",
+            "override-ns",
+            "--values",
+            str(values),
+        ]
+    )
+    assert rc == 0
+    assert "namespace: override-ns" in capsys.readouterr().out
+
+
+def test_submit_build_dry_run_touches_nothing(
+    fake_docker, fake_kubectl, tmp_path, capsys
+):
+    """--dry-run must not build, push, or write into the user tree."""
+    ctx = _make_context(tmp_path)
+    rc = main(
+        [
+            "submit",
+            "train.py",
+            "--backend",
+            "k8s",
+            "--name",
+            "bert",
+            "--build",
+            str(ctx),
+            "--registry",
+            "us-docker.pkg.dev/proj/repo",
+            "--dry-run",
+        ]
+    )
+    assert rc == 0
+    assert fake_docker() == []  # docker never invoked
+    assert fake_kubectl() == []  # nothing applied
+    assert not (ctx / "Dockerfile.adaptdl").exists()
+    out = capsys.readouterr().out
+    # Rendered with the same content-addressed ref a real submit
+    # would push.
+    from adaptdl_tpu.sched.k8s.images import planned_ref
+
+    assert planned_ref(
+        str(ctx), "us-docker.pkg.dev/proj/repo", "bert"
+    ) in out
+
+
+def test_submit_build_rejects_local_backend(tmp_path, capsys):
+    ctx = _make_context(tmp_path)
+    rc = main(["submit", "t.py", "--build", str(ctx)])
+    assert rc == 1
+    assert "--backend k8s" in capsys.readouterr().err

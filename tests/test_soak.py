@@ -187,6 +187,7 @@ def test_soak_survives_sigkill_and_corrupt_checkpoint(tmp_path):
     # both faults) and the final epoch completed.
     seen = [int(line.split()[0].split("=")[1]) for line in lines]
     assert seen == sorted(seen), "epochs went backwards"
+    assert len(seen) == len(set(seen)), "an epoch ran twice (replay-skip broke)"
     assert seen[-1] == 13
     # The garbage dir was pruned by the first post-corruption save.
     assert "checkpoint-999.0" not in _checkpoint_dirs(ckpt)
