@@ -118,6 +118,7 @@ def _accum_time(
     stage_shards=1,
     pipeline_micro=1,
     expert_shards=1,
+    pipeline_interleave=1,
 ):
     """Forward+backward time of one microbatch on one chip.
 
@@ -143,9 +144,13 @@ def _accum_time(
     )
     base = compute + ring + tp + ep
     # Degenerates exactly to `base` at stage_shards == 1 (ticks == M,
-    # stretch == 1, zero hops).
-    ticks = pipeline_micro + stage_shards - 1
-    stretch = ticks / xp.maximum(pipeline_micro, 1)
+    # stretch == 1, zero hops). With an interleaved schedule (v model
+    # chunks per device, parallel/pipeline.py interleaved_pipeline)
+    # a tick is 1/v of a stage-pass: v*M + S - 1 ticks total, bubble
+    # (S-1)/(v*M + S - 1), at v x the hand-off count.
+    v = xp.maximum(pipeline_interleave, 1)
+    ticks = v * pipeline_micro + stage_shards - 1
+    stretch = ticks / xp.maximum(v * pipeline_micro, 1)
     has_hops = (stage_shards - 1) / xp.maximum(stage_shards - 1, 1)
     hop_cost = params[11] + params[12] * atomic_bsz / xp.maximum(
         pipeline_micro, 1
@@ -196,6 +201,7 @@ class GoodputFunction:
         stage_shards=1,
         pipeline_micro=1,
         expert_shards=1,
+        pipeline_interleave=1,
     ):
         return self.evaluate(
             num_nodes,
@@ -207,6 +213,7 @@ class GoodputFunction:
             stage_shards=stage_shards,
             pipeline_micro=pipeline_micro,
             expert_shards=expert_shards,
+            pipeline_interleave=pipeline_interleave,
         )
 
     def evaluate(
@@ -220,6 +227,7 @@ class GoodputFunction:
         stage_shards=1,
         pipeline_micro=1,
         expert_shards=1,
+        pipeline_interleave=1,
     ):
         """num_replicas counts *data-parallel* replica groups; each
         group spans seq_shards*model_shards*stage_shards*expert_shards
@@ -237,6 +245,7 @@ class GoodputFunction:
             stage_shards=stage_shards,
             pipeline_micro=pipeline_micro,
             expert_shards=expert_shards,
+            pipeline_interleave=pipeline_interleave,
         ) * self.efficiency(batch_size)
 
     def throughput(
@@ -250,6 +259,7 @@ class GoodputFunction:
         stage_shards=1,
         pipeline_micro=1,
         expert_shards=1,
+        pipeline_interleave=1,
     ):
         """Samples/second: an iteration is accum_steps silent accumulation
         micro-steps plus one optim step that includes the gradient sync."""
@@ -257,6 +267,7 @@ class GoodputFunction:
         t_acc = _accum_time(
             np, p, atomic_bsz, seq_shards, model_shards,
             stage_shards, pipeline_micro, expert_shards,
+            pipeline_interleave,
         )
         t_net = _network_time(np, p, num_nodes, num_replicas)
         t_opt = np.exp(_log_optim_time(np, p, t_acc, t_net))
@@ -285,6 +296,7 @@ class GoodputFunction:
         stage_shards: int = 1,
         pipeline_micro: int = 1,
         expert_shards: int = 1,
+        pipeline_interleave: int = 1,
     ):
         """Best (goodput, atomic_bsz, accum_steps) per allocation, at a
         *fixed* (seq_shards, model_shards, stage_shards, expert_shards)
@@ -351,8 +363,15 @@ class GoodputFunction:
 
         # A pipeline microbatch cannot be smaller than one sample:
         # clamp the schedule's M to the candidate's atomic batch so
-        # tiny-batch candidates are priced at a feasible M.
+        # tiny-batch candidates are priced at a feasible M. The
+        # interleaved schedule additionally requires M >= S (wrap-hop
+        # buffering window, parallel/pipeline.py) — candidates whose
+        # clamped M falls below that run (and are priced as) plain
+        # GPipe.
         micro_eff = np.minimum(pipeline_micro, np.maximum(atomic_bsz, 1))
+        interleave_eff = np.where(
+            micro_eff >= stage_shards, pipeline_interleave, 1
+        )
         goodput = self.evaluate(
             nodes,
             replicas,
@@ -363,6 +382,7 @@ class GoodputFunction:
             stage_shards=stage_shards,
             pipeline_micro=micro_eff,
             expert_shards=expert_shards,
+            pipeline_interleave=interleave_eff,
         )
         best = np.argmax(goodput, axis=0)
         cols = np.arange(goodput.shape[1])
@@ -386,6 +406,7 @@ class GoodputFunction:
         max_stage_shards: int = 1,
         max_pipeline_micro: int = 8,
         max_expert_shards: int = 1,
+        pipeline_chunks: int = 0,
     ):
         """Best configuration over (data, seq, model, stage, expert)
         factorizations AND the pipeline microbatch count.
@@ -402,6 +423,14 @@ class GoodputFunction:
         This is the search the reference never needed — its only axis
         is data parallelism (reference: adaptdl/adaptdl/goodput.py:
         88-148 searches batch geometry at fixed parallelism).
+
+        ``pipeline_chunks`` declares how many uniform model chunks
+        the job can split into (parallel/pipeline.py
+        stack_interleaved_params); a stage candidate ss runs the
+        interleaved schedule with v = pipeline_chunks // ss chunks per
+        device (bubble (S-1)/(v*M + S - 1)), falling back to plain
+        GPipe (v = 1) when the chunks don't divide or none were
+        declared.
 
         Returns ``(goodput, atomic_bsz, accum_steps, seq_shards,
         model_shards, stage_shards, expert_shards, pipeline_micro)``,
@@ -436,6 +465,12 @@ class GoodputFunction:
             group = sp * tp * ss * ep
             dp = chips // group
             valid = (dp * group == chips) & (dp >= np.maximum(nodes, 1))
+            interleave = 1
+            if pipeline_chunks and ss > 1 and pipeline_chunks % ss == 0:
+                # interleaved_pipeline requires M >= S; only price the
+                # schedule where it is actually runnable.
+                if micro >= ss:
+                    interleave = max(pipeline_chunks // ss, 1)
             # Placeholder dp=1 keeps optimize()'s vectorized call well
             # formed for invalid rows; their goodput is masked to 0.
             dp_safe = np.where(valid, np.maximum(dp, 1), 1)
@@ -452,6 +487,7 @@ class GoodputFunction:
                 stage_shards=ss,
                 pipeline_micro=micro,
                 expert_shards=ep,
+                pipeline_interleave=interleave,
             )
             g = np.where(valid, np.atleast_1d(g), 0.0)
             results.append(
@@ -503,6 +539,7 @@ def _fit_objective(
     stage_shards,
     pipeline_micro,
     expert_shards,
+    pipeline_interleave,
     accum_time,
     optim_time,
     weight,
@@ -514,6 +551,7 @@ def _fit_objective(
     pred_acc = _accum_time(
         jnp, params, atomic_bsz, seq_shards, model_shards,
         stage_shards, pipeline_micro, expert_shards,
+        pipeline_interleave,
     )
     pred_net = _network_time(jnp, params, num_nodes, num_replicas)
     pred_log_opt = _log_optim_time(jnp, params, pred_acc, pred_net)
@@ -568,6 +606,7 @@ def fit_perf_params(
     stage_shards=None,
     pipeline_micro=None,
     expert_shards=None,
+    pipeline_interleave=None,
 ) -> PerfParams:
     """Fit PerfParams to profiled timings via L-BFGS-B + jax.grad.
 
@@ -598,11 +637,14 @@ def fit_perf_params(
         pipeline_micro = np.ones_like(num_nodes)
     if expert_shards is None:
         expert_shards = np.ones_like(num_nodes)
+    if pipeline_interleave is None:
+        pipeline_interleave = np.ones_like(num_nodes)
     seq_shards = np.asarray(seq_shards, dtype=float)
     model_shards = np.asarray(model_shards, dtype=float)
     stage_shards = np.asarray(stage_shards, dtype=float)
     pipeline_micro = np.asarray(pipeline_micro, dtype=float)
     expert_shards = np.asarray(expert_shards, dtype=float)
+    pipeline_interleave = np.asarray(pipeline_interleave, dtype=float)
 
     init = np.array(
         [1e-1, 1e-2, 1e-1, 1e-2, 1e-1, 1e-2, 1.0 + 1e-3]
@@ -668,6 +710,7 @@ def fit_perf_params(
                 _pad(stage_shards, 1),
                 _pad(pipeline_micro, 1),
                 _pad(expert_shards, 1),
+                _pad(pipeline_interleave, 1),
                 _pad(accum_step_time, 1),
                 _pad(optim_step_time, 1),
                 weight,

@@ -92,6 +92,9 @@ class MetricsState:
     # largest M the job's data layer supports (the search's cap).
     pipeline_microbatches: int = 4
     max_pipeline_micro: int = 8
+    # Interleaved-schedule chunk count the model can split into
+    # (0 = plain GPipe only); see parallel/pipeline.py.
+    pipeline_chunks: int = 0
     progress: float = 0.0
 
 
@@ -180,6 +183,7 @@ def set_topology_config(
     pipeline_microbatches: int = 4,
     max_expert_shards: int = 1,
     max_pipeline_micro: int | None = None,
+    pipeline_chunks: int = 0,
 ) -> None:
     """Advertise how far this job can shard each sample/model
     (sequence shards need ring attention; model shards need a
@@ -187,7 +191,9 @@ def set_topology_config(
     ``env.pipeline_micro()``; expert shards need an expert-sharded
     MoE). The scheduler's topology search stays within these limits;
     ``max_pipeline_micro`` caps the GPipe M it may pick (defaults to
-    the larger of 8 and the job's default M)."""
+    the larger of 8 and the job's default M); ``pipeline_chunks``
+    declares the interleaved schedule's uniform chunk count (jobs
+    built on ``interleaved_loss``; 0 = plain GPipe only)."""
     _state.max_seq_shards = max(int(max_seq_shards), 1)
     _state.max_model_shards = max(int(max_model_shards), 1)
     _state.max_stage_shards = max(int(max_stage_shards), 1)
@@ -196,6 +202,7 @@ def set_topology_config(
     if max_pipeline_micro is None:
         max_pipeline_micro = max(8, _state.pipeline_microbatches)
     _state.max_pipeline_micro = max(int(max_pipeline_micro), 1)
+    _state.pipeline_chunks = max(int(pipeline_chunks), 0)
 
 
 def _topology_suffix() -> tuple[int, int, int, int, int]:
@@ -273,6 +280,8 @@ def _fit() -> PerfParams | None:
             (key, _ProfileEntry(**vars(entry)))
             for key, entry in _state.profile.items()
         ]
+    chunks = _state.pipeline_chunks
+    interleaves = []
     for (n, r, sp, tp, ss, ep, micro, bsz), entry in snapshot:
         if entry.optim_count == 0:
             continue
@@ -292,6 +301,16 @@ def _fit() -> PerfParams | None:
         bszs.append(bsz)
         accum_times.append(accum)
         optim_times.append(entry.optim_time_sum / entry.optim_count)
+        # A chunk-declared job runs the interleaved schedule whenever
+        # the observed (ss, M) admits it — the fit must model those
+        # rows with the v-shrunken bubble or it mis-attributes the
+        # savings to the compute terms (and the topology search would
+        # then discount the bubble twice).
+        runnable = (
+            chunks > 0 and ss > 1
+            and chunks % ss == 0 and micro >= ss
+        )
+        interleaves.append(chunks // ss if runnable else 1)
     if not nodes:
         return None
     return fit_perf_params(
@@ -305,6 +324,7 @@ def _fit() -> PerfParams | None:
         stage_shards=sss,
         pipeline_micro=micros,
         expert_shards=eps,
+        pipeline_interleave=interleaves,
     )
 
 
@@ -371,6 +391,7 @@ def fit_and_report_now() -> None:
     hints["maxExpertShards"] = _state.max_expert_shards
     hints["maxPipelineMicro"] = _state.max_pipeline_micro
     hints["pipelineMicrobatches"] = _topology_suffix()[4]
+    hints["pipelineChunks"] = _state.pipeline_chunks
     if _state.grad_params is not None:
         hints["gradParams"] = dict(_state.grad_params._asdict())
     if _state.perf_params is not None:

@@ -64,6 +64,7 @@ def job_info_from_hints(
                 or hints.get("pipelineMicrobatches")
                 or 8
             ),
+            pipeline_chunks=int(hints.get("pipelineChunks") or 0),
         )
         profiled = int(hints.get("maxProfiledReplicas") or 1)
         # Profiling gates scale-up: at most double what was measured.

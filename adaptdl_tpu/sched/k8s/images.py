@@ -38,6 +38,11 @@ ENV PYTHONUNBUFFERED=1
 # Directories never shipped in a build context (mirrors the
 # reference's .dockerignore handling, cli/bin/adaptdl:158-170).
 _SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", ".venv", "node_modules"}
+# The generated Dockerfile is hashed via ``extra`` (its content), not
+# the tree walk — otherwise the first real build (which writes it into
+# the context) would produce a different tag than the --dry-run
+# planned_ref computed on the clean tree.
+_SKIP_FILES = {"Dockerfile.adaptdl"}
 
 
 def content_tag(context_dir: str, extra: bytes = b"") -> str:
@@ -47,6 +52,8 @@ def content_tag(context_dir: str, extra: bytes = b"") -> str:
     for root, dirs, files in os.walk(context_dir):
         dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
         for fname in sorted(files):
+            if fname in _SKIP_FILES:
+                continue
             path = os.path.join(root, fname)
             rel = os.path.relpath(path, context_dir)
             digest.update(rel.encode())

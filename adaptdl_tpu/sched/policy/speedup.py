@@ -35,6 +35,7 @@ class SpeedupFunction:
         max_stage_shards: int = 1,
         max_expert_shards: int = 1,
         max_pipeline_micro: int = 8,
+        pipeline_chunks: int = 0,
     ):
         self._goodput_fn = goodput_fn
         self._max_batch_size = max_batch_size
@@ -45,6 +46,7 @@ class SpeedupFunction:
         self._max_stage_shards = max(int(max_stage_shards or 1), 1)
         self._max_expert_shards = max(int(max_expert_shards or 1), 1)
         self._max_pipeline_micro = max(int(max_pipeline_micro or 1), 1)
+        self._pipeline_chunks = max(int(pipeline_chunks or 0), 0)
         # Base goodput: one chip on one slice.
         base, *_ = self._optimize(np.array([1]), np.array([1]))
         self._base_goodput = float(np.atleast_1d(base)[0])
@@ -65,6 +67,7 @@ class SpeedupFunction:
             max_stage_shards=self._max_stage_shards,
             max_expert_shards=self._max_expert_shards,
             max_pipeline_micro=self._max_pipeline_micro,
+            pipeline_chunks=self._pipeline_chunks,
         )
 
     def best_config(

@@ -32,7 +32,10 @@ GRAD_PARAMS_KEYS = tuple(GradParams._fields)
 # reference analog — the reference has no sp/tp/ss/ep axes).
 # maxPipelineMicro caps the GPipe microbatch count the scheduler may
 # choose (data-layer divisibility); pipelineMicrobatches reports the
-# M currently running, for dashboards and the fit.
+# M currently running, for dashboards and the fit. pipelineChunks
+# declares the interleaved schedule's uniform chunk count (0/absent =
+# plain GPipe only) — the topology search prices stage candidates at
+# v = pipelineChunks // ss chunks per device.
 SCHED_HINTS_KEYS = (
     "initBatchSize",
     "localBszBounds",
@@ -47,6 +50,7 @@ SCHED_HINTS_KEYS = (
     "maxExpertShards",
     "maxPipelineMicro",
     "pipelineMicrobatches",
+    "pipelineChunks",
 )
 
 

@@ -570,7 +570,14 @@ def _make_context(tmp_path):
 def test_submit_build_pushes_and_digest_pins(
     fake_docker, fake_kubectl, tmp_path, capsys
 ):
+    from adaptdl_tpu.sched.k8s.images import planned_ref
+
     ctx = _make_context(tmp_path)
+    # What --dry-run would promise on the clean tree (before the
+    # generated Dockerfile lands in the context).
+    promised = planned_ref(
+        str(ctx), "us-docker.pkg.dev/proj/repo", "bert"
+    )
     rc = main(
         [
             "submit",
@@ -591,6 +598,9 @@ def test_submit_build_pushes_and_digest_pins(
     build_argv = fake_docker()[0]["argv"]
     tag = build_argv[build_argv.index("-t") + 1]
     assert tag.startswith("us-docker.pkg.dev/proj/repo/bert:")
+    # The pushed tag is exactly what a prior --dry-run promised (the
+    # generated Dockerfile is excluded from the context hash).
+    assert tag == promised
     # The applied manifest carries the pushed DIGEST, not the tag.
     (apply_call,) = fake_kubectl()
     assert "@sha256:" + "ab" * 32 in apply_call["stdin"]

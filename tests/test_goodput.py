@@ -542,3 +542,75 @@ def test_profile_step_records_multi_slice_keys(monkeypatch):
     fitted = metrics._fit()
     assert fitted is not None
     metrics._reset_state()
+
+
+# ---- interleaved pipeline (chunked) pricing ------------------------------
+
+
+def test_interleave_shrinks_bubble_in_accum_time():
+    """v chunks per device: ticks v*M + S - 1, stretch -> 1 as v grows;
+    the hand-off count scales with v (nothing is free)."""
+    from adaptdl_tpu.goodput import _accum_time
+
+    perf = PerfParams(
+        0.02, 0.01, 0.5, 0.05, 0.01, 0.001, 1.5,
+        alpha_pp=0.0, beta_pp=0.0,
+    )
+    ideal_half = perf.alpha_c + perf.beta_c * 8 / 2
+    gpipe = _accum_time(np, perf, 8, 1, 1, 2, 4)
+    inter = _accum_time(np, perf, 8, 1, 1, 2, 4, 1, 2)
+    assert gpipe == pytest.approx(ideal_half * 5 / 4)
+    assert inter == pytest.approx(ideal_half * 9 / 8)  # (2*4+1)/(2*4)
+    assert inter < gpipe
+    # With a nonzero hop cost the v=2 schedule pays ~2x the hops.
+    perf_hop = PerfParams(
+        0.02, 0.01, 0.5, 0.05, 0.01, 0.001, 1.5,
+        alpha_pp=0.01, beta_pp=0.0,
+    )
+    gpipe_h = _accum_time(np, perf_hop, 8, 1, 1, 2, 4)
+    inter_h = _accum_time(np, perf_hop, 8, 1, 1, 2, 4, 1, 2)
+    hop_g = gpipe_h - gpipe
+    hop_i = inter_h - inter
+    assert hop_i == pytest.approx(hop_g * 9 / 5)  # ticks 9 vs 5
+
+
+def test_topology_search_uses_declared_chunks():
+    """A job declaring pipeline chunks is priced at the interleaved
+    schedule for stage candidates, beating the same job without the
+    declaration whenever a pipeline is chosen at all."""
+    perf = PerfParams(
+        0.02, 0.01, 0.5, 0.05, 0.01, 0.1, 1.5,
+        alpha_pp=1e-5, beta_pp=1e-6,
+    )
+    fn = GoodputFunction(perf, GRAD_LONGCTX, 8)
+    kwargs = dict(
+        max_batch_size=64, atomic_bsz_range=(1, 32),
+        accumulation=True, max_stage_shards=4, max_pipeline_micro=8,
+    )
+    g_plain, *_, ss_plain, _ep, _m = fn.optimize_topology(
+        1, 8, **kwargs
+    )
+    g_chunked, *_, ss_chunked, _ep2, _m2 = fn.optimize_topology(
+        1, 8, pipeline_chunks=8, **kwargs
+    )
+    assert ss_plain > 1  # the pipeline is worth it here at all
+    assert g_chunked > g_plain  # interleaving strictly shrinks bubble
+
+
+def test_interleave_requires_divisible_chunks_and_enough_micro():
+    """Indivisible chunk counts or M < S fall back to plain GPipe."""
+    perf = PerfParams(
+        0.02, 0.01, 0.5, 0.05, 0.01, 0.1, 1.5,
+        alpha_pp=1e-5, beta_pp=1e-6,
+    )
+    fn = GoodputFunction(perf, GRAD_LONGCTX, 8)
+    kwargs = dict(
+        max_batch_size=64, atomic_bsz_range=(1, 32),
+        accumulation=True, max_stage_shards=2, max_pipeline_micro=8,
+    )
+    g_plain, *_ = fn.optimize_topology(1, 8, **kwargs)
+    # 3 chunks cannot divide over 2 stages: same as undeclared.
+    g_indiv, *_ = fn.optimize_topology(
+        1, 8, pipeline_chunks=3, **kwargs
+    )
+    assert g_indiv == pytest.approx(g_plain)
