@@ -1,0 +1,189 @@
+"""The flagship transformer LM under pipeline parallelism.
+
+Builds an :class:`~adaptdl_tpu.trainer.ElasticTrainer`-ready
+(loss_fn, params) pair that runs the TransformerLM block stack through
+the GPipe or interleaved collective-permute schedule
+(``adaptdl_tpu.parallel.pipeline``) over a ``dp x stage`` mesh — the
+piece that turns pipeline parallelism from a toy-MLP capability into a
+model-zoo one. (The reference has no pipeline axis at all, SURVEY.md
+§2.7; its transformer example is pure DP,
+examples/transformer/main.py.)
+
+Layout decisions (TPU-first):
+
+- **Blocks are the pipeline.** Only the uniform-[batch, seq, d_model]
+  transformer blocks are staged; embedding, final LayerNorm, and the
+  tied LM head are *replicated* across the stage group and computed
+  redundantly. That keeps the inter-stage activation shape uniform
+  (the collective-permute schedule's requirement) and the redundant
+  work is O(vocab·d) per device — noise next to the block stack at
+  pipeline-worthy depths.
+- **Chunks scan their layers.** A chunk's ``layers_per_chunk`` block
+  applications run as a ``lax.scan`` over layer-stacked params: one
+  trace regardless of depth, XLA-friendly.
+- **Params carry the schedule.** ``blocks`` leaves are stacked
+  ``[S, layers_per_chunk, ...]`` (GPipe) or ``[S, v, layers_per_chunk,
+  ...]`` (interleaved), sharded ``P("stage")`` by
+  :func:`pipeline_lm_sharding_fn`; embed/head/ln_f leaves replicate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from adaptdl_tpu.models.transformer import Block, TransformerConfig
+from adaptdl_tpu.parallel.mesh import STAGE_AXIS
+from adaptdl_tpu.parallel.pipeline import (
+    gpipe,
+    interleaved_pipeline,
+    stack_interleaved_params,
+    stack_stage_params,
+)
+
+
+def pipeline_lm_sharding_fn(path, leaf) -> P:
+    """``param_sharding_fn`` for :func:`init_pipeline_lm` params:
+    block leaves stage-sharded, everything else replicated."""
+    keys = [getattr(k, "key", getattr(k, "name", "")) for k in path]
+    if keys and str(keys[0]) == "blocks":
+        return P(STAGE_AXIS)
+    return P()
+
+
+def init_pipeline_lm(
+    config: TransformerConfig,
+    num_stages: int,
+    num_micro: int,
+    interleave: int = 1,
+    rng=None,
+    seq_len: int | None = None,
+):
+    """(loss_fn, params) for a pipelined causal LM.
+
+    ``config.num_layers`` must divide into ``num_stages * interleave``
+    uniform chunks. ``loss_fn(params, batch, rng)`` expects
+    ``batch["tokens"]`` of shape ``[rows, seq_len + 1]`` with
+    ``rows`` divisible by ``num_micro``, and is built for an
+    ElasticTrainer over a ``{"data": dp, "stage": num_stages}`` mesh
+    with ``param_sharding_fn=pipeline_lm_sharding_fn``. Interleaved
+    schedules require ``num_micro >= num_stages``.
+    """
+    total_chunks = num_stages * max(interleave, 1)
+    assert config.num_layers % total_chunks == 0, (
+        f"{config.num_layers} layers cannot split into "
+        f"{total_chunks} uniform chunks ({num_stages} stages x "
+        f"{interleave} interleave)"
+    )
+    assert interleave == 1 or num_micro >= num_stages, (
+        "the interleaved schedule needs num_micro >= num_stages"
+    )
+    assert config.dropout_rate == 0, (
+        "dropout is unsupported under the pipeline schedule (blocks "
+        "run without dropout_rng); set dropout_rate=0"
+    )
+    layers_per_chunk = config.num_layers // total_chunks
+    rng = rng if rng is not None else jax.random.key(0)
+    seq_len = seq_len or min(config.max_seq_len, 128)
+
+    # Pipeline stages see plain (non-ring) attention; the seq axis
+    # composes with dp, not with the staged blocks, in this layout.
+    block_config = dataclasses.replace(
+        config, seq_axis=None, attention_fn=None, moe_axis=None
+    )
+    block = Block(block_config)
+    if config.remat:
+        block = nn.remat(Block, static_argnums=())(block_config)
+    embed = nn.Embed(
+        config.vocab_size, config.d_model, dtype=config.dtype
+    )
+    ln_f = nn.LayerNorm(dtype=config.dtype, use_bias=False)
+
+    dummy = jnp.zeros((1, seq_len, config.d_model), config.dtype)
+    positions0 = jnp.arange(seq_len)
+    rng, embed_rng, ln_rng = jax.random.split(rng, 3)
+    layer_rngs = jax.random.split(rng, config.num_layers)
+    layer_params = [
+        block.init(layer_rngs[i], dummy, positions0)["params"]
+        for i in range(config.num_layers)
+    ]
+    # Chunk c owns layers [c*lpc, (c+1)*lpc) in GLOBAL chunk order —
+    # layer-stacked so the chunk body is a scan.
+    chunk_trees = [
+        jax.tree.map(
+            lambda *leaves: jnp.stack(leaves),
+            *layer_params[c * layers_per_chunk:(c + 1) * layers_per_chunk],
+        )
+        for c in range(total_chunks)
+    ]
+    if interleave > 1:
+        blocks = stack_interleaved_params(chunk_trees, num_stages)
+    else:
+        blocks = stack_stage_params(chunk_trees)
+    params: dict[str, Any] = {
+        "embed": embed.init(
+            embed_rng, jnp.zeros((1, seq_len), jnp.int32)
+        )["params"],
+        "ln_f": ln_f.init(ln_rng, dummy)["params"],
+        "blocks": blocks,
+    }
+
+    def chunk_fn(chunk_params, x):
+        """Apply one chunk (layers_per_chunk blocks) to [mb, seq, d]."""
+        positions = jnp.arange(x.shape[1])
+
+        def body(h, one_layer):
+            h = block.apply({"params": one_layer}, h, positions)
+            return h, None
+
+        out, _ = lax.scan(body, x, chunk_params)
+        return out
+
+    def loss_fn(params, batch, rng):
+        del rng  # dropout unsupported under the pipeline schedule
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        assert inputs.shape[0] % num_micro == 0, (
+            f"per-replica batch {inputs.shape[0]} not divisible into "
+            f"{num_micro} pipeline microbatches"
+        )
+        x = embed.apply({"params": params["embed"]}, inputs).astype(
+            config.dtype
+        )
+        micro = x.reshape((num_micro, -1) + x.shape[1:])
+        blocks_local = jax.tree.map(
+            lambda leaf: leaf[0], params["blocks"]
+        )
+        if interleave > 1:
+            outs = interleaved_pipeline(
+                chunk_fn, blocks_local, micro
+            )
+        else:
+            outs = gpipe(chunk_fn, blocks_local, micro)
+        final = outs.reshape(x.shape)
+        stage = lax.axis_index(STAGE_AXIS)
+        num_stages_ = lax.axis_size(STAGE_AXIS)
+        is_last = stage == num_stages_ - 1
+        # Garbage intermediates off the last stage would feed the
+        # softmax; neutralize them BEFORE the head (0 * NaN is NaN in
+        # the cotangent, see gpipe_loss).
+        final = jnp.where(is_last, final, jnp.ones_like(final))
+        h = ln_f.apply({"params": params["ln_f"]}, final)
+        logits = embed.apply(
+            {"params": params["embed"]}, h, method="attend"
+        ).astype(jnp.float32)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets
+        ).mean()
+        return lax.psum(
+            jnp.where(is_last, loss, 0.0), STAGE_AXIS
+        )
+
+    return loss_fn, params

@@ -170,6 +170,15 @@ def interleaved_pipeline(
     stage = lax.axis_index(axis_name)
     num_stages = lax.axis_size(axis_name)
     num_micro = micro_inputs.shape[0]
+    if num_micro < num_stages:
+        # With M < S the wrap-hop activation lands AFTER its read
+        # tick and device 0 would consume garbage silently; both
+        # values are static, so fail at trace time.
+        raise ValueError(
+            f"interleaved pipeline needs num_micro >= num_stages "
+            f"(got M={num_micro} < S={num_stages}); use gpipe or "
+            "raise the microbatch count"
+        )
     v = jax.tree.leaves(chunks_local)[0].shape[0]
     ticks = v * num_micro + num_stages - 1
     perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]

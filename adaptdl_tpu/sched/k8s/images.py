@@ -51,8 +51,12 @@ def content_tag(context_dir: str, extra: bytes = b"") -> str:
     digest = hashlib.sha256(extra)
     for root, dirs, files in os.walk(context_dir):
         dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+        at_root = os.path.samefile(root, context_dir)
         for fname in sorted(files):
-            if fname in _SKIP_FILES:
+            # Only the context-root generated Dockerfile is excluded;
+            # a user's same-named file deeper in the tree ships in the
+            # image and must affect the tag.
+            if at_root and fname in _SKIP_FILES:
                 continue
             path = os.path.join(root, fname)
             rel = os.path.relpath(path, context_dir)

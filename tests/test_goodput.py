@@ -614,3 +614,33 @@ def test_interleave_requires_divisible_chunks_and_enough_micro():
         1, 8, pipeline_chunks=3, **kwargs
     )
     assert g_indiv == pytest.approx(g_plain)
+    # M < S: the only allowed M (1) is below the 4-stage buffering
+    # window, so interleave pricing must not apply anywhere.
+    kwargs_small_m = dict(kwargs, max_stage_shards=4)
+    kwargs_small_m["max_pipeline_micro"] = 1
+    g_plain_m, *_ = fn.optimize_topology(1, 8, **kwargs_small_m)
+    g_chunk_m, *_ = fn.optimize_topology(
+        1, 8, pipeline_chunks=8, **kwargs_small_m
+    )
+    assert g_chunk_m == pytest.approx(g_plain_m)
+
+
+def test_optimize_drops_interleave_when_clamp_breaks_m_ge_s():
+    """optimize() clamps M to atomic_bsz; candidates whose clamped M
+    falls below S must be priced as plain GPipe, not interleaved."""
+    perf = PerfParams(
+        0.02, 0.01, 0.5, 0.05, 0.01, 0.1, 1.5,
+        alpha_pp=0.0, beta_pp=0.0,
+    )
+    fn = GoodputFunction(perf, GRAD_LONGCTX, 8)
+    # atomic ceiling 2 clamps M=8 -> 2 < S=4: v must drop to 1.
+    g_inter = fn.optimize(
+        1, 2, max_batch_size=16, atomic_bsz_range=(1, 2),
+        accumulation=True, stage_shards=4, pipeline_micro=8,
+        pipeline_interleave=2,
+    )[0]
+    g_plain = fn.optimize(
+        1, 2, max_batch_size=16, atomic_bsz_range=(1, 2),
+        accumulation=True, stage_shards=4, pipeline_micro=8,
+    )[0]
+    assert g_inter == pytest.approx(g_plain)
