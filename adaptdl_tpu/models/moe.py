@@ -102,6 +102,55 @@ def _routing(x_local, router, num_experts, capacity, top_k=1):
     return dispatch, combine, aux
 
 
+def _expert_choice_routing(x_local, router, num_experts, capacity):
+    """Expert-choice dispatch/combine for one device's token slice
+    (Zhou et al. 2022, arXiv:2202.09368): each EXPERT selects its
+    top-``capacity`` tokens by router affinity, instead of tokens
+    selecting experts. Load balance is structural — every expert
+    processes exactly ``capacity`` tokens — so there is no auxiliary
+    loss (returned as 0.0); tokens may be picked by several experts or
+    none (residual pass-through).
+
+    Returns (dispatch [s, E, C], combine [s, E, C], aux 0.0).
+    """
+    logits = x_local.astype(jnp.float32) @ router.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)  # [s, E]
+    # Each expert's top-C tokens by affinity.
+    gates, token_idx = lax.top_k(probs.T, capacity)  # [E, C] both
+    slots = jax.nn.one_hot(
+        token_idx, probs.shape[0], dtype=jnp.float32
+    )  # [E, C, s]
+    dispatch = slots.transpose(2, 0, 1)  # [s, E, C]
+    combine = dispatch * gates[None, :, :]  # gate of slot (e, c)
+    return dispatch, combine, jnp.zeros(())
+
+
+def _capacity(
+    router_type, capacity_factor, top_k, slice_len, num_experts
+):
+    """Per-(source slice, expert) token capacity.
+
+    Token-choice scales with top_k (each token queues k times);
+    expert-choice does not (every expert takes exactly C tokens) and
+    is additionally clamped to the slice length — an expert can never
+    select more tokens than the slice holds (lax.top_k would reject
+    k > size at trace time)."""
+    if router_type == "experts":
+        return min(
+            max(int(capacity_factor * slice_len / num_experts), 1),
+            slice_len,
+        )
+    if router_type != "tokens":
+        raise ValueError(
+            f"unknown router_type {router_type!r}: expected "
+            "\"tokens\" (Switch/GShard) or \"experts\" "
+            "(expert-choice)"
+        )
+    return max(
+        int(capacity_factor * top_k * slice_len / num_experts), 1
+    )
+
+
 def switch_moe(
     params: Any,
     x: jnp.ndarray,
@@ -110,6 +159,7 @@ def switch_moe(
     activation: Callable = jax.nn.gelu,
     top_k: int = 1,
     return_aux: bool = False,
+    router_type: str = "tokens",
 ):
     """Expert-parallel Switch/GShard FFN inside a shard_map manual
     over ``axis_name``.
@@ -126,7 +176,11 @@ def switch_moe(
         re-assembled, so the return value is the full ``[n, d]``
         MoE output (identical across the group).
       return_aux: also return the load-balancing auxiliary loss
-        (pmean'd over the group — a replicated scalar).
+        (pmean'd over the group — a replicated scalar; identically 0
+        for expert-choice routing, where balance is structural).
+      router_type: ``"tokens"`` (Switch/GShard token-choice, honors
+        ``top_k``) or ``"experts"`` (expert-choice: every expert takes
+        its top-capacity tokens — arXiv:2202.09368).
     """
     my_rank = lax.axis_index(axis_name)
     num_devices = lax.axis_size(axis_name)
@@ -141,16 +195,21 @@ def switch_moe(
         f"batch {n} must divide across {num_devices} expert devices"
     )
     slice_len = n // num_devices
-    capacity = max(
-        int(capacity_factor * top_k * slice_len / num_experts), 1
+    capacity = _capacity(
+        router_type, capacity_factor, top_k, slice_len, num_experts
     )
 
     x_local = lax.dynamic_slice_in_dim(
         x, my_rank * slice_len, slice_len, axis=0
     )  # [s, d]
-    dispatch, combine, aux = _routing(
-        x_local, params["router"], num_experts, capacity, top_k
-    )
+    if router_type == "experts":
+        dispatch, combine, aux = _expert_choice_routing(
+            x_local, params["router"], num_experts, capacity
+        )
+    else:
+        dispatch, combine, aux = _routing(
+            x_local, params["router"], num_experts, capacity, top_k
+        )
     # [E, C, d]: this device's tokens, binned by destination expert,
     # then grouped by destination DEVICE for the exchange.
     sent = jnp.einsum(
@@ -201,6 +260,7 @@ def dense_switch_moe(
     activation: Callable = jax.nn.gelu,
     top_k: int = 1,
     return_aux: bool = False,
+    router_type: str = "tokens",
 ):
     """Single-device reference with IDENTICAL routing math (same
     per-slice capacity binning) — the equivalence target for tests and
@@ -208,17 +268,22 @@ def dense_switch_moe(
     n, dim = x.shape
     num_experts = expert_params_stacked["w_up"].shape[0]
     slice_len = n // num_slices
-    capacity = max(
-        int(capacity_factor * top_k * slice_len / num_experts), 1
+    capacity = _capacity(
+        router_type, capacity_factor, top_k, slice_len, num_experts
     )
     outs, auxes = [], []
     w_up = expert_params_stacked["w_up"].astype(jnp.float32)
     w_down = expert_params_stacked["w_down"].astype(jnp.float32)
     for s in range(num_slices):
         x_local = x[s * slice_len : (s + 1) * slice_len]
-        dispatch, combine, aux = _routing(
-            x_local, router, num_experts, capacity, top_k
-        )
+        if router_type == "experts":
+            dispatch, combine, aux = _expert_choice_routing(
+                x_local, router, num_experts, capacity
+            )
+        else:
+            dispatch, combine, aux = _routing(
+                x_local, router, num_experts, capacity, top_k
+            )
         sent = jnp.einsum(
             "sec,sd->ecd", dispatch, x_local.astype(jnp.float32)
         )

@@ -89,6 +89,11 @@ def init_pipeline_lm(
         "dropout is unsupported under the pipeline schedule (blocks "
         "run without dropout_rng); set dropout_rate=0"
     )
+    assert config.moe_every_n == 0, (
+        "MoE blocks are unsupported under the pipeline schedule (the "
+        "staged chunk scan applies the dense Block only); compose "
+        "expert parallelism with dp instead, or set moe_every_n=0"
+    )
     layers_per_chunk = config.num_layers // total_chunks
     rng = rng if rng is not None else jax.random.key(0)
     seq_len = seq_len or min(config.max_seq_len, 128)

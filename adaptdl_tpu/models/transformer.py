@@ -64,6 +64,9 @@ class TransformerConfig:
     moe_capacity_factor: float = 2.0
     moe_top_k: int = 1
     moe_aux_weight: float = 1e-2
+    # "tokens" (Switch/GShard token-choice) or "experts"
+    # (expert-choice, arXiv:2202.09368: structural balance, aux = 0).
+    moe_router: str = "tokens"
     # Test/equivalence knob: the dense (moe_axis=None) path bins
     # token slices as if the batch were split across this many
     # devices, matching an expert-parallel run's per-device capacity.
@@ -204,6 +207,7 @@ class MoEFFN(nn.Module):
                 capacity_factor=cfg.moe_capacity_factor,
                 top_k=cfg.moe_top_k,
                 return_aux=True,
+                router_type=cfg.moe_router,
             )
         else:
             out, aux = dense_switch_moe(
@@ -214,6 +218,7 @@ class MoEFFN(nn.Module):
                 capacity_factor=cfg.moe_capacity_factor,
                 top_k=cfg.moe_top_k,
                 return_aux=True,
+                router_type=cfg.moe_router,
             )
         self.sow("moe_losses", "aux", aux)
         return out.reshape(x.shape).astype(cfg.dtype)
