@@ -49,6 +49,104 @@ from adaptdl_tpu.parallel.pipeline import (
 )
 
 
+def _map_params_like(tree, fn):
+    """Apply ``fn`` to every subtree shaped like the pipeline-LM
+    params dict (keys exactly {embed, ln_f, blocks}) anywhere in a
+    TrainState — params themselves, optimizer moments (mu/nu), and any
+    other params-shaped mirror all get the same restacking."""
+    keys = {"embed", "ln_f", "blocks"}
+
+    def walk(node):
+        if isinstance(node, dict):
+            if set(node.keys()) == keys:
+                return fn(node)
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, tuple):
+            vals = [walk(v) for v in node]
+            if hasattr(node, "_fields"):  # NamedTuple
+                return type(node)(*vals)
+            return tuple(vals)
+        if isinstance(node, list):
+            return [walk(v) for v in node]
+        return node
+
+    return walk(tree)
+
+
+def _to_layer_major(leaf, num_stages: int, interleave: int):
+    """[S, (v,) lpc, ...] -> [num_layers, ...] in global layer order
+    (layer l = (k*S + d) * lpc + i lives at [d, (k,) i])."""
+    import numpy as _np
+
+    if interleave > 1:
+        s, v, lpc = leaf.shape[:3]
+        # [d, k, i] -> order (k, d, i)
+        arranged = _np.transpose(
+            leaf, (1, 0, 2) + tuple(range(3, leaf.ndim))
+        )
+        return arranged.reshape((s * v * lpc,) + leaf.shape[3:])
+    s, lpc = leaf.shape[:2]
+    return leaf.reshape((s * lpc,) + leaf.shape[2:])
+
+
+def _from_layer_major(leaf, num_stages: int, interleave: int):
+    """Inverse of :func:`_to_layer_major` for the new topology."""
+    import numpy as _np
+
+    num_layers = leaf.shape[0]
+    lpc = num_layers // (num_stages * interleave)
+    if interleave > 1:
+        shaped = leaf.reshape(
+            (interleave, num_stages, lpc) + leaf.shape[1:]
+        )
+        return _np.transpose(
+            shaped, (1, 0, 2) + tuple(range(3, shaped.ndim))
+        )
+    return leaf.reshape((num_stages, lpc) + leaf.shape[1:])
+
+
+def pipeline_checkpoint_transforms(num_stages: int, interleave: int = 1):
+    """(transform_save, transform_load) for
+    ``ElasticTrainer.make_checkpoint_state``: block leaves are stored
+    layer-major on disk (topology-independent) and restacked for the
+    RUN's (num_stages, interleave) on load — so the scheduler can
+    change the stage factorization between restarts and the job
+    restores weights AND optimizer moments (reference has no
+    structure-changing rescale at all; its checkpoints are plain
+    state_dicts, adaptdl/torch/checkpoint).
+    """
+
+    def save(host_state):
+        return _map_params_like(
+            host_state,
+            lambda p: {
+                **p,
+                "blocks": jax.tree.map(
+                    lambda leaf: _to_layer_major(
+                        leaf, num_stages, interleave
+                    ),
+                    p["blocks"],
+                ),
+            },
+        )
+
+    def load(host_state):
+        return _map_params_like(
+            host_state,
+            lambda p: {
+                **p,
+                "blocks": jax.tree.map(
+                    lambda leaf: _from_layer_major(
+                        leaf, num_stages, interleave
+                    ),
+                    p["blocks"],
+                ),
+            },
+        )
+
+    return save, load
+
+
 def pipeline_lm_sharding_fn(path, leaf) -> P:
     """``param_sharding_fn`` for :func:`init_pipeline_lm` params:
     block leaves stage-sharded, everything else replicated."""

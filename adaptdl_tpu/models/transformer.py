@@ -66,6 +66,10 @@ class TransformerConfig:
     moe_aux_weight: float = 1e-2
     # "tokens" (Switch/GShard token-choice) or "experts"
     # (expert-choice, arXiv:2202.09368: structural balance, aux = 0).
+    # CAVEAT: expert-choice ranks across the whole token slice, so a
+    # token's routing depends on LATER tokens — not causally valid for
+    # autoregressive training/decoding; intended for encoder/MLM
+    # models (causal=False), the paper's setting.
     moe_router: str = "tokens"
     # Test/equivalence knob: the dense (moe_axis=None) path bins
     # token slices as if the batch were split across this many

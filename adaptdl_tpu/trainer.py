@@ -822,8 +822,14 @@ class ElasticTrainer:
         self, get_state: Callable[[], TrainState],
         set_state: Callable[[TrainState], None],
         name: str = "elastic_trainer",
+        transform_save=None,
+        transform_load=None,
     ) -> "TrainerCheckpoint":
-        return TrainerCheckpoint(name, self, get_state, set_state)
+        return TrainerCheckpoint(
+            name, self, get_state, set_state,
+            transform_save=transform_save,
+            transform_load=transform_load,
+        )
 
 
 class TrainerCheckpoint(checkpoint.State):
@@ -844,11 +850,27 @@ class TrainerCheckpoint(checkpoint.State):
     notion of re-materialising onto a device mesh).
     """
 
-    def __init__(self, name, trainer, get_state, set_state):
+    def __init__(
+        self,
+        name,
+        trainer,
+        get_state,
+        set_state,
+        transform_save=None,
+        transform_load=None,
+    ):
+        """``transform_save(host_state) -> host_state`` /
+        ``transform_load(host_state) -> host_state`` convert between
+        the run layout and a topology-independent canonical disk
+        layout — the hook that lets a STRUCTURE-changing topology
+        (e.g. pipeline stage restacking, models/pipeline_lm.py) rescale
+        across restarts, where sp/tp only need re-sharding."""
         super().__init__(name)
         self._trainer = trainer
         self._get_state = get_state
         self._set_state = set_state
+        self._transform_save = transform_save
+        self._transform_load = transform_load
 
     def save(self, fileobj):
         state = self._get_state()
@@ -865,10 +887,15 @@ class TrainerCheckpoint(checkpoint.State):
                 )
         # RNG keys are opaque typed arrays; store raw key data.
         state = state._replace(rng=jax.random.key_data(state.rng))
-        pickle.dump(jax.tree.map(np.asarray, state), fileobj)
+        state = jax.tree.map(np.asarray, state)
+        if self._transform_save is not None:
+            state = self._transform_save(state)
+        pickle.dump(state, fileobj)
 
     def load(self, fileobj):
         host_state = pickle.load(fileobj)
+        if self._transform_load is not None:
+            host_state = self._transform_load(host_state)
         host_state = host_state._replace(
             rng=jax.random.wrap_key_data(jnp.asarray(host_state.rng)),
         )

@@ -39,6 +39,12 @@ def main():
     # the scheduler's chosen expertShards (ADAPTDL_EXPERT_SHARDS).
     parser.add_argument("--moe-experts", type=int, default=0)
     parser.add_argument("--moe-top-k", type=int, default=1)
+    # Pipeline parallelism: the block stack runs the GPipe (or
+    # interleaved, when the chunk count admits v = chunks/ss > 1)
+    # schedule over a "stage" axis. Defaults to the scheduler's
+    # ADAPTDL_STAGE_SHARDS / ADAPTDL_PIPELINE_MICRO.
+    parser.add_argument("--stage-shards", type=int, default=None)
+    parser.add_argument("--pipeline-micro", type=int, default=None)
     args = parser.parse_args()
     if args.cpu:
         force_cpu_devices()
@@ -77,6 +83,23 @@ def main():
     # Expert parallelism: scheduler-chosen (ADAPTDL_EXPERT_SHARDS);
     # only meaningful when the model actually has experts.
     expert_shards = env.expert_shards() if args.moe_experts > 0 else 1
+    stage_shards = (
+        args.stage_shards
+        if args.stage_shards is not None
+        else env.stage_shards()
+    )
+    if stage_shards > 1:
+        assert (
+            seq_shards <= 1
+            and args.moe_experts == 0
+            and not args.flash
+        ), (
+            "this example composes the stage axis with dp only "
+            "(ring attention / MoE / flash own their axes)"
+        )
+        # Export NOW: env.pipeline_micro()'s stage-aware default and
+        # the trainer's topology registration both read it.
+        os.environ["ADAPTDL_STAGE_SHARDS"] = str(stage_shards)
     config = TransformerConfig(
         vocab_size=256 if on_cpu else 32000,
         num_layers=2 if on_cpu else 12,
@@ -93,20 +116,54 @@ def main():
         moe_axis="expert" if expert_shards > 1 else None,
         moe_top_k=args.moe_top_k,
     )
-    model, params = init_transformer(config, seq_len=seq_len)
-
-    from adaptdl_tpu.models.transformer import apply_with_moe_aux
-
-    def loss_fn(params, batch, rng):
-        logits, aux = apply_with_moe_aux(
-            model, params, batch["inputs"], rng
+    transform_save = transform_load = None
+    pipeline_micro = 1
+    if stage_shards > 1:
+        # Pipelined body: GPipe, or the interleaved schedule when the
+        # layer count divides into v = L/ss > 1 chunks per device and
+        # M covers the wrap-hop window (models/pipeline_lm.py).
+        from adaptdl_tpu.models.pipeline_lm import (
+            init_pipeline_lm,
+            pipeline_checkpoint_transforms,
         )
-        return (
-            optax.softmax_cross_entropy_with_integer_labels(
-                logits, batch["targets"]
-            ).mean()
-            + aux
+
+        pipeline_micro = (
+            args.pipeline_micro
+            if args.pipeline_micro is not None
+            else env.pipeline_micro()
         )
+        interleave = 1
+        if (
+            config.num_layers % stage_shards == 0
+            and config.num_layers // stage_shards > 1
+            and pipeline_micro >= stage_shards
+        ):
+            interleave = config.num_layers // stage_shards
+        loss_fn, params = init_pipeline_lm(
+            config,
+            num_stages=stage_shards,
+            num_micro=pipeline_micro,
+            interleave=interleave,
+            seq_len=seq_len,
+        )
+        transform_save, transform_load = pipeline_checkpoint_transforms(
+            stage_shards, interleave
+        )
+    else:
+        model, params = init_transformer(config, seq_len=seq_len)
+
+        from adaptdl_tpu.models.transformer import apply_with_moe_aux
+
+        def loss_fn(params, batch, rng):
+            logits, aux = apply_with_moe_aux(
+                model, params, batch["inputs"], rng
+            )
+            return (
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits, batch["targets"]
+                ).mean()
+                + aux
+            )
 
     # ADAPTDL_NUM_REPLICAS counts CHIPS at launch; a seq-, tensor- or
     # expert-sharded group of chips forms one data-parallel replica,
@@ -115,13 +172,14 @@ def main():
     tp_shards = (
         args.tp_shards if args.tp_shards is not None else env.model_shards()
     )
-    group = seq_shards * tp_shards * expert_shards
+    if stage_shards > 1:
+        tp_shards = 1
+    group = seq_shards * tp_shards * expert_shards * stage_shards
     if group > 1:
-        import os
-
         os.environ["ADAPTDL_SEQ_SHARDS"] = str(seq_shards)
         os.environ["ADAPTDL_MODEL_SHARDS"] = str(tp_shards)
         os.environ["ADAPTDL_EXPERT_SHARDS"] = str(expert_shards)
+        os.environ["ADAPTDL_STAGE_SHARDS"] = str(stage_shards)
         data_shards = env.data_parallel_replicas()
         os.environ["ADAPTDL_NUM_REPLICAS"] = str(data_shards)
     else:
@@ -132,10 +190,18 @@ def main():
         mesh_axes["seq"] = seq_shards
     if tp_shards > 1:
         mesh_axes["model"] = tp_shards
+    if stage_shards > 1:
+        mesh_axes["stage"] = stage_shards
     if expert_shards > 1:
         mesh_axes["expert"] = expert_shards
     mesh = create_mesh(mesh_axes, devices=jax.devices()[:num_devices])
     param_sharding_fn = None
+    if stage_shards > 1:
+        from adaptdl_tpu.models.pipeline_lm import (
+            pipeline_lm_sharding_fn,
+        )
+
+        param_sharding_fn = pipeline_lm_sharding_fn
     if tp_shards > 1:
         from adaptdl_tpu.parallel.tensor_parallel import (
             transformer_tp_specs,
@@ -165,11 +231,19 @@ def main():
         precondition="adam",
         mesh=mesh,
         param_sharding_fn=param_sharding_fn,
+        # The M the pipelined loss_fn was actually built with — the
+        # dataloader sizes per-replica batches to divide by it.
+        pipeline_micro=pipeline_micro if stage_shards > 1 else None,
     )
     holder = {"state": trainer.init_state()}
     ckpt = trainer.make_checkpoint_state(
         lambda: holder["state"],
         lambda s: holder.__setitem__("state", s),
+        # Layer-major canonical disk layout: a scheduler-driven change
+        # of (stage_shards, interleave) between restarts restores
+        # weights and optimizer moments restacked for the new schedule.
+        transform_save=transform_save,
+        transform_load=transform_load,
     )
     checkpoint.load_state(ckpt)
     metrics.ensure_checkpoint_registered()
@@ -177,10 +251,15 @@ def main():
     raw = synthetic_tokens(
         4096 if on_cpu else 65536, seq_len, config.vocab_size
     )["tokens"]
-    dataset = {
-        "inputs": raw[:, :-1].copy(),
-        "targets": raw[:, 1:].copy(),
-    }
+    if stage_shards > 1:
+        # The pipelined loss consumes raw token rows and shifts
+        # internally (models/pipeline_lm.py).
+        dataset = {"tokens": raw}
+    else:
+        dataset = {
+            "inputs": raw[:, :-1].copy(),
+            "targets": raw[:, 1:].copy(),
+        }
     loader = AdaptiveDataLoader(dataset, batch_size=32)
     loader.autoscale_batch_size(
         1024, local_bsz_bounds=(4, 128), gradient_accumulation=True
@@ -196,18 +275,39 @@ def main():
         # asserts against, crash-looping every restart.
         while max_sp * 2 <= 8 and seq_len % (max_sp * 2) == 0:
             max_sp *= 2
+    # Advertise ONLY topologies this process would actually run: the
+    # pipelined build (stage mode) composes with dp alone, so in that
+    # mode sp/tp/ep advertise 1 — otherwise the scheduler would price
+    # tp x ss combinations the job silently coerces away, and its
+    # throughput model could never match reality.
+    stage_mode = stage_shards > 1
     metrics.set_topology_config(
-        max_seq_shards=max_sp,
+        max_seq_shards=1 if stage_mode else max_sp,
         # pallas_call is opaque to GSPMD: under a model axis the
         # flash kernel's q/k/v would be all-gathered and attention
         # recomputed per shard, so don't advertise TP with --flash.
-        max_model_shards=1 if args.flash else min(config.num_heads, 8),
+        max_model_shards=(
+            1
+            if (args.flash or stage_mode)
+            else min(config.num_heads, 8)
+        ),
+        # Stage shards must divide the layer count (uniform chunks);
+        # advertise the largest power of two dividing L, and declare
+        # the interleaved schedule's chunk pool (= the layer count) so
+        # the topology search prices v = L/ss stage candidates.
+        max_stage_shards=(
+            (config.num_layers & -config.num_layers)
+            if stage_mode
+            else 1
+        ),
+        pipeline_chunks=config.num_layers if stage_mode else 0,
+        pipeline_microbatches=max(pipeline_micro, 1),
         # Expert shards must divide the expert count (a shard owns
         # E/ep whole experts) and the scheduler only picks powers of
         # two — advertise the largest power of two dividing E.
         max_expert_shards=(
             (args.moe_experts & -args.moe_experts)
-            if args.moe_experts > 0
+            if args.moe_experts > 0 and not stage_mode
             else 1
         ),
     )
