@@ -49,16 +49,21 @@ from adaptdl_tpu.parallel.pipeline import (
 )
 
 
-def _map_params_like(tree, fn):
-    """Apply ``fn`` to every subtree shaped like the pipeline-LM
-    params dict (keys exactly {embed, ln_f, blocks}) anywhere in a
-    TrainState — params themselves, optimizer moments (mu/nu), and any
-    other params-shaped mirror all get the same restacking."""
-    keys = {"embed", "ln_f", "blocks"}
+def _map_params_like(tree, fn, match=None):
+    """Apply ``fn`` to every subtree that ``match`` recognizes as a
+    params dict anywhere in a TrainState — params themselves,
+    optimizer moments (mu/nu), and any other params-shaped mirror all
+    get the same restacking. Default match: the pipeline-LM layout
+    (keys exactly {embed, ln_f, blocks})."""
+    if match is None:
+        keys = {"embed", "ln_f", "blocks"}
+
+        def match(node):  # noqa: F811
+            return set(node.keys()) == keys
 
     def walk(node):
         if isinstance(node, dict):
-            if set(node.keys()) == keys:
+            if match(node):
                 return fn(node)
             return {k: walk(v) for k, v in node.items()}
         if isinstance(node, tuple):
@@ -143,6 +148,56 @@ def pipeline_checkpoint_transforms(num_stages: int, interleave: int = 1):
                 ),
             },
         )
+
+    return save, load
+
+
+def dense_lm_checkpoint_transforms(num_layers: int):
+    """(transform_save, transform_load) for the PLAIN (non-pipelined)
+    :class:`TransformerLM` — the other half of structure-changing
+    rescale. Both the dense and the pipelined builds persist the SAME
+    canonical layout ({embed, ln_f, blocks layer-major}), so the
+    scheduler can move a job between ss = 1 and ss > 1 across restarts
+    and either incarnation restores the other's checkpoint (weights
+    and optimizer moments). Only valid for homogeneous block stacks
+    (no MoE-every-n: heterogeneous layer trees cannot stack)."""
+
+    def is_dense(node):
+        return (
+            "embed" in node
+            and "LayerNorm_0" in node
+            and sum(1 for k in node if k.startswith("layer_"))
+            == num_layers
+            and len(node) == num_layers + 2
+        )
+
+    def to_canonical(p):
+        layers = [p[f"layer_{i}"] for i in range(num_layers)]
+        import numpy as _np
+
+        return {
+            "embed": p["embed"],
+            "ln_f": p["LayerNorm_0"],
+            "blocks": jax.tree.map(
+                lambda *ls: _np.stack(ls), *layers
+            ),
+        }
+
+    def from_canonical(p):
+        out = {"embed": p["embed"], "LayerNorm_0": p["ln_f"]}
+        for i in range(num_layers):
+            out[f"layer_{i}"] = jax.tree.map(
+                lambda leaf: leaf[i], p["blocks"]
+            )
+        return out
+
+    def save(host_state):
+        return _map_params_like(
+            host_state, to_canonical, match=is_dense
+        )
+
+    def load(host_state):
+        return _map_params_like(host_state, from_canonical)
 
     return save, load
 

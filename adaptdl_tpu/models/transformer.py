@@ -305,6 +305,16 @@ class TransformerLM(nn.Module):
 def init_transformer(config: TransformerConfig, rng=None, seq_len=None):
     import dataclasses
 
+    if config.moe_router == "experts" and config.causal:
+        # Expert-choice gating ranks across the whole token slice, so
+        # a token's routing depends on LATER tokens — silently invalid
+        # for autoregressive training/decoding. Fail loud; the
+        # encoder/MLM families (causal=False) are the paper's setting.
+        raise ValueError(
+            "moe_router='experts' is not causally valid with "
+            "causal=True (expert-choice gating sees future tokens); "
+            "use causal=False (encoder/MLM) or moe_router='tokens'"
+        )
     model = TransformerLM(config)
     # Parameter shapes don't depend on the parallelism config, and the
     # mapped seq/expert axes don't exist outside shard_map — init

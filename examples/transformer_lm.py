@@ -42,7 +42,14 @@ def main():
     # Pipeline parallelism: the block stack runs the GPipe (or
     # interleaved, when the chunk count admits v = chunks/ss > 1)
     # schedule over a "stage" axis. Defaults to the scheduler's
-    # ADAPTDL_STAGE_SHARDS / ADAPTDL_PIPELINE_MICRO.
+    # ADAPTDL_STAGE_SHARDS / ADAPTDL_PIPELINE_MICRO. --pipeline opts
+    # the job into the pipeline FAMILY: the hints advertise the stage
+    # axis (and sp/tp/ep = 1, since this example composes stage with
+    # dp only), and checkpoints use the canonical layer-major layout
+    # so the scheduler can move the job between ss = 1 and ss > 1
+    # across restarts. The flag lives in the submitted command line,
+    # so the advertisement is stable across incarnations.
+    parser.add_argument("--pipeline", action="store_true")
     parser.add_argument("--stage-shards", type=int, default=None)
     parser.add_argument("--pipeline-micro", type=int, default=None)
     args = parser.parse_args()
@@ -88,14 +95,17 @@ def main():
         if args.stage_shards is not None
         else env.stage_shards()
     )
-    if stage_shards > 1:
+    pipeline_family = args.pipeline or stage_shards > 1
+    if pipeline_family:
         assert (
             seq_shards <= 1
+            and args.tp_shards in (None, 1)
             and args.moe_experts == 0
             and not args.flash
         ), (
             "this example composes the stage axis with dp only "
-            "(ring attention / MoE / flash own their axes)"
+            "(ring attention / TP / MoE / flash own their axes); "
+            "drop --pipeline/--stage-shards to use them"
         )
         # Export NOW: env.pipeline_micro()'s stage-aware default and
         # the trainer's topology registration both read it.
@@ -151,6 +161,19 @@ def main():
         )
     else:
         model, params = init_transformer(config, seq_len=seq_len)
+        if args.moe_experts == 0:
+            # Persist the same canonical layout the pipelined build
+            # uses, so the scheduler can move this job between ss=1
+            # and ss>1 across restarts and either incarnation
+            # restores the other's checkpoint. (MoE stacks are
+            # heterogeneous and cannot canonicalize.)
+            from adaptdl_tpu.models.pipeline_lm import (
+                dense_lm_checkpoint_transforms,
+            )
+
+            transform_save, transform_load = (
+                dense_lm_checkpoint_transforms(config.num_layers)
+            )
 
         from adaptdl_tpu.models.transformer import apply_with_moe_aux
 
@@ -276,11 +299,13 @@ def main():
         while max_sp * 2 <= 8 and seq_len % (max_sp * 2) == 0:
             max_sp *= 2
     # Advertise ONLY topologies this process would actually run: the
-    # pipelined build (stage mode) composes with dp alone, so in that
-    # mode sp/tp/ep advertise 1 — otherwise the scheduler would price
+    # pipeline family composes with dp alone, so in that mode
+    # sp/tp/ep advertise 1 — otherwise the scheduler would price
     # tp x ss combinations the job silently coerces away, and its
-    # throughput model could never match reality.
-    stage_mode = stage_shards > 1
+    # throughput model could never match reality. The family is flag-
+    # stable across restarts, so ss = 1 incarnations keep advertising
+    # the stage axis (canonical checkpoints restore either way).
+    stage_mode = pipeline_family
     metrics.set_topology_config(
         max_seq_shards=1 if stage_mode else max_sp,
         # pallas_call is opaque to GSPMD: under a model axis the

@@ -413,6 +413,7 @@ def test_expert_choice_transformer_trains():
         moe_axis=EXPERT_AXIS,
         moe_top_k=1,
         moe_router="experts",
+        causal=False,  # expert-choice is encoder/MLM-only (non-causal)
     )
     model, params = init_transformer(cfg, seq_len=8)
     trainer = ElasticTrainer(
@@ -551,3 +552,15 @@ def test_unknown_router_type_raises():
             router, stacked, x, num_slices=1,
             router_type="expert-choice",
         )
+
+
+def test_expert_choice_rejects_causal_lm():
+    from adaptdl_tpu.models import TransformerConfig, init_transformer
+
+    cfg = TransformerConfig(
+        vocab_size=64, num_layers=2, num_heads=2, d_model=16,
+        d_ff=32, max_seq_len=8, moe_every_n=2, moe_num_experts=2,
+        moe_router="experts",  # causal defaults True
+    )
+    with pytest.raises(ValueError, match="causal"):
+        init_transformer(cfg, seq_len=8)

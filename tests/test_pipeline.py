@@ -574,3 +574,125 @@ def test_pipeline_lm_rescales_across_stage_topologies(tmp_path, monkeypatch):
     assert np.isfinite(float(m1["loss"]))
     assert int(holder1["state"].step) == 3
     ck1.unregister()
+
+
+def test_dense_and_pipelined_share_canonical_checkpoints(
+    tmp_path, monkeypatch
+):
+    """Structure-changing rescale both directions: a plain (ss=1)
+    TransformerLM checkpoint restores into a pipelined (ss=2)
+    incarnation and vice versa — same canonical layer-major disk
+    layout from both builds."""
+    import optax
+
+    from adaptdl_tpu import checkpoint as ckpt_mod
+    from adaptdl_tpu.models import (
+        TransformerConfig,
+        init_transformer,
+        lm_loss_fn,
+    )
+    from adaptdl_tpu.models.pipeline_lm import (
+        _to_layer_major,
+        dense_lm_checkpoint_transforms,
+        init_pipeline_lm,
+        pipeline_checkpoint_transforms,
+        pipeline_lm_sharding_fn,
+    )
+
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    cfg = TransformerConfig(
+        vocab_size=64, num_layers=4, num_heads=2, d_model=16,
+        d_ff=32, max_seq_len=8, dtype=jnp.float32, remat=False,
+    )
+    rng = np.random.default_rng(13)
+    tokens = rng.integers(0, 64, size=(8, 9), dtype=np.int32)
+
+    # Dense incarnation: 2 steps, save.
+    model, params = init_transformer(cfg, seq_len=8)
+    dense_trainer = ElasticTrainer(
+        lm_loss_fn(model), params, optax.adam(1e-3), 8,
+        mesh=create_mesh({"data": 2}, devices=jax.devices()[:2]),
+    )
+    d_save, d_load = dense_lm_checkpoint_transforms(cfg.num_layers)
+    holder = {"state": dense_trainer.init_state()}
+    ck = dense_trainer.make_checkpoint_state(
+        lambda: holder["state"],
+        lambda s: holder.__setitem__("state", s),
+        transform_save=d_save, transform_load=d_load,
+    )
+    step = dense_trainer.train_step(4, 0)
+    for _ in range(2):
+        holder["state"], _m = step(
+            holder["state"], dense_trainer.shard_batch({"tokens": tokens})
+        )
+    ckpt_mod.save_all_states()
+    ck.unregister()
+    dense_layer0_attn = np.asarray(
+        jax.device_get(
+            holder["state"].params["layer_0"]["attention"]["qkv"][
+                "kernel"
+            ]
+        )
+    )
+
+    # Pipelined incarnation (ss=2) restores the dense save.
+    loss_fn, pp_params = init_pipeline_lm(
+        cfg, num_stages=2, num_micro=2, interleave=1, seq_len=8
+    )
+    pp_trainer = ElasticTrainer(
+        loss_fn, pp_params, optax.adam(1e-3), 8,
+        mesh=create_mesh(
+            {"data": 2, STAGE_AXIS: 2}, devices=jax.devices()[:4]
+        ),
+        param_sharding_fn=pipeline_lm_sharding_fn,
+    )
+    p_save, p_load = pipeline_checkpoint_transforms(2, 1)
+    holder2 = {"state": pp_trainer.init_state()}
+    ck2 = pp_trainer.make_checkpoint_state(
+        lambda: holder2["state"],
+        lambda s: holder2.__setitem__("state", s),
+        transform_save=p_save, transform_load=p_load,
+    )
+    assert ckpt_mod.load_state(ck2)
+    assert int(holder2["state"].step) == 2
+    # Layer 0 of the canonical stack == the dense layer_0 weights.
+    blocks_flat = jax.tree.map(
+        lambda leaf: _to_layer_major(
+            np.asarray(jax.device_get(leaf)), 2, 1
+        ),
+        holder2["state"].params["blocks"],
+    )
+    np.testing.assert_allclose(
+        blocks_flat["attention"]["qkv"]["kernel"][0],
+        dense_layer0_attn,
+        atol=1e-6,
+    )
+    # The pipelined incarnation trains on, saves, and the DENSE build
+    # restores that save (the reverse direction).
+    pp_step = pp_trainer.train_step(4, 0)
+    holder2["state"], m2 = pp_step(
+        holder2["state"], pp_trainer.shard_batch({"tokens": tokens})
+    )
+    assert np.isfinite(float(m2["loss"]))
+    ckpt_mod.save_all_states()
+    ck2.unregister()
+
+    model3, params3 = init_transformer(cfg, seq_len=8)
+    dense3 = ElasticTrainer(
+        lm_loss_fn(model3), params3, optax.adam(1e-3), 8,
+        mesh=create_mesh({"data": 2}, devices=jax.devices()[:2]),
+    )
+    holder3 = {"state": dense3.init_state()}
+    ck3 = dense3.make_checkpoint_state(
+        lambda: holder3["state"],
+        lambda s: holder3.__setitem__("state", s),
+        transform_save=d_save, transform_load=d_load,
+    )
+    assert ckpt_mod.load_state(ck3)
+    assert int(holder3["state"].step) == 3
+    step3 = dense3.train_step(4, 0)
+    holder3["state"], m3 = step3(
+        holder3["state"], dense3.shard_batch({"tokens": tokens})
+    )
+    assert np.isfinite(float(m3["loss"]))
+    ck3.unregister()

@@ -183,11 +183,24 @@ def test_soak_survives_sigkill_and_corrupt_checkpoint(tmp_path):
     resumed2 = [line for line in lines if "restarts=2" in line]
     assert resumed2, lines
     assert "epoch=0 " not in resumed2[0], resumed2[0]
-    # Every epoch ran exactly once overall (replay-skip worked through
-    # both faults) and the final epoch completed.
+    # Epoch lines may repeat: a resumed incarnation re-ENTERS the
+    # epoch it died in, but replay-skip hands it zero batches (logged
+    # as loss=replayed). The real invariant is that no epoch's WORK
+    # runs twice — except work whose save the corruption fault
+    # destroyed, which legitimately re-runs (at-least-once recovery
+    # from the last good save). So: monotone epochs, at most one
+    # real-loss re-run (the corrupted save), all else replayed.
     seen = [int(line.split()[0].split("=")[1]) for line in lines]
     assert seen == sorted(seen), "epochs went backwards"
-    assert len(seen) == len(set(seen)), "an epoch ran twice (replay-skip broke)"
+    real = [
+        int(line.split()[0].split("=")[1])
+        for line in lines
+        if "loss=replayed" not in line
+    ]
+    real_dupes = len(real) - len(set(real))
+    assert real_dupes <= 1, (
+        f"replay-skip broke: epochs re-ran work {lines}"
+    )
     assert seen[-1] == 13
     # The garbage dir was pruned by the first post-corruption save.
     assert "checkpoint-999.0" not in _checkpoint_dirs(ckpt)
