@@ -211,6 +211,47 @@ def pipeline_lm_sharding_fn(path, leaf) -> P:
     return P()
 
 
+def pipeline_lm_tp_sharding_fn(path, leaf) -> P:
+    """``param_sharding_fn`` composing the stage axis with Megatron
+    tensor parallelism over a ``dp x stage x model`` mesh: block
+    leaves are manual on ``stage`` (axis 0, the schedule's shard) and
+    GSPMD-auto on ``model`` over the same kernel dims
+    ``transformer_tp_specs`` uses — the trainer's partial-manual step
+    leaves the model axis to the compiler, so the composition needs no
+    new collectives (tests hold the composed run to the stage-only
+    run within float tolerance — model-axis reduction ordering keeps
+    exact bitwise equality off the table).
+
+    Leaf shapes carry the ``[S, (v,) layers_per_chunk, ...]`` stacking
+    prefix, so the per-parameter kernel dims sit ``leaf.ndim - rank``
+    from the end; specs are built right-aligned to work for both the
+    GPipe and interleaved stackings.
+    """
+    keys = [
+        str(getattr(k, "key", getattr(k, "name", ""))) for k in path
+    ]
+    if not keys or keys[0] != "blocks":
+        return P()
+    joined = "/".join(keys)
+
+    def right_aligned(kernel_spec: tuple) -> P:
+        pad = leaf.ndim - len(kernel_spec) - 1
+        return P(STAGE_AXIS, *([None] * pad), *kernel_spec)
+
+    from adaptdl_tpu.parallel.mesh import MODEL_AXIS
+
+    if "qkv" in joined:
+        # kernel [d_model, 3, heads, head_dim] -> heads sharded
+        return right_aligned((None, None, MODEL_AXIS, None))
+    if "attention/out" in joined:
+        return right_aligned((MODEL_AXIS, None))
+    if "ff_up" in joined:
+        return right_aligned((None, MODEL_AXIS))
+    if "ff_down" in joined:
+        return right_aligned((MODEL_AXIS, None))
+    return P(STAGE_AXIS)
+
+
 def init_pipeline_lm(
     config: TransformerConfig,
     num_stages: int,

@@ -305,7 +305,12 @@ class TransformerLM(nn.Module):
 def init_transformer(config: TransformerConfig, rng=None, seq_len=None):
     import dataclasses
 
-    if config.moe_router == "experts" and config.causal:
+    if (
+        config.moe_router == "experts"
+        and config.causal
+        and config.moe_every_n > 0
+        and config.moe_num_experts > 0
+    ):
         # Expert-choice gating ranks across the whole token slice, so
         # a token's routing depends on LATER tokens — silently invalid
         # for autoregressive training/decoding. Fail loud; the

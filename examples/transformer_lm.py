@@ -44,11 +44,11 @@ def main():
     # schedule over a "stage" axis. Defaults to the scheduler's
     # ADAPTDL_STAGE_SHARDS / ADAPTDL_PIPELINE_MICRO. --pipeline opts
     # the job into the pipeline FAMILY: the hints advertise the stage
-    # axis (and sp/tp/ep = 1, since this example composes stage with
-    # dp only), and checkpoints use the canonical layer-major layout
-    # so the scheduler can move the job between ss = 1 and ss > 1
-    # across restarts. The flag lives in the submitted command line,
-    # so the advertisement is stable across incarnations.
+    # axis (composable with tensor parallelism; sp/ep advertise 1),
+    # and checkpoints use the canonical layer-major layout so the
+    # scheduler can move the job between ss = 1 and ss > 1 across
+    # restarts. The flag lives in the submitted command line, so the
+    # advertisement is stable across incarnations.
     parser.add_argument("--pipeline", action="store_true")
     parser.add_argument("--stage-shards", type=int, default=None)
     parser.add_argument("--pipeline-micro", type=int, default=None)
@@ -99,13 +99,12 @@ def main():
     if pipeline_family:
         assert (
             seq_shards <= 1
-            and args.tp_shards in (None, 1)
             and args.moe_experts == 0
             and not args.flash
         ), (
-            "this example composes the stage axis with dp only "
-            "(ring attention / TP / MoE / flash own their axes); "
-            "drop --pipeline/--stage-shards to use them"
+            "this example composes the stage axis with dp and tensor "
+            "parallelism (ring attention / MoE / flash own their "
+            "axes); drop --pipeline/--stage-shards to use them"
         )
         # Export NOW: env.pipeline_micro()'s stage-aware default and
         # the trainer's topology registration both read it.
@@ -195,8 +194,6 @@ def main():
     tp_shards = (
         args.tp_shards if args.tp_shards is not None else env.model_shards()
     )
-    if stage_shards > 1:
-        tp_shards = 1
     group = seq_shards * tp_shards * expert_shards * stage_shards
     if group > 1:
         os.environ["ADAPTDL_SEQ_SHARDS"] = str(seq_shards)
@@ -220,12 +217,21 @@ def main():
     mesh = create_mesh(mesh_axes, devices=jax.devices()[:num_devices])
     param_sharding_fn = None
     if stage_shards > 1:
-        from adaptdl_tpu.models.pipeline_lm import (
-            pipeline_lm_sharding_fn,
-        )
+        if tp_shards > 1:
+            # Stage x tensor parallelism composed: block leaves
+            # manual on "stage", GSPMD-auto on "model".
+            from adaptdl_tpu.models.pipeline_lm import (
+                pipeline_lm_tp_sharding_fn,
+            )
 
-        param_sharding_fn = pipeline_lm_sharding_fn
-    if tp_shards > 1:
+            param_sharding_fn = pipeline_lm_tp_sharding_fn
+        else:
+            from adaptdl_tpu.models.pipeline_lm import (
+                pipeline_lm_sharding_fn,
+            )
+
+            param_sharding_fn = pipeline_lm_sharding_fn
+    elif tp_shards > 1:
         from adaptdl_tpu.parallel.tensor_parallel import (
             transformer_tp_specs,
         )
@@ -299,12 +305,12 @@ def main():
         while max_sp * 2 <= 8 and seq_len % (max_sp * 2) == 0:
             max_sp *= 2
     # Advertise ONLY topologies this process would actually run: the
-    # pipeline family composes with dp alone, so in that mode
-    # sp/tp/ep advertise 1 — otherwise the scheduler would price
-    # tp x ss combinations the job silently coerces away, and its
-    # throughput model could never match reality. The family is flag-
-    # stable across restarts, so ss = 1 incarnations keep advertising
-    # the stage axis (canonical checkpoints restore either way).
+    # pipeline family composes with dp and TENSOR parallelism
+    # (pipeline_lm_tp_sharding_fn), so tp advertises normally while
+    # sp/ep advertise 1 — the scheduler never prices a combination
+    # the build can't execute. The family is flag-stable across
+    # restarts, so ss = 1 incarnations keep advertising the stage
+    # axis (canonical checkpoints restore either way).
     stage_mode = pipeline_family
     metrics.set_topology_config(
         max_seq_shards=1 if stage_mode else max_sp,
@@ -312,9 +318,7 @@ def main():
         # flash kernel's q/k/v would be all-gathered and attention
         # recomputed per shard, so don't advertise TP with --flash.
         max_model_shards=(
-            1
-            if (args.flash or stage_mode)
-            else min(config.num_heads, 8)
+            1 if args.flash else min(config.num_heads, 8)
         ),
         # Stage shards must divide the layer count (uniform chunks);
         # advertise the largest power of two dividing L, and declare

@@ -564,3 +564,16 @@ def test_expert_choice_rejects_causal_lm():
     )
     with pytest.raises(ValueError, match="causal"):
         init_transformer(cfg, seq_len=8)
+
+
+def test_expert_choice_guard_ignores_disabled_moe():
+    """moe_router='experts' on a config with MoE DISABLED builds a
+    plain causal LM — the causal guard must not fire."""
+    from adaptdl_tpu.models import TransformerConfig, init_transformer
+
+    cfg = TransformerConfig(
+        vocab_size=64, num_layers=2, num_heads=2, d_model=16,
+        d_ff=32, max_seq_len=8, moe_router="experts",  # moe off
+    )
+    model, params = init_transformer(cfg, seq_len=8)
+    assert "layer_0" in params
