@@ -232,24 +232,15 @@ def pipeline_lm_tp_sharding_fn(path, leaf) -> P:
     ]
     if not keys or keys[0] != "blocks":
         return P()
-    joined = "/".join(keys)
+    from adaptdl_tpu.parallel.tensor_parallel import (
+        match_tp_kernel_spec,
+    )
 
-    def right_aligned(kernel_spec: tuple) -> P:
-        pad = leaf.ndim - len(kernel_spec) - 1
-        return P(STAGE_AXIS, *([None] * pad), *kernel_spec)
-
-    from adaptdl_tpu.parallel.mesh import MODEL_AXIS
-
-    if "qkv" in joined:
-        # kernel [d_model, 3, heads, head_dim] -> heads sharded
-        return right_aligned((None, None, MODEL_AXIS, None))
-    if "attention/out" in joined:
-        return right_aligned((MODEL_AXIS, None))
-    if "ff_up" in joined:
-        return right_aligned((None, MODEL_AXIS))
-    if "ff_down" in joined:
-        return right_aligned((MODEL_AXIS, None))
-    return P(STAGE_AXIS)
+    spec = match_tp_kernel_spec(path)
+    if spec is None:
+        return P(STAGE_AXIS)
+    pad = leaf.ndim - len(spec) - 1
+    return P(STAGE_AXIS, *([None] * pad), *spec)
 
 
 def init_pipeline_lm(

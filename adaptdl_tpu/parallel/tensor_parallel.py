@@ -17,25 +17,38 @@ from jax.sharding import PartitionSpec as P
 from adaptdl_tpu.parallel.mesh import MODEL_AXIS
 
 
-def transformer_tp_specs(path, leaf) -> P:
-    """``param_sharding_fn`` for :class:`TransformerLM`.
+# Megatron layout by parameter role: (path substring, kernel-dim
+# spec). ONE table serves both the plain model (transformer_tp_specs)
+# and the pipelined composition (pipeline_lm_tp_sharding_fn, which
+# right-aligns these specs under the stage-stacking prefix) — a
+# layout change here propagates to both.
+TP_KERNEL_SPECS: tuple[tuple[str, tuple], ...] = (
+    # qkv/kernel [d_model, 3, heads, head_dim] -> heads sharded
+    ("qkv", (None, None, MODEL_AXIS, None)),
+    # out/kernel [heads*hd, d_model] -> rows (head-concat dim) sharded
+    ("attention/out", (MODEL_AXIS, None)),
+    # ff_up [d_model, d_ff] -> columns; ff_down [d_ff, d_model] -> rows
+    ("ff_up", (None, MODEL_AXIS)),
+    ("ff_down", (MODEL_AXIS, None)),
+)
 
-    Layout by parameter role:
-    - ``qkv/kernel [d_model, 3, heads, head_dim]`` → heads sharded
-    - ``out/kernel [d_model(=heads*hd), d_model]`` → rows sharded (the
-      head-concat dim), matching the attention output's layout
-    - ``ff_up/kernel [d_model, d_ff]`` → columns sharded
-    - ``ff_down/kernel [d_ff, d_model]`` → rows sharded
-    - embeddings and LayerNorm scales replicated.
-    """
+
+def match_tp_kernel_spec(path) -> tuple | None:
+    """The Megatron kernel-dim spec for a param path, or None for
+    replicated roles (embeddings, LayerNorm scales, biases)."""
     keys = [getattr(k, "key", getattr(k, "name", "")) for k in path]
     joined = "/".join(str(k) for k in keys)
-    if "qkv" in joined and leaf.ndim == 4:
-        return P(None, None, MODEL_AXIS, None)
-    if "attention/out" in joined and leaf.ndim == 2:
-        return P(MODEL_AXIS, None)
-    if "ff_up" in joined and leaf.ndim == 2:
-        return P(None, MODEL_AXIS)
-    if "ff_down" in joined and leaf.ndim == 2:
-        return P(MODEL_AXIS, None)
+    for needle, spec in TP_KERNEL_SPECS:
+        if needle in joined:
+            return spec
+    return None
+
+
+def transformer_tp_specs(path, leaf) -> P:
+    """``param_sharding_fn`` for :class:`TransformerLM` — the
+    :data:`TP_KERNEL_SPECS` layout; embeddings and LayerNorm scales
+    replicated."""
+    spec = match_tp_kernel_spec(path)
+    if spec is not None and leaf.ndim == len(spec):
+        return P(*spec)
     return P()

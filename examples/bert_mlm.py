@@ -29,6 +29,15 @@ def main():
     parser.add_argument("--cpu", action="store_true")
     parser.add_argument("--epochs", type=int, default=4)
     parser.add_argument("--seq-len", type=int, default=None)
+    # Mixture-of-experts FFNs every 2nd block. Expert-choice routing
+    # (--moe-router experts, arXiv:2202.09368) is causally valid here
+    # precisely because the encoder is bidirectional — this example is
+    # its natural home; the causal LM example rejects it.
+    parser.add_argument("--moe-experts", type=int, default=0)
+    parser.add_argument(
+        "--moe-router", choices=("tokens", "experts"),
+        default="tokens",
+    )
     args = parser.parse_args()
     if args.cpu:
         force_cpu_devices()
@@ -50,6 +59,9 @@ def main():
     from adaptdl_tpu.trainer import ElasticTrainer
 
     adaptdl_tpu.initialize_job()
+    expert_shards = (
+        env.expert_shards() if args.moe_experts > 0 else 1
+    )
     on_cpu = args.cpu
     seq_len = args.seq_len or (32 if on_cpu else 512)
     vocab = 64 if on_cpu else 30522  # BERT-base vocab size
@@ -65,9 +77,28 @@ def main():
         dtype=jnp.float32 if on_cpu else jnp.bfloat16,
         remat=True,
         causal=False,  # bidirectional encoder
+        moe_every_n=2 if args.moe_experts > 0 else 0,
+        moe_num_experts=args.moe_experts,
+        moe_axis="expert" if expert_shards > 1 else None,
+        moe_router=args.moe_router,
     )
     model, params = init_transformer(config, seq_len=seq_len)
 
+    mesh = None
+    param_sharding_fn = None
+    if expert_shards > 1:
+        from adaptdl_tpu.models.transformer import (
+            moe_param_sharding_fn,
+        )
+        from adaptdl_tpu.parallel import create_mesh
+
+        data_shards = env.data_parallel_replicas()
+        os.environ["ADAPTDL_NUM_REPLICAS"] = str(data_shards)
+        mesh = create_mesh(
+            {"data": data_shards, "expert": expert_shards},
+            devices=jax.devices()[: data_shards * expert_shards],
+        )
+        param_sharding_fn = moe_param_sharding_fn
     trainer = ElasticTrainer(
         loss_fn=mlm_loss_fn(model, mask_token=mask_token),
         params=params,
@@ -75,6 +106,8 @@ def main():
         init_batch_size=32,
         scaling_rule=AdamScale(),
         precondition="adam",
+        mesh=mesh,
+        param_sharding_fn=param_sharding_fn,
     )
     holder = {"state": trainer.init_state()}
     ckpt = trainer.make_checkpoint_state(
@@ -99,6 +132,12 @@ def main():
     loader.autoscale_batch_size(
         2048, local_bsz_bounds=(8, 32), gradient_accumulation=True
     )
+    if args.moe_experts > 0:
+        # Advertise the expert axis (largest power of two dividing E)
+        # so the scheduler can factor chips = dp x ep for this job.
+        metrics.set_topology_config(
+            max_expert_shards=args.moe_experts & -args.moe_experts,
+        )
     for e in epoch.remaining_epochs_until(args.epochs):
         for batch in loader:
             holder["state"], m = trainer.run_step(

@@ -747,8 +747,6 @@ def test_pipeline_lm_composes_with_tensor_parallel():
         8,
     )
     assert loss_pp_tp == pytest.approx(loss_pp, rel=1e-5)
-    # The composed run's qkv leaves really are model-sharded.
-    qkv = jax.tree.leaves(
-        state_tp.params["blocks"]["attention"]
-    )[0]
+    # The composed run's qkv projection really is model-sharded.
+    qkv = state_tp.params["blocks"]["attention"]["qkv"]["kernel"]
     assert "model" in str(qkv.sharding.spec)
