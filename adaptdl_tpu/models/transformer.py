@@ -43,8 +43,14 @@ class TransformerConfig:
     attention_fn: Callable | None = None
     # Mesh axis the sequence dim is sharded over (sequence
     # parallelism): positions become global and attention defaults to
-    # ring attention over this axis.
+    # ``seq_attention`` over this axis.
     seq_axis: str | None = None
+    # Which sequence-parallel attention runs over seq_axis: "ring"
+    # (ppermute K/V rotation, any head count, O(seq/shards) memory —
+    # parallel/ring_attention.py) or "ulysses" (two all_to_all head
+    # exchanges around one full-sequence attention; needs
+    # num_heads % seq_shards == 0 — parallel/ulysses.py).
+    seq_attention: str = "ring"
     # causal=False gives bidirectional (encoder / BERT-style)
     # attention — the MLM families (reference: examples/BERT/) — for
     # both the plain and the ring attention paths.
@@ -136,13 +142,27 @@ class Attention(nn.Module):
         attn = cfg.attention_fn
         if attn is None:
             if cfg.seq_axis is not None:
-                from adaptdl_tpu.parallel.ring_attention import (
-                    make_ring_attention,
-                )
+                if cfg.seq_attention == "ulysses":
+                    from adaptdl_tpu.parallel.ulysses import (
+                        make_ulysses_attention,
+                    )
 
-                attn = make_ring_attention(
-                    cfg.seq_axis, causal=cfg.causal
-                )
+                    attn = make_ulysses_attention(
+                        cfg.seq_axis, causal=cfg.causal
+                    )
+                elif cfg.seq_attention == "ring":
+                    from adaptdl_tpu.parallel.ring_attention import (
+                        make_ring_attention,
+                    )
+
+                    attn = make_ring_attention(
+                        cfg.seq_axis, causal=cfg.causal
+                    )
+                else:
+                    raise ValueError(
+                        "seq_attention must be 'ring' or 'ulysses', "
+                        f"got {cfg.seq_attention!r}"
+                    )
             else:
                 from functools import partial
 

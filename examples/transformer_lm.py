@@ -3,12 +3,18 @@
 The reference's transformer/BERT example family (reference:
 examples/transformer/transformer.py:163-175, examples/BERT/) on the
 elastic stack, plus the long-context capability the reference lacks:
-``--seq-shards k`` splits every sequence across k chips with ring
-attention (K/V blocks rotating over ICI).
+``--seq-shards k`` splits every sequence across k chips, with either
+ring attention (K/V blocks rotating over ICI, the default) or
+``--seq-mode ulysses`` (two all_to_all head exchanges around one
+full-sequence attention — composable with ``--flash`` as the
+within-chip block engine).
 
 Run:   python examples/transformer_lm.py --cpu --epochs 2
 Long sequences over a 4x2 (data x seq) mesh:
        python examples/transformer_lm.py --cpu --seq-shards 2
+Ulysses with the Pallas kernel inside:
+       python examples/transformer_lm.py --seq-shards 2 \
+           --seq-mode ulysses --flash
 """
 
 import argparse
@@ -28,10 +34,18 @@ def main():
     # the goodput topology search picks a dp x sp x tp mesh); flags
     # override for manual runs.
     parser.add_argument("--seq-shards", type=int, default=None)
+    # How attention runs over the seq axis: "ring" (ppermute K/V
+    # rotation, any head count) or "ulysses" (all_to_all head
+    # exchange; needs num_heads % seq_shards == 0).
+    parser.add_argument(
+        "--seq-mode", choices=("ring", "ulysses"), default="ring"
+    )
     parser.add_argument("--tp-shards", type=int, default=None)
     # Pallas flash-attention kernel for the within-chip attention
-    # (blocked online softmax, no [seq, seq] intermediate). Not
-    # composable with --seq-shards (ring attention owns that path).
+    # (blocked online softmax, no [seq, seq] intermediate). Composable
+    # with --seq-shards only under --seq-mode ulysses (the kernel then
+    # runs on the gathered full sequence); ring attention owns its
+    # blocked softmax.
     parser.add_argument("--flash", action="store_true")
     parser.add_argument("--seq-len", type=int, default=None)
     # Mixture-of-experts: every 2nd block's FFN becomes a Switch/
@@ -78,15 +92,29 @@ def main():
 
     attention_fn = None
     if args.flash:
-        assert seq_shards <= 1, (
-            "--flash is the within-chip kernel; sequence sharding "
-            "uses ring attention"
+        assert seq_shards <= 1 or args.seq_mode == "ulysses", (
+            "--flash composes with sequence sharding only under "
+            "--seq-mode ulysses (full sequence gathered per head "
+            "slice); ring attention owns its blocked softmax"
         )
-        from adaptdl_tpu.ops import make_flash_attention
+        import functools
 
-        attention_fn = make_flash_attention(
-            block_q=min(128, seq_len), block_k=min(128, seq_len)
+        from adaptdl_tpu.ops.flash_attention import flash_attention
+
+        block = min(128, seq_len)
+        flash_inner = functools.partial(
+            flash_attention, block_q=block, block_k=block
         )
+        if seq_shards > 1:
+            from adaptdl_tpu.parallel.ulysses import (
+                make_ulysses_attention,
+            )
+
+            attention_fn = make_ulysses_attention(
+                "seq", inner_attention=flash_inner
+            )
+        else:
+            attention_fn = flash_inner
     # Expert parallelism: scheduler-chosen (ADAPTDL_EXPERT_SHARDS);
     # only meaningful when the model actually has experts.
     expert_shards = env.expert_shards() if args.moe_experts > 0 else 1
@@ -119,6 +147,7 @@ def main():
         dtype=jnp.float32 if on_cpu else jnp.bfloat16,
         remat=True,
         seq_axis="seq" if seq_shards > 1 else None,
+        seq_attention=args.seq_mode,
         attention_fn=attention_fn,
         moe_every_n=2 if args.moe_experts > 0 else 0,
         moe_num_experts=args.moe_experts,
@@ -296,14 +325,20 @@ def main():
     # Advertise how far this model can shard each sample: the largest
     # power of two dividing seq_len (the scheduler only picks
     # power-of-two factorizations, and a non-dividing choice would
-    # assert on every restart), and TP up to the head count.
+    # assert on every restart), and TP up to the head count. Ulysses
+    # additionally swaps the sharded axis onto heads, so its cap is
+    # also bounded by the largest power of two dividing num_heads
+    # (ulysses_attention raises on a non-dividing shard count —
+    # advertising one would crash-loop every restart). --flash with
+    # ring mode advertises 1 for the same reason: the flash path
+    # asserts against ring sharding.
     max_sp = 1
-    if not args.flash:
-        # --flash is the within-chip kernel: advertising seq shards
-        # would let the scheduler assign a topology the flash path
-        # asserts against, crash-looping every restart.
+    if not args.flash or args.seq_mode == "ulysses":
         while max_sp * 2 <= 8 and seq_len % (max_sp * 2) == 0:
             max_sp *= 2
+    if args.seq_mode == "ulysses":
+        while max_sp > 1 and config.num_heads % max_sp != 0:
+            max_sp //= 2
     # Advertise ONLY topologies this process would actually run: the
     # pipeline family composes with dp and TENSOR parallelism
     # (pipeline_lm_tp_sharding_fn), so tp advertises normally while
