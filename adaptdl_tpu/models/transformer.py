@@ -281,7 +281,14 @@ class TransformerLM(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = True, rng=None):
+    def __call__(
+        self,
+        tokens,
+        *,
+        train: bool = True,
+        rng=None,
+        return_hidden: bool = False,
+    ):
         cfg = self.config
         embed = nn.Embed(
             cfg.vocab_size,
@@ -317,6 +324,11 @@ class TransformerLM(nn.Module):
                 x, positions, dropout_rng
             )
         x = nn.LayerNorm(dtype=cfg.dtype, use_bias=False)(x)
+        if return_hidden:
+            # For losses that stream the output head themselves (the
+            # chunked cross-entropy, ops/chunked_xent.py): no
+            # [tokens, vocab] logits tensor is ever built.
+            return x
         # Tied output head through the embedding table keeps the only
         # O(vocab x d_model) matmul single-sourced.
         return embed.attend(x).astype(jnp.float32)
@@ -356,20 +368,25 @@ def init_transformer(config: TransformerConfig, rng=None, seq_len=None):
     return model, params
 
 
-def apply_with_moe_aux(model: TransformerLM, params, inputs, rng):
+def apply_with_moe_aux(
+    model: TransformerLM, params, inputs, rng, return_hidden=False
+):
     """model.apply that also returns the weighted MoE load-balancing
     aux loss (0.0 for dense models) from the "moe_losses" collection —
     the building block for custom losses over MoE configs (the
     lm/mlm loss factories below use it; example:
-    examples/transformer_lm.py).
+    examples/transformer_lm.py). ``return_hidden`` passes through to
+    the model (final hidden states instead of logits — for losses
+    that stream the output head, ops/chunked_xent.py).
     """
     cfg = model.config
     if cfg.moe_every_n > 0 and cfg.moe_num_experts > 0:
-        logits, mutated = model.apply(
+        out, mutated = model.apply(
             {"params": params},
             inputs,
             train=True,
             rng=rng,
+            return_hidden=return_hidden,
             mutable=["moe_losses"],
         )
         auxes = jax.tree.leaves(mutated.get("moe_losses", {}))
@@ -378,11 +395,15 @@ def apply_with_moe_aux(model: TransformerLM, params, inputs, rng):
             if auxes
             else jnp.zeros(())
         )
-        return logits, aux
-    logits = model.apply(
-        {"params": params}, inputs, train=True, rng=rng
+        return out, aux
+    out = model.apply(
+        {"params": params},
+        inputs,
+        train=True,
+        rng=rng,
+        return_hidden=return_hidden,
     )
-    return logits, jnp.zeros(())
+    return out, jnp.zeros(())
 
 
 def mlm_loss_fn(

@@ -48,6 +48,10 @@ def main():
     # blocked softmax.
     parser.add_argument("--flash", action="store_true")
     parser.add_argument("--seq-len", type=int, default=None)
+    # Stream the output head in vocab chunks of this size instead of
+    # materializing [tokens, vocab] logits (ops/chunked_xent.py) —
+    # the HBM saving buys batch size at large vocab. 0 = dense head.
+    parser.add_argument("--chunked-xent", type=int, default=0)
     # Mixture-of-experts: every 2nd block's FFN becomes a Switch/
     # GShard MoE with this many experts; the expert axis shards over
     # the scheduler's chosen expertShards (ADAPTDL_EXPERT_SHARDS).
@@ -129,10 +133,12 @@ def main():
             seq_shards <= 1
             and args.moe_experts == 0
             and not args.flash
+            and args.chunked_xent == 0
         ), (
             "this example composes the stage axis with dp and tensor "
-            "parallelism (ring attention / MoE / flash own their "
-            "axes); drop --pipeline/--stage-shards to use them"
+            "parallelism (ring attention / MoE / flash / chunked-xent "
+            "own their axes or loss head); drop "
+            "--pipeline/--stage-shards to use them"
         )
         # Export NOW: env.pipeline_micro()'s stage-aware default and
         # the trainer's topology registration both read it.
@@ -205,16 +211,37 @@ def main():
 
         from adaptdl_tpu.models.transformer import apply_with_moe_aux
 
-        def loss_fn(params, batch, rng):
-            logits, aux = apply_with_moe_aux(
-                model, params, batch["inputs"], rng
+        if args.chunked_xent > 0:
+            from adaptdl_tpu.ops.chunked_xent import (
+                chunked_softmax_xent,
             )
-            return (
-                optax.softmax_cross_entropy_with_integer_labels(
-                    logits, batch["targets"]
-                ).mean()
-                + aux
-            )
+
+            def loss_fn(params, batch, rng):
+                hidden, aux = apply_with_moe_aux(
+                    model, params, batch["inputs"], rng,
+                    return_hidden=True,
+                )
+                flat = hidden.reshape(-1, hidden.shape[-1])
+                losses = chunked_softmax_xent(
+                    flat,
+                    params["embed"]["embedding"],
+                    batch["targets"].reshape(-1),
+                    args.chunked_xent,
+                )
+                return losses.mean() + aux
+
+        else:
+
+            def loss_fn(params, batch, rng):
+                logits, aux = apply_with_moe_aux(
+                    model, params, batch["inputs"], rng
+                )
+                return (
+                    optax.softmax_cross_entropy_with_integer_labels(
+                        logits, batch["targets"]
+                    ).mean()
+                    + aux
+                )
 
     # ADAPTDL_NUM_REPLICAS counts CHIPS at launch; a seq-, tensor- or
     # expert-sharded group of chips forms one data-parallel replica,

@@ -1,0 +1,147 @@
+"""Chunked (vocab-streaming) cross-entropy correctness tests."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from adaptdl_tpu.ops.chunked_xent import (
+    chunked_lm_loss_fn,
+    chunked_softmax_xent,
+)
+
+
+def _dense_xent(x, embedding, targets):
+    logits = x.astype(jnp.float32) @ embedding.astype(jnp.float32).T
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, targets
+    )
+
+
+def _inputs(tokens=24, d=16, vocab=50, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(tokens, d)).astype(np.float32))
+    emb = jnp.asarray(rng.normal(size=(vocab, d)).astype(np.float32))
+    tgt = jnp.asarray(rng.integers(0, vocab, size=tokens), jnp.int32)
+    return x, emb, tgt
+
+
+@pytest.mark.parametrize("chunk", [8, 16, 50, 64, 4096])
+def test_matches_dense_xent(chunk):
+    """Every chunking (dividing, non-dividing, single-chunk,
+    larger-than-vocab) reproduces the dense loss."""
+    x, emb, tgt = _inputs()
+    got = chunked_softmax_xent(x, emb, tgt, chunk)
+    want = _dense_xent(x, emb, tgt)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("chunk", [16, 50, 64])
+def test_gradients_match_dense(chunk):
+    x, emb, tgt = _inputs()
+
+    def chunked_loss(x, emb):
+        return chunked_softmax_xent(x, emb, tgt, chunk).mean()
+
+    def dense_loss(x, emb):
+        return _dense_xent(x, emb, tgt).mean()
+
+    gx_c, ge_c = jax.jit(jax.grad(chunked_loss, argnums=(0, 1)))(x, emb)
+    gx_d, ge_d = jax.jit(jax.grad(dense_loss, argnums=(0, 1)))(x, emb)
+    np.testing.assert_allclose(
+        np.asarray(gx_c), np.asarray(gx_d), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(ge_c), np.asarray(ge_d), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_bf16_hidden_states():
+    """bf16 activations (the TPU training dtype) accumulate in f32;
+    gradients come back in the input dtypes."""
+    x, emb, tgt = _inputs()
+    x16 = x.astype(jnp.bfloat16)
+
+    def loss(x, emb):
+        return chunked_softmax_xent(x, emb, tgt, 16).mean()
+
+    val = loss(x16, emb)
+    ref = _dense_xent(x16, emb, tgt).mean()
+    assert float(abs(val - ref)) < 1e-2
+    gx, ge = jax.grad(loss, argnums=(0, 1))(x16, emb)
+    assert gx.dtype == jnp.bfloat16
+    assert ge.dtype == jnp.float32
+
+
+def test_chunked_lm_loss_matches_dense_lm_loss():
+    """The drop-in loss factory reproduces models.lm_loss_fn on the
+    flagship transformer — loss value AND parameter gradients."""
+    from adaptdl_tpu.models import (
+        TransformerConfig,
+        init_transformer,
+        lm_loss_fn,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=96, num_layers=2, num_heads=2, d_model=32,
+        d_ff=64, max_seq_len=32, dtype=jnp.float32, remat=False,
+    )
+    model, params = init_transformer(cfg, seq_len=16)
+    rng = np.random.default_rng(1)
+    batch = {
+        "tokens": jnp.asarray(
+            rng.integers(0, 96, size=(4, 17)), jnp.int32
+        )
+    }
+    key = jax.random.key(0)
+    dense = lm_loss_fn(model)
+    chunked = chunked_lm_loss_fn(model, chunk_size=32)
+    l_dense, g_dense = jax.value_and_grad(dense)(params, batch, key)
+    l_chunk, g_chunk = jax.value_and_grad(chunked)(params, batch, key)
+    assert float(l_chunk) == pytest.approx(float(l_dense), rel=1e-5)
+    for pd, pc in zip(
+        jax.tree.leaves(g_dense), jax.tree.leaves(g_chunk)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(pc), np.asarray(pd), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_chunked_loss_trains_under_elastic_trainer():
+    """End-to-end: the chunked loss drives the fused elastic step on a
+    data-parallel mesh and the loss decreases."""
+    from adaptdl_tpu.models import TransformerConfig, init_transformer
+    from adaptdl_tpu.parallel import create_mesh
+    from adaptdl_tpu.trainer import ElasticTrainer
+
+    cfg = TransformerConfig(
+        vocab_size=64, num_layers=1, num_heads=2, d_model=32,
+        d_ff=64, max_seq_len=16, dtype=jnp.float32, remat=False,
+    )
+    model, params = init_transformer(cfg, seq_len=8)
+    mesh = create_mesh({"data": 2}, devices=jax.devices()[:2])
+    trainer = ElasticTrainer(
+        chunked_lm_loss_fn(model, chunk_size=32),
+        params,
+        optax.adam(1e-2),
+        4,
+        mesh=mesh,
+    )
+    state = trainer.init_state()
+    step = trainer.train_step(2, 0)
+    rng = np.random.default_rng(2)
+    batch = trainer.shard_batch(
+        {
+            "tokens": rng.integers(
+                0, 64, size=(4, 9), dtype=np.int32
+            )
+        }
+    )
+    state, m0 = step(state, batch)
+    for _ in range(20):
+        state, m = step(state, batch)
+    assert float(m["loss"]) < float(m0["loss"])
