@@ -106,6 +106,55 @@ class ShardedTrainerCheckpoint(checkpoint.State):
 
     # -- State protocol ----------------------------------------------
 
+    def _zero1_canon_device(self, opt_state):
+        """zero1 run layout -> canonical on-device: [dp, shard] moment
+        rows reshape to one [n] vector (pad trimmed), still sharded
+        over the data axis — no host gather, so the path works
+        multi-host where TrainerCheckpoint's host-numpy canonical form
+        cannot."""
+        from adaptdl_tpu.parallel.mesh import DATA_AXIS
+
+        tr = self._trainer
+        dp, shard, n = tr.num_replicas, tr._zero1_shard, tr._zero1_n
+        sharding = NamedSharding(tr.mesh, P(DATA_AXIS))
+        canon = jax.jit(
+            lambda v: v.reshape(dp * shard)[:n],
+            out_shardings=sharding,
+        )
+        return jax.tree.map(
+            lambda leaf: (
+                canon(leaf)
+                if getattr(leaf, "shape", None) == (dp, shard)
+                else leaf
+            ),
+            opt_state,
+        )
+
+    def _zero1_expand_device(self, opt_state):
+        """Canonical [n] moment vectors -> this incarnation's
+        [dp, shard] rows, re-padded on device for the current replica
+        count."""
+        from adaptdl_tpu.parallel.mesh import DATA_AXIS
+
+        tr = self._trainer
+        dp, shard, n, pad = (
+            tr.num_replicas, tr._zero1_shard, tr._zero1_n,
+            tr._zero1_pad,
+        )
+        sharding = NamedSharding(tr.mesh, P(DATA_AXIS))
+        expand = jax.jit(
+            lambda v: jax.numpy.pad(v, (0, pad)).reshape(dp, shard),
+            out_shardings=sharding,
+        )
+        return jax.tree.map(
+            lambda leaf: (
+                expand(leaf)
+                if getattr(leaf, "shape", None) == (n,)
+                else leaf
+            ),
+            opt_state,
+        )
+
     def sync(self) -> None:
         """All processes write their shards via orbax — into a fresh
         versioned directory, never over a payload an existing complete
@@ -115,6 +164,10 @@ class ShardedTrainerCheckpoint(checkpoint.State):
         state = self._get_state()
         # RNG keys are opaque; store raw key data alongside.
         state = state._replace(rng=jax.random.key_data(state.rng))
+        if self._trainer.zero1:
+            state = state._replace(
+                opt_state=self._zero1_canon_device(state.opt_state)
+            )
         path = _next_payload_dir(self.name)
         checkpointer = ocp.StandardCheckpointer()
         checkpointer.save(path, state)
@@ -161,8 +214,41 @@ class ShardedTrainerCheckpoint(checkpoint.State):
                 ),
                 template,
             )
+        if self._trainer.zero1:
+            # The payload stores moments in the canonical [n] layout
+            # (sync() wrote them that way); restore them [n] sharded
+            # over data, expand to this incarnation's [dp, shard]
+            # after.
+            from adaptdl_tpu.parallel.mesh import DATA_AXIS
+
+            tr = self._trainer
+            dp, shard, n = (
+                tr.num_replicas, tr._zero1_shard, tr._zero1_n,
+            )
+            target = target._replace(
+                opt_state=jax.tree.map(
+                    lambda t: (
+                        jax.ShapeDtypeStruct(
+                            (n,),
+                            t.dtype,
+                            sharding=NamedSharding(
+                                mesh, P(DATA_AXIS)
+                            ),
+                        )
+                        if getattr(t, "shape", None) == (dp, shard)
+                        else t
+                    ),
+                    target.opt_state,
+                )
+            )
         checkpointer = ocp.StandardCheckpointer()
         restored = checkpointer.restore(path, target)
+        if self._trainer.zero1:
+            restored = restored._replace(
+                opt_state=self._zero1_expand_device(
+                    restored.opt_state
+                )
+            )
         restored = restored._replace(
             rng=jax.random.wrap_key_data(restored.rng)
         )

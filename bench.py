@@ -153,39 +153,104 @@ def _bench_transformer_tokens(on_tpu: bool, full: bool) -> dict | None:
             logits, batch["targets"]
         ).mean()
 
-    trainer = ElasticTrainer(
-        loss_fn=loss_fn,
-        params=params,
-        optimizer=optax.adamw(3e-4),
-        init_batch_size=8,
-    )
-    state = trainer.init_state()
+    def peak_hbm_gb() -> float | None:
+        stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
+        if stats and "peak_bytes_in_use" in stats:
+            return round(stats["peak_bytes_in_use"] / 2**30, 3)
+        return None
+
+    def run_arm(arm_loss, bsz):
+        trainer = ElasticTrainer(
+            loss_fn=arm_loss,
+            params=params,
+            optimizer=optax.adamw(3e-4),
+            init_batch_size=bsz,
+        )
+        state = trainer.init_state()
+        rng = np.random.default_rng(3)
+        tokens = rng.integers(
+            0, cfg.vocab_size, size=(bsz, seq_len + 1)
+        )
+        batch = trainer.shard_batch(
+            {
+                "inputs": tokens[:, :-1].astype(np.int32),
+                "targets": tokens[:, 1:].astype(np.int32),
+            }
+        )
+        step_fn = trainer.train_step(bsz // trainer.num_replicas, 0)
+        steps = 20 if full else 3
+        _, t_step, _ = _steady_state_time(state, step_fn, batch, steps)
+        return bsz * seq_len / t_step, t_step
+
     bsz = 8
-    rng = np.random.default_rng(3)
-    tokens = rng.integers(0, cfg.vocab_size, size=(bsz, seq_len + 1))
-    batch = trainer.shard_batch(
-        {
-            "inputs": tokens[:, :-1].astype(np.int32),
-            "targets": tokens[:, 1:].astype(np.int32),
-        }
-    )
-    step_fn = trainer.train_step(bsz // trainer.num_replicas, 0)
-    steps = 20 if full else 3
-    _, t_step, _ = _steady_state_time(state, step_fn, batch, steps)
-    tokens_per_s = bsz * seq_len / t_step
+    out = {}
+    # Chunked-head arm FIRST (TPU full mode only): the vocab-streaming
+    # loss (ops/chunked_xent.py) removes the [tokens, vocab] logits
+    # buffer. peak_bytes_in_use is a cumulative process-wide
+    # high-water mark, so the smaller arm must run before the dense
+    # arm for its peak reading to mean anything (earlier resnet phases
+    # peak well below either arm).
+    peak_chunked = None
+    if full and _remaining() > 150:
+        from adaptdl_tpu.ops.chunked_xent import chunked_softmax_xent
+
+        def chunked_loss(p, batch, rng):
+            hidden = model.apply(
+                {"params": p}, batch["inputs"], train=True, rng=rng,
+                return_hidden=True,
+            )
+            flat = hidden.reshape(-1, hidden.shape[-1])
+            return chunked_softmax_xent(
+                flat,
+                p["embed"]["embedding"],
+                batch["targets"].reshape(-1),
+                4096,
+            ).mean()
+
+        try:
+            chunked_tps, t_chunked = run_arm(chunked_loss, bsz)
+            peak_chunked = peak_hbm_gb()
+            _log(
+                f"transformer chunked-xent: step={t_chunked*1e3:.1f}ms "
+                f"tokens/s={chunked_tps:.0f} peak_hbm_gb={peak_chunked}"
+            )
+            out["transformer_chunked_xent_tokens_per_s"] = round(
+                chunked_tps, 1
+            )
+            if peak_chunked is not None:
+                out["transformer_chunked_xent_peak_hbm_gb"] = (
+                    peak_chunked
+                )
+        except Exception as exc:  # noqa: BLE001 - optional arm
+            _log(f"chunked-xent arm failed: {exc}")
+
+    tokens_per_s, t_step = run_arm(loss_fn, bsz)
     flops = transformer_train_flops(cfg, bsz, seq_len)
     mfu_val = mfu_fn(
         flops.total, t_step, num_devices=len(jax.devices())
     )
+    # Valid as the dense arm's peak only if it exceeds the chunked
+    # arm's (expected: the dense head's logits dominate); otherwise
+    # the high-water mark belongs to the chunked arm — don't claim it.
+    peak_dense = peak_hbm_gb()
+    if (
+        peak_dense is not None
+        and peak_chunked is not None
+        and peak_dense <= peak_chunked
+    ):
+        peak_dense = None
     _log(
         f"transformer: seq={seq_len} bsz={bsz} step={t_step*1e3:.1f}ms "
         f"tokens/s={tokens_per_s:.0f} "
         f"model_tflops/step={flops.total/1e12:.2f} "
-        f"mfu={mfu_val if mfu_val is None else round(mfu_val, 4)}"
+        f"mfu={mfu_val if mfu_val is None else round(mfu_val, 4)} "
+        f"peak_hbm_gb={peak_dense}"
     )
-    out = {"transformer_tokens_per_s": round(tokens_per_s, 1)}
+    out["transformer_tokens_per_s"] = round(tokens_per_s, 1)
     if mfu_val is not None:
         out["transformer_mfu"] = round(mfu_val, 4)
+    if peak_dense is not None:
+        out["transformer_peak_hbm_gb"] = peak_dense
     return out
 
 

@@ -52,6 +52,11 @@ def main():
     # materializing [tokens, vocab] logits (ops/chunked_xent.py) —
     # the HBM saving buys batch size at large vocab. 0 = dense head.
     parser.add_argument("--chunked-xent", type=int, default=0)
+    # ZeRO-1: shard the Adam moments across the data axis (8 bytes/
+    # param -> 8/dp) at the cost of one extra parameter-sized
+    # all-reduce per step. Composes with dp/seq; stage/expert/tp
+    # manage their own optimizer layouts.
+    parser.add_argument("--zero1", action="store_true")
     # Mixture-of-experts: every 2nd block's FFN becomes a Switch/
     # GShard MoE with this many experts; the expert axis shards over
     # the scheduler's chosen expertShards (ADAPTDL_EXPERT_SHARDS).
@@ -128,6 +133,16 @@ def main():
         else env.stage_shards()
     )
     pipeline_family = args.pipeline or stage_shards > 1
+    if args.zero1:
+        assert (
+            not pipeline_family
+            and args.moe_experts == 0
+            and (args.tp_shards or env.model_shards()) <= 1
+        ), (
+            "--zero1 shards optimizer state over the data axis and "
+            "composes with dp/seq only; stage/expert/tensor axes "
+            "manage their own optimizer layouts"
+        )
     if pipeline_family:
         assert (
             seq_shards <= 1
@@ -319,6 +334,7 @@ def main():
         # The M the pipelined loss_fn was actually built with — the
         # dataloader sizes per-replica batches to divide by it.
         pipeline_micro=pipeline_micro if stage_shards > 1 else None,
+        zero1=args.zero1,
     )
     holder = {"state": trainer.init_state()}
     ckpt = trainer.make_checkpoint_state(
@@ -379,8 +395,14 @@ def main():
         # pallas_call is opaque to GSPMD: under a model axis the
         # flash kernel's q/k/v would be all-gathered and attention
         # recomputed per shard, so don't advertise TP with --flash.
+        # ...and under --zero1 advertise NO tp/stage/expert axes: the
+        # trainer rejects them (sharded-param layouts manage their own
+        # optimizer state), so a scheduler-chosen tp rescale would
+        # crash-loop every restart.
         max_model_shards=(
-            1 if args.flash else min(config.num_heads, 8)
+            1
+            if args.flash or args.zero1
+            else min(config.num_heads, 8)
         ),
         # Stage shards must divide the layer count (uniform chunks);
         # advertise the largest power of two dividing L, and declare
