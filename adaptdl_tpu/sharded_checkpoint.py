@@ -108,27 +108,21 @@ class ShardedTrainerCheckpoint(checkpoint.State):
 
     def _zero1_canon_device(self, opt_state):
         """zero1 run layout -> canonical on-device: [dp, shard] moment
-        rows reshape to one [n] vector (pad trimmed), still sharded
-        over the data axis — no host gather, so the path works
-        multi-host where TrainerCheckpoint's host-numpy canonical form
-        cannot."""
-        from adaptdl_tpu.parallel.mesh import DATA_AXIS
-
+        rows reshape to one [n] vector (pad trimmed) — a device-side
+        collective, no host gather, so the path works multi-host where
+        TrainerCheckpoint's host-numpy canonical form cannot."""
         tr = self._trainer
         dp, shard, n = tr.num_replicas, tr._zero1_shard, tr._zero1_n
-        sharding = NamedSharding(tr.mesh, P(DATA_AXIS))
+        # Canonical vectors are REPLICATED: n is rarely divisible by
+        # dp, and in zero1 the params themselves are replicated, so a
+        # transient params-sized moment vector stays within the job's
+        # existing memory envelope.
+        sharding = NamedSharding(tr.mesh, P())
         canon = jax.jit(
             lambda v: v.reshape(dp * shard)[:n],
             out_shardings=sharding,
         )
-        return jax.tree.map(
-            lambda leaf: (
-                canon(leaf)
-                if getattr(leaf, "shape", None) == (dp, shard)
-                else leaf
-            ),
-            opt_state,
-        )
+        return tr._zero1_map_opt(opt_state, False, canon)
 
     def _zero1_expand_device(self, opt_state):
         """Canonical [n] moment vectors -> this incarnation's
@@ -137,23 +131,15 @@ class ShardedTrainerCheckpoint(checkpoint.State):
         from adaptdl_tpu.parallel.mesh import DATA_AXIS
 
         tr = self._trainer
-        dp, shard, n, pad = (
-            tr.num_replicas, tr._zero1_shard, tr._zero1_n,
-            tr._zero1_pad,
+        dp, shard, pad = (
+            tr.num_replicas, tr._zero1_shard, tr._zero1_pad,
         )
         sharding = NamedSharding(tr.mesh, P(DATA_AXIS))
         expand = jax.jit(
             lambda v: jax.numpy.pad(v, (0, pad)).reshape(dp, shard),
             out_shardings=sharding,
         )
-        return jax.tree.map(
-            lambda leaf: (
-                expand(leaf)
-                if getattr(leaf, "shape", None) == (n,)
-                else leaf
-            ),
-            opt_state,
-        )
+        return tr._zero1_map_opt(opt_state, True, expand)
 
     def sync(self) -> None:
         """All processes write their shards via orbax — into a fresh
@@ -216,11 +202,8 @@ class ShardedTrainerCheckpoint(checkpoint.State):
             )
         if self._trainer.zero1:
             # The payload stores moments in the canonical [n] layout
-            # (sync() wrote them that way); restore them [n] sharded
-            # over data, expand to this incarnation's [dp, shard]
-            # after.
-            from adaptdl_tpu.parallel.mesh import DATA_AXIS
-
+            # (sync() wrote them that way, replicated); restore them
+            # [n] and expand to this incarnation's [dp, shard] rows.
             tr = self._trainer
             dp, shard, n = (
                 tr.num_replicas, tr._zero1_shard, tr._zero1_n,
@@ -231,9 +214,7 @@ class ShardedTrainerCheckpoint(checkpoint.State):
                         jax.ShapeDtypeStruct(
                             (n,),
                             t.dtype,
-                            sharding=NamedSharding(
-                                mesh, P(DATA_AXIS)
-                            ),
+                            sharding=NamedSharding(mesh, P()),
                         )
                         if getattr(t, "shape", None) == (dp, shard)
                         else t

@@ -406,6 +406,28 @@ class ElasticTrainer:
             opt_state,
         )
 
+    def _zero1_map_opt(self, opt_state, from_canonical: bool, convert):
+        """THE single definition of which optimizer leaves carry the
+        zero1 moment layout: canonical ``[n]`` vectors when
+        ``from_canonical``, run-layout ``[dp, shard]`` rows otherwise.
+        Every canonical<->run conversion (host pickle path here,
+        device orbax path in sharded_checkpoint) goes through this
+        matcher with its own ``convert``, so the on-disk layout and
+        the leaf-identification rule cannot drift between paths."""
+        match_shape = (
+            (self._zero1_n,)
+            if from_canonical
+            else (self.num_replicas, self._zero1_shard)
+        )
+        return jax.tree.map(
+            lambda leaf: (
+                convert(leaf)
+                if np.shape(leaf) == match_shape
+                else leaf
+            ),
+            opt_state,
+        )
+
     def _zero1_canonical_opt(self, opt_state):
         """Host opt state, run layout -> canonical disk layout: the
         [dp, shard] moment rows flatten to one [n] vector (pad
@@ -415,35 +437,28 @@ class ElasticTrainer:
         dp, shard, n = (
             self.num_replicas, self._zero1_shard, self._zero1_n,
         )
-
-        def canon(leaf):
-            if np.shape(leaf) == (dp, shard):
-                return np.asarray(leaf).reshape(dp * shard)[:n]
-            return leaf
-
-        return jax.tree.map(canon, opt_state)
+        return self._zero1_map_opt(
+            opt_state,
+            False,
+            lambda leaf: np.asarray(leaf).reshape(dp * shard)[:n],
+        )
 
     def _zero1_expand_opt(self, opt_state):
         """Canonical [n] moment vectors -> this trainer's [dp, shard]
         rows (re-padded for the current replica count)."""
-        dp, shard, n, pad = (
-            self.num_replicas,
-            self._zero1_shard,
-            self._zero1_n,
-            self._zero1_pad,
+        dp, shard, pad = (
+            self.num_replicas, self._zero1_shard, self._zero1_pad,
         )
 
         def expand(leaf):
-            if np.shape(leaf) == (n,):
-                flat = np.asarray(leaf)
-                if pad:
-                    flat = np.concatenate(
-                        [flat, np.zeros(pad, flat.dtype)]
-                    )
-                return flat.reshape(dp, shard)
-            return leaf
+            flat = np.asarray(leaf)
+            if pad:
+                flat = np.concatenate(
+                    [flat, np.zeros(pad, flat.dtype)]
+                )
+            return flat.reshape(dp, shard)
 
-        return jax.tree.map(expand, opt_state)
+        return self._zero1_map_opt(opt_state, True, expand)
 
     def _abstract_state(self) -> "TrainState":
         """Shape/structure skeleton of the TrainState (no devices):
@@ -513,11 +528,21 @@ class ElasticTrainer:
         # Optimizer moments follow the params' layout: eager
         # zeros_like on a sharded array preserves its sharding. Under
         # zero1 the moments are flat [dp, shard] rows placed P("data").
-        opt_state = self._init_opt_state(params)
         if self.zero1:
-            opt_state = jax.tree.map(
-                put, opt_state, self._zero1_opt_specs(opt_state)
+            # Born sharded: jit with out_shardings so the moment rows
+            # never exist replicated — an eager init would transiently
+            # hold params + flat copy + both replicated moments per
+            # device, an OOM risk at exactly the scale zero1 targets.
+            abstract = jax.eval_shape(self._init_opt_state, params)
+            out_sh = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s),
+                self._zero1_opt_specs(abstract),
             )
+            opt_state = jax.jit(
+                self._init_opt_state, out_shardings=out_sh
+            )(params)
+        else:
+            opt_state = self._init_opt_state(params)
         gns_state = gns.init(params, self.num_param_groups)
         gns_state = gns_state._replace(
             prev_grad=jax.tree.map(put, gns_state.prev_grad, specs),

@@ -56,7 +56,17 @@ def loss_fn(params, batch, _rng):
 
 import jax.numpy as jnp
 
-trainer = ElasticTrainer(loss_fn, {"w": jnp.zeros(4)}, optax.sgd(0.05), 8)
+# ZERO1=1 switches to sharded-moment adamw: the multi-host zero1
+# checkpoint path (canonical flat moments written collectively via
+# orbax, re-partitioned for the restoring process count).
+zero1 = os.environ.get("ZERO1") == "1"
+trainer = ElasticTrainer(
+    loss_fn,
+    {"w": jnp.zeros(4)},
+    optax.adamw(0.05) if zero1 else optax.sgd(0.05),
+    8,
+    zero1=zero1,
+)
 holder = {"state": trainer.init_state()}
 ck = ShardedTrainerCheckpoint(
     "mh_trainer",
@@ -88,7 +98,7 @@ print(
 """
 
 
-def test_two_process_train_then_single_process_restore(tmp_path):
+def _run_phases(tmp_path, extra_env=None):
     worker = tmp_path / "worker.py"
     worker.write_text(WORKER)
     coord_port = portpicker.pick_unused_port()
@@ -127,6 +137,8 @@ def test_two_process_train_then_single_process_restore(tmp_path):
                     ),
                 }
             )
+            if extra_env:
+                env.update(extra_env)
             if num_processes > 1:
                 env["ADAPTDL_COORDINATOR_ADDR"] = (
                     f"127.0.0.1:{coord_port}"
@@ -177,3 +189,16 @@ def test_two_process_train_then_single_process_restore(tmp_path):
     # init; instead assert it changed from zeros AND from saved).
     assert fields["w"] != w_saved
     assert any(abs(float(v)) > 1e-8 for v in w_saved.split(","))
+
+
+def test_two_process_train_then_single_process_restore(tmp_path):
+    _run_phases(tmp_path)
+
+
+def test_two_process_zero1_then_single_process_restore(tmp_path):
+    """The same cross-process-count rescale with ZeRO-1 moments: the
+    2-process save writes canonical flat moments collectively (each
+    process holds only its data-axis rows — no host gather is
+    possible), and the 1-process incarnation re-partitions them for
+    its own replica count."""
+    _run_phases(tmp_path, extra_env={"ZERO1": "1"})

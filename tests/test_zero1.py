@@ -29,9 +29,7 @@ def _lm_setup(seed=0):
 
 def _run_steps(trainer, batch_np, steps=5):
     state = trainer.init_state()
-    step = trainer.train_step(
-        8 // trainer.num_replicas // max(1, 1), 0
-    )
+    step = trainer.train_step(8 // trainer.num_replicas, 0)
     batch = trainer.shard_batch(batch_np)
     for _ in range(steps):
         state, m = step(state, batch)
