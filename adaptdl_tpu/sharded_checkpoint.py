@@ -141,6 +141,33 @@ class ShardedTrainerCheckpoint(checkpoint.State):
         )
         return tr._zero1_map_opt(opt_state, True, expand)
 
+    def _zero3_canon_params_device(self, rows):
+        """zero3 run layout -> canonical on-device: [dp, shard] param
+        rows unravel to the (replicated) parameter tree — the same
+        dp-independent disk format a dense trainer would write."""
+        tr = self._trainer
+        dp, shard, n = tr.num_replicas, tr._zero1_shard, tr._zero1_n
+
+        def to_tree(r):
+            return tr._zero1_unravel(r.reshape(dp * shard)[:n])
+
+        abstract = jax.eval_shape(to_tree, rows)
+        out_sh = jax.tree.map(
+            lambda _: NamedSharding(tr.mesh, P()), abstract
+        )
+        return jax.jit(to_tree, out_shardings=out_sh)(rows)
+
+    def _zero3_rows_device(self, tree):
+        """Canonical param tree -> this incarnation's [dp, shard]
+        rows, sharded over the data axis."""
+        from adaptdl_tpu.parallel.mesh import DATA_AXIS
+
+        tr = self._trainer
+        return jax.jit(
+            tr._tree_to_rows,
+            out_shardings=NamedSharding(tr.mesh, P(DATA_AXIS)),
+        )(tree)
+
     def sync(self) -> None:
         """All processes write their shards via orbax — into a fresh
         versioned directory, never over a payload an existing complete
@@ -153,6 +180,23 @@ class ShardedTrainerCheckpoint(checkpoint.State):
         if self._trainer.zero1:
             state = state._replace(
                 opt_state=self._zero1_canon_device(state.opt_state)
+            )
+        if self._trainer.zero3:
+            state = state._replace(
+                params=self._zero3_canon_params_device(state.params)
+            )
+        if self._trainer.zero1 and self._trainer.num_replicas == 1:
+            # Canonical prev_grad is the placeholder layout; at dp>1
+            # the run state already IS that layout (replicated on the
+            # mesh), so only the dp==1 full tree needs converting —
+            # built under jit with out_shardings (host-local arrays
+            # would be unserializable in a multi-process job).
+            state = state._replace(
+                gns=state.gns._replace(
+                    prev_grad=(
+                        self._trainer._empty_prev_grad_replicated()
+                    )
+                )
             )
         path = _next_payload_dir(self.name)
         checkpointer = ocp.StandardCheckpointer()
@@ -222,13 +266,109 @@ class ShardedTrainerCheckpoint(checkpoint.State):
                     target.opt_state,
                 )
             )
+        if self._trainer.zero3:
+            # Params are stored as the canonical tree; build its
+            # abstract target from the trainer's init tree (shapes
+            # and dtypes are dp-independent).
+            tr = self._trainer
+            target = target._replace(
+                params=jax.tree.map(
+                    lambda p: jax.ShapeDtypeStruct(
+                        np.shape(p),
+                        p.dtype,
+                        sharding=NamedSharding(mesh, P()),
+                    ),
+                    tr._init_params,
+                )
+            )
+        tr = self._trainer
+        if tr.zero1:
+            # Saved prev_grad is canonical-empty; align the restore
+            # target.
+            target = target._replace(
+                gns=target.gns._replace(
+                    prev_grad=jax.tree.map(
+                        lambda _: jax.ShapeDtypeStruct(
+                            (1,),
+                            np.float32,
+                            sharding=NamedSharding(mesh, P()),
+                        ),
+                        tr._init_params,
+                    )
+                )
+            )
         checkpointer = ocp.StandardCheckpointer()
-        restored = checkpointer.restore(path, target)
-        if self._trainer.zero1:
+        try:
+            restored = checkpointer.restore(path, target)
+        except Exception:
+            if not tr.zero1:
+                raise
+            # Back-compat: zero1 payloads written before the
+            # placeholder layout carry full param-shaped prev_grad
+            # leaves; retry with that target, then re-canonicalize.
+            full_target = target._replace(
+                gns=target.gns._replace(
+                    prev_grad=jax.tree.map(
+                        lambda p: jax.ShapeDtypeStruct(
+                            np.shape(p),
+                            np.float32,
+                            sharding=NamedSharding(mesh, P()),
+                        ),
+                        tr._init_params,
+                    )
+                )
+            )
+            restored = checkpointer.restore(path, full_target)
+            if tr.num_replicas > 1:
+                restored = restored._replace(
+                    gns=restored.gns._replace(
+                        prev_grad=tr._empty_prev_grad_replicated()
+                    )
+                )
+        if tr.zero1:
             restored = restored._replace(
                 opt_state=self._zero1_expand_device(
                     restored.opt_state
+                ),
+            )
+            if tr.num_replicas == 1:
+                # The only prev_grad reader: re-materialize the full
+                # zeros tree on the mesh and let the differenced
+                # estimator re-prime.
+                restored_leaves = jax.tree.leaves(
+                    restored.gns.prev_grad
                 )
+                if restored_leaves and any(
+                    np.shape(leaf) == (1,) and np.shape(p) != (1,)
+                    for leaf, p in zip(
+                        restored_leaves,
+                        jax.tree.leaves(tr._init_params),
+                    )
+                ):
+                    full_fn = lambda: jax.tree.map(  # noqa: E731
+                        lambda p: jax.numpy.zeros(
+                            np.shape(p), jax.numpy.float32
+                        ),
+                        tr._init_params,
+                    )
+                    out_sh = jax.tree.map(
+                        lambda _: NamedSharding(mesh, P()),
+                        jax.eval_shape(full_fn),
+                    )
+                    restored = restored._replace(
+                        gns=restored.gns._replace(
+                            prev_grad=jax.jit(
+                                full_fn, out_shardings=out_sh
+                            )(),
+                            prev_grad_valid=jax.device_put(
+                                np.zeros((), bool),
+                                NamedSharding(mesh, P()),
+                            ),
+                        )
+                    )
+        if self._trainer.zero3:
+            restored = restored._replace(
+                params=self._zero3_rows_device(restored.params)
             )
         restored = restored._replace(
             rng=jax.random.wrap_key_data(restored.rng)

@@ -154,6 +154,7 @@ class ElasticTrainer:
         param_group_fn: Callable | None = None,
         pipeline_micro: int | None = None,
         zero1: bool = False,
+        zero3: bool = False,
     ):
         self.has_aux = has_aux
         self.param_sharding_fn = param_sharding_fn
@@ -228,7 +229,21 @@ class ElasticTrainer:
         # ICI under the compute. (ZeRO stage 1, Rajbhandari et al.;
         # implementation original, built on the flat-vector psum
         # pattern rather than torch's per-bucket broadcast.)
-        self.zero1 = bool(zero1)
+        # ZeRO-3-lite: additionally store the PARAMETERS as flat
+        # [dp, shard] rows over the data axis. The step assembles the
+        # full tree on the fly (scatter+psum, the FSDP all-gather) and
+        # the optimizer updates only this replica's row — which also
+        # makes the update path CHEAPER than zero1's (no parameter
+        # reassembly collective after the update; assembly happens
+        # once at step start). Storage per device: params n/dp +
+        # moments 2n/dp, vs n + 2n replicated — the transient full
+        # tree lives only inside the step. Params checkpoint in
+        # canonical TREE form (dp-independent; same layout a dense
+        # trainer writes) while the moments stay flat-canonical, so
+        # like zero1 the flag is part of the job's stable config:
+        # rescales change dp freely, not the zero family.
+        self.zero3 = bool(zero3)
+        self.zero1 = bool(zero1) or self.zero3
         if self.zero1:
             if (
                 self.sharded_param_axes
@@ -344,10 +359,18 @@ class ElasticTrainer:
         """
         if self.zero1:
             # zero1 excludes param_sharding_fn (checked in __init__):
-            # every leaf replicates except the sharded moment rows.
-            return jax.tree.map(lambda _: P(), state)._replace(
+            # every leaf replicates except the sharded moment rows —
+            # and, under zero3, the params rows themselves.
+            base = jax.tree.map(lambda _: P(), state)._replace(
                 opt_state=self._zero1_opt_specs(state.opt_state)
             )
+            rows_shape = (self.num_replicas, self._zero1_shard)
+            if (
+                self.zero3
+                and getattr(state.params, "shape", None) == rows_shape
+            ):
+                base = base._replace(params=P(DATA_AXIS))
+            return base
         if self.param_sharding_fn is None:
             return jax.tree.map(lambda _: P(), state)
         param_leaves = jax.tree_util.tree_flatten_with_path(state.params)[0]
@@ -373,16 +396,9 @@ class ElasticTrainer:
 
         return jax.tree_util.tree_map_with_path(assign, state)
 
-    def _init_opt_state(self, params):
-        """Optimizer state in the run layout: the param tree normally;
-        under zero1, the optimizer is initialized over the padded flat
-        parameter vector reshaped ``[dp, shard]`` so its moment leaves
-        shard ``P("data")`` (dim 0) and each replica owns one row.
-        Works for elementwise transforms (the Adam/SGD families);
-        norm-based transforms (clip_by_global_norm) would see
-        shard-local norms and are unsupported under zero1."""
-        if not self.zero1:
-            return self.optimizer.init(params)
+    def _tree_to_rows(self, params):
+        """Param tree -> padded flat ``[dp, shard]`` rows (the zero1/
+        zero3 run layout). Traceable; works on host or under jit."""
         from jax.flatten_util import ravel_pytree
 
         flat, _ = ravel_pytree(params)
@@ -390,9 +406,41 @@ class ElasticTrainer:
             flat = jnp.concatenate(
                 [flat, jnp.zeros((self._zero1_pad,), flat.dtype)]
             )
-        return self.optimizer.init(
-            flat.reshape(self.num_replicas, self._zero1_shard)
+        return flat.reshape(self.num_replicas, self._zero1_shard)
+
+    def _init_opt_state(self, params):
+        """Optimizer state in the run layout: the param tree normally;
+        under zero1, the optimizer is initialized over the padded flat
+        parameter vector reshaped ``[dp, shard]`` so its moment leaves
+        shard ``P("data")`` (dim 0) and each replica owns one row.
+        Works for elementwise transforms (the Adam/SGD families);
+        norm-based transforms (clip_by_global_norm) would see
+        shard-local norms and are unsupported under zero1. Accepts
+        params already in rows layout (zero3 states)."""
+        if not self.zero1:
+            return self.optimizer.init(params)
+        rows_shape = (self.num_replicas, self._zero1_shard)
+        if getattr(params, "shape", None) == rows_shape:
+            rows = params
+        else:
+            rows = self._tree_to_rows(params)
+        return self.optimizer.init(rows)
+
+    def _rows_to_flat(self, rows_local):
+        """Inside the manual step: this replica's ``[1, shard]`` row
+        -> the full ``[n]`` flat vector. Scatter + psum over the data
+        axis (psum output is typed invariant under the vma system,
+        which a tiled all_gather is not)."""
+        full = jnp.zeros(
+            (self.num_replicas * self._zero1_shard,),
+            rows_local.dtype,
         )
+        full = jax.lax.pcast(full, DATA_AXIS, to="varying")
+        rank = jax.lax.axis_index(DATA_AXIS)
+        full = jax.lax.dynamic_update_slice(
+            full, rows_local[0], (rank * self._zero1_shard,)
+        )
+        return jax.lax.psum(full, DATA_AXIS)[: self._zero1_n]
 
     def _zero1_opt_specs(self, opt_state):
         dp = self.num_replicas
@@ -460,6 +508,93 @@ class ElasticTrainer:
 
         return self._zero1_map_opt(opt_state, True, expand)
 
+    def _zero3_canonical_params(self, rows):
+        """Host params, run layout -> canonical disk layout: the
+        [dp, shard] rows unravel back to the parameter TREE, so the
+        on-disk format is dp-independent (and identical to a dense
+        trainer's param layout)."""
+        dp, shard, n = (
+            self.num_replicas, self._zero1_shard, self._zero1_n,
+        )
+        flat = np.asarray(rows).reshape(dp * shard)[:n]
+        tree = self._zero1_unravel(jnp.asarray(flat))
+        return jax.tree.map(np.asarray, tree)
+
+    def _zero3_rows_from_tree(self, tree):
+        """Canonical param tree -> this trainer's [dp, shard] rows
+        (host wrapper over the single layout definition)."""
+        return np.asarray(
+            self._tree_to_rows(jax.tree.map(jnp.asarray, tree))
+        )
+
+    def _empty_prev_grad(self):
+        """zero1/zero3 at dp > 1: the GNS differenced-estimator carry
+        (prev_grad, a full f32 param-sized tree) backs ONLY the dp==1
+        single-sample estimator — at dp > 1 gns.update's count>1
+        branch never reads it, so persisting it replicated would
+        silently claw back the memory the zero family sheds. Store
+        one-element placeholder leaves instead ((1,), not (0,):
+        orbax refuses zero-size arrays)."""
+        return jax.tree.map(
+            lambda _: jnp.zeros((1,), jnp.float32), self._init_params
+        )
+
+    def _empty_prev_grad_host(self):
+        """Host-numpy form of the placeholder layout (checkpoint
+        canonicalization paths)."""
+        return jax.tree.map(
+            lambda _: np.zeros((1,), np.float32), self._init_params
+        )
+
+    def _empty_prev_grad_replicated(self):
+        """The placeholder layout placed replicated on THIS mesh
+        (multi-process safe: built under jit with out_shardings, never
+        as host-local arrays orbax would refuse to serialize)."""
+        out_sh = jax.tree.map(
+            lambda _: NamedSharding(self.mesh, P()),
+            jax.eval_shape(self._empty_prev_grad),
+        )
+        return jax.jit(
+            self._empty_prev_grad, out_shardings=out_sh
+        )()
+
+    def _normalize_gns_layout(self, gns_state):
+        """Restore-time prev_grad layout fix-up: canonical checkpoints
+        store it EMPTY under the zero family; a dp==1 trainer (the only
+        reader) re-materializes zeros and invalidates the carry so the
+        differenced estimator re-primes on its next step."""
+        if not self.zero1:
+            return gns_state
+
+        def is_marker(leaf, param):
+            # A (1,) leaf standing in for a differently-shaped param.
+            return (
+                np.shape(leaf) == (1,) and np.shape(param) != (1,)
+            )
+
+        if self.num_replicas > 1:
+            # The carry is never read at dp>1: placeholder layout,
+            # whatever came in.
+            return gns_state._replace(
+                prev_grad=self._empty_prev_grad_host()
+            )
+        markers = [
+            is_marker(leaf, param)
+            for leaf, param in zip(
+                jax.tree.leaves(gns_state.prev_grad),
+                jax.tree.leaves(self._init_params),
+            )
+        ]
+        if not any(markers):
+            return gns_state
+        return gns_state._replace(
+            prev_grad=jax.tree.map(
+                lambda p: np.zeros(np.shape(p), np.float32),
+                self._init_params,
+            ),
+            prev_grad_valid=np.zeros((), bool),
+        )
+
     def _abstract_state(self) -> "TrainState":
         """Shape/structure skeleton of the TrainState (no devices):
         what spec-tree construction needs before any state exists."""
@@ -468,6 +603,15 @@ class ElasticTrainer:
             params = self._init_params
             opt_state = self._init_opt_state(params)
             gns_state = gns.init(params, self.num_param_groups)
+            if self.zero1 and self.num_replicas > 1:
+                # prev_grad backs only the dp==1 differenced
+                # estimator; at dp>1 keep it empty (see
+                # _empty_prev_grad).
+                gns_state = gns_state._replace(
+                    prev_grad=self._empty_prev_grad()
+                )
+            if self.zero3:
+                params = self._tree_to_rows(params)
             return TrainState(
                 params=params,
                 opt_state=opt_state,
@@ -544,8 +688,19 @@ class ElasticTrainer:
         else:
             opt_state = self._init_opt_state(params)
         gns_state = gns.init(params, self.num_param_groups)
+        if self.zero1 and self.num_replicas > 1:
+            gns_state = gns_state._replace(
+                prev_grad=self._empty_prev_grad()
+            )
+            prev_specs = jax.tree.map(
+                lambda _: P(), gns_state.prev_grad
+            )
+        else:
+            prev_specs = specs
         gns_state = gns_state._replace(
-            prev_grad=jax.tree.map(put, gns_state.prev_grad, specs),
+            prev_grad=jax.tree.map(
+                put, gns_state.prev_grad, prev_specs
+            ),
             sqr_biased=put(gns_state.sqr_biased, P()),
             sqr_unbias=put(gns_state.sqr_unbias, P()),
             var_biased=put(gns_state.var_biased, P()),
@@ -553,6 +708,14 @@ class ElasticTrainer:
             ema_is_biased=put(gns_state.ema_is_biased, P()),
             prev_grad_valid=put(gns_state.prev_grad_valid, P()),
         )
+        if self.zero3:
+            # Params born sharded too: each device ends with only its
+            # [1, shard] row (the replicated tree above was needed to
+            # seed the optimizer/GNS mirrors and is dropped here).
+            params = jax.jit(
+                self._tree_to_rows,
+                out_shardings=NamedSharding(self.mesh, P(DATA_AXIS)),
+            )(params)
         return TrainState(
             params=params,
             opt_state=opt_state,
@@ -588,16 +751,7 @@ class ElasticTrainer:
                 "precondition='adam' but optimizer state has no "
                 "ScaleByAdamState"
             )
-        full = jnp.zeros(
-            (self.num_replicas * self._zero1_shard,), nu_local.dtype
-        )
-        full = jax.lax.pcast(full, DATA_AXIS, to="varying")
-        rank = jax.lax.axis_index(DATA_AXIS)
-        full = jax.lax.dynamic_update_slice(
-            full, nu_local[0], (rank * self._zero1_shard,)
-        )
-        flat_nu = jax.lax.psum(full, DATA_AXIS)[: self._zero1_n]
-        nu_tree = self._zero1_unravel(flat_nu)
+        nu_tree = self._zero1_unravel(self._rows_to_flat(nu_local))
         return jax.tree.map(
             lambda v: jnp.sqrt(
                 jnp.maximum(v.astype(jnp.float32), 0.0)
@@ -662,35 +816,42 @@ class ElasticTrainer:
                 pre,
             )
 
-        def zero1_update(grads, opt_local, params, group_factors):
-            """ZeRO-1 sharded optimizer step: slice this replica's row
-            of the flat (grad, param) vectors, update it against the
-            local [1, shard] moment row, apply the per-position group
-            LR factor, and reassemble the full parameter vector with
-            scatter + psum (typed invariant over the data axis, which
-            a tiled all_gather is not under the vma system)."""
+        def zero1_update(grads, opt_local, params, p_rows, group_factors):
+            """ZeRO-1/3 sharded optimizer step: slice this replica's
+            row of the flat gradient vector, update it against the
+            local [1, shard] moment row, and apply the per-position
+            group LR factor. Under zero1 the full parameter vector is
+            then reassembled with scatter + psum (typed invariant over
+            the data axis, which a tiled all_gather is not under the
+            vma system); under zero3 the updated row IS the new
+            parameter state — no reassembly collective at all (the
+            next step's assembly does that work once)."""
             from jax.flatten_util import ravel_pytree
 
             shard = self._zero1_shard
-            n = self._zero1_n
             pad = self._zero1_pad
             flat_g, _ = ravel_pytree(grads)
-            flat_p, unravel_p = ravel_pytree(params)
             if pad:
                 flat_g = jnp.concatenate(
                     [flat_g, jnp.zeros((pad,), flat_g.dtype)]
-                )
-                flat_p = jnp.concatenate(
-                    [flat_p, jnp.zeros((pad,), flat_p.dtype)]
                 )
             rank = jax.lax.axis_index(DATA_AXIS)
             start = rank * shard
             g_sh = jax.lax.dynamic_slice(flat_g, (start,), (shard,))[
                 None
             ]
-            p_sh = jax.lax.dynamic_slice(flat_p, (start,), (shard,))[
-                None
-            ]
+            if self.zero3:
+                p_sh = p_rows  # the local [1, shard] row, as stored
+                unravel_p = None
+            else:
+                flat_p, unravel_p = ravel_pytree(params)
+                if pad:
+                    flat_p = jnp.concatenate(
+                        [flat_p, jnp.zeros((pad,), flat_p.dtype)]
+                    )
+                p_sh = jax.lax.dynamic_slice(
+                    flat_p, (start,), (shard,)
+                )[None]
             updates_sh, new_opt = self.optimizer.update(
                 g_sh, opt_local, p_sh
             )
@@ -707,15 +868,9 @@ class ElasticTrainer:
                 updates_sh.astype(jnp.float32) * factor_sh
             ).astype(updates_sh.dtype)
             new_p_sh = optax.apply_updates(p_sh, updates_sh)
-            full = jnp.zeros(
-                (num_replicas * shard,), new_p_sh.dtype
-            )
-            full = jax.lax.pcast(full, DATA_AXIS, to="varying")
-            full = jax.lax.dynamic_update_slice(
-                full, new_p_sh[0], (start,)
-            )
-            new_flat = jax.lax.psum(full, DATA_AXIS)
-            return unravel_p(new_flat[:n]), new_opt
+            if self.zero3:
+                return new_p_sh, new_opt
+            return unravel_p(self._rows_to_flat(new_p_sh)), new_opt
 
         def per_replica_step(state: TrainState, local_batch, aux):
             # Differentiate wrt a per-replica *varying* view of the
@@ -725,6 +880,13 @@ class ElasticTrainer:
             # noise signal the GNS needs. Varying params keep gradients
             # local; the cross-replica mean is taken explicitly below.
             params = state.params
+            if self.zero3:
+                # FSDP-style assembly: this device's [1, shard] row ->
+                # the full parameter tree, once per step (the
+                # all-gather of ZeRO-3, as a vma-typed scatter+psum).
+                params = self._zero1_unravel(
+                    self._rows_to_flat(params)
+                )
             varying_axes = (
                 (DATA_AXIS, SEQ_AXIS) if seq_shards > 1 else DATA_AXIS
             )
@@ -843,7 +1005,9 @@ class ElasticTrainer:
             group_factors = self.scaling_rule.lr_factor_groups(ctx)
             if self.zero1:
                 new_params, new_opt_state = zero1_update(
-                    grads, state.opt_state, params, group_factors
+                    grads, state.opt_state, params,
+                    state.params if self.zero3 else None,
+                    group_factors,
                 )
             else:
                 updates, new_opt_state = self.optimizer.update(
@@ -973,6 +1137,10 @@ class ElasticTrainer:
         )
 
         def per_replica(params, local_batch, rng):
+            if self.zero3:
+                params = self._zero1_unravel(
+                    self._rows_to_flat(params)
+                )
             params_v = jax.lax.pcast(params, varying_axes, to="varying")
             rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
             loss, grads = jax.value_and_grad(self.loss_fn)(
@@ -994,9 +1162,12 @@ class ElasticTrainer:
         extra = {}
         if MODEL_AXIS in self.mesh.shape:
             extra["axis_names"] = manual
-        param_specs = self._restrict_specs(
-            self._param_spec_tree(self._init_params), manual
-        )
+        if self.zero3:
+            param_specs = P(DATA_AXIS)  # the flat rows
+        else:
+            param_specs = self._restrict_specs(
+                self._param_spec_tree(self._init_params), manual
+            )
         sharded = shard_map(
             per_replica,
             mesh=self.mesh,
@@ -1155,6 +1326,20 @@ class TrainerCheckpoint(checkpoint.State):
                     state.opt_state
                 )
             )
+        if self._trainer.zero3:
+            state = state._replace(
+                params=self._trainer._zero3_canonical_params(
+                    state.params
+                )
+            )
+        if self._trainer.zero1:
+            # Canonical prev_grad is always empty under the zero
+            # family (dp-independent; the dp==1 reader re-primes).
+            state = state._replace(
+                gns=state.gns._replace(
+                    prev_grad=self._trainer._empty_prev_grad_host()
+                )
+            )
         if self._transform_save is not None:
             state = self._transform_save(state)
         pickle.dump(state, fileobj)
@@ -1167,6 +1352,18 @@ class TrainerCheckpoint(checkpoint.State):
             host_state = host_state._replace(
                 opt_state=self._trainer._zero1_expand_opt(
                     host_state.opt_state
+                )
+            )
+        if self._trainer.zero3:
+            host_state = host_state._replace(
+                params=self._trainer._zero3_rows_from_tree(
+                    host_state.params
+                )
+            )
+        if self._trainer.zero1:
+            host_state = host_state._replace(
+                gns=self._trainer._normalize_gns_layout(
+                    host_state.gns
                 )
             )
         host_state = host_state._replace(

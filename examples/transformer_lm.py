@@ -57,6 +57,10 @@ def main():
     # all-reduce per step. Composes with dp/seq; stage/expert/tp
     # manage their own optimizer layouts.
     parser.add_argument("--zero1", action="store_true")
+    # ZeRO-3-lite: additionally shard the PARAMETER storage (params +
+    # moments live as [dp, shard] rows; the step assembles the full
+    # tree on the fly). Same composition rules as --zero1.
+    parser.add_argument("--zero3", action="store_true")
     # Mixture-of-experts: every 2nd block's FFN becomes a Switch/
     # GShard MoE with this many experts; the expert axis shards over
     # the scheduler's chosen expertShards (ADAPTDL_EXPERT_SHARDS).
@@ -133,6 +137,8 @@ def main():
         else env.stage_shards()
     )
     pipeline_family = args.pipeline or stage_shards > 1
+    if args.zero3:
+        args.zero1 = True  # zero3 implies the zero1 constraints below
     if args.zero1:
         assert (
             not pipeline_family
@@ -335,6 +341,7 @@ def main():
         # dataloader sizes per-replica batches to divide by it.
         pipeline_micro=pipeline_micro if stage_shards > 1 else None,
         zero1=args.zero1,
+        zero3=args.zero3,
     )
     holder = {"state": trainer.init_state()}
     ckpt = trainer.make_checkpoint_state(
