@@ -37,6 +37,13 @@ class TransformerConfig:
     dropout_rate: float = 0.0
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # Rematerialisation policy (a jax.checkpoint_policies name, e.g.
+    # "dots_with_no_batch_dims_saveable" to keep matmul outputs and
+    # recompute only the cheap elementwise ops, or "nothing_saveable"
+    # for maximum HBM savings). None = save nothing beyond the
+    # defaults. The policy trades recompute FLOPs for HBM — the knob
+    # to turn when activations, not weights, bound the batch size.
+    remat_policy: str | None = None
     # attention_fn(q, k, v) -> out; q/k/v are [batch, heads, seq,
     # head_dim]; None selects plain causal attention (or ring
     # attention when seq_axis is set).
@@ -308,7 +315,14 @@ class TransformerLM(nn.Module):
             positions = jnp.arange(tokens.shape[1])
         block_cls = Block
         if cfg.remat:
-            block_cls = nn.remat(Block, static_argnums=())
+            remat_kwargs = {}
+            if cfg.remat_policy is not None:
+                remat_kwargs["policy"] = getattr(
+                    jax.checkpoint_policies, cfg.remat_policy
+                )
+            block_cls = nn.remat(
+                Block, static_argnums=(), **remat_kwargs
+            )
         for layer in range(cfg.num_layers):
             dropout_rng = (
                 jax.random.fold_in(rng, layer)
@@ -351,6 +365,20 @@ def init_transformer(config: TransformerConfig, rng=None, seq_len=None):
             "moe_router='experts' is not causally valid with "
             "causal=True (expert-choice gating sees future tokens); "
             "use causal=False (encoder/MLM) or moe_router='tokens'"
+        )
+    if config.remat_policy is not None and not hasattr(
+        jax.checkpoint_policies, config.remat_policy
+    ):
+        # Fail at configuration time, not deep inside the first step's
+        # jit trace (which on TPU wastes the whole startup).
+        valid = sorted(
+            name
+            for name in dir(jax.checkpoint_policies)
+            if not name.startswith("_")
+        )
+        raise ValueError(
+            f"unknown remat_policy {config.remat_policy!r}; valid "
+            f"jax.checkpoint_policies names: {valid}"
         )
     model = TransformerLM(config)
     # Parameter shapes don't depend on the parallelism config, and the

@@ -168,6 +168,26 @@ class ShardedTrainerCheckpoint(checkpoint.State):
             out_shardings=NamedSharding(tr.mesh, P(DATA_AXIS)),
         )(tree)
 
+    def _saved_prev_grad_is_placeholder(self, checkpointer, path):
+        """Whether the payload's gns.prev_grad was written in the
+        placeholder ((1,)-leaf) layout, from orbax metadata. Defaults
+        to True (the current writer's layout) if metadata is missing —
+        a genuinely broken payload then fails in restore() with the
+        real error, not a layout guess."""
+        try:
+            tree = checkpointer.metadata(path).item_metadata.tree
+            prev = tree["gns"]["prev_grad"]
+            leaves = jax.tree.leaves(
+                prev, is_leaf=lambda x: hasattr(x, "shape")
+            )
+            params = jax.tree.leaves(self._trainer._init_params)
+            return any(
+                tuple(leaf.shape) == (1,) and np.shape(p) != (1,)
+                for leaf, p in zip(leaves, params)
+            )
+        except Exception:  # noqa: BLE001 - metadata is best-effort
+            return True
+
     def sync(self) -> None:
         """All processes write their shards via orbax — into a fresh
         versioned directory, never over a payload an existing complete
@@ -282,35 +302,22 @@ class ShardedTrainerCheckpoint(checkpoint.State):
                 )
             )
         tr = self._trainer
+        checkpointer = ocp.StandardCheckpointer()
         if tr.zero1:
-            # Saved prev_grad is canonical-empty; align the restore
-            # target.
+            # Align the prev_grad target with the SAVED layout, read
+            # from the payload's metadata (canonical placeholders
+            # since the placeholder change; full param-shaped trees
+            # in payloads written before it).
+            saved_placeholder = self._saved_prev_grad_is_placeholder(
+                checkpointer, path
+            )
             target = target._replace(
                 gns=target.gns._replace(
                     prev_grad=jax.tree.map(
-                        lambda _: jax.ShapeDtypeStruct(
-                            (1,),
-                            np.float32,
-                            sharding=NamedSharding(mesh, P()),
-                        ),
-                        tr._init_params,
-                    )
-                )
-            )
-        checkpointer = ocp.StandardCheckpointer()
-        try:
-            restored = checkpointer.restore(path, target)
-        except Exception:
-            if not tr.zero1:
-                raise
-            # Back-compat: zero1 payloads written before the
-            # placeholder layout carry full param-shaped prev_grad
-            # leaves; retry with that target, then re-canonicalize.
-            full_target = target._replace(
-                gns=target.gns._replace(
-                    prev_grad=jax.tree.map(
                         lambda p: jax.ShapeDtypeStruct(
-                            np.shape(p),
+                            (1,)
+                            if saved_placeholder
+                            else np.shape(p),
                             np.float32,
                             sharding=NamedSharding(mesh, P()),
                         ),
@@ -318,54 +325,17 @@ class ShardedTrainerCheckpoint(checkpoint.State):
                     )
                 )
             )
-            restored = checkpointer.restore(path, full_target)
-            if tr.num_replicas > 1:
-                restored = restored._replace(
-                    gns=restored.gns._replace(
-                        prev_grad=tr._empty_prev_grad_replicated()
-                    )
-                )
+        restored = checkpointer.restore(path, target)
         if tr.zero1:
             restored = restored._replace(
                 opt_state=self._zero1_expand_device(
                     restored.opt_state
                 ),
+                # One shared rule (trainer._normalize_gns_layout):
+                # dp>1 -> placeholder layout; dp==1 -> re-materialize
+                # full zeros and let the estimator re-prime.
+                gns=tr._normalize_gns_layout_on_mesh(restored.gns),
             )
-            if tr.num_replicas == 1:
-                # The only prev_grad reader: re-materialize the full
-                # zeros tree on the mesh and let the differenced
-                # estimator re-prime.
-                restored_leaves = jax.tree.leaves(
-                    restored.gns.prev_grad
-                )
-                if restored_leaves and any(
-                    np.shape(leaf) == (1,) and np.shape(p) != (1,)
-                    for leaf, p in zip(
-                        restored_leaves,
-                        jax.tree.leaves(tr._init_params),
-                    )
-                ):
-                    full_fn = lambda: jax.tree.map(  # noqa: E731
-                        lambda p: jax.numpy.zeros(
-                            np.shape(p), jax.numpy.float32
-                        ),
-                        tr._init_params,
-                    )
-                    out_sh = jax.tree.map(
-                        lambda _: NamedSharding(mesh, P()),
-                        jax.eval_shape(full_fn),
-                    )
-                    restored = restored._replace(
-                        gns=restored.gns._replace(
-                            prev_grad=jax.jit(
-                                full_fn, out_shardings=out_sh
-                            )(),
-                            prev_grad_valid=jax.device_put(
-                                np.zeros((), bool),
-                                NamedSharding(mesh, P()),
-                            ),
-                        )
-                    )
         if self._trainer.zero3:
             restored = restored._replace(
                 params=self._zero3_rows_device(restored.params)

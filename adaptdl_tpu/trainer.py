@@ -595,6 +595,26 @@ class ElasticTrainer:
             prev_grad_valid=np.zeros((), bool),
         )
 
+    def _normalize_gns_layout_on_mesh(self, gns_state):
+        """:meth:`_normalize_gns_layout` with any rebuilt leaves placed
+        replicated on this trainer's mesh (multi-process safe) — the
+        single re-prime/placeholder rule shared by the pickle and
+        orbax restore paths."""
+        normalized = self._normalize_gns_layout(gns_state)
+        if normalized is gns_state:
+            return gns_state
+        sharding = NamedSharding(self.mesh, P())
+
+        def place(x):
+            if isinstance(x, jax.Array):
+                return x
+            return _materialize(np.asarray(x), sharding)
+
+        return normalized._replace(
+            prev_grad=jax.tree.map(place, normalized.prev_grad),
+            prev_grad_valid=place(normalized.prev_grad_valid),
+        )
+
     def _abstract_state(self) -> "TrainState":
         """Shape/structure skeleton of the TrainState (no devices):
         what spec-tree construction needs before any state exists."""

@@ -61,6 +61,9 @@ def main():
     # moments live as [dp, shard] rows; the step assembles the full
     # tree on the fly). Same composition rules as --zero1.
     parser.add_argument("--zero3", action="store_true")
+    # Rematerialisation policy (jax.checkpoint_policies name): trade
+    # recompute FLOPs for activation HBM per block.
+    parser.add_argument("--remat-policy", type=str, default=None)
     # Mixture-of-experts: every 2nd block's FFN becomes a Switch/
     # GShard MoE with this many experts; the expert axis shards over
     # the scheduler's chosen expertShards (ADAPTDL_EXPERT_SHARDS).
@@ -173,6 +176,7 @@ def main():
         max_seq_len=seq_len,
         dtype=jnp.float32 if on_cpu else jnp.bfloat16,
         remat=True,
+        remat_policy=args.remat_policy,
         seq_axis="seq" if seq_shards > 1 else None,
         seq_attention=args.seq_mode,
         attention_fn=attention_fn,

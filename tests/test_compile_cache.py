@@ -1,0 +1,142 @@
+"""Compilation-cache persistence + remat-policy knob tests."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+WORKER = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import adaptdl_tpu
+
+adaptdl_tpu.initialize_job()
+print("CACHE_DIR=" + str(jax.config.jax_compilation_cache_dir))
+"""
+
+
+def _run(extra_env):
+    env = dict(os.environ)
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    env["PYTHONPATH"] = os.pathsep.join(
+        filter(None, [repo_root, env.get("PYTHONPATH")])
+    )
+    env.update({"JAX_PLATFORMS": "cpu"})
+    env.pop("ADAPTDL_COMPILE_CACHE", None)
+    env.pop("ADAPTDL_SHARE_PATH", None)
+    env.pop("ADAPTDL_CHECKPOINT_PATH", None)
+    env.update(extra_env)
+    out = subprocess.run(
+        [sys.executable, "-c", WORKER],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stderr
+    line = [
+        l for l in out.stdout.splitlines() if l.startswith("CACHE_DIR=")
+    ][0]
+    return line.split("=", 1)[1]
+
+
+def test_cache_dir_set_from_checkpoint_path(tmp_path):
+    got = _run({"ADAPTDL_CHECKPOINT_PATH": str(tmp_path)})
+    assert got == os.path.join(str(tmp_path), ".jax_compile_cache")
+    assert os.path.isdir(got)
+
+
+def test_cache_dir_prefers_share_path(tmp_path):
+    share = tmp_path / "share"
+    ckpt = tmp_path / "ckpt"
+    share.mkdir()
+    ckpt.mkdir()
+    got = _run(
+        {
+            "ADAPTDL_SHARE_PATH": str(share),
+            "ADAPTDL_CHECKPOINT_PATH": str(ckpt),
+        }
+    )
+    assert got == os.path.join(str(share), ".jax_compile_cache")
+
+
+def test_cache_off_and_explicit_override(tmp_path):
+    got = _run(
+        {
+            "ADAPTDL_CHECKPOINT_PATH": str(tmp_path),
+            "ADAPTDL_COMPILE_CACHE": "off",
+        }
+    )
+    assert got == "None"
+    override = tmp_path / "elsewhere"
+    got = _run(
+        {
+            "ADAPTDL_CHECKPOINT_PATH": str(tmp_path),
+            "ADAPTDL_COMPILE_CACHE": str(override),
+        }
+    )
+    assert got == os.path.join(str(override), ".jax_compile_cache")
+
+
+@pytest.mark.parametrize(
+    "policy",
+    [None, "dots_with_no_batch_dims_saveable", "nothing_saveable"],
+)
+def test_remat_policy_preserves_numerics(policy):
+    """Remat policies change the memory/recompute schedule, never the
+    values: loss and gradients match the no-policy build."""
+    import optax
+
+    from adaptdl_tpu.models import (
+        TransformerConfig,
+        init_transformer,
+        lm_loss_fn,
+    )
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": jnp.asarray(
+            rng.integers(0, 64, size=(2, 17)), jnp.int32
+        )
+    }
+    key = jax.random.key(0)
+
+    def run(policy):
+        cfg = TransformerConfig(
+            vocab_size=64, num_layers=2, num_heads=2, d_model=32,
+            d_ff=64, max_seq_len=16, dtype=jnp.float32, remat=True,
+            remat_policy=policy,
+        )
+        model, params = init_transformer(cfg, seq_len=16)
+        loss, grads = jax.value_and_grad(lm_loss_fn(model))(
+            params, batch, key
+        )
+        return float(loss), grads
+
+    base_loss, base_grads = run(None)
+    loss, grads = run(policy)
+    assert loss == pytest.approx(base_loss, rel=1e-6)
+    for a, b in zip(jax.tree.leaves(base_grads), jax.tree.leaves(grads)):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-6, atol=1e-7
+        )
+
+
+def test_remat_policy_typo_fails_eagerly():
+    from adaptdl_tpu.models import TransformerConfig, init_transformer
+
+    cfg = TransformerConfig(
+        vocab_size=64, num_layers=1, num_heads=2, d_model=32,
+        d_ff=64, max_seq_len=16, dtype=jnp.float32, remat=True,
+        remat_policy="dots_savable",  # typo
+    )
+    with pytest.raises(ValueError, match="remat_policy"):
+        init_transformer(cfg, seq_len=16)
