@@ -366,19 +366,29 @@ def init_transformer(config: TransformerConfig, rng=None, seq_len=None):
             "causal=True (expert-choice gating sees future tokens); "
             "use causal=False (encoder/MLM) or moe_router='tokens'"
         )
-    if config.remat_policy is not None and not hasattr(
-        jax.checkpoint_policies, config.remat_policy
+    # Only the zero-config policies are valid by NAME — the other
+    # jax.checkpoint_policies attributes are factories (they build a
+    # policy from arguments) and passing one where a policy is
+    # expected silently disables remat or crashes mid-trace. Fail at
+    # configuration time, not deep inside the first step's jit trace
+    # (which on TPU wastes the whole startup).
+    _REMAT_POLICIES = (
+        "everything_saveable",
+        "nothing_saveable",
+        "dots_saveable",
+        "checkpoint_dots",
+        "dots_with_no_batch_dims_saveable",
+        "checkpoint_dots_with_no_batch_dims",
+    )
+    if (
+        config.remat_policy is not None
+        and config.remat_policy not in _REMAT_POLICIES
     ):
-        # Fail at configuration time, not deep inside the first step's
-        # jit trace (which on TPU wastes the whole startup).
-        valid = sorted(
-            name
-            for name in dir(jax.checkpoint_policies)
-            if not name.startswith("_")
-        )
         raise ValueError(
             f"unknown remat_policy {config.remat_policy!r}; valid "
-            f"jax.checkpoint_policies names: {valid}"
+            f"names: {sorted(_REMAT_POLICIES)} (policy FACTORIES like "
+            "save_only_these_names need arguments — build them "
+            "yourself and wrap the Block with nn.remat directly)"
         )
     model = TransformerLM(config)
     # Parameter shapes don't depend on the parallelism config, and the

@@ -170,10 +170,10 @@ class ShardedTrainerCheckpoint(checkpoint.State):
 
     def _saved_prev_grad_is_placeholder(self, checkpointer, path):
         """Whether the payload's gns.prev_grad was written in the
-        placeholder ((1,)-leaf) layout, from orbax metadata. Defaults
-        to True (the current writer's layout) if metadata is missing —
-        a genuinely broken payload then fails in restore() with the
-        real error, not a layout guess."""
+        placeholder ((1,)-leaf) layout, from orbax metadata: True /
+        False, or None when the metadata cannot be read (the restore
+        then tries the current layout first and falls back to the
+        pre-placeholder one)."""
         try:
             tree = checkpointer.metadata(path).item_metadata.tree
             prev = tree["gns"]["prev_grad"]
@@ -186,7 +186,7 @@ class ShardedTrainerCheckpoint(checkpoint.State):
                 for leaf, p in zip(leaves, params)
             )
         except Exception:  # noqa: BLE001 - metadata is best-effort
-            return True
+            return None
 
     def sync(self) -> None:
         """All processes write their shards via orbax — into a fresh
@@ -311,21 +311,42 @@ class ShardedTrainerCheckpoint(checkpoint.State):
             saved_placeholder = self._saved_prev_grad_is_placeholder(
                 checkpointer, path
             )
+
+            def prev_grad_target(placeholder: bool):
+                return jax.tree.map(
+                    lambda p: jax.ShapeDtypeStruct(
+                        (1,) if placeholder else np.shape(p),
+                        np.float32,
+                        sharding=NamedSharding(mesh, P()),
+                    ),
+                    tr._init_params,
+                )
+
             target = target._replace(
                 gns=target.gns._replace(
-                    prev_grad=jax.tree.map(
-                        lambda p: jax.ShapeDtypeStruct(
-                            (1,)
-                            if saved_placeholder
-                            else np.shape(p),
-                            np.float32,
-                            sharding=NamedSharding(mesh, P()),
-                        ),
-                        tr._init_params,
+                    prev_grad=prev_grad_target(
+                        saved_placeholder is not False
                     )
                 )
             )
-        restored = checkpointer.restore(path, target)
+        if tr.zero1 and saved_placeholder is None:
+            # Metadata unreadable (likely an older payload): try the
+            # current layout, fall back to the pre-placeholder one —
+            # re-raising the ORIGINAL error if neither fits.
+            try:
+                restored = checkpointer.restore(path, target)
+            except Exception as first_err:
+                fallback = target._replace(
+                    gns=target.gns._replace(
+                        prev_grad=prev_grad_target(False)
+                    )
+                )
+                try:
+                    restored = checkpointer.restore(path, fallback)
+                except Exception:
+                    raise first_err
+        else:
+            restored = checkpointer.restore(path, target)
         if tr.zero1:
             restored = restored._replace(
                 opt_state=self._zero1_expand_device(

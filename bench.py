@@ -313,7 +313,33 @@ def _bench_flash_attention(on_tpu: bool, full: bool) -> dict | None:
 def _bench_rescale_latency(trainer_factory, dataset, init_bsz) -> float | None:
     """Median checkpoint-save -> restore -> first-step time: the cost
     of one elastic rescale (reference analog: the checkpoint-restart
-    path, SURVEY §3.4 — the reference never measures it)."""
+    path, SURVEY §3.4 — the reference never measures it).
+
+    The persistent compilation cache is enabled for the phase (as
+    initialize_job does in production): the restored trainer's
+    recompile — the dominant term — hits the cache the way a real
+    restarted incarnation would."""
+    import tempfile
+
+    from adaptdl_tpu import checkpoint as ckpt_mod
+    from adaptdl_tpu.bootstrap import _enable_compilation_cache
+
+    cache_dir = tempfile.mkdtemp(prefix="bench-compile-cache-")
+    os.environ["ADAPTDL_COMPILE_CACHE"] = cache_dir
+    # Swallows its own errors (the cache is an optimization); the
+    # tempdir and env var are cleaned in the finally below.
+    _enable_compilation_cache()
+
+    try:
+        return _rescale_trials(trainer_factory, dataset, init_bsz)
+    finally:
+        import shutil
+
+        os.environ.pop("ADAPTDL_COMPILE_CACHE", None)
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
+def _rescale_trials(trainer_factory, dataset, init_bsz) -> float:
     import tempfile
 
     from adaptdl_tpu import checkpoint as ckpt_mod
