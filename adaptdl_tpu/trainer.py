@@ -1099,6 +1099,81 @@ class ElasticTrainer:
         # Hide the unused aux slot from non-aux callers.
         return lambda state, batch: jitted(state, batch, ())
 
+    def params_tree(self, state: TrainState) -> Any:
+        """The parameter TREE of a TrainState, whatever the storage
+        layout — the accessor user code (evaluation, export, analysis)
+        should reach for instead of ``state.params``, which under
+        zero3 holds flat [dp, shard] rows."""
+        if not self.zero3:
+            return state.params
+        rows = np.asarray(state.params).reshape(-1)[: self._zero1_n]
+        return self._zero1_unravel(jnp.asarray(rows))
+
+    def eval_step(self, metric_fn: Callable) -> Callable:
+        """Compiled sharded evaluation: ``(state, batch) -> metrics``.
+
+        ``metric_fn(params_tree, local_batch)`` runs on each data (and
+        seq) shard and returns a pytree of PARTIAL SUMS (e.g. correct
+        counts, loss sums, row counts); the step psums them over the
+        mesh's manual axes and returns replicated totals. Under zero3
+        the parameter tree is assembled on the fly, so the same
+        metric_fn works for every storage layout. Cached per
+        metric_fn.
+        """
+        key = ("eval", id(metric_fn))
+        if key in self._step_cache:
+            return self._step_cache[key]
+        seq_shards = self.seq_shards
+        sharded_axes = self.sharded_param_axes
+
+        def per_replica(params, local_batch):
+            if self.zero3:
+                params = self._zero1_unravel(
+                    self._rows_to_flat(params)
+                )
+            out = metric_fn(params, local_batch)
+            # Partial sums must be varying before the psum (computed
+            # from the sharded batch, they already are; pcast is for
+            # metric_fns that return constants).
+            axes = (
+                (DATA_AXIS, SEQ_AXIS) if seq_shards > 1 else (DATA_AXIS,)
+            )
+            total = jax.lax.psum(out, axes)
+            if sharded_axes:
+                # Param-sharded layouts compute per-shard partials
+                # too; their psum is the metric_fn's concern (it knows
+                # which values are shard-local) — most metrics under
+                # stage/expert use the loss path instead.
+                pass
+            return total
+
+        batch_spec = (
+            P(DATA_AXIS, SEQ_AXIS) if seq_shards > 1 else P(DATA_AXIS)
+        )
+        manual = {DATA_AXIS, *sharded_axes}
+        if seq_shards > 1:
+            manual.add(SEQ_AXIS)
+        extra = {}
+        if MODEL_AXIS in self.mesh.shape:
+            extra["axis_names"] = manual
+        if self.zero3:
+            param_specs = P(DATA_AXIS)
+        else:
+            param_specs = self._restrict_specs(
+                self._param_spec_tree(self._init_params), manual
+            )
+        sharded = shard_map(
+            per_replica,
+            mesh=self.mesh,
+            in_specs=(param_specs, batch_spec),
+            out_specs=P(),
+            **extra,
+        )
+        jitted = jax.jit(sharded)
+        fn = lambda state, batch: jitted(state.params, batch)  # noqa: E731
+        self._step_cache[key] = fn
+        return fn
+
     def shard_batch(self, batch: Any) -> Any:
         """Host batch -> jax arrays sharded along the data axis (and
         the seq axis on dim 1 under sequence parallelism)."""
