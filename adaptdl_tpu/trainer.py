@@ -1106,8 +1106,23 @@ class ElasticTrainer:
         zero3 holds flat [dp, shard] rows."""
         if not self.zero3:
             return state.params
-        rows = np.asarray(state.params).reshape(-1)[: self._zero1_n]
-        return self._zero1_unravel(jnp.asarray(rows))
+        # Assemble ON DEVICE: the [dp, shard] rows are sharded over the
+        # data axis and not fully addressable on multi-host jobs, so a
+        # host-side np.asarray would crash exactly where zero3 matters.
+        # A jit with replicated out_shardings makes XLA all-gather the
+        # rows and unravel them into the canonical tree.
+        key = ("params_tree",)
+        assemble = self._step_cache.get(key)
+        if assemble is None:
+            n = self._zero1_n
+            assemble = jax.jit(
+                lambda rows: self._zero1_unravel(
+                    rows.reshape(-1)[:n]
+                ),
+                out_shardings=NamedSharding(self.mesh, P()),
+            )
+            self._step_cache[key] = assemble
+        return assemble(state.params)
 
     def eval_step(self, metric_fn: Callable) -> Callable:
         """Compiled sharded evaluation: ``(state, batch) -> metrics``.
@@ -1120,6 +1135,10 @@ class ElasticTrainer:
         metric_fn works for every storage layout. Cached per
         metric_fn.
         """
+        # id() is a safe key here (and keeps unhashable callables
+        # working): the cached step's per_replica closure holds a
+        # strong reference to metric_fn, so its id cannot be reused
+        # while the entry lives.
         key = ("eval", id(metric_fn))
         if key in self._step_cache:
             return self._step_cache[key]
