@@ -155,6 +155,7 @@ class ElasticTrainer:
         pipeline_micro: int | None = None,
         zero1: bool = False,
         zero3: bool = False,
+        zero3_blocks: str | None = None,
     ):
         self.has_aux = has_aux
         self.param_sharding_fn = param_sharding_fn
@@ -242,6 +243,57 @@ class ElasticTrainer:
         # trainer writes) while the moments stay flat-canonical, so
         # like zero1 the flag is part of the job's stable config:
         # rescales change dp freely, not the zero family.
+        # zero3_blocks: TRUE per-layer ZeRO-3/FSDP. Parameters persist
+        # as per-block flat rows over the data axis and the loss_fn
+        # (written against parallel.zero3.Zero3View) gathers ONE block
+        # at a time inside its layer scan — per-device peak HBM is
+        # params/dp + one gathered block + activations, where the lite
+        # ``zero3=True`` mode still materialises the whole tree at
+        # step start. Gradients arrive reduce-scattered through the
+        # gather's AD transpose, so the GNS runs on per-microbatch
+        # GLOBAL gradients (count = num_microbatches; the differenced
+        # estimator covers accum_steps == 0).
+        self.zero3_blocks = zero3_blocks
+        if zero3_blocks is not None:
+            if zero1 or zero3:
+                raise ValueError(
+                    "zero3_blocks is a storage mode of its own; do not "
+                    "combine with zero1/zero3"
+                )
+            if (
+                param_sharding_fn is not None
+                or MODEL_AXIS in self.mesh.shape
+                or self.sharded_param_axes
+                or self.seq_shards > 1
+            ):
+                raise ValueError(
+                    "zero3_blocks shards parameter storage over the "
+                    "data axis and composes with data parallelism "
+                    "only (seq/model/stage/expert axes manage their "
+                    "own layouts)"
+                )
+            if self.num_param_groups > 1:
+                raise ValueError(
+                    "zero3_blocks supports a single param group (the "
+                    "row layout has no per-position group table yet)"
+                )
+            if zero3_blocks not in params:
+                raise ValueError(
+                    f"params has no {zero3_blocks!r} entry to treat as "
+                    "the layer-stacked block family"
+                )
+            from adaptdl_tpu.parallel import zero3 as z3
+
+            self._z3b = z3
+            self._z3b_spec = z3.block_spec(params, zero3_blocks)
+            self._z3b_shard_b, self._z3b_shard_o = z3.shard_sizes(
+                self._z3b_spec, self.num_replicas
+            )
+            from jax.flatten_util import ravel_pytree
+
+            flat_all, unravel_all = ravel_pytree(params)
+            self._z3b_n_total = int(flat_all.size)
+            self._z3b_unravel_full = unravel_all
         self.zero3 = bool(zero3)
         self.zero1 = bool(zero1) or self.zero3
         if self.zero1:
