@@ -31,3 +31,7 @@ from adaptdl_tpu.models.transformer import (  # noqa: F401
     lm_loss_fn,
     mlm_loss_fn,
 )
+from adaptdl_tpu.models.zero3_lm import (  # noqa: F401
+    init_zero3_lm,
+    zero3_lm_metric_fn,
+)

@@ -1,0 +1,151 @@
+"""The flagship transformer LM under per-layer ZeRO-3 (zero3_blocks).
+
+Builds an :class:`~adaptdl_tpu.trainer.ElasticTrainer`-ready
+(loss_fn, params) pair whose loss is written against
+:class:`adaptdl_tpu.parallel.zero3.Zero3View`: parameters persist as
+flat rows over the data axis (1/dp of every tensor per device) and the
+layer scan gathers ONE block's parameters at a time — FSDP's
+communication schedule, produced by the gather's AD transpose instead
+of the reference's backward hooks (the reference is pure DDP and has
+no parameter-sharded storage at all, SURVEY.md §2.7;
+reference: adaptdl/adaptdl/torch/parallel.py keeps a full replica per
+GPU).
+
+Layout decisions (TPU-first, mirroring ``models/pipeline_lm.py``'s
+stacked-leaf convention):
+
+- **Blocks are the sharded family.** The uniform transformer blocks
+  stack layer-major (``[L, ...]`` leaves) under the ``"blocks"`` key —
+  the exact convention the pipeline LM established — and ride
+  ``scan_blocks``: one traced block application regardless of depth,
+  per-block gather + reduce-scatter, ``jax.checkpoint``'d so backward
+  re-gathers instead of saving the assembled block.
+- **Embed / ln_f are the "other" family**: needed at both ends of the
+  network, small next to the block stack, assembled once per step by
+  ``build_view`` from their own row shards.
+- The LM head is tied to the embedding (``attend``), so the full
+  vocab projection lives in the "other" family once, not twice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from adaptdl_tpu.models.transformer import Block, TransformerConfig
+from adaptdl_tpu.parallel import zero3 as z3
+
+BLOCKS_KEY = "blocks"
+
+
+def init_zero3_lm(
+    config: TransformerConfig,
+    rng=None,
+    seq_len: int | None = None,
+):
+    """(loss_fn, params) for a causal LM trained with
+    ``ElasticTrainer(..., zero3_blocks="blocks")``.
+
+    ``loss_fn(view, batch, rng)`` receives the trainer's
+    :class:`Zero3View` and expects ``batch["tokens"]`` of shape
+    ``[rows, seq_len + 1]``. ``params`` is the canonical TREE — the
+    trainer converts it to row storage itself. The companion
+    ``block_spec(params, "blocks")`` the model scan needs is derived
+    here once and closed over (static layout facts, dp-independent).
+    """
+    assert config.dropout_rate == 0, (
+        "zero3_blocks LM runs blocks under a lax.scan with no "
+        "per-layer dropout rng threading (same limitation as the "
+        "pipeline schedule, models/pipeline_lm.py); set "
+        "dropout_rate=0"
+    )
+    rng = rng if rng is not None else jax.random.key(0)
+    seq_len = seq_len or min(config.max_seq_len, 128)
+    # Blocks see plain attention: the seq/moe axes manage their own
+    # layouts and zero3_blocks composes with data parallelism only
+    # (enforced by the trainer).
+    block_config = dataclasses.replace(
+        config, seq_axis=None, attention_fn=None, moe_axis=None
+    )
+    block = Block(block_config)
+
+    import flax.linen as nn
+
+    embed = nn.Embed(
+        config.vocab_size, config.d_model, dtype=config.dtype
+    )
+    ln_f = nn.LayerNorm(dtype=config.dtype, use_bias=False)
+
+    dummy = jnp.zeros((1, seq_len, config.d_model), config.dtype)
+    positions0 = jnp.arange(seq_len)
+    rng, embed_rng, ln_rng = jax.random.split(rng, 3)
+    layer_rngs = jax.random.split(rng, config.num_layers)
+    layer_params = [
+        block.init(layer_rngs[i], dummy, positions0)["params"]
+        for i in range(config.num_layers)
+    ]
+    params: dict[str, Any] = {
+        "embed": embed.init(
+            embed_rng, jnp.zeros((1, seq_len), jnp.int32)
+        )["params"],
+        "ln_f": ln_f.init(ln_rng, dummy)["params"],
+        BLOCKS_KEY: jax.tree.map(
+            lambda *leaves: jnp.stack(leaves), *layer_params
+        ),
+    }
+    spec = z3.block_spec(params, BLOCKS_KEY)
+
+    def forward(view: z3.Zero3View, inputs):
+        """[rows, seq] tokens -> [rows, seq, vocab] logits through the
+        per-block-gather layer scan."""
+        x = embed.apply({"params": view.other["embed"]}, inputs)
+        x = x.astype(config.dtype)
+        positions = jnp.arange(inputs.shape[1])
+
+        def block_fn(p, h):
+            return block.apply({"params": p}, h, positions)
+
+        x = z3.scan_blocks(block_fn, view.blocks, x, spec)
+        h = ln_f.apply({"params": view.other["ln_f"]}, x)
+        return embed.apply(
+            {"params": view.other["embed"]}, h, method="attend"
+        ).astype(jnp.float32)
+
+    def loss_fn(view, batch, rng):
+        del rng  # dropout off under the block scan (cf. pipeline_lm)
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = forward(view, inputs)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets
+        ).mean()
+
+    loss_fn.forward = forward  # eval/metric fns reuse the same scan
+    return loss_fn, params
+
+
+def zero3_lm_metric_fn(loss_fn):
+    """``metric_fn`` for ``ElasticTrainer.eval_step`` (which hands it
+    the Zero3View under zero3_blocks): partial sums of token
+    cross-entropy and accuracy."""
+
+    def metric_fn(view, batch):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = loss_fn.forward(view, inputs)
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets
+        )
+        correct = (logits.argmax(-1) == targets).sum()
+        return {
+            "loss_sum": losses.sum(),
+            "correct": correct,
+            "seen": jnp.asarray(targets.size),
+        }
+
+    return metric_fn

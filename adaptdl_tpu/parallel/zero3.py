@@ -125,6 +125,20 @@ def gather_block(
     return jax.lax.pcast(tree, axis, to="varying")
 
 
+def _ensure_varying(tree: Any, axis: str) -> Any:
+    """pcast leaves to varying over ``axis`` unless they already are —
+    the scan carry below must have a stable vma type, and callers
+    legitimately pass either (an axis-invariant embedding output, or a
+    batch-sharded activation that is already varying)."""
+
+    def cast(leaf):
+        if axis in jax.typeof(leaf).vma:
+            return leaf
+        return jax.lax.pcast(leaf, axis, to="varying")
+
+    return jax.tree.map(cast, tree)
+
+
 def scan_blocks(
     block_fn: Callable[[Any, Any], Any],
     blocks_rows: jnp.ndarray,
@@ -136,14 +150,63 @@ def scan_blocks(
     zero3-blocks layer stack. ``block_fn(block_params, x) -> x``.
     The body is checkpointed: backward re-gathers each block and
     reduce-scatters its gradient — FSDP's exact communication
-    schedule, produced by AD instead of hooks."""
+    schedule, produced by AD instead of hooks.
+
+    ``x`` may be axis-invariant (e.g. computed from replicated inputs)
+    or varying; the carry is pcast to varying either way because the
+    body's output — built from the varying gathered block — is varying,
+    and ``lax.scan`` requires carry-in and carry-out types to match."""
 
     def body(h, row):
         params_b = gather_block(row, spec, axis)
         return block_fn(params_b, h), None
 
+    x = _ensure_varying(x, axis)
     out, _ = jax.lax.scan(jax.checkpoint(body), x, blocks_rows)
     return out
+
+
+def build_view(
+    blocks_rows_local: jnp.ndarray,
+    other_rows_local: jnp.ndarray,
+    spec: BlockSpec,
+    axis: str = DATA_AXIS,
+) -> Zero3View:
+    """Inside the manual step: this device's local rows -> the
+    :class:`Zero3View` a zero3-blocks loss_fn consumes. The non-block
+    subtree is assembled here (needed at both ends of the network,
+    small next to the block stack); block rows pass through untouched
+    for :func:`scan_blocks`/:func:`gather_block` to gather one layer at
+    a time. Differentiating a loss through this view hands back
+    cotangents in ROW layout, already reduce-scattered (globally
+    summed) through the gathers' AD transposes."""
+    other = spec.unravel_other(
+        gather_rows(other_rows_local, spec.n_other, axis)
+    )
+    return Zero3View(
+        other=jax.lax.pcast(other, axis, to="varying"),
+        blocks=_ensure_varying(blocks_rows_local, axis),
+    )
+
+
+def assemble_tree(
+    blocks_rows_local: jnp.ndarray,
+    other_rows_local: jnp.ndarray,
+    blocks_key: str,
+    spec: BlockSpec,
+    axis: str = DATA_AXIS,
+) -> Any:
+    """Inside the manual step: local rows -> the FULL canonical param
+    tree (materializes every block at once — evaluation/export helper,
+    not the training path, which gathers per block)."""
+    other = spec.unravel_other(
+        gather_rows(other_rows_local, spec.n_other, axis)
+    )
+    blocks_flat = jax.vmap(
+        lambda row: gather_rows(row, spec.n_block, axis)
+    )(blocks_rows_local)
+    blocks = jax.vmap(spec.unravel_block)(blocks_flat)
+    return {**other, blocks_key: blocks}
 
 
 # ---- layout conversions (trainer + checkpoint side) ----------------------

@@ -168,6 +168,75 @@ class ShardedTrainerCheckpoint(checkpoint.State):
             out_shardings=NamedSharding(tr.mesh, P(DATA_AXIS)),
         )(tree)
 
+    # -- zero3_blocks device-side canonical conversions ----------------
+
+    def _z3b_canon_device(self, state):
+        """zero3_blocks run layout -> canonical on-device: params as
+        the replicated TREE, moments and the prev_grad carry as
+        replicated flat [n] vectors — the same dp-independent formats
+        the pickle path writes, produced by device collectives (no
+        host gather, multi-host safe)."""
+        tr = self._trainer
+
+        def tree_canon(rows):
+            abstract = jax.eval_shape(tr._z3b_tree_from_rows, rows)
+            out_sh = jax.tree.map(
+                lambda _: NamedSharding(tr.mesh, P()), abstract
+            )
+            return jax.jit(
+                tr._z3b_tree_from_rows, out_shardings=out_sh
+            )(rows)
+
+        def flat_canon(rows):
+            return jax.jit(
+                lambda r: tr._z3b.rows_to_flat_canonical(
+                    r["blocks"], r["other"],
+                    tr.zero3_blocks, tr._z3b_spec,
+                ),
+                out_shardings=NamedSharding(tr.mesh, P()),
+            )(rows)
+
+        return state._replace(
+            params=tree_canon(state.params),
+            opt_state=tr._z3b_map_opt(state.opt_state, False, flat_canon),
+            gns=state.gns._replace(
+                prev_grad=flat_canon(state.gns.prev_grad)
+            ),
+        )
+
+    def _z3b_rows_sharding(self):
+        from adaptdl_tpu.parallel.mesh import DATA_AXIS
+
+        tr = self._trainer
+        return {
+            "blocks": NamedSharding(tr.mesh, P(None, DATA_AXIS)),
+            "other": NamedSharding(tr.mesh, P(DATA_AXIS)),
+        }
+
+    def _z3b_expand_device(self, flat):
+        """Canonical flat [n] -> this incarnation's rows dict, born
+        sharded over the data axis."""
+        tr = self._trainer
+
+        def expand(v):
+            blocks_rows, other_rows = tr._z3b.flat_canonical_to_rows(
+                v, tr.zero3_blocks, tr._z3b_spec,
+                tr.num_replicas, tr._z3b_unravel_full,
+            )
+            return {"blocks": blocks_rows, "other": other_rows}
+
+        return jax.jit(
+            expand, out_shardings=self._z3b_rows_sharding()
+        )(flat)
+
+    def _z3b_rows_device(self, tree):
+        """Canonical param tree -> rows dict, born sharded."""
+        tr = self._trainer
+        return jax.jit(
+            tr._z3b_rows_from_tree,
+            out_shardings=self._z3b_rows_sharding(),
+        )(tree)
+
     def _saved_prev_grad_is_placeholder(self, checkpointer, path):
         """Whether the payload's gns.prev_grad was written in the
         placeholder ((1,)-leaf) layout, from orbax metadata: True /
@@ -197,6 +266,8 @@ class ShardedTrainerCheckpoint(checkpoint.State):
         state = self._get_state()
         # RNG keys are opaque; store raw key data alongside.
         state = state._replace(rng=jax.random.key_data(state.rng))
+        if self._trainer.zero3_blocks is not None:
+            state = self._z3b_canon_device(state)
         if self._trainer.zero1:
             state = state._replace(
                 opt_state=self._zero1_canon_device(state.opt_state)
@@ -301,6 +372,32 @@ class ShardedTrainerCheckpoint(checkpoint.State):
                     tr._init_params,
                 )
             )
+        if self._trainer.zero3_blocks is not None:
+            # Canonical targets: params as the init TREE, moments and
+            # prev_grad as flat [n] vectors — all replicated.
+            tr = self._trainer
+            n = tr._z3b_n_total
+            repl = NamedSharding(mesh, P())
+            target = target._replace(
+                params=jax.tree.map(
+                    lambda p: jax.ShapeDtypeStruct(
+                        np.shape(p), p.dtype, sharding=repl
+                    ),
+                    tr._init_params,
+                ),
+                opt_state=tr._z3b_map_opt(
+                    target.opt_state,
+                    False,
+                    lambda rows: jax.ShapeDtypeStruct(
+                        (n,), rows["blocks"].dtype, sharding=repl
+                    ),
+                ),
+                gns=target.gns._replace(
+                    prev_grad=jax.ShapeDtypeStruct(
+                        (n,), np.float32, sharding=repl
+                    )
+                ),
+            )
         tr = self._trainer
         checkpointer = ocp.StandardCheckpointer()
         if tr.zero1:
@@ -360,6 +457,18 @@ class ShardedTrainerCheckpoint(checkpoint.State):
         if self._trainer.zero3:
             restored = restored._replace(
                 params=self._zero3_rows_device(restored.params)
+            )
+        if self._trainer.zero3_blocks is not None:
+            restored = restored._replace(
+                params=self._z3b_rows_device(restored.params),
+                opt_state=tr._z3b_map_opt(
+                    restored.opt_state, True, self._z3b_expand_device
+                ),
+                gns=restored.gns._replace(
+                    prev_grad=self._z3b_expand_device(
+                        restored.gns.prev_grad
+                    )
+                ),
             )
         restored = restored._replace(
             rng=jax.random.wrap_key_data(restored.rng)

@@ -336,7 +336,7 @@ class ElasticTrainer:
             else:
                 self._zero1_flat_gids = None
         self._init_params = params
-        self._step_cache: dict[tuple[int, int], Callable] = {}
+        self._step_cache: dict[tuple, Callable] = {}
         self._calibrated: set[int] = set()
         # How often run_step syncs GNS statistics to the host.
         self.metrics_every = 10
@@ -409,6 +409,24 @@ class ElasticTrainer:
         Everything else (counts, EMA scalars, rng, progress) is
         replicated.
         """
+        if self.zero3_blocks is not None:
+            # Rows dicts (params, moments, prev_grad) shard over the
+            # data axis; everything else replicates. Matching is by
+            # shape, like zero1's moment matcher.
+            dp = self.num_replicas
+            L = self._z3b_spec.num_blocks
+            blocks_shape = (L, dp, self._z3b_shard_b)
+            other_shape = (dp, self._z3b_shard_o)
+
+            def spec_for(leaf):
+                shp = np.shape(leaf)
+                if shp == blocks_shape:
+                    return P(None, DATA_AXIS)
+                if shp == other_shape:
+                    return P(DATA_AXIS)
+                return P()
+
+            return jax.tree.map(spec_for, state)
         if self.zero1:
             # zero1 excludes param_sharding_fn (checked in __init__):
             # every leaf replicates except the sharded moment rows —
@@ -579,6 +597,178 @@ class ElasticTrainer:
             self._tree_to_rows(jax.tree.map(jnp.asarray, tree))
         )
 
+    # ---- zero3_blocks (per-layer FSDP) layout plumbing ---------------
+    #
+    # Storage: params (and every params-shaped mirror: optimizer
+    # moments, the GNS prev_grad carry) live as the rows dict
+    #     {"blocks": [L, dp, shard_b], "other": [dp, shard_o]}
+    # sharded P(None, "data") / P("data") — each device persistently
+    # holds 1/dp of every tensor. Canonical disk layouts match the
+    # zero1/zero3-lite family: params as the plain TREE, derived
+    # mirrors as the flat [n] vector in ravel_pytree(tree) order, so
+    # rescales change dp freely and may even cross storage modes.
+
+    def _z3b_rows_from_tree(self, tree):
+        """Canonical param tree -> rows dict (traceable)."""
+        blocks_rows, other_rows = self._z3b.tree_to_rows(
+            tree, self.zero3_blocks, self._z3b_spec, self.num_replicas
+        )
+        return {"blocks": blocks_rows, "other": other_rows}
+
+    def _z3b_tree_from_rows(self, rows):
+        """Rows dict -> canonical param tree (traceable)."""
+        return self._z3b.rows_to_tree(
+            rows["blocks"], rows["other"], self.zero3_blocks,
+            self._z3b_spec,
+        )
+
+    def _z3b_build_state(self) -> "TrainState":
+        """THE single zero3_blocks TrainState constructor (traceable):
+        rows-layout params, moments, and GNS carry. Both
+        ``_abstract_state`` (spec derivation) and ``init_state`` (the
+        born-sharded jit) call this, so the abstract specs can never
+        diverge from the real state."""
+        rows = self._z3b_rows_from_tree(
+            jax.tree.map(jnp.asarray, self._init_params)
+        )
+        return TrainState(
+            params=rows,
+            opt_state=self.optimizer.init(rows),
+            gns=gns.init(rows, self.num_param_groups),
+            progress=jnp.zeros(()),
+            step=jnp.zeros((), jnp.int32),
+            rng=jax.random.key(self._seed),
+        )
+
+    def _z3b_is_rows(self, node) -> bool:
+        """Recognize a rows-dict mirror inside an arbitrary state tree
+        (the optax moments that track the params' structure)."""
+        return (
+            isinstance(node, dict)
+            and set(node) == {"blocks", "other"}
+            and np.shape(node.get("blocks"))
+            == (
+                self._z3b_spec.num_blocks,
+                self.num_replicas,
+                self._z3b_shard_b,
+            )
+            and np.shape(node.get("other"))
+            == (self.num_replicas, self._z3b_shard_o)
+        )
+
+    def _z3b_canonical_params(self, rows):
+        """Host rows dict -> canonical param TREE (dp-independent, the
+        same layout a dense trainer checkpoints)."""
+        return jax.tree.map(
+            np.asarray,
+            self._z3b_tree_from_rows(
+                jax.tree.map(jnp.asarray, dict(rows))
+            ),
+        )
+
+    def _z3b_map_opt(self, opt_state, from_canonical: bool, convert):
+        """THE single matcher for zero3_blocks optimizer-state layout
+        conversions — rows dicts on the run side, flat [n] canonical
+        vectors on disk (identical to zero1's moment layout, so lite
+        and blocks checkpoints interchange)."""
+        if from_canonical:
+            n = (self._z3b_n_total,)
+            return jax.tree.map(
+                lambda leaf: (
+                    convert(leaf) if np.shape(leaf) == n else leaf
+                ),
+                opt_state,
+            )
+        return jax.tree.map(
+            lambda node: (
+                convert(node) if self._z3b_is_rows(node) else node
+            ),
+            opt_state,
+            is_leaf=self._z3b_is_rows,
+        )
+
+    def _z3b_flat_canonical(self, rows):
+        """Rows dict -> flat [n] canonical vector (host)."""
+        return np.asarray(
+            self._z3b.rows_to_flat_canonical(
+                jnp.asarray(rows["blocks"]),
+                jnp.asarray(rows["other"]),
+                self.zero3_blocks,
+                self._z3b_spec,
+            )
+        )
+
+    def _z3b_rows_from_flat(self, flat):
+        """Flat [n] canonical vector -> rows dict for THIS dp (host)."""
+        blocks_rows, other_rows = self._z3b.flat_canonical_to_rows(
+            flat, self.zero3_blocks, self._z3b_spec,
+            self.num_replicas, self._z3b_unravel_full,
+        )
+        return {
+            "blocks": np.asarray(blocks_rows),
+            "other": np.asarray(other_rows),
+        }
+
+    def _z3b_rows_from_tree_host(self, tree):
+        """Canonical param tree -> rows dict, host numpy (checkpoint
+        restore for THIS trainer's dp)."""
+        return jax.tree.map(
+            np.asarray,
+            self._z3b_rows_from_tree(
+                jax.tree.map(jnp.asarray, tree)
+            ),
+        )
+
+    def _z3b_canonical_opt(self, opt_state):
+        return self._z3b_map_opt(
+            opt_state, False, self._z3b_flat_canonical
+        )
+
+    def _z3b_is_param_tree(self, node) -> bool:
+        """Recognize a params-TREE-shaped mirror (what a dense
+        trainer's checkpoint stores for Adam's mu/nu) so cross-mode
+        restores convert it to rows instead of leaving a structure
+        mismatch for the first step to trip over."""
+        try:
+            if jax.tree_util.tree_structure(
+                node
+            ) != jax.tree_util.tree_structure(self._init_params):
+                return False
+        except Exception:  # noqa: BLE001 - unregistered node types
+            return False
+        return all(
+            np.shape(a) == np.shape(b)
+            for a, b in zip(
+                jax.tree.leaves(node),
+                jax.tree.leaves(self._init_params),
+            )
+        )
+
+    def _z3b_expand_opt(self, opt_state):
+        """Canonical moments -> rows dicts. Accepts BOTH canonical
+        layouts: flat [n] vectors (zero family checkpoints) and plain
+        param trees (a dense trainer's checkpoint crossing into
+        blocks mode)."""
+        n = (self._z3b_n_total,)
+
+        def is_match(node):
+            # getattr, not np.shape: is_leaf probes container nodes
+            # too, and np.asarray on ragged containers can throw.
+            return getattr(
+                node, "shape", None
+            ) == n or self._z3b_is_param_tree(node)
+
+        def convert(node):
+            if self._z3b_is_param_tree(node):
+                return self._z3b_rows_from_tree_host(node)
+            return self._z3b_rows_from_flat(node)
+
+        return jax.tree.map(
+            lambda node: convert(node) if is_match(node) else node,
+            opt_state,
+            is_leaf=is_match,
+        )
+
     def _empty_prev_grad(self):
         """zero1/zero3 at dp > 1: the GNS differenced-estimator carry
         (prev_grad, a full f32 param-sized tree) backs ONLY the dp==1
@@ -673,6 +863,13 @@ class ElasticTrainer:
 
         def build():
             params = self._init_params
+            if self.zero3_blocks is not None:
+                # Rows-layout state throughout: params, moments, and
+                # the GNS prev_grad (the differenced-estimator carry is
+                # LIVE at any dp under zero3_blocks — count is the
+                # microbatch count, not dp*microbatches — and in rows
+                # layout it costs n/dp per device, not n).
+                return self._z3b_build_state()
             opt_state = self._init_opt_state(params)
             gns_state = gns.init(params, self.num_param_groups)
             if self.zero1 and self.num_replicas > 1:
@@ -738,6 +935,23 @@ class ElasticTrainer:
 
         def put(x, spec):
             return _materialize(x, NamedSharding(self.mesh, spec))
+
+        if self.zero3_blocks is not None:
+            # Born sharded: one jit with rows out_shardings so params,
+            # moments, and prev_grad land as [.., dp, shard] rows over
+            # the data axis and never exist replicated on device. (The
+            # init TREE itself is a replicated host constant — the
+            # transient any fresh init or checkpoint load pays; the
+            # per-STEP bound is what zero3_blocks guarantees.)
+            abstract = self._abstract_state()
+            out_sh = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s),
+                self.state_spec_tree(abstract),
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            return jax.jit(
+                self._z3b_build_state, out_shardings=out_sh
+            )()
 
         specs = self._param_spec_tree(self._init_params)
         params = jax.tree.map(put, self._init_params, specs)
@@ -832,6 +1046,193 @@ class ElasticTrainer:
             nu_tree,
         )
 
+    def _z3b_precond(self, opt_state_local):
+        """Preconditioner under zero3_blocks: Adam's nu is a rows-dict
+        mirror; this device's local rows precondition this device's
+        row-space gradients directly — no reassembly (globally
+        consistent: the rows ARE the true nu shards)."""
+        if self.precondition != "adam":
+            return None
+        nu_local = _find_adam_nu(opt_state_local)
+        if nu_local is None:
+            raise ValueError(
+                "precondition='adam' but optimizer state has no "
+                "ScaleByAdamState"
+            )
+        return jax.tree.map(
+            lambda v: jnp.sqrt(
+                jnp.maximum(v.astype(jnp.float32), 0.0)
+            )
+            + 1e-8,
+            nu_local,
+        )
+
+    def _build_step_z3b(self, atomic_bsz: int, accum_steps: int):
+        """The zero3_blocks train step (per-layer FSDP).
+
+        Differs from the dense/zero1 step in one structural way: the
+        loss is differentiated directly with respect to this device's
+        ROW storage. The forward gathers parameters (the non-block
+        subtree once, each block inside the model's layer scan), so
+        the AD transpose hands back cotangents that are already
+        globally SUMMED over the data axis and scattered to each
+        device's own rows — FSDP's reduce-scatter, for free. Two
+        consequences:
+
+        - No gradient pmean: dividing the row cotangent by dp IS the
+          fully averaged gradient. The optimizer runs on local rows.
+        - The GNS sees only per-microbatch GLOBAL gradients (the
+          per-replica signal is consumed by the reduce-scatter), so
+          ``count = num_microbatches`` — the estimator pairs batch
+          sizes (dp*atomic, full) instead of (atomic, full) — and at
+          accum_steps == 0 the differenced estimator takes over, its
+          prev_grad carry held in rows layout (n/dp per device).
+        """
+        z3 = self._z3b
+        spec = self._z3b_spec
+        num_replicas = self.num_replicas
+        num_micro = accum_steps + 1
+        count = num_micro
+        accum_scale = num_replicas * atomic_bsz / self.init_batch_size
+        scale = accum_scale * num_micro
+        batch_size = num_replicas * num_micro * atomic_bsz
+
+        def rows_normsqr(tree, pre=None):
+            """Squared norm of a row-space tree, psum'd over the data
+            axis: each device's rows are a disjoint shard of the flat
+            gradient, so the sum of local squared norms is the global
+            squared norm (pad positions carry zero cotangent)."""
+            ids = tuple(0 for _ in jax.tree.leaves(tree))
+            out = gns.group_normsqr(tree, ids, 1, pre)
+            return jax.lax.psum(out, DATA_AXIS)
+
+        def per_replica_step(state: TrainState, local_batch, aux):
+            rows = state.params  # {"blocks":[L,1,sb], "other":[1,so]}
+            precond = self._z3b_precond(state.opt_state)
+            rng = jax.random.fold_in(state.rng, state.step)
+            rng = jax.random.fold_in(
+                rng, jax.lax.axis_index(DATA_AXIS)
+            )
+            micro_batches = jax.tree.map(
+                lambda x: x.reshape(
+                    (num_micro, atomic_bsz) + x.shape[1:]
+                ),
+                local_batch,
+            )
+            micro_rngs = jax.random.split(rng, num_micro)
+
+            def loss_of_rows(r, mb, mb_rng):
+                view = z3.build_view(r["blocks"], r["other"], spec)
+                if self.has_aux:
+                    return self.loss_fn(view, mb, mb_rng, aux)
+                return self.loss_fn(view, mb, mb_rng)
+
+            def micro_step(carry, inputs):
+                grad_sum, lsqr_sum, loss_sum = carry
+                mb, mb_rng = inputs
+                loss, grad = jax.value_and_grad(loss_of_rows)(
+                    rows, mb, mb_rng
+                )
+                # The row cotangent is the SUM over replicas of the
+                # per-replica mean-loss gradient (reduce-scatter);
+                # /dp makes it this microbatch's global mean gradient.
+                grad = jax.tree.map(
+                    lambda g: g / num_replicas, grad
+                )
+                grad_sum = jax.tree.map(jnp.add, grad_sum, grad)
+                # Per-microbatch GLOBAL squared norm (invariant after
+                # the psum inside rows_normsqr).
+                lsqr_sum = lsqr_sum + rows_normsqr(grad, precond)
+                return (grad_sum, lsqr_sum, loss_sum + loss), None
+
+            grad_init = jax.tree.map(
+                lambda p: (p * 0.0).astype(jnp.float32), rows
+            )
+            lsqr_init = jnp.zeros((1,))
+            loss_init = jax.lax.pcast(
+                jnp.zeros(()), DATA_AXIS, to="varying"
+            )
+            init = (grad_init, lsqr_init, loss_init)
+            (grad_sum, lsqr_sum, loss_sum), _ = jax.lax.scan(
+                micro_step, init, (micro_batches, micro_rngs)
+            )
+            # Already globally averaged over replicas; average the
+            # microbatches. No pmean — the collective already happened
+            # inside AD.
+            grads = jax.tree.map(lambda g: g / num_micro, grad_sum)
+            local_sqr_mean = lsqr_sum / num_micro
+            loss = jax.lax.pmean(loss_sum / num_micro, DATA_AXIS)
+
+            new_gns = gns.update(
+                state.gns,
+                grads,
+                local_sqr_mean,
+                count=count,
+                accum_scale=accum_scale,
+                num_microbatches=num_micro,
+                smoothing=self.smoothing,
+                precond=precond,
+                group_ids=tuple(
+                    0 for _ in jax.tree.leaves(grads)
+                ),
+                num_groups=1,
+                normsqr_fn=rows_normsqr,
+            )
+            step_gain = gns.gain(new_gns, scale)
+            ctx = RuleContext(
+                scale=scale,
+                batch_size=batch_size,
+                init_batch_size=self.init_batch_size,
+                gns_state=new_gns,
+                progress=state.progress,
+            )
+            lr_factor = self.scaling_rule.lr_factor(ctx)
+            group_factors = self.scaling_rule.lr_factor_groups(ctx)
+            updates, new_opt_state = self.optimizer.update(
+                grads, state.opt_state, rows
+            )
+            updates = jax.tree.map(
+                lambda u: (
+                    u.astype(jnp.float32) * group_factors[0]
+                ).astype(u.dtype),
+                updates,
+            )
+            new_rows = optax.apply_updates(rows, updates)
+            new_state = TrainState(
+                params=new_rows,
+                opt_state=new_opt_state,
+                gns=new_gns,
+                progress=state.progress + step_gain,
+                step=state.step + 1,
+                rng=state.rng,
+            )
+            metrics = {
+                "loss": loss,
+                "gain": step_gain,
+                "lr_factor": lr_factor,
+                "grad_sqr": gns.sqr_avg(new_gns),
+                "grad_var": gns.var_avg(new_gns),
+                "progress": new_state.progress,
+                "scale": jnp.asarray(scale, jnp.float32),
+            }
+            return new_state, metrics
+
+        state_specs = self._manual_state_specs({DATA_AXIS})
+        sharded = shard_map(
+            per_replica_step,
+            mesh=self.mesh,
+            in_specs=(state_specs, P(DATA_AXIS), P()),
+            out_specs=(state_specs, P()),
+        )
+        jitted = jax.jit(sharded, donate_argnums=0)
+        if self.has_aux:
+            return jitted
+        wrapper = lambda state, batch: jitted(state, batch, ())  # noqa: E731
+        # Expose the jitted program for lower()/compile() introspection
+        # (memory-analysis tests, benchmark tooling).
+        wrapper._jitted = jitted
+        return wrapper
+
     def train_step(self, atomic_bsz: int, accum_steps: int = 0) -> Callable:
         """Compiled ``(state, global_batch) -> (state, metrics)`` (or
         ``(state, global_batch, aux) -> ...`` when ``has_aux``).
@@ -847,6 +1248,8 @@ class ElasticTrainer:
         return self._step_cache[key]
 
     def _build_step(self, atomic_bsz: int, accum_steps: int):
+        if self.zero3_blocks is not None:
+            return self._build_step_z3b(atomic_bsz, accum_steps)
         num_replicas = self.num_replicas
         seq_shards = self.seq_shards
         sharded_axes = self.sharded_param_axes
@@ -1149,13 +1552,25 @@ class ElasticTrainer:
         if self.has_aux:
             return jitted
         # Hide the unused aux slot from non-aux callers.
-        return lambda state, batch: jitted(state, batch, ())
+        wrapper = lambda state, batch: jitted(state, batch, ())  # noqa: E731
+        wrapper._jitted = jitted
+        return wrapper
 
     def params_tree(self, state: TrainState) -> Any:
         """The parameter TREE of a TrainState, whatever the storage
         layout — the accessor user code (evaluation, export, analysis)
         should reach for instead of ``state.params``, which under
         zero3 holds flat [dp, shard] rows."""
+        if self.zero3_blocks is not None:
+            key = ("params_tree",)
+            assemble = self._step_cache.get(key)
+            if assemble is None:
+                assemble = jax.jit(
+                    self._z3b_tree_from_rows,
+                    out_shardings=NamedSharding(self.mesh, P()),
+                )
+                self._step_cache[key] = assemble
+            return assemble(state.params)
         if not self.zero3:
             return state.params
         # Assemble ON DEVICE: the [dp, shard] rows are sharded over the
@@ -1198,7 +1613,14 @@ class ElasticTrainer:
         sharded_axes = self.sharded_param_axes
 
         def per_replica(params, local_batch):
-            if self.zero3:
+            if self.zero3_blocks is not None:
+                # metric_fn receives the same Zero3View the loss_fn
+                # does: the model's scan_blocks forward works unchanged
+                # and eval keeps the per-block memory bound.
+                params = self._z3b.build_view(
+                    params["blocks"], params["other"], self._z3b_spec
+                )
+            elif self.zero3:
                 params = self._zero1_unravel(
                     self._rows_to_flat(params)
                 )
@@ -1227,7 +1649,12 @@ class ElasticTrainer:
         extra = {}
         if MODEL_AXIS in self.mesh.shape:
             extra["axis_names"] = manual
-        if self.zero3:
+        if self.zero3_blocks is not None:
+            param_specs = {
+                "blocks": P(None, DATA_AXIS),
+                "other": P(DATA_AXIS),
+            }
+        elif self.zero3:
             param_specs = P(DATA_AXIS)
         else:
             param_specs = self._restrict_specs(
@@ -1302,7 +1729,25 @@ class ElasticTrainer:
             (DATA_AXIS, SEQ_AXIS) if seq_shards > 1 else DATA_AXIS
         )
 
-        def per_replica(params, local_batch, rng):
+        def per_replica(params, local_batch, rng, aux):
+            extra = (aux,) if self.has_aux else ()
+            if self.zero3_blocks is not None:
+                # Differentiate wrt the rows through the view, exactly
+                # as the train step does — the calibration must time
+                # the same gather/reduce-scatter schedule it models.
+                rng = jax.random.fold_in(
+                    rng, jax.lax.axis_index(DATA_AXIS)
+                )
+
+                def loss_of_rows(r):
+                    view = self._z3b.build_view(
+                        r["blocks"], r["other"], self._z3b_spec
+                    )
+                    return self.loss_fn(view, local_batch, rng, *extra)
+
+                loss, grads = jax.value_and_grad(loss_of_rows)(params)
+                total = gns.normsqr(grads) + loss
+                return total[None]
             if self.zero3:
                 params = self._zero1_unravel(
                     self._rows_to_flat(params)
@@ -1310,7 +1755,7 @@ class ElasticTrainer:
             params_v = jax.lax.pcast(params, varying_axes, to="varying")
             rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
             loss, grads = jax.value_and_grad(self.loss_fn)(
-                params_v, local_batch, rng
+                params_v, local_batch, rng, *extra
             )
             total = gns.normsqr(grads) + loss
             if seq_shards > 1:
@@ -1328,7 +1773,12 @@ class ElasticTrainer:
         extra = {}
         if MODEL_AXIS in self.mesh.shape:
             extra["axis_names"] = manual
-        if self.zero3:
+        if self.zero3_blocks is not None:
+            param_specs = {
+                "blocks": P(None, DATA_AXIS),
+                "other": P(DATA_AXIS),
+            }
+        elif self.zero3:
             param_specs = P(DATA_AXIS)  # the flat rows
         else:
             param_specs = self._restrict_specs(
@@ -1337,7 +1787,7 @@ class ElasticTrainer:
         sharded = shard_map(
             per_replica,
             mesh=self.mesh,
-            in_specs=(param_specs, batch_spec, P()),
+            in_specs=(param_specs, batch_spec, P(), P()),
             out_specs=P(DATA_AXIS),
             **extra,
         )
@@ -1345,7 +1795,7 @@ class ElasticTrainer:
 
     def calibrate_accum_time(
         self, state: TrainState, host_batch: Any, atomic_bsz: int,
-        repeats: int = 3,
+        repeats: int = 3, aux: Any = (),
     ) -> float:
         """Time the compute-only microbatch step; record into metrics."""
         import time as _time
@@ -1362,19 +1812,31 @@ class ElasticTrainer:
         )
         micro = jax.tree.map(lambda x: x[:local_rows], host_batch)
         micro = self.shard_batch(micro)
-        jax.block_until_ready(fn(state.params, micro, state.rng))  # compile
+        jax.block_until_ready(
+            fn(state.params, micro, state.rng, aux)
+        )  # compile
         best = float("inf")
         for _ in range(repeats):
             start = _time.monotonic()
-            jax.block_until_ready(fn(state.params, micro, state.rng))
+            jax.block_until_ready(
+                fn(state.params, micro, state.rng, aux)
+            )
             best = min(best, _time.monotonic() - start)
         metrics_mod.profile_accum_time(atomic_bsz, best)
         return best
 
-    def run_step(self, state: TrainState, host_batch: Any, dataloader):
+    def run_step(
+        self,
+        state: TrainState,
+        host_batch: Any,
+        dataloader,
+        aux: Any = None,
+    ):
         """One elastic step wired to the dataloader's current config:
         calibrates new batch sizes, runs the fused step, and feeds the
-        GNS statistics and progress back into the metrics engine."""
+        GNS statistics and progress back into the metrics engine.
+        ``aux`` is forwarded to the loss when the trainer was built
+        with ``has_aux=True`` (e.g. the DCGAN generator params)."""
         from adaptdl_tpu import metrics as metrics_mod
 
         from adaptdl_tpu import env as env_mod
@@ -1389,11 +1851,17 @@ class ElasticTrainer:
         atomic_bsz = dataloader.current_atomic_bsz
         accum_steps = dataloader.current_accum_steps
         if atomic_bsz not in self._calibrated:
-            self.calibrate_accum_time(state, host_batch, atomic_bsz)
+            self.calibrate_accum_time(
+                state, host_batch, atomic_bsz,
+                aux=aux if self.has_aux else (),
+            )
             self._calibrated.add(atomic_bsz)
         step_fn = self.train_step(atomic_bsz, accum_steps)
         batch = self.shard_batch(host_batch)
-        state, metrics_out = step_fn(state, batch)
+        if self.has_aux:
+            state, metrics_out = step_fn(state, batch, aux)
+        else:
+            state, metrics_out = step_fn(state, batch)
         # Keep the device pipeline full: host syncs are expensive
         # (round trips; the whole point of async dispatch) and the GNS
         # hints don't need per-step freshness. Pull the statistics to
@@ -1483,6 +1951,25 @@ class TrainerCheckpoint(checkpoint.State):
         # RNG keys are opaque typed arrays; store raw key data.
         state = state._replace(rng=jax.random.key_data(state.rng))
         state = jax.tree.map(np.asarray, state)
+        if self._trainer.zero3_blocks is not None:
+            # Canonical disk layouts: params as the plain TREE (what a
+            # dense trainer writes), moments and the prev_grad carry
+            # as flat [n] vectors in tree-ravel order (what zero1/lite
+            # write) — dp-independent, and the carry itself holds the
+            # GLOBAL mean gradient, so it survives a dp change intact.
+            state = state._replace(
+                params=self._trainer._z3b_canonical_params(
+                    state.params
+                ),
+                opt_state=self._trainer._z3b_canonical_opt(
+                    state.opt_state
+                ),
+                gns=state.gns._replace(
+                    prev_grad=self._trainer._z3b_flat_canonical(
+                        state.gns.prev_grad
+                    )
+                ),
+            )
         if self._trainer.zero1:
             # Canonical (dp-independent) moment layout on disk; zero1
             # is part of the job's flag-stable config, so the restoring
@@ -1514,6 +2001,50 @@ class TrainerCheckpoint(checkpoint.State):
         host_state = pickle.load(fileobj)
         if self._transform_load is not None:
             host_state = self._transform_load(host_state)
+        if self._trainer.zero3_blocks is not None:
+            tr = self._trainer
+            prev = host_state.gns.prev_grad
+            if (
+                isinstance(prev, np.ndarray)
+                and prev.shape == (tr._z3b_n_total,)
+            ):
+                # Our canonical carry: the global mean gradient,
+                # dp-independent — expand to this dp's rows.
+                new_prev = tr._z3b_rows_from_flat(prev)
+                new_valid = host_state.gns.prev_grad_valid
+            else:
+                # Foreign layout (a dense/lite checkpoint crossing
+                # into blocks mode): re-prime the differenced
+                # estimator.
+                new_prev = jax.tree.map(
+                    lambda x: np.zeros(np.shape(x), np.float32),
+                    tr._z3b_rows_from_tree_host(tr._init_params),
+                )
+                new_valid = np.zeros((), bool)
+            host_state = host_state._replace(
+                params=tr._z3b_rows_from_tree_host(host_state.params),
+                opt_state=tr._z3b_expand_opt(host_state.opt_state),
+                gns=host_state.gns._replace(
+                    prev_grad=new_prev, prev_grad_valid=new_valid
+                ),
+            )
+        if self._trainer.zero1 and (
+            isinstance(host_state.gns.prev_grad, np.ndarray)
+            and host_state.gns.prev_grad.shape
+            == (self._trainer._zero1_n,)
+            and np.shape(self._trainer._init_params) != (
+                self._trainer._zero1_n,
+            )
+        ):
+            # A zero3_blocks checkpoint crossing into the zero1/lite
+            # family: its flat canonical carry has no zero1 reader —
+            # drop to the placeholder layout and re-prime.
+            host_state = host_state._replace(
+                gns=host_state.gns._replace(
+                    prev_grad=self._trainer._empty_prev_grad_host(),
+                    prev_grad_valid=np.zeros((), bool),
+                )
+            )
         if self._trainer.zero1:
             host_state = host_state._replace(
                 opt_state=self._trainer._zero1_expand_opt(
