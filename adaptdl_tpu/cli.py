@@ -139,11 +139,91 @@ def _cmd_submit(args) -> int:
     return runner.run()
 
 
+def _age(creation_ts: str) -> str:
+    """k8s-style humanized age from an ISO creationTimestamp."""
+    import datetime
+
+    try:
+        created = datetime.datetime.fromisoformat(
+            creation_ts.replace("Z", "+00:00")
+        )
+    except (ValueError, AttributeError):
+        return "?"
+    delta = (
+        datetime.datetime.now(datetime.timezone.utc) - created
+    ).total_seconds()
+    if delta < 0:
+        return "0s"
+    for unit, width in (("d", 86400), ("h", 3600), ("m", 60)):
+        if delta >= width:
+            return f"{int(delta // width)}{unit}"
+    return f"{int(delta)}s"
+
+
 def _cmd_ls(args) -> int:
+    if args.backend == "k8s":
+        return _ls_k8s(args)
+    if not args.supervisor:
+        print(
+            "ls: --supervisor URL required (or use --backend k8s)",
+            file=sys.stderr,
+        )
+        return 2
     import requests
 
     text = requests.get(f"{args.supervisor}/metrics", timeout=10).text
     print(text, end="")
+    return 0
+
+
+def _ls_k8s(args) -> int:
+    """Job table straight off the AdaptDLJob CRD — name / phase /
+    replicas / restarts / age, the reference's ls columns (reference:
+    cli/bin/adaptdl:321-396 renders the same fields from its CRD) —
+    so cluster jobs are listable without supervisor reachability
+    (the operator publishes status each reconcile,
+    sched/k8s/operator.py Operator._publish_status)."""
+    if not _require_kubectl():
+        return 1
+    proc = subprocess.run(
+        [
+            "kubectl", "get", "adaptdljobs",
+            "-n", args.namespace, "-o", "json",
+        ],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        print(proc.stderr.strip(), file=sys.stderr)
+        return proc.returncode
+    try:
+        items = json.loads(proc.stdout or "{}").get("items", [])
+    except json.JSONDecodeError:
+        print("ls: unparseable kubectl output", file=sys.stderr)
+        return 1
+    rows = [("NAME", "PHASE", "REPLICAS", "RESTARTS", "AGE")]
+    for obj in items:
+        meta = obj.get("metadata", {})
+        status = obj.get("status", {}) or {}
+        rows.append(
+            (
+                meta.get("name", "?"),
+                str(status.get("phase", "Pending")),
+                str(status.get("replicas", 0)),
+                str(status.get("restarts", 0)),
+                _age(meta.get("creationTimestamp", "")),
+            )
+        )
+    widths = [
+        max(len(row[col]) for row in rows)
+        for col in range(len(rows[0]))
+    ]
+    for row in rows:
+        print(
+            "  ".join(
+                cell.ljust(width) for cell, width in zip(row, widths)
+            ).rstrip()
+        )
     return 0
 
 
@@ -503,8 +583,16 @@ def main(argv=None) -> int:
     p.add_argument("--dry-run", action="store_true")
     p.set_defaults(fn=_cmd_submit)
 
-    p = sub.add_parser("ls", help="list jobs known to a supervisor")
-    p.add_argument("--supervisor", required=True)
+    p = sub.add_parser(
+        "ls",
+        help="list jobs: --backend k8s reads the CRD status table; "
+        "default queries a live supervisor's /metrics",
+    )
+    p.add_argument("--supervisor", default=None)
+    p.add_argument(
+        "--backend", choices=["supervisor", "k8s"], default="supervisor"
+    )
+    p.add_argument("--namespace", default="default")
     p.set_defaults(fn=_cmd_ls)
 
     p = sub.add_parser("hints", help="show a job's posted sched hints")

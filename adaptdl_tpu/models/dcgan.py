@@ -102,23 +102,73 @@ def discriminator_loss_fn(discriminator, generator):
     return loss_fn
 
 
-def make_generator_step(generator, discriminator, optimizer):
-    """Plain jitted generator update (not elastic-wrapped, mirroring
-    the reference's one-wrapped-model GAN recipe)."""
+def make_generator_step(generator, discriminator, optimizer, mesh=None):
+    """Jitted generator update (not elastic-wrapped, mirroring the
+    reference's one-wrapped-model GAN recipe).
 
-    @jax.jit
-    def step(g_params, g_opt_state, d_params, z):
-        def loss_fn(gp):
-            fakes = generator.apply({"params": gp}, z)
-            logits = discriminator.apply({"params": d_params}, fakes)
-            return optax.sigmoid_binary_cross_entropy(
-                logits, jnp.ones_like(logits)
-            ).mean()
+    Pass the discriminator trainer's ``mesh`` for any multi-device or
+    multi-process run: ``z`` is then consumed data-sharded and the
+    generator gradient is ``pmean``'d over the data axis, so every
+    replica applies the identical update — without it, per-process
+    loader shards would silently diverge the generator params across
+    an elastic allocation (rank 0's copy then wins at checkpoint
+    time). ``mesh=None`` keeps the single-device fast path."""
 
-        loss, grads = jax.value_and_grad(loss_fn)(g_params)
+    def loss_of(gp, d_params, z):
+        fakes = generator.apply({"params": gp}, z)
+        logits = discriminator.apply({"params": d_params}, fakes)
+        return optax.sigmoid_binary_cross_entropy(
+            logits, jnp.ones_like(logits)
+        ).mean()
+
+    if mesh is None:
+
+        @jax.jit
+        def step(g_params, g_opt_state, d_params, z):
+            loss, grads = jax.value_and_grad(loss_of)(
+                g_params, d_params, z
+            )
+            updates, g_opt_state = optimizer.update(
+                grads, g_opt_state, g_params
+            )
+            return (
+                optax.apply_updates(g_params, updates),
+                g_opt_state,
+                loss,
+            )
+
+        return step
+
+    from jax.sharding import PartitionSpec as P
+
+    from adaptdl_tpu.parallel.mesh import DATA_AXIS
+
+    try:  # jax >= 0.6
+        shard_map = jax.shard_map
+    except AttributeError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    def per_replica(g_params, g_opt_state, d_params, z_local):
+        g_v = jax.lax.pcast(g_params, DATA_AXIS, to="varying")
+        loss, grads = jax.value_and_grad(loss_of)(
+            g_v, d_params, z_local
+        )
+        grads = jax.lax.pmean(grads, DATA_AXIS)
+        loss = jax.lax.pmean(loss, DATA_AXIS)
         updates, g_opt_state = optimizer.update(
             grads, g_opt_state, g_params
         )
-        return optax.apply_updates(g_params, updates), g_opt_state, loss
+        return (
+            optax.apply_updates(g_params, updates),
+            g_opt_state,
+            loss,
+        )
 
-    return step
+    return jax.jit(
+        shard_map(
+            per_replica,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P(DATA_AXIS)),
+            out_specs=(P(), P(), P()),
+        )
+    )

@@ -116,6 +116,7 @@ class Operator:
         self.allocator: Allocator | None = None
         self.expander: ClusterExpander | None = None
         self._slice_inventory: dict[str, NodeInfo] = {}
+        self._published_status: dict[str, dict] = {}
 
     async def run(self):
         client, config, watch = _require_k8s()
@@ -242,6 +243,9 @@ class Operator:
         key = f"{self.namespace}/{obj['metadata']['name']}"
         if event["type"] == "DELETED":
             self.state.remove_job(key)
+            # A later re-creation under the same name must re-publish
+            # its status from scratch.
+            self._published_status.pop(key, None)
             return
         spec = obj.get("spec", {})
         normalized = {
@@ -276,7 +280,40 @@ class Operator:
                     await self._reconcile_job(api, core, key, record)
                 except Exception:  # noqa: BLE001
                     LOG.exception("reconcile failed for %s", key)
+                try:
+                    await self._publish_status(api, key, record)
+                except Exception:  # noqa: BLE001
+                    LOG.exception("status publish failed for %s", key)
             await asyncio.sleep(interval)
+
+    async def _publish_status(self, api, key, record) -> None:
+        """Write the job's observed state into the CRD status
+        subresource so ``adaptdl-tpu ls --backend k8s`` (and plain
+        ``kubectl get adaptdljobs``) can render jobs WITHOUT reaching
+        the supervisor — the reference's ls reads the same fields off
+        its CRD (reference: cli/bin/adaptdl:321-396; the reference
+        controller patches status in controller.py). No-op when no API
+        client is injected (unit-test reconciles pass api=None).
+        Patches only on TRANSITION: an unchanged body is skipped, so N
+        idle jobs do not generate N identical etcd writes (and watch
+        fanout) every reconcile interval."""
+        if api is None:
+            return
+        namespace, name = key.split("/", 1)
+        body = {
+            "status": {
+                "phase": record.status,
+                "replicas": len(record.allocation or []),
+                "restarts": int(record.group),
+                "allocation": list(record.allocation or []),
+            }
+        }
+        if self._published_status.get(key) == body:
+            return
+        await api.patch_namespaced_custom_object_status(
+            GROUP, VERSION, namespace, PLURAL, name, body
+        )
+        self._published_status[key] = body
 
     @staticmethod
     def _launch_fingerprint(record) -> str:

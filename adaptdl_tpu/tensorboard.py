@@ -100,6 +100,69 @@ def _scalar_event(step: int, scalars: dict[str, float]) -> bytes:
     )
 
 
+def _encode_png(image) -> bytes:
+    """Minimal stdlib PNG encoder (8-bit RGB/grayscale, zlib-deflated
+    scanlines) — enough for TensorBoard image summaries without a
+    Pillow dependency (this image has no network egress; the reference
+    leans on torch/PIL for the same job)."""
+    import zlib
+
+    import numpy as _np
+
+    arr = _np.asarray(image)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    h, w, c = arr.shape
+    assert c in (1, 3), f"PNG encoder supports 1 or 3 channels, got {c}"
+    arr = _np.clip(arr, 0, 255).astype(_np.uint8)
+    color_type = 0 if c == 1 else 2
+    raw = b"".join(
+        b"\x00" + arr[row].tobytes() for row in range(h)
+    )
+
+    def chunk(tag: bytes, payload: bytes) -> bytes:
+        body = tag + payload
+        return (
+            struct.pack(">I", len(payload))
+            + body
+            + struct.pack(">I", zlib.crc32(body) & 0xFFFFFFFF)
+        )
+
+    ihdr = struct.pack(">IIBBBBB", w, h, 8, color_type, 0, 0, 0)
+    return (
+        b"\x89PNG\r\n\x1a\n"
+        + chunk(b"IHDR", ihdr)
+        + chunk(b"IDAT", zlib.compress(raw, 6))
+        + chunk(b"IEND", b"")
+    )
+
+
+def _image_event(step: int, tag: str, image) -> bytes:
+    """Summary.Value.image (field 4): Summary.Image {height=1,
+    width=2, colorspace=3, encoded_image_string=4} with a PNG
+    payload — the wire format TensorBoard's image dashboard reads."""
+    import numpy as _np
+
+    arr = _np.asarray(image)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    h, w, c = arr.shape
+    image_proto = (
+        _field_varint(1, h)
+        + _field_varint(2, w)
+        + _field_varint(3, 1 if c == 1 else 3)
+        + _field_bytes(4, _encode_png(arr))
+    )
+    value = _field_bytes(1, tag.encode()) + _field_bytes(
+        4, image_proto
+    )
+    return (
+        _field_double(1, time.time())
+        + _field_varint(2, int(step))
+        + _field_bytes(5, _field_bytes(1, value))
+    )
+
+
 def _version_event() -> bytes:
     return _field_double(1, time.time()) + _field_bytes(
         3, b"brain.Event:2"
@@ -133,6 +196,13 @@ class EventFileWriter:
     def add_scalars(self, step: int, scalars: dict[str, float]) -> None:
         if scalars:
             self._write_record(_scalar_event(step, scalars))
+
+    def add_image(self, step: int, tag: str, image) -> None:
+        """``image``: [h, w] or [h, w, {1,3}] array, values in [0, 255]
+        (float inputs in [0, 1] or [-1, 1] should be rescaled by the
+        caller). Lands in TensorBoard's Images dashboard — the DCGAN
+        example's sample grids (reference family: examples/dcgan)."""
+        self._write_record(_image_event(step, tag, image))
 
     def flush(self) -> None:
         self._file.flush()

@@ -116,6 +116,57 @@ def test_submit_k8s_dry_run_renders_manifest(tmp_path, capsys):
     assert manifest["spec"]["maxReplicas"] == 16
 
 
+def test_ls_k8s_renders_crd_job_table(tmp_path, monkeypatch, capsys):
+    """``ls --backend k8s`` renders name/phase/replicas/restarts/age
+    straight off the CRD (reference: cli/bin/adaptdl:321-396) — no
+    supervisor reachability needed."""
+    import datetime
+
+    created = (
+        datetime.datetime.now(datetime.timezone.utc)
+        - datetime.timedelta(hours=2)
+    ).strftime("%Y-%m-%dT%H:%M:%SZ")
+    listing = {
+        "items": [
+            {
+                "metadata": {
+                    "name": "bert-large",
+                    "creationTimestamp": created,
+                },
+                "status": {
+                    "phase": "Running",
+                    "replicas": 4,
+                    "restarts": 2,
+                },
+            },
+            {
+                # Freshly submitted: no status subresource yet.
+                "metadata": {
+                    "name": "cifar",
+                    "creationTimestamp": created,
+                },
+            },
+        ]
+    }
+    script = tmp_path / "bin" / "kubectl"
+    script.parent.mkdir()
+    script.write_text(
+        "#!/usr/bin/env python3\n"
+        "import json, sys\n"
+        f"print(json.dumps({listing!r}))\n"
+    )
+    script.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{script.parent}:{os.environ['PATH']}")
+    assert main(["ls", "--backend", "k8s", "--namespace", "ns"]) == 0
+    out = capsys.readouterr().out
+    lines = out.splitlines()
+    assert lines[0].split() == [
+        "NAME", "PHASE", "REPLICAS", "RESTARTS", "AGE",
+    ]
+    assert lines[1].split() == ["bert-large", "Running", "4", "2", "2h"]
+    assert lines[2].split() == ["cifar", "Pending", "0", "0", "2h"]
+
+
 def test_ls_and_hints_against_live_supervisor(capsys):
     from adaptdl_tpu.sched.state import ClusterState
     from adaptdl_tpu.sched.supervisor import Supervisor

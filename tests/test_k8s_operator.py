@@ -112,6 +112,52 @@ def op():
     return operator
 
 
+def test_publish_status_patches_crd_subresource(op):
+    """Each reconcile pass writes phase/replicas/restarts into the
+    CRD status subresource — what `adaptdl-tpu ls --backend k8s`
+    renders (reference: controller patches status the same way)."""
+    core = FakeCore()
+    _reconcile(op, core, "ns/job")
+
+    patches = []
+
+    class FakeCustomObjects:
+        async def patch_namespaced_custom_object_status(
+            self, group, version, namespace, plural, name, body
+        ):
+            patches.append(
+                (group, version, namespace, plural, name, body)
+            )
+
+    record = op.state.get_job("ns/job")
+    asyncio.run(
+        op._publish_status(FakeCustomObjects(), "ns/job", record)
+    )
+    (group, version, namespace, plural, name, body) = patches[0]
+    assert (group, version, namespace, plural, name) == (
+        "adaptdl.org", "v1", "ns", "adaptdljobs", "job",
+    )
+    assert body["status"]["phase"] == "Starting"
+    assert body["status"]["replicas"] == 2
+    assert body["status"]["restarts"] == 1
+    assert body["status"]["allocation"] == ["pool-a", "pool-a"]
+    # Unchanged status is NOT re-patched (no per-interval etcd churn);
+    # a transition is.
+    asyncio.run(
+        op._publish_status(FakeCustomObjects(), "ns/job", record)
+    )
+    assert len(patches) == 1
+    _reconcile(op, core, "ns/job")  # Starting -> Running
+    record = op.state.get_job("ns/job")
+    asyncio.run(
+        op._publish_status(FakeCustomObjects(), "ns/job", record)
+    )
+    assert len(patches) == 2
+    assert patches[1][5]["status"]["phase"] == "Running"
+    # api=None (unit reconciles) is a no-op, not a crash.
+    asyncio.run(op._publish_status(None, "ns/job", record))
+
+
 def test_pending_to_starting_to_running(op):
     core = FakeCore()
     _reconcile(op, core, "ns/job")

@@ -111,6 +111,35 @@ def test_allocator_assigns_and_grows():
     assert len(second["ns/a"]) >= len(first["ns/a"])
 
 
+def test_allocator_publishes_slice_spanning_allocation():
+    """A job whose replica floor exceeds one slice's chips gets an
+    allocation SPANNING two slices (the DCN case the two-tier
+    alpha_n/beta_n goodput terms price; reference two-tier model:
+    adaptdl/adaptdl/goodput.py:31-49) — published to the cluster
+    state for the launcher to build a spanning mesh from."""
+    state = ClusterState()
+    state.create_job(
+        "ns/span", spec={"min_replicas": 6, "max_replicas": 8}
+    )
+    state.update(
+        "ns/span", hints=dict(HINTS, maxProfiledReplicas=4)
+    )
+    nodes = {
+        "slice-0": NodeInfo(resources={"tpu": 4}),
+        "slice-1": NodeInfo(resources={"tpu": 4}),
+    }
+    allocator = Allocator(
+        state,
+        nodes,
+        policy=PolluxPolicy(pop_size=16, generations=10),
+    )
+    alloc = allocator.optimize_once()["ns/span"]
+    assert len(alloc) >= 6  # floor honored
+    assert set(alloc) == {"slice-0", "slice-1"}  # spans both slices
+    # Published to the state (what the worker launcher reads).
+    assert state.get_job("ns/span").allocation == alloc
+
+
 def test_metrics_exposition(cluster):
     state, url = cluster
     state.update("test/job", allocation=["slice-0"] * 3, hints=HINTS)

@@ -37,6 +37,44 @@ def test_event_file_round_trip(tmp_path):
     writer.close()
 
 
+def test_add_image_writes_valid_png_and_keeps_scalars_readable(tmp_path):
+    """Image summaries (the DCGAN sample grids) land as PNG-encoded
+    Summary.Image records; scalar events around them still parse, and
+    the PNG payload decodes back to the original pixels."""
+    import zlib
+
+    import numpy as np
+
+    writer = EventFileWriter(str(tmp_path))
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, size=(8, 12, 3), dtype=np.uint8)
+    writer.add_scalars(1, {"g/loss": 1.0})
+    writer.add_image(1, "g/samples", img)
+    writer.add_scalars(2, {"g/loss": 0.5})
+    writer.flush()
+    rows = read_events(writer.path)
+    assert (1, {"g/loss": 1.0}) in rows
+    assert (2, {"g/loss": 0.5}) in rows
+    blob = open(writer.path, "rb").read()
+    assert b"g/samples" in blob
+    sig = b"\x89PNG\r\n\x1a\n"
+    start = blob.index(sig)
+    # IHDR: width/height as written.
+    w, h = struct.unpack(">II", blob[start + 16:start + 24])
+    assert (h, w) == (8, 12)
+    # Decode the IDAT scanlines and compare pixels exactly.
+    idat_pos = blob.index(b"IDAT", start) + 4
+    idat_len = struct.unpack(
+        ">I", blob[blob.index(b"IDAT", start) - 4:blob.index(b"IDAT", start)]
+    )[0]
+    raw = zlib.decompress(blob[idat_pos:idat_pos + idat_len])
+    decoded = np.frombuffer(raw, np.uint8).reshape(8, 12 * 3 + 1)[:, 1:]
+    np.testing.assert_array_equal(
+        decoded.reshape(8, 12, 3), img
+    )
+    writer.close()
+
+
 def test_corruption_is_detected(tmp_path):
     writer = EventFileWriter(str(tmp_path))
     writer.add_scalars(1, {"x": 1.0})
