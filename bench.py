@@ -398,6 +398,80 @@ def _bench_transformer_tokens(on_tpu: bool, full: bool) -> dict | None:
     return out
 
 
+def _bench_z3b_memory(on_tpu: bool, full: bool) -> dict | None:
+    """Compiled per-device memory accounting for the three parameter
+    storage modes (dense / zero3-lite / zero3_blocks) on a block-stack
+    LM shape: XLA's memory analysis is deterministic and hardware-
+    independent, so this arm reports even on the CPU fallback — the
+    HBM story behind zero3_blocks (per-step peak = params/dp + ONE
+    gathered block) as numbers, not prose."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from adaptdl_tpu.models import TransformerConfig, init_zero3_lm
+    from adaptdl_tpu.models.transformer import init_transformer, lm_loss_fn
+    from adaptdl_tpu.parallel.mesh import create_mesh
+    from adaptdl_tpu.trainer import ElasticTrainer
+
+    dp = min(len(jax.devices()), 8)
+    if dp < 2:
+        return None
+    cfg = TransformerConfig(
+        vocab_size=2048 if full else 256,
+        num_layers=8 if full else 4,
+        num_heads=8 if full else 2,
+        d_model=512 if full else 64,
+        d_ff=2048 if full else 128,
+        max_seq_len=128 if full else 32,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        remat=False,
+    )
+    seq = 32 if full else 16
+    bsz = dp * 2
+    mesh = create_mesh({"data": dp}, devices=jax.devices()[:dp])
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, cfg.vocab_size, size=(bsz, seq + 1)).astype(
+        np.int32
+    )
+    out = {}
+    for mode in ("dense", "lite", "z3b"):
+        if mode == "z3b":
+            loss_fn, params = init_zero3_lm(cfg, seq_len=seq)
+            kw = {"zero3_blocks": "blocks"}
+        else:
+            model, params = init_transformer(cfg, seq_len=seq)
+            loss_fn = lm_loss_fn(model)
+            kw = {"zero3": True} if mode == "lite" else {}
+        trainer = ElasticTrainer(
+            loss_fn, params, optax.adamw(1e-3), bsz, mesh=mesh, **kw
+        )
+        state = trainer.init_state()
+        step = trainer.train_step(bsz // dp, 0)
+        batch = trainer.shard_batch({"tokens": tokens})
+        ma = (
+            step._jitted.lower(state, batch, ())
+            .compile()
+            .memory_analysis()
+        )
+        if ma is None or not hasattr(ma, "temp_size_in_bytes"):
+            return None
+        out[f"mem_{mode}_temp_mb"] = round(
+            ma.temp_size_in_bytes / 2**20, 2
+        )
+        out[f"mem_{mode}_args_mb"] = round(
+            ma.argument_size_in_bytes / 2**20, 2
+        )
+    _log(
+        "z3b memory (per device, compiled): "
+        + " ".join(f"{k}={v}" for k, v in out.items())
+    )
+    out["mem_z3b_temp_vs_lite"] = round(
+        out["mem_z3b_temp_mb"] / max(out["mem_lite_temp_mb"], 1e-9), 3
+    )
+    return out
+
+
 def _bench_flash_attention(on_tpu: bool, full: bool) -> dict | None:
     """Compiled flash-attention vs XLA dense attention, fwd+bwd step
     time at the shape where the kernel matters (long seq, bf16).
@@ -736,6 +810,7 @@ def main(quick: bool = False):
     # ---- optional depth: realized convergence, transformer tokens/s
     # + MFU, flash kernel, rescale p50. Ordered by verdict priority.
     convergence_stats = None
+    z3b_stats = None
     transformer_stats = None
     flash_stats = None
     rescale_p50 = None
@@ -744,6 +819,11 @@ def main(quick: bool = False):
             convergence_stats = _bench_convergence(on_tpu, full)
     except Exception as exc:  # noqa: BLE001 - optional metric
         _log(f"convergence bench failed: {exc}")
+    try:
+        if _remaining() > 140:
+            z3b_stats = _bench_z3b_memory(on_tpu, full)
+    except Exception as exc:  # noqa: BLE001 - optional metric
+        _log(f"z3b memory bench failed: {exc}")
     try:
         if _remaining() > 120:
             transformer_stats = _bench_transformer_tokens(on_tpu, full)
@@ -768,6 +848,8 @@ def main(quick: bool = False):
     result.update(_PROBE_INFO)
     if convergence_stats:
         result.update(convergence_stats)
+    if z3b_stats:
+        result.update(z3b_stats)
     if transformer_stats:
         result.update(transformer_stats)
     if flash_stats:

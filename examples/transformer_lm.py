@@ -61,6 +61,12 @@ def main():
     # moments live as [dp, shard] rows; the step assembles the full
     # tree on the fly). Same composition rules as --zero1.
     parser.add_argument("--zero3", action="store_true")
+    # Per-layer ZeRO-3/FSDP: params/moments/GNS-carry persist as
+    # per-BLOCK rows and the layer scan gathers one block at a time
+    # (models/zero3_lm.py) — per-step peak HBM is params/dp + one
+    # block, where --zero3 still materializes the whole tree in-step.
+    # Composes with dp only (the trainer enforces it).
+    parser.add_argument("--zero3-blocks", action="store_true")
     # Rematerialisation policy (jax.checkpoint_policies name): trade
     # recompute FLOPs for activation HBM per block.
     parser.add_argument("--remat-policy", type=str, default=None)
@@ -140,6 +146,22 @@ def main():
         else env.stage_shards()
     )
     pipeline_family = args.pipeline or stage_shards > 1
+    if args.zero3_blocks:
+        assert not (args.zero1 or args.zero3), (
+            "--zero3-blocks is a storage mode of its own; drop "
+            "--zero1/--zero3"
+        )
+        assert (
+            not pipeline_family
+            and args.moe_experts == 0
+            and seq_shards <= 1
+            and (args.tp_shards or env.model_shards()) <= 1
+            and not args.flash
+            and args.chunked_xent == 0
+        ), (
+            "--zero3-blocks shards parameter storage over the data "
+            "axis and composes with data parallelism only"
+        )
     if args.zero3:
         args.zero1 = True  # zero3 implies the zero1 constraints below
     if args.zero1:
@@ -218,6 +240,14 @@ def main():
         transform_save, transform_load = pipeline_checkpoint_transforms(
             stage_shards, interleave
         )
+    elif args.zero3_blocks:
+        from adaptdl_tpu.models import init_zero3_lm
+
+        # The zero3_lm loss is written against Zero3View (per-block
+        # gather inside its layer scan) and consumes raw token rows.
+        # Its canonical checkpoint layout is ALREADY the shared
+        # {embed, ln_f, blocks layer-major} tree, so no transforms.
+        loss_fn, params = init_zero3_lm(config, seq_len=seq_len)
     else:
         model, params = init_transformer(config, seq_len=seq_len)
         if args.moe_experts == 0:
@@ -346,6 +376,7 @@ def main():
         pipeline_micro=pipeline_micro if stage_shards > 1 else None,
         zero1=args.zero1,
         zero3=args.zero3,
+        zero3_blocks="blocks" if args.zero3_blocks else None,
     )
     holder = {"state": trainer.init_state()}
     ckpt = trainer.make_checkpoint_state(
@@ -363,9 +394,9 @@ def main():
     raw = synthetic_tokens(
         4096 if on_cpu else 65536, seq_len, config.vocab_size
     )["tokens"]
-    if stage_shards > 1:
-        # The pipelined loss consumes raw token rows and shifts
-        # internally (models/pipeline_lm.py).
+    if stage_shards > 1 or args.zero3_blocks:
+        # The pipelined and zero3-blocks losses consume raw token rows
+        # and shift internally (models/{pipeline_lm,zero3_lm}.py).
         dataset = {"tokens": raw}
     else:
         dataset = {
@@ -401,6 +432,11 @@ def main():
     # restarts, so ss = 1 incarnations keep advertising the stage
     # axis (canonical checkpoints restore either way).
     stage_mode = pipeline_family
+    if args.zero3_blocks:
+        # dp-only mode: a scheduler-chosen sp/tp/stage/expert rescale
+        # would crash-loop (the trainer rejects those axes under
+        # zero3_blocks), so advertise none of them.
+        max_sp = 1
     metrics.set_topology_config(
         max_seq_shards=1 if stage_mode else max_sp,
         # pallas_call is opaque to GSPMD: under a model axis the
@@ -412,7 +448,7 @@ def main():
         # crash-loop every restart.
         max_model_shards=(
             1
-            if args.flash or args.zero1
+            if args.flash or args.zero1 or args.zero3_blocks
             else min(config.num_heads, 8)
         ),
         # Stage shards must divide the layer count (uniform chunks);
