@@ -47,6 +47,7 @@ def init_zero3_lm(
     config: TransformerConfig,
     rng=None,
     seq_len: int | None = None,
+    gather_unroll: int = 1,
 ):
     """(loss_fn, params) for a causal LM trained with
     ``ElasticTrainer(..., zero3_blocks="blocks")``.
@@ -57,6 +58,9 @@ def init_zero3_lm(
     trainer converts it to row storage itself. The companion
     ``block_spec(params, "blocks")`` the model scan needs is derived
     here once and closed over (static layout facts, dp-independent).
+    ``gather_unroll`` > 1 lets XLA overlap the next block's all-gather
+    with the current block's compute (see ``scan_blocks``) at the
+    cost of one extra gathered block of peak HBM per step.
     """
     assert config.dropout_rate == 0, (
         "zero3_blocks LM runs blocks under a lax.scan with no "
@@ -110,7 +114,9 @@ def init_zero3_lm(
         def block_fn(p, h):
             return block.apply({"params": p}, h, positions)
 
-        x = z3.scan_blocks(block_fn, view.blocks, x, spec)
+        x = z3.scan_blocks(
+            block_fn, view.blocks, x, spec, unroll=gather_unroll
+        )
         h = ln_f.apply({"params": view.other["ln_f"]}, x)
         return embed.apply(
             {"params": view.other["embed"]}, h, method="attend"

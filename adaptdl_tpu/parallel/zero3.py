@@ -97,18 +97,19 @@ def gather_rows(
     row_local: jnp.ndarray, n: int, axis: str = DATA_AXIS
 ) -> jnp.ndarray:
     """This device's ``[1, shard]`` row -> the full ``[n]`` flat vector
-    (axis-invariant). Scatter + psum — the all-gather whose transpose
-    is the reduce-scatter the gradient path needs. ``n`` trims the
+    (typed VARYING over ``axis``). A true tiled ``all_gather`` — ring
+    traffic (dp-1)/dp * n per device — whose AD transpose is
+    ``psum_scatter``: each device receives exactly its own row of the
+    globally summed cotangent, again at ring cost. (The zero1/lite
+    trainer paths use a scatter+psum instead because they need an
+    axis-INVARIANT result; here every consumer wants varying anyway —
+    the view is differentiated per-device — so the all_gather halves
+    the collective bytes in both directions.) ``n`` trims the
     dp-alignment padding and must be static."""
-    dp = jax.lax.psum(1, axis)
-    shard = row_local.shape[-1]
-    full = jnp.zeros((dp * shard,), row_local.dtype)
-    full = jax.lax.pcast(full, axis, to="varying")
-    rank = jax.lax.axis_index(axis)
-    full = jax.lax.dynamic_update_slice(
-        full, row_local.reshape(-1), (rank * shard,)
+    full = jax.lax.all_gather(
+        row_local.reshape(-1), axis, tiled=True
     )
-    return jax.lax.psum(full, axis)[:n]
+    return full[:n]
 
 
 def gather_block(
@@ -122,7 +123,7 @@ def gather_block(
     (wrapped in ``jax.checkpoint`` so the gathered tree is re-gathered,
     not saved, for backward)."""
     tree = spec.unravel_block(gather_rows(row_local, spec.n_block, axis))
-    return jax.lax.pcast(tree, axis, to="varying")
+    return _ensure_varying(tree, axis)
 
 
 def _ensure_varying(tree: Any, axis: str) -> Any:
@@ -145,12 +146,23 @@ def scan_blocks(
     x: Any,
     spec: BlockSpec,
     axis: str = DATA_AXIS,
+    unroll: int = 1,
 ):
     """Apply L blocks to ``x`` with per-block gather: the canonical
     zero3-blocks layer stack. ``block_fn(block_params, x) -> x``.
     The body is checkpointed: backward re-gathers each block and
     reduce-scatters its gradient — FSDP's exact communication
     schedule, produced by AD instead of hooks.
+
+    ``unroll``: iterations unrolled per loop step (forwarded to
+    ``lax.scan``). At 1, each gather serializes before its block's
+    compute (the loop boundary bars cross-iteration scheduling). At
+    2+, consecutive block bodies share one loop body, so XLA's
+    latency-hiding scheduler can start block i+1's all-gather while
+    block i's matmuls run — FSDP's prefetch-next-shard overlap,
+    produced by the compiler instead of CUDA streams. Peak memory
+    grows by one extra gathered block per unroll step; the remat
+    (re-gather on backward) semantics are unchanged.
 
     ``x`` may be axis-invariant (e.g. computed from replicated inputs)
     or varying; the carry is pcast to varying either way because the
@@ -162,7 +174,9 @@ def scan_blocks(
         return block_fn(params_b, h), None
 
     x = _ensure_varying(x, axis)
-    out, _ = jax.lax.scan(jax.checkpoint(body), x, blocks_rows)
+    out, _ = jax.lax.scan(
+        jax.checkpoint(body), x, blocks_rows, unroll=unroll
+    )
     return out
 
 
@@ -184,7 +198,7 @@ def build_view(
         gather_rows(other_rows_local, spec.n_other, axis)
     )
     return Zero3View(
-        other=jax.lax.pcast(other, axis, to="varying"),
+        other=_ensure_varying(other, axis),
         blocks=_ensure_varying(blocks_rows_local, axis),
     )
 

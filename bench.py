@@ -748,6 +748,14 @@ def main(quick: bool = False):
                 break
         if steps >= adapt_steps or _remaining() < 90:
             break
+    # Re-decide at measurement time with the FINAL fitted perf/grad
+    # state: the in-loop decisions run on whatever statistics existed
+    # mid-adaptation, and measuring a config the policy would no
+    # longer pick makes the ratio swing run-to-run (the r3-r5 noise
+    # band) — the retention question is "the config the policy holds
+    # NOW vs fixed", so align the decision with the evaluation state.
+    metrics.fit_and_report_now()
+    loader._optimize_batch_size()
     final_atomic = loader.current_atomic_bsz
     final_accum = loader.current_accum_steps
     final_bsz = loader.current_batch_size
