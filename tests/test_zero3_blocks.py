@@ -573,6 +573,100 @@ def test_dense_checkpoint_into_z3b(tmp_path, monkeypatch):
         )
 
 
+def test_dense_transformer_checkpoint_into_z3b_lm(
+    tmp_path, monkeypatch
+):
+    """Cross-MODEL-FAMILY rescale: a plain TransformerLM job's
+    checkpoint (written through dense_lm_checkpoint_transforms' s
+    canonical {embed, ln_f, blocks layer-major} layout) restores into
+    a zero3_blocks zero3_lm trainer of the same config — weights AND
+    Adam moments — so the scheduler can switch a job's storage mode
+    between dense DP and per-layer FSDP across restarts (e.g. when a
+    rescale shrinks per-chip HBM). The two model builds share the
+    canonical tree by construction (models/zero3_lm.py mirrors
+    pipeline_lm's stacked-leaf convention)."""
+    import optax as ox
+
+    from adaptdl_tpu import checkpoint as ckpt_mod
+    from adaptdl_tpu.models import (
+        TransformerConfig,
+        init_transformer,
+        init_zero3_lm,
+        lm_loss_fn,
+    )
+    from adaptdl_tpu.models.pipeline_lm import (
+        dense_lm_checkpoint_transforms,
+    )
+
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    cfg = TransformerConfig(
+        vocab_size=64, num_layers=2, num_heads=2, d_model=32,
+        d_ff=64, max_seq_len=16, dtype=jnp.float32, remat=False,
+    )
+    rng = np.random.default_rng(17)
+    batch_np = {
+        "tokens": rng.integers(0, 64, size=(8, 9), dtype=np.int32)
+    }
+    mesh = create_mesh({"data": 4}, devices=jax.devices()[:4])
+
+    # Incarnation 0: dense TransformerLM, canonical transforms.
+    model, d_params = init_transformer(cfg, seq_len=8)
+    tr_d = ElasticTrainer(
+        lm_loss_fn(model), d_params, ox.adamw(1e-2), 8, mesh=mesh
+    )
+    save_t, load_t = dense_lm_checkpoint_transforms(cfg.num_layers)
+    holder = {"state": tr_d.init_state()}
+    ck = tr_d.make_checkpoint_state(
+        lambda: holder["state"],
+        lambda s: holder.__setitem__("state", s),
+        name="dense-to-z3b-lm",
+        transform_save=save_t,
+        transform_load=load_t,
+    )
+    step_d = tr_d.train_step(2, 0)
+    batch = tr_d.shard_batch(batch_np)
+    for _ in range(3):
+        holder["state"], m_d = step_d(holder["state"], batch)
+    ckpt_mod.save_all_states()
+    ck.unregister()
+
+    # Incarnation 1: same config as a zero3_blocks zero3_lm.
+    loss_fn, z_params = init_zero3_lm(cfg, seq_len=8)
+    tr_z = ElasticTrainer(
+        loss_fn, z_params, ox.adamw(1e-2), 8, mesh=mesh,
+        zero3_blocks="blocks",
+    )
+    holder2 = {"state": tr_z.init_state()}
+    ck2 = tr_z.make_checkpoint_state(
+        lambda: holder2["state"],
+        lambda s: holder2.__setitem__("state", s),
+        name="dense-to-z3b-lm",
+    )
+    ckpt_mod.load_state(ck2)
+    ck2.unregister()
+    assert int(holder2["state"].step) == 3
+    # The restored rows hold the dense run's weights exactly.
+    restored = tr_z.params_tree(holder2["state"])
+    host_state = jax.tree.map(
+        np.asarray,
+        holder["state"]._replace(
+            rng=jax.random.key_data(holder["state"].rng)
+        ),
+    )
+    canonical = save_t(host_state).params
+    for a, b in zip(
+        jax.tree.leaves(canonical), jax.tree.leaves(restored)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-6, atol=0
+        )
+    # And training continues (same loss scale as the dense run).
+    step_z = tr_z.train_step(2, 0)
+    _, m_z = step_z(holder2["state"], tr_z.shard_batch(batch_np))
+    assert np.isfinite(float(m_z["loss"]))
+    assert float(m_z["loss"]) < float(m_d["loss"]) + 1.0
+
+
 def test_z3b_eval_and_run_step_paths(monkeypatch):
     """eval_step hands metric_fn the Zero3View; run_step's compute-only
     calibration differentiates through the same gather schedule."""
