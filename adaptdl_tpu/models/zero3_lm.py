@@ -62,6 +62,14 @@ def init_zero3_lm(
     with the current block's compute (see ``scan_blocks``) at the
     cost of one extra gathered block of peak HBM per step.
     """
+    assert config.seq_axis is None, (
+        "init_zero3_lm builds a dp-only model (its token slicing and "
+        "positions assume the full sequence per device); the "
+        "zero3_blocks MECHANISM composes with a seq axis — write the "
+        "loss with scan_blocks(..., varying_axes=('data', 'seq')) and "
+        "seq-aware attention, cf. docs/parallelism.md and "
+        "tests/test_zero3_blocks.py::test_z3b_composes_with_sequence_parallelism"
+    )
     assert config.dropout_rate == 0, (
         "zero3_blocks LM runs blocks under a lax.scan with no "
         "per-layer dropout rng threading (same limitation as the "

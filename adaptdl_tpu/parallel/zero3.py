@@ -116,26 +116,40 @@ def gather_block(
     row_local: jnp.ndarray,
     spec: BlockSpec,
     axis: str = DATA_AXIS,
+    varying_axes=None,
 ) -> Any:
     """One block's local ``[1, shard_b]`` row -> that block's full
     parameter tree, typed varying so gradients stay per-device until
     the transpose's reduce-scatter. Call INSIDE the layer scan body
     (wrapped in ``jax.checkpoint`` so the gathered tree is re-gathered,
-    not saved, for backward)."""
+    not saved, for backward).
+
+    ``varying_axes`` (default: just the gather axis) is the full
+    varying set the MODEL runs under — with sequence parallelism the
+    gathered block must additionally vary over the seq axis, and that
+    pcast's transpose auto-psums the seq shards' cotangents before the
+    all_gather transpose reduce-scatters over data."""
     tree = spec.unravel_block(gather_rows(row_local, spec.n_block, axis))
-    return _ensure_varying(tree, axis)
+    return _ensure_varying(
+        tree, varying_axes if varying_axes is not None else axis
+    )
 
 
-def _ensure_varying(tree: Any, axis: str) -> Any:
-    """pcast leaves to varying over ``axis`` unless they already are —
-    the scan carry below must have a stable vma type, and callers
-    legitimately pass either (an axis-invariant embedding output, or a
-    batch-sharded activation that is already varying)."""
+def _ensure_varying(tree: Any, axes) -> Any:
+    """pcast leaves to varying over ``axes`` (a name or tuple of
+    names) unless they already are — the scan carry below must have a
+    stable vma type, and callers legitimately pass either (an
+    axis-invariant embedding output, or a batch-sharded activation
+    that is already varying)."""
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
 
     def cast(leaf):
-        if axis in jax.typeof(leaf).vma:
+        missing = tuple(
+            a for a in axes if a not in jax.typeof(leaf).vma
+        )
+        if not missing:
             return leaf
-        return jax.lax.pcast(leaf, axis, to="varying")
+        return jax.lax.pcast(leaf, missing, to="varying")
 
     return jax.tree.map(cast, tree)
 
@@ -147,9 +161,12 @@ def scan_blocks(
     spec: BlockSpec,
     axis: str = DATA_AXIS,
     unroll: int = 1,
+    varying_axes=None,
 ):
     """Apply L blocks to ``x`` with per-block gather: the canonical
     zero3-blocks layer stack. ``block_fn(block_params, x) -> x``.
+    ``varying_axes``: the model's full varying set when it runs under
+    more axes than the gather axis (sequence parallelism).
     The body is checkpointed: backward re-gathers each block and
     reduce-scatters its gradient — FSDP's exact communication
     schedule, produced by AD instead of hooks.
@@ -170,10 +187,11 @@ def scan_blocks(
     and ``lax.scan`` requires carry-in and carry-out types to match."""
 
     def body(h, row):
-        params_b = gather_block(row, spec, axis)
+        params_b = gather_block(row, spec, axis, varying_axes)
         return block_fn(params_b, h), None
 
-    x = _ensure_varying(x, axis)
+    axes = varying_axes if varying_axes is not None else axis
+    x = _ensure_varying(x, axes)
     out, _ = jax.lax.scan(
         jax.checkpoint(body), x, blocks_rows, unroll=unroll
     )
@@ -185,6 +203,7 @@ def build_view(
     other_rows_local: jnp.ndarray,
     spec: BlockSpec,
     axis: str = DATA_AXIS,
+    varying_axes=None,
 ) -> Zero3View:
     """Inside the manual step: this device's local rows -> the
     :class:`Zero3View` a zero3-blocks loss_fn consumes. The non-block
@@ -194,11 +213,19 @@ def build_view(
     a time. Differentiating a loss through this view hands back
     cotangents in ROW layout, already reduce-scattered (globally
     summed) through the gathers' AD transposes."""
+    axes = varying_axes if varying_axes is not None else axis
     other = spec.unravel_other(
         gather_rows(other_rows_local, spec.n_other, axis)
     )
     return Zero3View(
-        other=_ensure_varying(other, axis),
+        # The assembled values carry the model's FULL varying set (the
+        # +seq pcast's transpose is the seq-shard gradient psum)...
+        other=_ensure_varying(other, axes),
+        # ...but the block ROWS stay varying over the gather axis
+        # only: their cotangents must come back seq-INVARIANT (the
+        # storage and optimizer rows are replicated across seq), which
+        # they do because gather_block applies the +seq cast after the
+        # gather, inside the scan body.
         blocks=_ensure_varying(blocks_rows_local, axis),
     )
 

@@ -264,13 +264,12 @@ class ElasticTrainer:
                 param_sharding_fn is not None
                 or MODEL_AXIS in self.mesh.shape
                 or self.sharded_param_axes
-                or self.seq_shards > 1
             ):
                 raise ValueError(
                     "zero3_blocks shards parameter storage over the "
-                    "data axis and composes with data parallelism "
-                    "only (seq/model/stage/expert axes manage their "
-                    "own layouts)"
+                    "data axis and composes with data and sequence "
+                    "parallelism only (model/stage/expert axes "
+                    "manage their own layouts)"
                 )
             if self.num_param_groups > 1:
                 raise ValueError(
@@ -1046,6 +1045,15 @@ class ElasticTrainer:
             nu_tree,
         )
 
+    def _z3b_varying_axes(self) -> tuple:
+        """The zero3_blocks model's full varying set: gathered values
+        (and activations) vary over data plus, under sequence
+        parallelism, seq — THE single definition every z3b builder
+        (train step, eval, compute-only calibration) shares."""
+        if self.seq_shards > 1:
+            return (DATA_AXIS, SEQ_AXIS)
+        return (DATA_AXIS,)
+
     def _z3b_precond(self, opt_state_local):
         """Preconditioner under zero3_blocks: Adam's nu is a rows-dict
         mirror; this device's local rows precondition this device's
@@ -1091,6 +1099,14 @@ class ElasticTrainer:
         z3 = self._z3b
         spec = self._z3b_spec
         num_replicas = self.num_replicas
+        seq_shards = self.seq_shards
+        # The model's full varying set: a seq-sharded group is one
+        # logical replica whose members hold pieces of the same batch
+        # rows; gathered values vary over both axes, but the rows and
+        # their cotangents stay seq-invariant (the +seq pcast's
+        # transpose psums the seq shards before the reduce-scatter).
+        varying_axes = self._z3b_varying_axes()
+        grad_divisor = num_replicas * seq_shards
         num_micro = accum_steps + 1
         count = num_micro
         accum_scale = num_replicas * atomic_bsz / self.init_batch_size
@@ -1113,6 +1129,10 @@ class ElasticTrainer:
             rng = jax.random.fold_in(
                 rng, jax.lax.axis_index(DATA_AXIS)
             )
+            if seq_shards > 1:
+                rng = jax.random.fold_in(
+                    rng, jax.lax.axis_index(SEQ_AXIS)
+                )
             micro_batches = jax.tree.map(
                 lambda x: x.reshape(
                     (num_micro, atomic_bsz) + x.shape[1:]
@@ -1122,7 +1142,10 @@ class ElasticTrainer:
             micro_rngs = jax.random.split(rng, num_micro)
 
             def loss_of_rows(r, mb, mb_rng):
-                view = z3.build_view(r["blocks"], r["other"], spec)
+                view = z3.build_view(
+                    r["blocks"], r["other"], spec,
+                    varying_axes=varying_axes,
+                )
                 if self.has_aux:
                     return self.loss_fn(view, mb, mb_rng, aux)
                 return self.loss_fn(view, mb, mb_rng)
@@ -1133,11 +1156,13 @@ class ElasticTrainer:
                 loss, grad = jax.value_and_grad(loss_of_rows)(
                     rows, mb, mb_rng
                 )
-                # The row cotangent is the SUM over replicas of the
-                # per-replica mean-loss gradient (reduce-scatter);
-                # /dp makes it this microbatch's global mean gradient.
+                # The row cotangent is the SUM over every device (seq
+                # shards psum'd by the pcast transpose, data replicas
+                # by the reduce-scatter) of the per-device mean-loss
+                # gradient; /(dp*sp) makes it this microbatch's global
+                # mean gradient.
                 grad = jax.tree.map(
-                    lambda g: g / num_replicas, grad
+                    lambda g: g / grad_divisor, grad
                 )
                 grad_sum = jax.tree.map(jnp.add, grad_sum, grad)
                 # Per-microbatch GLOBAL squared norm (invariant after
@@ -1150,7 +1175,7 @@ class ElasticTrainer:
             )
             lsqr_init = jnp.zeros((1,))
             loss_init = jax.lax.pcast(
-                jnp.zeros(()), DATA_AXIS, to="varying"
+                jnp.zeros(()), varying_axes, to="varying"
             )
             init = (grad_init, lsqr_init, loss_init)
             (grad_sum, lsqr_sum, loss_sum), _ = jax.lax.scan(
@@ -1161,7 +1186,7 @@ class ElasticTrainer:
             # inside AD.
             grads = jax.tree.map(lambda g: g / num_micro, grad_sum)
             local_sqr_mean = lsqr_sum / num_micro
-            loss = jax.lax.pmean(loss_sum / num_micro, DATA_AXIS)
+            loss = jax.lax.pmean(loss_sum / num_micro, varying_axes)
 
             new_gns = gns.update(
                 state.gns,
@@ -1217,11 +1242,17 @@ class ElasticTrainer:
             }
             return new_state, metrics
 
-        state_specs = self._manual_state_specs({DATA_AXIS})
+        batch_spec = (
+            P(DATA_AXIS, SEQ_AXIS) if seq_shards > 1 else P(DATA_AXIS)
+        )
+        manual = {DATA_AXIS}
+        if seq_shards > 1:
+            manual.add(SEQ_AXIS)
+        state_specs = self._manual_state_specs(manual)
         sharded = shard_map(
             per_replica_step,
             mesh=self.mesh,
-            in_specs=(state_specs, P(DATA_AXIS), P()),
+            in_specs=(state_specs, batch_spec, P()),
             out_specs=(state_specs, P()),
         )
         jitted = jax.jit(sharded, donate_argnums=0)
@@ -1618,7 +1649,8 @@ class ElasticTrainer:
                 # does: the model's scan_blocks forward works unchanged
                 # and eval keeps the per-block memory bound.
                 params = self._z3b.build_view(
-                    params["blocks"], params["other"], self._z3b_spec
+                    params["blocks"], params["other"], self._z3b_spec,
+                    varying_axes=self._z3b_varying_axes(),
                 )
             elif self.zero3:
                 params = self._zero1_unravel(
@@ -1741,11 +1773,14 @@ class ElasticTrainer:
 
                 def loss_of_rows(r):
                     view = self._z3b.build_view(
-                        r["blocks"], r["other"], self._z3b_spec
+                        r["blocks"], r["other"], self._z3b_spec,
+                        varying_axes=self._z3b_varying_axes(),
                     )
                     return self.loss_fn(view, local_batch, rng, *extra)
 
                 loss, grads = jax.value_and_grad(loss_of_rows)(params)
+                if seq_shards > 1:
+                    loss = jax.lax.pmean(loss, SEQ_AXIS)
                 total = gns.normsqr(grads) + loss
                 return total[None]
             if self.zero3:
