@@ -367,6 +367,41 @@ def _bench_transformer_tokens(on_tpu: bool, full: bool) -> dict | None:
         except Exception as exc:  # noqa: BLE001 - optional arm
             _log(f"chunked-xent arm failed: {exc}")
 
+    # zero3_blocks arm (TPU full mode): the per-layer-FSDP flagship's
+    # steady-state tokens/s on the same shape — prices the per-block
+    # gather/reduce-scatter schedule against the dense replicated arm.
+    if full and on_tpu and _remaining() > 180:
+        try:
+            from adaptdl_tpu.models import init_zero3_lm
+
+            z_loss, z_params = init_zero3_lm(cfg, seq_len=seq_len)
+            z_trainer = ElasticTrainer(
+                loss_fn=z_loss,
+                params=z_params,
+                optimizer=optax.adamw(3e-4),
+                init_batch_size=bsz,
+                zero3_blocks="blocks",
+            )
+            z_state = z_trainer.init_state()
+            rngz = np.random.default_rng(13)
+            z_tokens = rngz.integers(
+                0, cfg.vocab_size, size=(bsz, seq_len + 1)
+            ).astype(np.int32)
+            z_batch = z_trainer.shard_batch({"tokens": z_tokens})
+            z_step = z_trainer.train_step(
+                bsz // z_trainer.num_replicas, 0
+            )
+            _, t_z, _ = _steady_state_time(z_state, z_step, z_batch, 10)
+            out["transformer_z3b_tokens_per_s"] = round(
+                bsz * seq_len / t_z, 1
+            )
+            _log(
+                f"transformer z3b: step={t_z*1e3:.1f}ms "
+                f"tokens/s={bsz*seq_len/t_z:.0f}"
+            )
+        except Exception as exc:  # noqa: BLE001 - optional arm
+            _log(f"z3b transformer arm failed: {exc}")
+
     tokens_per_s, t_step = run_arm(loss_fn, bsz)
     flops = transformer_train_flops(cfg, bsz, seq_len)
     mfu_val = mfu_fn(
@@ -522,10 +557,44 @@ def _bench_flash_attention(on_tpu: bool, full: bool) -> dict | None:
         f"flash attn: seq={S} flash={t_flash*1e3:.2f}ms "
         f"dense={t_dense*1e3:.2f}ms speedup={speedup:.3f}x"
     )
-    return {
+    out = {
         "flash_attn_ms": round(t_flash * 1e3, 3),
         "flash_attn_speedup_vs_xla": round(speedup, 3),
     }
+    # Block-size sweep (full mode): the Mosaic-compiled kernel's best
+    # (block_q, block_k) at this shape — the round-2 verdict's tuning
+    # ask, runnable the session the tunnel answers.
+    if full and _remaining() > 120:
+        import functools
+
+        best = (None, t_flash)
+        for bq in (128, 256, 512):
+            for bk in (128, 256, 512):
+                if (bq, bk) == (128, 128):
+                    continue  # the default, timed above
+                try:
+                    fa = functools.partial(
+                        flash_attention, block_q=bq, block_k=bk
+                    )
+                    t = timed(
+                        lambda q, k, v: fa(q, k, v)
+                        .astype(jnp.float32)
+                        .sum()
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    _log(f"flash sweep ({bq},{bk}) failed: {exc}")
+                    continue
+                _log(f"flash sweep ({bq},{bk}): {t*1e3:.2f}ms")
+                if t < best[1]:
+                    best = ((bq, bk), t)
+                if _remaining() < 90:
+                    break
+            if _remaining() < 90:
+                break
+        if best[0] is not None:
+            out["flash_attn_best_block"] = list(best[0])
+            out["flash_attn_best_ms"] = round(best[1] * 1e3, 3)
+    return out
 
 
 def _bench_rescale_latency(trainer_factory, dataset, init_bsz) -> float | None:
