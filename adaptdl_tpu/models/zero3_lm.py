@@ -54,7 +54,10 @@ def init_zero3_lm(
 
     ``loss_fn(view, batch, rng)`` receives the trainer's
     :class:`Zero3View` and expects ``batch["tokens"]`` of shape
-    ``[rows, seq_len + 1]``. ``params`` is the canonical TREE — the
+    ``[rows, seq_len + 1]`` — or, with ``config.seq_axis`` set
+    (long-context: seq-parallel attention + per-layer FSDP on a
+    ``data x seq`` mesh), pre-split ``batch["inputs"]``/``targets``
+    of shape ``[rows, seq_len]`` so the seq dim shards cleanly. ``params`` is the canonical TREE — the
     trainer converts it to row storage itself. The companion
     ``block_spec(params, "blocks")`` the model scan needs is derived
     here once and closed over (static layout facts, dp-independent).
@@ -62,14 +65,6 @@ def init_zero3_lm(
     with the current block's compute (see ``scan_blocks``) at the
     cost of one extra gathered block of peak HBM per step.
     """
-    assert config.seq_axis is None, (
-        "init_zero3_lm builds a dp-only model (its token slicing and "
-        "positions assume the full sequence per device); the "
-        "zero3_blocks MECHANISM composes with a seq axis — write the "
-        "loss with scan_blocks(..., varying_axes=('data', 'seq')) and "
-        "seq-aware attention, cf. docs/parallelism.md and "
-        "tests/test_zero3_blocks.py::test_z3b_composes_with_sequence_parallelism"
-    )
     assert config.dropout_rate == 0, (
         "zero3_blocks LM runs blocks under a lax.scan with no "
         "per-layer dropout rng threading (same limitation as the "
@@ -78,13 +73,23 @@ def init_zero3_lm(
     )
     rng = rng if rng is not None else jax.random.key(0)
     seq_len = seq_len or min(config.max_seq_len, 128)
-    # Blocks see plain attention: the seq/moe axes manage their own
-    # layouts and zero3_blocks composes with data parallelism only
-    # (enforced by the trainer).
-    block_config = dataclasses.replace(
-        config, seq_axis=None, attention_fn=None, moe_axis=None
-    )
+    # With ``config.seq_axis`` set, blocks run the seq-parallel
+    # attention (ring or Ulysses per config) over that axis —
+    # long-context + per-layer FSDP on a data x seq mesh. The MoE axis
+    # stays off (zero3_blocks excludes the expert axis; the trainer
+    # enforces it).
+    block_config = dataclasses.replace(config, moe_axis=None)
+    seq_axis = config.seq_axis
     block = Block(block_config)
+    # Parameter shapes don't depend on the parallelism config, and a
+    # mapped seq axis doesn't exist outside shard_map — INIT with the
+    # unsharded block, APPLY the seq-aware one (the init_transformer
+    # convention).
+    init_block = Block(
+        dataclasses.replace(
+            block_config, seq_axis=None, attention_fn=None
+        )
+    )
 
     import flax.linen as nn
 
@@ -98,7 +103,7 @@ def init_zero3_lm(
     rng, embed_rng, ln_rng = jax.random.split(rng, 3)
     layer_rngs = jax.random.split(rng, config.num_layers)
     layer_params = [
-        block.init(layer_rngs[i], dummy, positions0)["params"]
+        init_block.init(layer_rngs[i], dummy, positions0)["params"]
         for i in range(config.num_layers)
     ]
     params: dict[str, Any] = {
@@ -112,18 +117,31 @@ def init_zero3_lm(
     }
     spec = z3.block_spec(params, BLOCKS_KEY)
 
+    varying_axes = (
+        ("data", seq_axis) if seq_axis is not None else ("data",)
+    )
+
     def forward(view: z3.Zero3View, inputs):
-        """[rows, seq] tokens -> [rows, seq, vocab] logits through the
-        per-block-gather layer scan."""
+        """[rows, seq_local] tokens -> [rows, seq_local, vocab] logits
+        through the per-block-gather layer scan. Under ``seq_axis``
+        each device holds one contiguous block of the global sequence;
+        positions are offset to global so RoPE and the seq-parallel
+        causal mask line up (same convention as TransformerLM)."""
         x = embed.apply({"params": view.other["embed"]}, inputs)
         x = x.astype(config.dtype)
-        positions = jnp.arange(inputs.shape[1])
+        if seq_axis is not None:
+            positions = jax.lax.axis_index(
+                seq_axis
+            ) * inputs.shape[1] + jnp.arange(inputs.shape[1])
+        else:
+            positions = jnp.arange(inputs.shape[1])
 
         def block_fn(p, h):
             return block.apply({"params": p}, h, positions)
 
         x = z3.scan_blocks(
-            block_fn, view.blocks, x, spec, unroll=gather_unroll
+            block_fn, view.blocks, x, spec, unroll=gather_unroll,
+            varying_axes=varying_axes,
         )
         h = ln_f.apply({"params": view.other["ln_f"]}, x)
         return embed.apply(
@@ -132,8 +150,13 @@ def init_zero3_lm(
 
     def loss_fn(view, batch, rng):
         del rng  # dropout off under the block scan (cf. pipeline_lm)
-        tokens = batch["tokens"]
-        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        if seq_axis is not None:
+            # Seq-sharded batches arrive pre-split (a [rows, S+1]
+            # "tokens" leaf cannot shard its seq dim cleanly).
+            inputs, targets = batch["inputs"], batch["targets"]
+        else:
+            tokens = batch["tokens"]
+            inputs, targets = tokens[:, :-1], tokens[:, 1:]
         logits = forward(view, inputs)
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, targets
@@ -146,11 +169,17 @@ def init_zero3_lm(
 def zero3_lm_metric_fn(loss_fn):
     """``metric_fn`` for ``ElasticTrainer.eval_step`` (which hands it
     the Zero3View under zero3_blocks): partial sums of token
-    cross-entropy and accuracy."""
+    cross-entropy and accuracy. Same batch contract as the loss:
+    ``{"tokens"}`` dense, pre-split ``{"inputs","targets"}`` under
+    ``seq_axis`` (a [rows, S+1] leaf cannot shard its seq dim, and a
+    locally shifted slice would misalign with global positions)."""
 
     def metric_fn(view, batch):
-        tokens = batch["tokens"]
-        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        if "tokens" in batch:
+            tokens = batch["tokens"]
+            inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        else:
+            inputs, targets = batch["inputs"], batch["targets"]
         logits = loss_fn.forward(view, inputs)
         losses = optax.softmax_cross_entropy_with_integer_labels(
             logits, targets

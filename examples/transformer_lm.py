@@ -65,7 +65,8 @@ def main():
     # per-BLOCK rows and the layer scan gathers one block at a time
     # (models/zero3_lm.py) — per-step peak HBM is params/dp + one
     # block, where --zero3 still materializes the whole tree in-step.
-    # Composes with dp only (the trainer enforces it).
+    # Composes with dp and --seq-shards (long-context: seq-parallel
+    # attention + per-layer FSDP); tp/stage/expert are excluded.
     parser.add_argument("--zero3-blocks", action="store_true")
     # Rematerialisation policy (jax.checkpoint_policies name): trade
     # recompute FLOPs for activation HBM per block.
@@ -154,13 +155,13 @@ def main():
         assert (
             not pipeline_family
             and args.moe_experts == 0
-            and seq_shards <= 1
             and (args.tp_shards or env.model_shards()) <= 1
             and not args.flash
             and args.chunked_xent == 0
         ), (
             "--zero3-blocks shards parameter storage over the data "
-            "axis and composes with data parallelism only"
+            "axis and composes with data and sequence parallelism "
+            "only"
         )
     if args.zero3:
         args.zero1 = True  # zero3 implies the zero1 constraints below
@@ -394,7 +395,14 @@ def main():
     raw = synthetic_tokens(
         4096 if on_cpu else 65536, seq_len, config.vocab_size
     )["tokens"]
-    if stage_shards > 1 or args.zero3_blocks:
+    if args.zero3_blocks and seq_shards > 1:
+        # Long-context zero3_blocks: pre-split so the seq dim shards
+        # cleanly (models/zero3_lm.py's seq contract).
+        dataset = {
+            "inputs": raw[:, :-1].copy(),
+            "targets": raw[:, 1:].copy(),
+        }
+    elif stage_shards > 1 or args.zero3_blocks:
         # The pipelined and zero3-blocks losses consume raw token rows
         # and shift internally (models/{pipeline_lm,zero3_lm}.py).
         dataset = {"tokens": raw}
@@ -432,11 +440,6 @@ def main():
     # restarts, so ss = 1 incarnations keep advertising the stage
     # axis (canonical checkpoints restore either way).
     stage_mode = pipeline_family
-    if args.zero3_blocks:
-        # dp-only mode: a scheduler-chosen sp/tp/stage/expert rescale
-        # would crash-loop (the trainer rejects those axes under
-        # zero3_blocks), so advertise none of them.
-        max_sp = 1
     metrics.set_topology_config(
         max_seq_shards=1 if stage_mode else max_sp,
         # pallas_call is opaque to GSPMD: under a model axis the
