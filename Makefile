@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast bench bench-quick dryrun examples lint
+.PHONY: test test-fast bench bench-quick dryrun examples lint probe
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -27,3 +27,6 @@ examples:
 
 lint:
 	$(PY) -m compileall -q adaptdl_tpu examples tutorial tests bench.py __graft_entry__.py
+
+probe:
+	timeout 180 $(PY) tools/tpu_probe.py || echo "probe: tunnel dead/cpu-only"

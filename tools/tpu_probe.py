@@ -1,0 +1,59 @@
+"""Standing first action of every session: probe the TPU tunnel.
+
+Run (bounded; a wedged tunnel cannot hang the caller):
+
+    timeout 180 python tools/tpu_probe.py >> PROBE_LOG_r<N>.txt 2>&1
+
+Exit 0 with a JSON line when a chip answers (then IMMEDIATELY run
+``python bench.py`` full mode — MFU, flash block sweep, zero3_blocks
+tokens/s are all armed and budget-guarded); nonzero/timeout otherwise.
+The axon tunnel has wedged at import for rounds 4-5 (see
+PROBE_LOG_r05.txt: 11/11 probes dead); bench.py's own child-probe +
+cpu-fallback discipline remains the in-bench safety net.
+"""
+
+import json
+import time
+
+
+def main() -> int:
+    import os
+
+    t0 = time.time()
+    try:
+        import jax
+
+        if os.environ.get("TPU_PROBE_FORCE_CPU") == "1":
+            # Self-test hook: the axon plugin force-registers and its
+            # init is exactly what wedges, so validating the script's
+            # own logic needs the cpu override BEFORE first backend
+            # touch (the tests/conftest.py trick).
+            jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+        x = jax.numpy.ones((256, 256))
+        jax.block_until_ready(x @ x)
+        info = {
+            "ok": True,
+            "platform": devs[0].platform,
+            "device_kind": devs[0].device_kind,
+            "n_devices": len(devs),
+            "seconds": round(time.time() - t0, 1),
+        }
+        print(json.dumps(info), flush=True)
+        return 0 if devs[0].platform not in ("", "cpu") else 1
+    except Exception as exc:  # noqa: BLE001 - report, don't raise
+        print(
+            json.dumps(
+                {
+                    "ok": False,
+                    "err": str(exc)[:200],
+                    "seconds": round(time.time() - t0, 1),
+                }
+            ),
+            flush=True,
+        )
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
