@@ -1,0 +1,67 @@
+"""Small compatibility shims for optional/new dependencies.
+
+The framework targets recent jax (vma-typed shard_map) and a full
+container image, but must degrade on leaner environments instead of
+failing at import time:
+
+- ``pick_unused_port``: portpicker when installed, else a socket-based
+  fallback (bind port 0, read back the assignment). The fallback has a
+  marginally wider race window than portpicker's reservation protocol,
+  which is acceptable for the local-runner/test uses it serves.
+- ``pcast``: ``jax.lax.pcast`` on jax versions with the varying-manual-
+  axes type system; identity on older jax, where every value inside
+  shard_map is already implicitly varying over the manual axes so the
+  cast has nothing to record. Resolved lazily on first call so
+  importing this module stays jax-free.
+"""
+
+from __future__ import annotations
+
+
+def pick_unused_port() -> int:
+    try:
+        import portpicker
+
+        return portpicker.pick_unused_port()
+    except ImportError:
+        import socket
+
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as sock:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind(("127.0.0.1", 0))
+            return sock.getsockname()[1]
+
+
+_pcast_impl = None
+
+
+def pcast(x, axes, to):
+    """Lazy resolver: jax is only imported on first use, so consumers
+    that need nothing but ``pick_unused_port`` (runners, the forked
+    test harness — which must keep the forking parent jax-free) never
+    pay the jax import."""
+    global _pcast_impl
+    if _pcast_impl is None:
+        import jax
+
+        try:
+            _pcast_impl = jax.lax.pcast
+        except AttributeError:  # pragma: no cover - older jax
+
+            def _identity(x, axes, to):  # noqa: ARG001 - parity
+                return x
+
+            _pcast_impl = _identity
+    return _pcast_impl(x, axes, to)
+
+
+def shard_map_kwargs() -> dict:
+    """Extra shard_map kwargs for the running jax version: on pre-vma
+    jax the replication checker predates the pcast-typed carries this
+    codebase uses, so it must be disabled (``check_rep=False``); on
+    vma-era jax there is nothing to add."""
+    import jax
+
+    if hasattr(jax.lax, "pcast"):
+        return {}
+    return {"check_rep": False}  # pragma: no cover - older jax

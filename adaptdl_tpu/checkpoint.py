@@ -7,6 +7,27 @@ and atomically renamed, so an incarnation that dies mid-save can never
 corrupt the previous complete checkpoint. On restart, each state is
 restored from the newest complete checkpoint directory.
 
+Saving is a two-phase pipeline (the CheckFreq FAST'21 split):
+
+1. **snapshot** — each state captures a point-in-time copy of itself
+   on the caller's thread (:meth:`State.snapshot`). Device-backed
+   states kick their device->host transfers non-blocking first, so
+   the copies of every state overlap each other; the phase returns as
+   soon as the host copies exist and training's next step may run.
+2. **write** — a writer serializes all the snapshots in parallel into
+   a fresh temp dir, atomically renames it to the next versioned name,
+   fsyncs the parent directory (so the completed save survives power
+   loss, not just process kill), prunes superseded dirs, and runs the
+   per-state :meth:`State.commit` hooks. With ``wait=False`` the whole
+   phase runs on a background thread and only the *final* pre-exit
+   save (SIGTERM) blocks; :func:`load_state` joins any in-flight write
+   first, so reads always observe completed saves.
+
+All crash-atomicity invariants are phase-independent: a kill between
+snapshot and write, during the parallel writes, or between rename and
+prune always leaves at least one complete, self-consistent checkpoint
+on disk (tests/test_checkpoint_atomicity.py exercises each window).
+
 (reference semantics: adaptdl/adaptdl/checkpoint.py — State registry at
 :34-104, atomic save at :106-133, latest-dir selection at :180-196. The
 implementation here is new; the TPU-specific delta is that array state
@@ -17,16 +38,23 @@ different slice sizes.)
 
 from __future__ import annotations
 
+import io
 import logging
 import os
 import re
 import shutil
 import tempfile
-from typing import IO
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import IO, Any
 
 from adaptdl_tpu import env
 
 LOG = logging.getLogger(__name__)
+
+# Parallel per-state serialization width for the write phase.
+_WRITE_THREADS = 4
 
 # Dir names are checkpoint-{num_restarts}.{seq}; seq increments on each
 # save within one incarnation so a new save never deletes or overwrites
@@ -62,6 +90,25 @@ class State:
     def load(self, fileobj: IO[bytes]) -> None:
         raise NotImplementedError
 
+    def snapshot(self) -> Any:
+        """Phase 1 of the save pipeline: capture a point-in-time copy
+        of this state on the caller's thread. The default serializes
+        through :meth:`save` immediately (small host states), so a
+        state mutated after ``snapshot()`` returns never leaks into
+        the checkpoint being written. Device-backed subclasses
+        override this to kick device->host transfers non-blocking and
+        return the host copy instead, deferring serialization to
+        :meth:`write_snapshot` on the writer thread."""
+        buf = io.BytesIO()
+        self.save(buf)
+        return buf.getvalue()
+
+    def write_snapshot(self, snapshot: Any, fileobj: IO[bytes]) -> None:
+        """Phase 2: serialize a :meth:`snapshot` result to ``fileobj``.
+        Runs on the background writer thread under ``wait=False`` —
+        it must only touch the snapshot, never the live object."""
+        fileobj.write(snapshot)
+
     def commit(self) -> None:
         """Hook: the checkpoint containing this state's :meth:`save`
         output is now durably on disk (the registry rename succeeded).
@@ -76,6 +123,7 @@ class State:
 
 def _reset_registry() -> None:
     """Clear all registered states (test isolation only)."""
+    wait_for_inflight_save()
     _registry.clear()
     _bad_dirs.clear()
     _loaded_from.clear()
@@ -126,13 +174,161 @@ def latest_checkpoint_dir(root: str | None = None) -> str | None:
     return ckpts[-1][2] if ckpts else None
 
 
-def save_all_states() -> None:
-    """Sync every registered state, then write them all on rank 0."""
-    for state in list(_registry.values()):
+class AsyncSaveHandle:
+    """Handle to a pipelined save: snapshot timings are populated when
+    :func:`save_all_states` returns; write timings once the write
+    phase lands. ``wait()`` joins the background write and re-raises
+    any error it hit (the previous checkpoint is intact in that case,
+    exactly as with a failed blocking save)."""
+
+    def __init__(self):
+        self._thread: threading.Thread | None = None
+        self._exc: BaseException | None = None
+        self._done = threading.Event()
+        self.snapshot_s = 0.0
+        self.write_s = 0.0
+        # name -> {"snapshot_s": ..., "write_s": ...}
+        self.per_state: dict[str, dict[str, float]] = {}
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+
+
+_inflight_save: AsyncSaveHandle | None = None
+_atexit_registered = False
+
+
+def _ensure_atexit_join() -> None:
+    """Let an in-flight background write land before the interpreter
+    tears down: a daemon writer killed mid-serialization would both
+    lose the save and risk aborting the process mid-C-call (turning a
+    graceful exit into a counted failure)."""
+    global _atexit_registered
+    if _atexit_registered:
+        return
+    _atexit_registered = True
+    import atexit
+
+    atexit.register(wait_for_inflight_save)
+
+
+def wait_for_inflight_save() -> None:
+    """Join the in-flight background write, if any. A failed
+    background write is logged, NOT re-raised: every caller is a
+    synchronization point (the next save, a load, registry reset) for
+    which the correct response to an old failure is to proceed — the
+    previous checkpoint is intact, and aborting would e.g. turn the
+    final pre-exit SIGTERM save (the recovery attempt!) into a
+    crashed job. Callers that want the error use ``handle.wait()``."""
+    global _inflight_save
+    if _inflight_save is not None:
+        handle, _inflight_save = _inflight_save, None
+        try:
+            handle.wait()
+        except Exception:  # noqa: BLE001 - logged; old checkpoint intact
+            LOG.warning(
+                "a background checkpoint write had failed; continuing "
+                "from the previous complete checkpoint",
+                exc_info=True,
+            )
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a just-completed rename/unlink in it
+    survives power loss (os.replace alone only orders the metadata in
+    the page cache). Best-effort: some filesystems refuse directory
+    fds, and durability there degrades to the old behavior."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover - exotic filesystems
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover - exotic filesystems
+        pass
+    finally:
+        os.close(fd)
+
+
+def save_all_states(wait: bool = True) -> AsyncSaveHandle:
+    """Sync + snapshot every registered state, then write them all on
+    rank 0 — in the background when ``wait=False`` (the snapshot phase
+    always completes before this returns, so the caller may mutate
+    state immediately). The final pre-exit save must use the default
+    blocking form: it is the one save whose durability the restarting
+    incarnation depends on before this process dies."""
+    wait_for_inflight_save()
+    global _inflight_save
+    states = list(_registry.values())
+    handle = AsyncSaveHandle()
+    start = time.monotonic()
+    for state in states:
         state.sync()
     root = env.checkpoint_path()
-    if root is None or env.replica_rank() != 0:
-        return
+    rank0 = root is not None and env.replica_rank() == 0
+    snapshots: list[Any] = []
+    if rank0:
+        for state in states:
+            t0 = time.monotonic()
+            snapshots.append(state.snapshot())
+            handle.per_state[state.name] = {
+                "snapshot_s": time.monotonic() - t0
+            }
+    handle.snapshot_s = time.monotonic() - start
+    if not rank0:
+        handle._done.set()
+        return handle
+    restart = env.num_restarts()
+
+    def _write() -> None:
+        t0 = time.monotonic()
+        _write_snapshots(root, restart, states, snapshots, handle)
+        handle.write_s = time.monotonic() - t0
+        _record_save_metrics(handle)
+
+    if wait:
+        try:
+            _write()
+        finally:
+            handle._done.set()
+        return handle
+
+    def _background() -> None:
+        try:
+            _write()
+        except BaseException as exc:  # noqa: BLE001 - surfaced in wait()
+            handle._exc = exc
+            LOG.warning("background checkpoint write failed", exc_info=True)
+        finally:
+            handle._done.set()
+
+    thread = threading.Thread(
+        target=_background, name="adaptdl-ckpt-writer", daemon=True
+    )
+    handle._thread = thread
+    _inflight_save = handle
+    _ensure_atexit_join()
+    thread.start()
+    return handle
+
+
+def _write_snapshots(
+    root: str,
+    restart: int,
+    states: list["State"],
+    snapshots: list[Any],
+    handle: AsyncSaveHandle,
+) -> None:
+    """The write phase: parallel per-state serialization into a fresh
+    temp dir, atomic rename to the next versioned name, parent-dir
+    fsync, prune, commit hooks."""
     os.makedirs(root, exist_ok=True)
     existing = _list_checkpoints(root)
     # Write into a fresh temp dir on the same filesystem, then atomically
@@ -140,18 +336,46 @@ def save_all_states() -> None:
     # is only deleted after this one fully exists, so a kill at any point
     # leaves at least one complete checkpoint on disk.
     tmpdir = tempfile.mkdtemp(prefix=_TMP_PREFIX, dir=root)
-    try:
-        for state in _registry.values():
-            with open(os.path.join(tmpdir, state.name), "wb") as f:
-                state.save(f)
-        seq = next_save_seq(existing, env.num_restarts())
-        final = os.path.join(
-            root, f"checkpoint-{env.num_restarts()}.{seq}"
+
+    def write_one(state: "State", snap: Any) -> None:
+        t0 = time.monotonic()
+        with open(os.path.join(tmpdir, state.name), "wb") as f:
+            state.write_snapshot(snap, f)
+            f.flush()
+            os.fsync(f.fileno())
+        handle.per_state.setdefault(state.name, {})["write_s"] = (
+            time.monotonic() - t0
         )
+
+    try:
+        if len(states) > 1:
+            with ThreadPoolExecutor(
+                max_workers=min(len(states), _WRITE_THREADS),
+                thread_name_prefix="adaptdl-ckpt",
+            ) as pool:
+                futures = [
+                    pool.submit(write_one, state, snap)
+                    for state, snap in zip(states, snapshots)
+                ]
+                for future in futures:
+                    future.result()
+        elif states:
+            write_one(states[0], snapshots[0])
+        seq = next_save_seq(existing, restart)
+        final = os.path.join(root, f"checkpoint-{restart}.{seq}")
+        # The state files' directory ENTRIES live in tmpdir's own
+        # directory inode: without this fsync a power loss after the
+        # rename could leave a complete-looking checkpoint dir with
+        # missing files (which load_state would silently skip).
+        _fsync_dir(tmpdir)
         os.replace(tmpdir, final)
     except BaseException:
         shutil.rmtree(tmpdir, ignore_errors=True)
         raise
+    # The rename is only durable once the parent directory is synced;
+    # without this a power loss after "success" could roll back to the
+    # pre-save state (or worse, to the pruned state below).
+    _fsync_dir(root)
     # Prune everything superseded by the save that just completed,
     # including temp dirs abandoned by crashed incarnations.
     for _, _, path in existing:
@@ -159,8 +383,22 @@ def save_all_states() -> None:
     for entry in os.listdir(root):
         if entry.startswith(_TMP_PREFIX):
             shutil.rmtree(os.path.join(root, entry), ignore_errors=True)
-    for state in list(_registry.values()):
+    _fsync_dir(root)
+    for state in states:
         state.commit()
+
+
+def _record_save_metrics(handle: AsyncSaveHandle) -> None:
+    """Feed measured save timings to the metrics engine (best-effort;
+    a metrics hiccup must never fail a completed save)."""
+    try:
+        from adaptdl_tpu import metrics as metrics_mod
+
+        metrics_mod.record_checkpoint_save(
+            handle.snapshot_s, handle.write_s, dict(handle.per_state)
+        )
+    except Exception:  # noqa: BLE001 - observability is best-effort
+        LOG.debug("failed to record checkpoint metrics", exc_info=True)
 
 
 # Checkpoint dirs found unreadable by ANY state this process: every
@@ -201,6 +439,10 @@ def load_state(state: State) -> bool:
     root = env.checkpoint_path()
     if root is None:
         return False
+    # Read-your-writes: a load issued while a background write phase
+    # is in flight must observe the completed save, not the previous
+    # checkpoint the rename hasn't superseded yet.
+    wait_for_inflight_save()
     attempted = False
     for _, _, ckpt in reversed(_list_checkpoints(root)):
         if ckpt in _bad_dirs:
@@ -208,6 +450,7 @@ def load_state(state: State) -> bool:
         path = os.path.join(ckpt, state.name)
         if not os.path.isfile(path):
             continue
+        t0 = time.monotonic()
         try:
             with open(path, "rb") as f:
                 state.load(f)
@@ -223,6 +466,14 @@ def load_state(state: State) -> bool:
             _poison_dir(ckpt)
             continue
         _loaded_from[state.name] = ckpt
+        try:
+            from adaptdl_tpu import metrics as metrics_mod
+
+            metrics_mod.record_checkpoint_restore(
+                state.name, time.monotonic() - t0
+            )
+        except Exception:  # noqa: BLE001 - observability is best-effort
+            pass
         return True
     if attempted:
         raise CheckpointUnreadableError(

@@ -50,6 +50,7 @@ from adaptdl_tpu import (
     collective,
     env,
     metrics,
+    sched_hints,
 )
 
 LOG = logging.getLogger(__name__)
@@ -154,7 +155,17 @@ class AdaptiveDataLoader:
         self._loops_started: dict[int, int] = {}
         self._exit_future = None
         self._reoptimize_every = 50  # optimizer steps between re-opts
+        # Periodic fault-tolerance saves (ADAPTDL_CKPT_EVERY_STEPS):
+        # deterministic in the step counter so every replica calls the
+        # collective sync() in lockstep; pipelined (wait=False) so
+        # only the snapshot phase blocks the loop.
+        self._ckpt_every_steps = env.checkpoint_every_steps()
         self._last_profiled_config: tuple[int, int] | None = None
+        # True once a (bsz, accum) decision has been taken this
+        # incarnation: only *changes* after that count as live
+        # re-tunes (the first decision is initialization, not a
+        # rescale avoided).
+        self._decided_once = False
         metrics.set_batch_size_config(batch_size)
         self._checkpoint = _DataLoaderCheckpoint(name, self)
         checkpoint.load_state(self._checkpoint)
@@ -211,12 +222,33 @@ class AdaptiveDataLoader:
         else:
             decision = None
         decision = collective.broadcast(decision)
+        self.apply_retune(*decision)
+
+    def apply_retune(self, atomic_bsz: int, accum_steps: int) -> None:
+        """Adopt a new (atomic_bsz, accum_steps) IN-PROCESS — the live
+        re-tune fast path. The sampler position, epoch bookkeeping,
+        and the trainer's jit cache (keyed by these shapes) all carry
+        over; nothing restarts and ``ADAPTDL_NUM_RESTARTS`` does not
+        move. Must be called with the same values on every replica
+        (the internal path broadcasts from rank 0)."""
+        decision = (max(int(atomic_bsz), 1), max(int(accum_steps), 0))
+        changed = decision != (self._atomic_bsz, self._accum_steps)
         self._atomic_bsz, self._accum_steps = decision
+        if changed and self._decided_once:
+            LOG.info(
+                "live re-tune: atomic_bsz=%d accum_steps=%d "
+                "(no restart)", *decision,
+            )
+            metrics.record_retune()
+        self._decided_once = True
 
     def _rank0_decision(self) -> tuple[int, int]:
         num_replicas = env.num_replicas()
         if self._max_batch_size is None:
             return max(self.batch_size // num_replicas, 1), 0
+        remote = self._supervisor_decision(num_replicas)
+        if remote is not None:
+            return remote
         goodput_fn = metrics.get_goodput_fn()
         if goodput_fn is None:
             # No fitted model yet: split the initial batch size.
@@ -303,6 +335,51 @@ class AdaptiveDataLoader:
             return atomic_bsz, int(accum_steps)
         return self._atomic_bsz, self._accum_steps
 
+    def _supervisor_decision(
+        self, num_replicas: int
+    ) -> tuple[int, int] | None:
+        """The allocator's published (atomicBsz, accumSteps) for this
+        job, if any — computed from the same fitted goodput model the
+        local path uses, already hysteresis-filtered, and counted by
+        the supervisor as a live re-tune rather than a restart. The
+        fetch is best-effort (rank 0 only, re-optimization cadence):
+        None falls back to the local decision."""
+        remote = sched_hints.fetch_job_config()
+        if not remote or not remote.get("batchConfig"):
+            return None
+        # The published config belongs to the published ALLOCATION. If
+        # the allocator just decided a different device set, this
+        # incarnation is about to be restarted — adopting a config
+        # sized for the future world would skew the remaining steps'
+        # profile for nothing.
+        allocation = remote.get("allocation") or []
+        if allocation and len(allocation) != num_replicas:
+            return None
+        cfg = remote["batchConfig"]
+        try:
+            atomic = bucket_atomic_bsz(int(cfg.get("atomicBsz", 0)))
+            accum = max(int(cfg.get("accumSteps", 0)), 0)
+        except (TypeError, ValueError):
+            return None
+        if atomic < 1:
+            return None
+        # Same bucketing/bounds discipline as a local decision: the
+        # allocator optimizes off the recompile grid and without the
+        # sp/tp activation-sharding allowance.
+        sp, tp, _, _, _ = metrics.active_topology()
+        if self._local_bsz_bounds is not None:
+            atomic = int(
+                np.clip(
+                    atomic,
+                    self._local_bsz_bounds[0],
+                    self._local_bsz_bounds[1] * sp * tp,
+                )
+            )
+        total = num_replicas * atomic * (accum + 1)
+        if total > self._max_batch_size:
+            return None
+        return atomic, accum
+
     # -- elasticity ----------------------------------------------------
 
     def _check_exit(self) -> None:
@@ -388,6 +465,11 @@ class AdaptiveDataLoader:
                 steps += 1
                 if steps % self._reoptimize_every == 0:
                     self._optimize_batch_size()
+                if (
+                    self._ckpt_every_steps
+                    and steps % self._ckpt_every_steps == 0
+                ):
+                    checkpoint.save_all_states(wait=False)
             self._loops_finished[epoch] = finished + 1
             # Dead bookkeeping from earlier epochs never replays.
             for key in [k for k in self._loops_finished if k < epoch]:

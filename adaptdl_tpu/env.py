@@ -169,6 +169,16 @@ def num_restarts() -> int:
     return _get_int("ADAPTDL_NUM_RESTARTS", 0)
 
 
+def checkpoint_every_steps() -> int:
+    """Periodic fault-tolerance checkpoint cadence, in dataloader
+    steps (0 = disabled: only the final pre-exit save). Periodic
+    saves use the pipelined non-blocking form — the snapshot phase
+    blocks the loop briefly, the write overlaps the following steps —
+    so the cost of surviving a power loss is the snapshot, not the
+    full serialization."""
+    return _get_int("ADAPTDL_CKPT_EVERY_STEPS", 0)
+
+
 def supervisor_url() -> str | None:
     """Base URL of the cluster supervisor (rendezvous + sched hints)."""
     return _get_str("ADAPTDL_SUPERVISOR_URL")

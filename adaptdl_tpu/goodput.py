@@ -698,7 +698,11 @@ def fit_perf_params(
         out[:n] = a
         return out
 
-    with jax.enable_x64():
+    try:  # jax >= 0.5 exposes enable_x64 at top level
+        _enable_x64 = jax.enable_x64
+    except AttributeError:  # pragma: no cover - older jax
+        from jax.experimental import enable_x64 as _enable_x64
+    with _enable_x64():
         args64 = tuple(
             jnp.asarray(a, dtype=jnp.float64)
             for a in (

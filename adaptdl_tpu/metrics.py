@@ -96,6 +96,18 @@ class MetricsState:
     # (0 = plain GPipe only); see parallel/pipeline.py.
     pipeline_chunks: int = 0
     progress: float = 0.0
+    # Measured checkpoint pipeline timings (checkpoint.save_all_states
+    # records them): the last save's snapshot/write phase durations,
+    # per-state breakdowns, and per-state restore durations from this
+    # incarnation's startup. Together they price a rescale from
+    # measurements instead of the policy's assumed restart penalty.
+    ckpt_snapshot_s: float | None = None
+    ckpt_write_s: float | None = None
+    ckpt_per_state: dict = field(default_factory=dict)
+    restore_per_state: dict = field(default_factory=dict)
+    # In-process (atomic_bsz, accum) re-tunes adopted without a
+    # checkpoint-restart (the live re-tune fast path).
+    num_retunes: int = 0
 
 
 _state = MetricsState()
@@ -262,6 +274,51 @@ def profile_step(
     _maybe_fit_and_report()
 
 
+def record_checkpoint_save(
+    snapshot_s: float, write_s: float, per_state: dict
+) -> None:
+    """Measured phase durations of the last completed save (called by
+    the checkpoint writer; snapshot is the training-blocking part,
+    write overlaps the next steps under the async pipeline)."""
+    _state.ckpt_snapshot_s = float(snapshot_s)
+    _state.ckpt_write_s = float(write_s)
+    _state.ckpt_per_state = dict(per_state)
+
+
+def record_checkpoint_restore(name: str, seconds: float) -> None:
+    """Measured restore duration of one state at incarnation start."""
+    _state.restore_per_state[name] = float(seconds)
+
+
+def record_retune() -> None:
+    """An in-process (atomic_bsz, accum) re-tune was adopted — a
+    rescale that cost zero restarts."""
+    _state.num_retunes += 1
+
+
+def restart_stats() -> dict | None:
+    """Measured rescale-cost components for the sched-hints payload:
+    ``snapshotS``/``writeS`` from the last save, ``restoreS`` summed
+    over this incarnation's state restores, ``overlapFrac`` = the
+    fraction of the save pipeline that runs off the training critical
+    path (write / (snapshot + write)). None until something has been
+    measured."""
+    if _state.ckpt_snapshot_s is None and not _state.restore_per_state:
+        return None
+    stats: dict = {"numRetunes": _state.num_retunes}
+    if _state.ckpt_snapshot_s is not None:
+        snap, write = _state.ckpt_snapshot_s, _state.ckpt_write_s or 0.0
+        stats["snapshotS"] = round(snap, 4)
+        stats["writeS"] = round(write, 4)
+        if snap + write > 0:
+            stats["overlapFrac"] = round(write / (snap + write), 4)
+    if _state.restore_per_state:
+        stats["restoreS"] = round(
+            sum(_state.restore_per_state.values()), 4
+        )
+    return stats
+
+
 def update_grad_params(sqr: float, var: float) -> None:
     """Latest GNS estimates from the train step's fused statistics."""
     _state.grad_params = GradParams(sqr=float(sqr), var=float(var))
@@ -340,7 +397,14 @@ def _maybe_fit_and_report(
     if env.replica_rank() != 0:
         return
     # Fit in the background: the refit compiles/solves on the host and
-    # must never stall the training step loop.
+    # must never stall the training step loop. Pre-vma jax (no
+    # jax.lax.pcast) has a CPU runtime that is not safe for concurrent
+    # dispatch from a second thread — run the fit inline there.
+    import jax as _jax
+
+    if not hasattr(_jax.lax, "pcast"):  # pragma: no cover - older jax
+        fit_and_report_now()
+        return
     global _fit_thread
     if _fit_thread is None or not _fit_thread.is_alive():
         _fit_thread = threading.Thread(
@@ -392,6 +456,12 @@ def fit_and_report_now() -> None:
     hints["maxPipelineMicro"] = _state.max_pipeline_micro
     hints["pipelineMicrobatches"] = _topology_suffix()[4]
     hints["pipelineChunks"] = _state.pipeline_chunks
+    stats = restart_stats()
+    if stats is not None:
+        # Measured rescale cost: the supervisor prices checkpoint-
+        # restart decisions against these instead of an assumed
+        # penalty (sched/allocator.job_info_from_hints).
+        hints["restartStats"] = stats
     if _state.grad_params is not None:
         hints["gradParams"] = dict(_state.grad_params._asdict())
     if _state.perf_params is not None:
@@ -444,6 +514,14 @@ class _MetricsCheckpoint(checkpoint.State):
             "pipeline_microbatches": _state.pipeline_microbatches,
             "max_pipeline_micro": _state.max_pipeline_micro,
             "progress": _state.progress,
+            # The save that persists this payload is still in flight
+            # when these are read back, so they describe the PREVIOUS
+            # save — exactly what a restarted incarnation can report
+            # before its own first save completes.
+            "ckpt_snapshot_s": _state.ckpt_snapshot_s,
+            "ckpt_write_s": _state.ckpt_write_s,
+            "ckpt_per_state": dict(_state.ckpt_per_state),
+            "num_retunes": _state.num_retunes,
         }
         pickle.dump(payload, fileobj)
 
@@ -483,6 +561,10 @@ class _MetricsCheckpoint(checkpoint.State):
             "max_pipeline_micro", max(8, old_micro)
         )
         _state.progress = payload["progress"]
+        _state.ckpt_snapshot_s = payload.get("ckpt_snapshot_s")
+        _state.ckpt_write_s = payload.get("ckpt_write_s")
+        _state.ckpt_per_state = dict(payload.get("ckpt_per_state", {}))
+        _state.num_retunes = int(payload.get("num_retunes", 0))
 
 
 def ensure_checkpoint_registered() -> None:

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from adaptdl_tpu._compat import pcast as _pcast
 from adaptdl_tpu.parallel.mesh import STAGE_AXIS
 
 
@@ -71,7 +72,7 @@ def gpipe(
     # stage's activations), while micro_inputs is replicated across
     # the stage group — pcast the init so the scan carry types line up
     # under shard_map's vma tracking.
-    zero_act = lax.pcast(
+    zero_act = _pcast(
         micro_inputs[0] * 0.0, axis_name, to="varying"
     )
 
@@ -183,7 +184,7 @@ def interleaved_pipeline(
     ticks = v * num_micro + num_stages - 1
     perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
 
-    zero_act = lax.pcast(
+    zero_act = _pcast(
         micro_inputs[0] * 0.0, axis_name, to="varying"
     )
     # buffer[m] = activation for microbatch m at this device's

@@ -15,6 +15,8 @@ import logging
 import threading
 import time
 
+import numpy as np
+
 from adaptdl_tpu.goodput import GoodputFunction, GradParams, PerfParams
 from adaptdl_tpu.sched.policy import (
     JobInfo,
@@ -29,6 +31,34 @@ from adaptdl_tpu.sched.state import (
 )
 
 LOG = logging.getLogger(__name__)
+
+
+# Amortization horizon for measured restart costs: moving a job is
+# priced as restart_seconds / this horizon (clamped), i.e. a rescale
+# should pay for itself within ~5 minutes of the new allocation's
+# goodput — the same order as the reference's reallocation cadence.
+RESTART_AMORTIZATION_S = 300.0
+
+
+def restart_penalty_from_stats(stats: dict | None) -> float | None:
+    """Fractional goodput penalty from a job's measured rescale-cost
+    components (metrics.restart_stats schema). Only the phases on the
+    rescale critical path count: the final pre-exit save blocks
+    (snapshot + write) and the restore blocks the new incarnation;
+    steady-state saves overlap training and are free. None when
+    nothing was measured — the policy keeps its assumed default."""
+    if not stats:
+        return None
+    cost = 0.0
+    measured = False
+    for key in ("snapshotS", "writeS", "restoreS"):
+        value = stats.get(key)
+        if value is not None:
+            cost += max(float(value), 0.0)
+            measured = True
+    if not measured:
+        return None
+    return float(np.clip(cost / RESTART_AMORTIZATION_S, 0.005, 0.5))
 
 
 def job_info_from_hints(
@@ -81,6 +111,9 @@ def job_info_from_hints(
         min_replicas=min_replicas,
         max_replicas=max(max_replicas, max(min_replicas, 1)),
         preemptible=preemptible,
+        restart_penalty=restart_penalty_from_stats(
+            (hints or {}).get("restartStats")
+        ),
     )
 
 
@@ -163,11 +196,12 @@ class Allocator:
             # factorizations would otherwise flap across perf refits
             # and restart the job every cycle.
             topology = None
+            batch_config = None
             best_config = getattr(
                 jobs[key].speedup_fn, "best_config_with_hysteresis", None
             )
             if best_config is not None and alloc:
-                _, _, sp, tp, ss, ep, micro = best_config(
+                bsz, accum, sp, tp, ss, ep, micro = best_config(
                     len(set(alloc)), len(alloc), record.topology
                 )
                 topology = {
@@ -177,15 +211,38 @@ class Allocator:
                     "expertShards": ep,
                     "pipelineMicro": micro,
                 }
-            changed = record.allocation != alloc or normalize_topology(
+                if bsz > 0:
+                    batch_config = {
+                        "atomicBsz": int(bsz),
+                        "accumSteps": int(accum),
+                    }
+            # Classify the decision. A change to the device set or the
+            # mesh factorization needs checkpoint-restart; a change to
+            # only the per-replica batch configuration is a LIVE
+            # RE-TUNE — published without touching allocation/topology
+            # so the worker backend never restarts the job, and the
+            # job adopts it in-process (data.AdaptiveDataLoader).
+            reallocate = record.allocation != alloc or normalize_topology(
                 record.topology
             ) != normalize_topology(topology)
-            if changed:
+            if reallocate:
                 LOG.info("allocation %s: %s -> %s (topology %s)", key,
                          record.allocation, alloc, topology)
                 self._state.update(
-                    key, allocation=alloc, topology=topology
+                    key,
+                    allocation=alloc,
+                    topology=topology,
+                    batch_config=batch_config,
                 )
+            elif (
+                batch_config is not None
+                and batch_config != record.batch_config
+            ):
+                LOG.info(
+                    "re-tune %s: batch config %s -> %s (no restart)",
+                    key, record.batch_config, batch_config,
+                )
+                self._state.publish_retune(key, batch_config)
         return allocations
 
     def start(self) -> None:

@@ -30,7 +30,7 @@ import subprocess
 import sys
 import time
 
-import portpicker
+from adaptdl_tpu._compat import pick_unused_port
 
 from adaptdl_tpu._signal import GRACEFUL_EXIT_CODE
 from adaptdl_tpu.sched.allocator import Allocator
@@ -97,7 +97,7 @@ class LocalElasticRunner:
                 "ADAPTDL_JOB_ID": self.job_name,
                 "ADAPTDL_CHECKPOINT_PATH": self.checkpoint_dir,
                 "ADAPTDL_MASTER_ADDR": "127.0.0.1",
-                "ADAPTDL_MASTER_PORT": str(portpicker.pick_unused_port()),
+                "ADAPTDL_MASTER_PORT": str(pick_unused_port()),
                 "ADAPTDL_REPLICA_RANK": "0",
                 "ADAPTDL_NUM_REPLICAS": str(num_replicas),
                 "ADAPTDL_NUM_PROCESSES": "1",
@@ -186,13 +186,34 @@ class LocalElasticRunner:
     ):
         """Wait for the process; SIGTERM it if the allocation or the
         chosen topology moves, escalating to SIGKILL if the grace
-        period expires. Returns (exit_code, we_signalled_it)."""
+        period expires. Returns (exit_code, we_signalled_it).
+
+        Batch-config-only decisions (the allocator's live re-tunes)
+        deliberately do NOT signal the job: it adopts them in-process
+        through the supervisor's /config endpoint, keeping its
+        dataloader position and jit caches — a rescale with zero
+        restarts. Only device-set or mesh-factorization changes pay
+        the checkpoint-restart path."""
         signalled = False
         term_deadline = None
+        seen_retunes = 0
+        record = self.state.get_job(self.job_name)
+        if record is not None:
+            seen_retunes = record.retunes
         while True:
             code = proc.poll()
             if code is not None:
                 return code, signalled
+            record = self.state.get_job(self.job_name)
+            if record is not None and record.retunes > seen_retunes:
+                LOG.info(
+                    "live re-tune #%d for %s: batch config %s "
+                    "(no restart)",
+                    record.retunes,
+                    self.job_name,
+                    record.batch_config,
+                )
+                seen_retunes = record.retunes
             current, cur_topology = self.state.get_launch_config(
                 self.job_name
             )

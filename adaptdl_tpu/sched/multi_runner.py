@@ -26,7 +26,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
-import portpicker
+from adaptdl_tpu._compat import pick_unused_port
 
 from adaptdl_tpu._signal import GRACEFUL_EXIT_CODE
 from adaptdl_tpu.sched.allocator import Allocator
@@ -117,7 +117,7 @@ class MultiJobRunner:
                 "ADAPTDL_CHECKPOINT_PATH": job.checkpoint_dir,
                 "ADAPTDL_MASTER_ADDR": "127.0.0.1",
                 "ADAPTDL_MASTER_PORT": str(
-                    portpicker.pick_unused_port()
+                    pick_unused_port()
                 ),
                 "ADAPTDL_REPLICA_RANK": "0",
                 "ADAPTDL_NUM_REPLICAS": str(num_replicas),

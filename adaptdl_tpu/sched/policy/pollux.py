@@ -337,6 +337,17 @@ class _Problem:
         self._cap = np.stack(caps)  # (jobs, nodes)
         self._min_replicas = np.array([j.min_replicas for j in jobs])
         self._max_replicas = np.array([j.max_replicas for j in jobs])
+        # Per-job restart pricing: measured (from posted checkpoint/
+        # restore timings) when the job reports it, the assumed
+        # default otherwise.
+        self._restart_penalty = np.array(
+            [
+                RESTART_PENALTY
+                if job.restart_penalty is None
+                else float(job.restart_penalty)
+                for job in jobs
+            ]
+        )
 
     # -- objectives ----------------------------------------------------
 
@@ -360,7 +371,9 @@ class _Problem:
         speedups = self._speedups(states)
         scaled = speedups * self._dominant_share * len(self.nodes)
         moved = (states != self.base_state).any(axis=2)
-        scaled = np.where(moved, scaled * (1 - RESTART_PENALTY), scaled)
+        scaled = np.where(
+            moved, scaled * (1 - self._restart_penalty[None, :]), scaled
+        )
         return np.column_stack(
             [-scaled.sum(axis=1), self._cluster_sizes(states)]
         )

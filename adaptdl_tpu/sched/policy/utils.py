@@ -16,6 +16,13 @@ class JobInfo:
     min_replicas: int = 0
     max_replicas: int = 1
     preemptible: bool = True
+    # Fractional goodput discount the policy applies to solutions that
+    # move this job off its current allocation. None -> the policy's
+    # assumed default; jobs that report measured checkpoint/restore
+    # timings get a measured value instead (allocator.job_info_from_
+    # hints), so cheap-to-rescale jobs move freely and expensive ones
+    # stay put.
+    restart_penalty: float | None = None
 
     def __post_init__(self):
         assert self.max_replicas > 0

@@ -55,6 +55,15 @@ class JobRecord:
     # {"seqShards": s, "modelShards": t} (exported to the job as
     # ADAPTDL_SEQ_SHARDS / ADAPTDL_MODEL_SHARDS by the launcher).
     topology: dict | None = None
+    # Scheduler-chosen per-replica batch configuration
+    # ({"atomicBsz": b, "accumSteps": a}) for the current allocation.
+    # Unlike allocation/topology, a change here is a LIVE RE-TUNE: the
+    # job adopts it in-process (jit cache keyed by shape, dataloader
+    # position kept) and is never restarted for it.
+    batch_config: dict | None = None
+    # Count of batch-config-only decisions published (re-tunes that
+    # cost zero restarts) — the observability counterpart of `group`.
+    retunes: int = 0
     status: str = "Pending"  # Pending|Starting|Running|Stopping|Succeeded|Failed
     # rank -> address ("host:port"), registered by running workers.
     workers: dict[int, str] = field(default_factory=dict)
@@ -134,6 +143,23 @@ class ClusterState:
                 list(record.allocation),
                 dict(record.topology) if record.topology else None,
             )
+
+    def get_batch_config(self, key: str) -> dict | None:
+        with self._cond:
+            record = self._jobs.get(key)
+            if record is None or record.batch_config is None:
+                return None
+            return dict(record.batch_config)
+
+    def publish_retune(self, key: str, batch_config: dict) -> None:
+        """Record a batch-config-only decision: updates the published
+        config and bumps the re-tune counter atomically (read-modify-
+        write under the lock, unlike a bare ``update()``)."""
+        with self._cond:
+            record = self._jobs[key]
+            record.batch_config = dict(batch_config)
+            record.retunes += 1
+            self._cond.notify_all()
 
     def jobs(self) -> dict[str, JobRecord]:
         with self._cond:

@@ -91,6 +91,26 @@ class Supervisor(ThreadedHttpServer):
             return web.json_response({"error": "no such job"}, status=404)
         return web.json_response(record.hints or {})
 
+    async def _get_config(self, request: web.Request) -> web.Response:
+        """The cluster's current decision for a job, as one snapshot:
+        allocation + topology (changes mean checkpoint-restart) and
+        the batch config + re-tune counter (changes are adopted live,
+        in-process — the re-tune fast path). Jobs poll this from the
+        dataloader's re-optimization cadence."""
+        key = "{namespace}/{name}".format(**request.match_info)
+        record = self._state.get_job(key)
+        if record is None:
+            return web.json_response({"error": "no such job"}, status=404)
+        return web.json_response(
+            {
+                "allocation": list(record.allocation),
+                "topology": record.topology,
+                "batchConfig": record.batch_config,
+                "retunes": record.retunes,
+                "group": record.group,
+            }
+        )
+
     async def _healthz(self, request: web.Request) -> web.Response:
         return web.json_response({"ok": True})
 
@@ -103,6 +123,7 @@ class Supervisor(ThreadedHttpServer):
             "# TYPE adaptdl_jobs gauge",
             "# TYPE adaptdl_job_replicas gauge",
             "# TYPE adaptdl_job_batch_size gauge",
+            "# TYPE adaptdl_job_retunes_total counter",
             "# TYPE adaptdl_job_submissions_total counter",
             f"adaptdl_job_submissions_total "
             f"{lifecycle['submitted_total']}",
@@ -134,6 +155,9 @@ class Supervisor(ThreadedHttpServer):
                 f"adaptdl_job_replicas{{{label}}} "
                 f"{len(record.allocation)}"
             )
+            lines.append(
+                f"adaptdl_job_retunes_total{{{label}}} {record.retunes}"
+            )
             hints = record.hints or {}
             if hints.get("initBatchSize"):
                 lines.append(
@@ -160,6 +184,7 @@ class Supervisor(ThreadedHttpServer):
                 ),
                 web.put("/hints/{namespace}/{name}", self._put_hints),
                 web.get("/hints/{namespace}/{name}", self._get_hints),
+                web.get("/config/{namespace}/{name}", self._get_config),
                 web.get("/healthz", self._healthz),
                 web.get("/metrics", self._metrics),
             ]

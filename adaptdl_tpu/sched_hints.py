@@ -10,6 +10,7 @@ adaptdl/adaptdl/sched_hints.py:33-59).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any
 
 from adaptdl_tpu import env
@@ -51,6 +52,12 @@ SCHED_HINTS_KEYS = (
     "maxPipelineMicro",
     "pipelineMicrobatches",
     "pipelineChunks",
+    # Measured rescale-cost components (metrics.restart_stats):
+    # snapshotS/writeS of the last checkpoint save, restoreS of this
+    # incarnation's restore, overlapFrac, numRetunes — the allocator
+    # prices checkpoint-restart moves with these instead of the
+    # assumed default penalty.
+    "restartStats",
 )
 
 
@@ -73,6 +80,48 @@ def validate_hints(hints: dict[str, Any]) -> None:
         missing = set(GRAD_PARAMS_KEYS) - set(hints["gradParams"])
         if missing:
             raise ValueError(f"gradParams missing {sorted(missing)}")
+    if hints.get("restartStats") is not None and not isinstance(
+        hints["restartStats"], dict
+    ):
+        raise ValueError("restartStats must be an object")
+
+
+# After a failed /config fetch, skip further fetches for this long —
+# a dead supervisor must not tax every re-optimization cycle.
+_FETCH_BACKOFF_S = 60.0
+_fetch_backoff_until = 0.0
+
+
+def fetch_job_config(job_id: str | None = None) -> dict | None:
+    """GET the supervisor's current decision for this job (allocation,
+    topology, batchConfig, retunes) — the cluster -> job half of the
+    live re-tune fast path. Best-effort like hint posting: training
+    never blocks on the scheduler being reachable; None on any
+    failure."""
+    url = env.supervisor_url()
+    job_id = job_id if job_id is not None else env.job_id()
+    if not url or not job_id:
+        return None
+    global _fetch_backoff_until
+    now = time.monotonic()
+    if now < _fetch_backoff_until:
+        return None
+    try:
+        import requests
+
+        # Sub-second connect budget: this runs on the training thread
+        # (rank 0, re-optimization cadence) — an unreachable
+        # supervisor must cost a fraction of a step, not seconds.
+        response = requests.get(
+            f"{url}/config/{job_id}", timeout=(0.5, 2)
+        )
+        response.raise_for_status()
+        payload = response.json()
+        return payload if isinstance(payload, dict) else None
+    except Exception as exc:  # noqa: BLE001 - best effort by design
+        LOG.debug("failed to fetch job config: %s", exc)
+        _fetch_backoff_until = now + _FETCH_BACKOFF_S
+        return None
 
 
 def post_sched_hints(

@@ -103,6 +103,11 @@ class ShardedTrainerCheckpoint(checkpoint.State):
         self._set_state = set_state
         self._sharding_fn = sharding_fn
         self._last_payload_dir: str | None = None
+        # Orbax checkpointer with its array write still in flight
+        # (StandardCheckpointer is an AsyncCheckpointer: save()
+        # returns once the on-device data is snapshotted and the
+        # write continues in the background).
+        self._pending_checkpointer = None
 
     # -- State protocol ----------------------------------------------
 
@@ -257,12 +262,27 @@ class ShardedTrainerCheckpoint(checkpoint.State):
         except Exception:  # noqa: BLE001 - metadata is best-effort
             return None
 
+    def _finish_pending(self) -> None:
+        """Join this state's in-flight orbax write, if any. Saves are
+        serialized per state so the payload-dir scan (seq allocation)
+        always sees every finalized predecessor."""
+        pending, self._pending_checkpointer = (
+            self._pending_checkpointer, None,
+        )
+        if pending is not None:
+            pending.wait_until_finished()
+
+    def unregister(self) -> None:
+        self._finish_pending()
+        super().unregister()
+
     def sync(self) -> None:
         """All processes write their shards via orbax — into a fresh
         versioned directory, never over a payload an existing complete
         checkpoint still references."""
         import orbax.checkpoint as ocp
 
+        self._finish_pending()
         state = self._get_state()
         # RNG keys are opaque; store raw key data alongside.
         state = state._replace(rng=jax.random.key_data(state.rng))
@@ -292,11 +312,33 @@ class ShardedTrainerCheckpoint(checkpoint.State):
         path = _next_payload_dir(self.name)
         checkpointer = ocp.StandardCheckpointer()
         checkpointer.save(path, state)
-        checkpointer.wait_until_finished()
+        if env.num_processes() > 1:
+            # Multi-host: every process must finish its shards before
+            # rank 0's registry rename can reference the payload — the
+            # non-rank-0 processes have no later pipeline point to
+            # wait at, so the overlap is single-host only.
+            checkpointer.wait_until_finished()
+        else:
+            # Single-host: defer the wait to the write phase
+            # (write_snapshot below), overlapping the orbax array
+            # write with training's next steps. The registry pointer
+            # is only written after the payload is fully durable, so
+            # the newest complete registry checkpoint always
+            # references a complete payload.
+            self._pending_checkpointer = checkpointer
         self._last_payload_dir = path
 
+    def snapshot(self):
+        return {"payload_dir": self._last_payload_dir}
+
+    def write_snapshot(self, snapshot, fileobj) -> None:
+        self._finish_pending()
+        pickle.dump(snapshot, fileobj)
+
     def save(self, fileobj) -> None:
-        pickle.dump({"payload_dir": self._last_payload_dir}, fileobj)
+        self.write_snapshot(
+            {"payload_dir": self._last_payload_dir}, fileobj
+        )
 
     def commit(self) -> None:
         """Registry rename succeeded: every payload dir other than the

@@ -30,6 +30,7 @@ adaptdl_tpu.data) so recompilation stays rare.
 
 from __future__ import annotations
 
+import logging
 import pickle
 from typing import Any, Callable, NamedTuple
 
@@ -40,6 +41,9 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from adaptdl_tpu import checkpoint, gns
+from adaptdl_tpu._compat import pcast as _pcast, shard_map_kwargs as _sm_kwargs
+
+_LOG = logging.getLogger(__name__)
 from adaptdl_tpu.parallel.mesh import (
     DATA_AXIS,
     EXPERT_AXIS,
@@ -504,7 +508,7 @@ class ElasticTrainer:
             (self.num_replicas * self._zero1_shard,),
             rows_local.dtype,
         )
-        full = jax.lax.pcast(full, DATA_AXIS, to="varying")
+        full = _pcast(full, DATA_AXIS, to="varying")
         rank = jax.lax.axis_index(DATA_AXIS)
         full = jax.lax.dynamic_update_slice(
             full, rows_local[0], (rank * self._zero1_shard,)
@@ -1174,7 +1178,7 @@ class ElasticTrainer:
                 lambda p: (p * 0.0).astype(jnp.float32), rows
             )
             lsqr_init = jnp.zeros((1,))
-            loss_init = jax.lax.pcast(
+            loss_init = _pcast(
                 jnp.zeros(()), varying_axes, to="varying"
             )
             init = (grad_init, lsqr_init, loss_init)
@@ -1254,13 +1258,67 @@ class ElasticTrainer:
             mesh=self.mesh,
             in_specs=(state_specs, batch_spec, P()),
             out_specs=(state_specs, P()),
+            **_sm_kwargs(),
         )
-        jitted = jax.jit(sharded, donate_argnums=0)
-        if self.has_aux:
+        return self._finalize_step(sharded, (atomic_bsz, accum_steps))
+
+    def _aot_wrap(self, stepped_pair, key) -> Callable:
+        """First-call AOT fast path over a 3-arg jitted step: consult
+        the persistent executable cache (adaptdl_tpu.aot_cache) so a
+        restarted same-topology incarnation skips tracing + lowering +
+        compiling entirely; on a miss, AOT-compile once and persist
+        the executable in the background. Any failure — disabled
+        cache, stale entry, aval drift — falls back to the ordinary
+        jitted path, permanently for this step."""
+        from adaptdl_tpu import aot_cache
+
+        jitted, cacheable = stepped_pair
+        if not aot_cache.enabled():
             return jitted
-        wrapper = lambda state, batch: jitted(state, batch, ())  # noqa: E731
-        # Expose the jitted program for lower()/compile() introspection
-        # (memory-analysis tests, benchmark tooling).
+        cell: dict[str, Any] = {"compiled": None, "tried": False}
+
+        def stepped(state, batch, aux):
+            if not cell["tried"]:
+                cell["tried"] = True
+                try:
+                    cell["compiled"] = aot_cache.load_or_compile(
+                        self, key, cacheable, (state, batch, aux)
+                    )
+                except Exception:  # noqa: BLE001 - cache best-effort
+                    cell["compiled"] = None
+            if cell["compiled"] is not None:
+                try:
+                    return cell["compiled"](state, batch, aux)
+                except Exception:  # noqa: BLE001 - aval/sharding drift
+                    _LOG.warning(
+                        "cached AOT executable for step %s failed; "
+                        "falling back to the jitted path permanently",
+                        key,
+                        exc_info=True,
+                    )
+                    cell["compiled"] = None
+            return jitted(state, batch, aux)
+
+        return stepped
+
+    def _finalize_step(self, sharded, key) -> Callable:
+        """Shared tail of every step builder: AOT-cache wrapping plus
+        the aux-arity adaptation. Two jit variants exist: the ordinary
+        donating program (`_jitted`, also the lower()/compile()
+        introspection handle), and a NON-donating twin backing the
+        AOT executable cache — a deserialized executable's
+        input-aliasing metadata is not reliably reconstructed across
+        processes, so executing one with donated buffers can corrupt
+        memory; dropping donation on the cached path costs one extra
+        state-sized buffer during the step."""
+        jitted = jax.jit(sharded, donate_argnums=0)
+        cacheable = jax.jit(sharded)
+        stepped = self._aot_wrap((jitted, cacheable), key)
+        if self.has_aux:
+            if stepped is not jitted:
+                stepped._jitted = jitted
+            return stepped
+        wrapper = lambda state, batch: stepped(state, batch, ())  # noqa: E731
         wrapper._jitted = jitted
         return wrapper
 
@@ -1396,7 +1454,7 @@ class ElasticTrainer:
             varying_axes = (
                 (DATA_AXIS, SEQ_AXIS) if seq_shards > 1 else DATA_AXIS
             )
-            params_v = jax.lax.pcast(params, varying_axes, to="varying")
+            params_v = _pcast(params, varying_axes, to="varying")
             precond = (
                 self._zero1_precond(state.opt_state)
                 if self.zero1
@@ -1407,7 +1465,7 @@ class ElasticTrainer:
             precond_v = (
                 None
                 if precond is None
-                else jax.lax.pcast(precond, DATA_AXIS, to="varying")
+                else _pcast(precond, DATA_AXIS, to="varying")
             )
             # Per-replica, per-step rng; microbatch rngs split below.
             rng = jax.random.fold_in(state.rng, state.step)
@@ -1459,15 +1517,15 @@ class ElasticTrainer:
             zeros = jax.tree.map(
                 lambda p: (p * 0.0).astype(jnp.float32), params
             )
-            grad_init = jax.lax.pcast(zeros, DATA_AXIS, to="varying")
+            grad_init = _pcast(zeros, DATA_AXIS, to="varying")
             # lsqr is already psum'd over the sharded axes inside
             # stat_normsqr, so the carry varies over data only.
-            lsqr_init = jax.lax.pcast(
+            lsqr_init = _pcast(
                 jnp.zeros((self.num_param_groups,)),
                 DATA_AXIS,
                 to="varying",
             )
-            loss_init = jax.lax.pcast(
+            loss_init = _pcast(
                 jnp.zeros(()), DATA_AXIS, to="varying"
             )
             init = (grad_init, lsqr_init, loss_init)
@@ -1578,14 +1636,9 @@ class ElasticTrainer:
             in_specs=(state_specs, batch_spec, P()),
             out_specs=(state_specs, P()),
             **extra,
+            **_sm_kwargs(),
         )
-        jitted = jax.jit(sharded, donate_argnums=0)
-        if self.has_aux:
-            return jitted
-        # Hide the unused aux slot from non-aux callers.
-        wrapper = lambda state, batch: jitted(state, batch, ())  # noqa: E731
-        wrapper._jitted = jitted
-        return wrapper
+        return self._finalize_step(sharded, (atomic_bsz, accum_steps))
 
     def params_tree(self, state: TrainState) -> Any:
         """The parameter TREE of a TrainState, whatever the storage
@@ -1698,6 +1751,7 @@ class ElasticTrainer:
             in_specs=(param_specs, batch_spec),
             out_specs=P(),
             **extra,
+            **_sm_kwargs(),
         )
         jitted = jax.jit(sharded)
         fn = lambda state, batch: jitted(state.params, batch)  # noqa: E731
@@ -1787,7 +1841,7 @@ class ElasticTrainer:
                 params = self._zero1_unravel(
                     self._rows_to_flat(params)
                 )
-            params_v = jax.lax.pcast(params, varying_axes, to="varying")
+            params_v = _pcast(params, varying_axes, to="varying")
             rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
             loss, grads = jax.value_and_grad(self.loss_fn)(
                 params_v, local_batch, rng, *extra
@@ -1825,6 +1879,7 @@ class ElasticTrainer:
             in_specs=(param_specs, batch_spec, P(), P()),
             out_specs=P(DATA_AXIS),
             **extra,
+            **_sm_kwargs(),
         )
         return jax.jit(sharded)
 
@@ -1970,7 +2025,14 @@ class TrainerCheckpoint(checkpoint.State):
         self._transform_save = transform_save
         self._transform_load = transform_load
 
-    def save(self, fileobj):
+    def snapshot(self):
+        """Phase 1 of the save pipeline: a point-in-time HOST copy of
+        the TrainState in its canonical disk layout. Device->host
+        transfers are kicked non-blocking for every leaf before the
+        first blocking read, so the copies all overlap; once this
+        returns, the caller may keep training (the donated train step
+        may consume the device buffers) while the background writer
+        serializes the snapshot."""
         state = self._get_state()
         for leaf in jax.tree.leaves(state):
             if (
@@ -1985,6 +2047,12 @@ class TrainerCheckpoint(checkpoint.State):
                 )
         # RNG keys are opaque typed arrays; store raw key data.
         state = state._replace(rng=jax.random.key_data(state.rng))
+        for leaf in jax.tree.leaves(state):
+            if isinstance(leaf, jax.Array):
+                try:
+                    leaf.copy_to_host_async()
+                except (AttributeError, RuntimeError):
+                    pass  # backend without async transfers
         state = jax.tree.map(np.asarray, state)
         if self._trainer.zero3_blocks is not None:
             # Canonical disk layouts: params as the plain TREE (what a
@@ -2030,7 +2098,15 @@ class TrainerCheckpoint(checkpoint.State):
             )
         if self._transform_save is not None:
             state = self._transform_save(state)
-        pickle.dump(state, fileobj)
+        return state
+
+    def write_snapshot(self, snapshot, fileobj):
+        """Phase 2: serialize the host snapshot (writer thread under
+        the async pipeline — must not touch the live state)."""
+        pickle.dump(snapshot, fileobj)
+
+    def save(self, fileobj):
+        self.write_snapshot(self.snapshot(), fileobj)
 
     def load(self, fileobj):
         host_state = pickle.load(fileobj)

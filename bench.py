@@ -597,15 +597,21 @@ def _bench_flash_attention(on_tpu: bool, full: bool) -> dict | None:
     return out
 
 
-def _bench_rescale_latency(trainer_factory, dataset, init_bsz) -> float | None:
+def _bench_rescale_latency(trainer_factory, dataset, init_bsz, trials=3):
     """Median checkpoint-save -> restore -> first-step time: the cost
     of one elastic rescale (reference analog: the checkpoint-restart
-    path, SURVEY §3.4 — the reference never measures it).
+    path, SURVEY §3.4 — the reference never measures it). Returns
+    ``(p50_seconds, breakdown)`` where the breakdown holds per-phase
+    medians: snapshot_s / write_s / restore_s / first_step_s.
 
-    The persistent compilation cache is enabled for the phase (as
-    initialize_job does in production): the restored trainer's
-    recompile — the dominant term — hits the cache the way a real
-    restarted incarnation would."""
+    The measurement exercises the pipelined save path: the snapshot
+    phase is on the critical path, the background write overlaps the
+    restarted incarnation's construction (as a relaunch overlaps it in
+    production), restore joins the write, and the first step goes
+    through the persistent AOT-executable cache the way a real
+    restarted incarnation with shared storage would. The persistent
+    XLA compilation cache is also enabled for the phase (as
+    initialize_job does in production)."""
     import tempfile
 
     from adaptdl_tpu import checkpoint as ckpt_mod
@@ -629,7 +635,9 @@ def _bench_rescale_latency(trainer_factory, dataset, init_bsz) -> float | None:
     _enable_compilation_cache()
 
     try:
-        return _rescale_trials(trainer_factory, dataset, init_bsz)
+        return _rescale_trials(
+            trainer_factory, dataset, init_bsz, trials=trials
+        )
     finally:
         import shutil
 
@@ -650,14 +658,19 @@ def _bench_rescale_latency(trainer_factory, dataset, init_bsz) -> float | None:
         shutil.rmtree(cache_dir, ignore_errors=True)
 
 
-def _rescale_trials(trainer_factory, dataset, init_bsz) -> float:
+def _rescale_trials(trainer_factory, dataset, init_bsz, trials=3):
     import tempfile
 
+    from adaptdl_tpu import aot_cache
     from adaptdl_tpu import checkpoint as ckpt_mod
 
     times = []
+    parts: dict[str, list] = {
+        "snapshot_s": [], "write_s": [],
+        "restore_s": [], "first_step_s": [],
+    }
     rng = np.random.default_rng(4)
-    for trial in range(3):
+    for trial in range(trials):
         with tempfile.TemporaryDirectory() as tmp:
             os.environ["ADAPTDL_CHECKPOINT_PATH"] = tmp
             trainer = trainer_factory()
@@ -667,7 +680,9 @@ def _rescale_trials(trainer_factory, dataset, init_bsz) -> float:
                 lambda s: holder.__setitem__("state", s),
                 name=f"bench-rescale-{trial}",
             )
-            # Warm state: one compiled step.
+            # Warm state: one compiled step (this also persists the
+            # step executable into the job's AOT cache, as steady-
+            # state training does long before any rescale).
             atomic = init_bsz // trainer.num_replicas
             step_fn = trainer.train_step(atomic, 0)
             idx = rng.integers(0, len(dataset["label"]), size=init_bsz)
@@ -678,11 +693,16 @@ def _rescale_trials(trainer_factory, dataset, init_bsz) -> float:
             import jax
 
             jax.block_until_ready(m["loss"])
+            aot_cache.wait_for_writes()
 
             start = time.monotonic()
-            ckpt_mod.save_all_states()
-            # "Restart": a fresh trainer (new step cache => recompile)
-            # restoring the saved state, then one step to readiness.
+            # Pipelined save: the snapshot phase blocks; the write
+            # runs behind the restarted incarnation's construction,
+            # exactly as it runs behind the relaunch in production.
+            handle = ckpt_mod.save_all_states(wait=False)
+            snapshot_s = time.monotonic() - start
+            # "Restart": a fresh trainer (new step cache) restoring
+            # the saved state, then one step to readiness.
             trainer2 = trainer_factory()
             holder2 = {"state": trainer2.init_state()}
             ck.unregister()
@@ -691,16 +711,39 @@ def _rescale_trials(trainer_factory, dataset, init_bsz) -> float:
                 lambda s: holder2.__setitem__("state", s),
                 name=f"bench-rescale-{trial}",
             )
-            ckpt_mod.load_state(ck2)
+            t0 = time.monotonic()
+            # Joins the background write; a False return means the
+            # write failed (load_state logs-and-proceeds from older
+            # checkpoints by design) and the trial would silently
+            # time a restore that restored nothing.
+            if not ckpt_mod.load_state(ck2):
+                raise RuntimeError(
+                    "rescale trial: checkpoint restore found no "
+                    "complete checkpoint (background write failed?)"
+                )
+            restore_s = time.monotonic() - t0
+            t0 = time.monotonic()
             step_fn2 = trainer2.train_step(atomic, 0)
             s2, m2 = step_fn2(holder2["state"], batch)
             jax.block_until_ready(m2["loss"])
+            first_step_s = time.monotonic() - t0
             times.append(time.monotonic() - start)
+            parts["snapshot_s"].append(snapshot_s)
+            parts["write_s"].append(handle.write_s)
+            parts["restore_s"].append(restore_s)
+            parts["first_step_s"].append(first_step_s)
             ck2.unregister()
             os.environ.pop("ADAPTDL_CHECKPOINT_PATH", None)
     p50 = float(np.median(times))
-    _log(f"rescale: trials={['%.2f' % t for t in times]} p50={p50:.2f}s")
-    return p50
+    breakdown = {
+        key: round(float(np.median(vals)), 4)
+        for key, vals in parts.items()
+    }
+    _log(
+        f"rescale: trials={['%.2f' % t for t in times]} p50={p50:.2f}s "
+        f"breakdown={breakdown}"
+    )
+    return p50, breakdown
 
 
 def main(quick: bool = False):
@@ -911,10 +954,11 @@ def main(quick: bool = False):
             flash_stats = _bench_flash_attention(on_tpu, full)
     except Exception as exc:  # noqa: BLE001 - optional metric
         _log(f"flash bench failed: {exc}")
+    rescale_breakdown = None
     try:
         if _remaining() > 60:
             metrics._reset_state()
-            rescale_p50 = _bench_rescale_latency(
+            rescale_p50, rescale_breakdown = _bench_rescale_latency(
                 make_trainer, dataset, init_bsz
             )
     except Exception as exc:  # noqa: BLE001 - optional metric
@@ -933,6 +977,8 @@ def main(quick: bool = False):
         result.update(flash_stats)
     if rescale_p50 is not None:
         result["rescale_p50_s"] = round(rescale_p50, 3)
+    if rescale_breakdown is not None:
+        result["rescale_breakdown"] = rescale_breakdown
     print(json.dumps(result))
 
 

@@ -21,7 +21,7 @@ import pickle
 import sys
 import traceback
 
-import portpicker
+from adaptdl_tpu._compat import pick_unused_port
 import pytest
 
 
@@ -55,7 +55,7 @@ def _run_replica(fn, rank, num_replicas, num_restarts, ckpt_dir, port, write_fd)
 
 
 def _fork_round(fn, num_replicas, num_restarts, ckpt_dir):
-    port = portpicker.pick_unused_port()
+    port = pick_unused_port()
     pipes, pids = [], []
     for rank in range(num_replicas):
         read_fd, write_fd = os.pipe()

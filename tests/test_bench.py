@@ -8,6 +8,7 @@ import os
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -49,3 +50,57 @@ def test_bench_quick_emits_headline_json():
     # The round-5 depth keys ride the same line when budget allows.
     assert "value_ci" in result
     assert "mem_z3b_temp_vs_lite" in result
+
+
+def test_rescale_breakdown_sums_consistently(tmp_path, monkeypatch):
+    """Fast smoke test of the rescale instrumentation: the breakdown
+    (snapshot_s / write_s / restore_s / first_step_s) is emitted and
+    internally consistent — the serial components are disjoint
+    sub-segments of the measured total, and the overlapped write never
+    reports negative time."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import bench as bench_mod
+    from adaptdl_tpu import metrics
+    from adaptdl_tpu.trainer import ElasticTrainer
+
+    monkeypatch.setenv("ADAPTDL_NUM_REPLICAS", "1")
+    metrics._reset_state()
+    rng = np.random.default_rng(0)
+    dataset = {
+        "x": rng.normal(size=(64, 4)).astype(np.float32),
+        "label": rng.normal(size=(64,)).astype(np.float32),
+    }
+
+    def loss_fn(params, batch, _rng):
+        return jnp.mean((batch["x"] @ params["w"] - batch["label"]) ** 2)
+
+    def make_trainer():
+        from adaptdl_tpu.parallel import create_mesh
+
+        return ElasticTrainer(
+            loss_fn=loss_fn,
+            params={"w": jnp.zeros(4)},
+            optimizer=optax.sgd(0.1),
+            init_batch_size=8,
+            mesh=create_mesh(devices=jax.devices()[:1]),
+        )
+
+    p50, breakdown = bench_mod._bench_rescale_latency(
+        make_trainer, dataset, 8, trials=1
+    )
+    assert p50 > 0
+    for key in ("snapshot_s", "write_s", "restore_s", "first_step_s"):
+        assert key in breakdown, breakdown
+        assert breakdown[key] >= 0, breakdown
+    # snapshot/restore/first-step are disjoint segments of the timed
+    # window (the write overlaps other work), so their sum bounds the
+    # total from below.
+    serial = (
+        breakdown["snapshot_s"]
+        + breakdown["restore_s"]
+        + breakdown["first_step_s"]
+    )
+    assert serial <= p50 + 1e-6, (serial, p50, breakdown)

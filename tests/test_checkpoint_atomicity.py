@@ -1,7 +1,13 @@
-"""Crash-safety: a complete checkpoint always exists on disk."""
+"""Crash-safety: a complete checkpoint always exists on disk.
+
+Covers the async pipeline's crash windows too: a death between
+snapshot and write, during the parallel writes, and between rename and
+prune must each leave load_state() restoring ONE consistent version.
+"""
 
 import os
 import pickle
+import threading
 
 import pytest
 
@@ -60,3 +66,141 @@ def test_failed_resave_preserves_previous(tmp_path, monkeypatch):
         e for e in os.listdir(tmp_path) if e.startswith("_tmp-checkpoint-")
     ]
     assert not leftovers, "failed save cleans its temp dir"
+
+
+def test_crash_between_snapshot_and_write(tmp_path, monkeypatch):
+    """A death after the snapshot phase but before any write leaves
+    the previous complete checkpoint as the only (and newest) one."""
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    state = Val("v", "first")
+    checkpoint.save_all_states()
+    state.value = "second"
+    # Simulate the crash by never running the write phase: snapshot
+    # exists only in memory, disk is untouched.
+    snap = state.snapshot()
+    assert pickle.loads(snap) == "second"
+    state.value = None
+    assert checkpoint.load_state(state)
+    assert state.value == "first"
+
+
+def test_crash_during_parallel_writes(tmp_path, monkeypatch):
+    """One state's write failing mid-phase (after another state's file
+    landed in the temp dir) aborts the whole save: no rename, temp dir
+    cleaned, previous checkpoint intact for BOTH states."""
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    a = Val("a", 1)
+    b = Val("b", 10)
+    checkpoint.save_all_states()
+
+    a.value, b.value = 2, 20
+    original = Val.write_snapshot
+
+    def bomb(self, snapshot, fileobj):
+        if self.name == "b":
+            raise OSError("disk on fire")
+        original(self, snapshot, fileobj)
+
+    monkeypatch.setattr(Val, "write_snapshot", bomb)
+    with pytest.raises(OSError):
+        checkpoint.save_all_states()
+    monkeypatch.setattr(Val, "write_snapshot", original)
+    a.value = b.value = None
+    assert checkpoint.load_state(a) and checkpoint.load_state(b)
+    assert (a.value, b.value) == (1, 10), "one consistent version"
+    leftovers = [
+        e for e in os.listdir(tmp_path) if e.startswith("_tmp-checkpoint-")
+    ]
+    assert not leftovers
+
+
+def test_crash_between_rename_and_prune(tmp_path, monkeypatch):
+    """A death after the atomic rename but before pruning leaves TWO
+    complete checkpoints; loads take the newest, and the next
+    completed save prunes the stale one."""
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    state = Val("v", "old")
+    checkpoint.save_all_states()
+
+    state.value = "new"
+    real_fsync = checkpoint._fsync_dir
+    calls = {"n": 0}
+
+    def die_after_rename(path):
+        # The first fsync of the checkpoint ROOT happens right after
+        # the rename (the earlier one targets the temp dir); dying
+        # there models the kill-between-rename-and-prune window.
+        real_fsync(path)
+        if path == str(tmp_path):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise KeyboardInterrupt(
+                    "killed between rename and prune"
+                )
+
+    monkeypatch.setattr(checkpoint, "_fsync_dir", die_after_rename)
+    with pytest.raises(KeyboardInterrupt):
+        checkpoint.save_all_states()
+    monkeypatch.setattr(checkpoint, "_fsync_dir", real_fsync)
+
+    dirs = [
+        e for e in os.listdir(tmp_path) if e.startswith("checkpoint-")
+    ]
+    assert len(dirs) == 2, "both complete versions on disk"
+    state.value = None
+    assert checkpoint.load_state(state)
+    assert state.value == "new", "newest complete version wins"
+
+    state.value = "newer"
+    checkpoint.save_all_states()
+    dirs = [
+        e for e in os.listdir(tmp_path) if e.startswith("checkpoint-")
+    ]
+    assert len(dirs) == 1, "completed save prunes everything stale"
+
+
+def test_async_save_is_point_in_time_and_readable(tmp_path, monkeypatch):
+    """wait=False: mutations after the snapshot phase never leak into
+    the checkpoint being written, and load_state observes the
+    completed save (read-your-writes through the in-flight joint)."""
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    gate = threading.Event()
+    original = Val.write_snapshot
+
+    def slow_write(self, snapshot, fileobj):
+        gate.wait(timeout=10)
+        original(self, snapshot, fileobj)
+
+    monkeypatch.setattr(Val, "write_snapshot", slow_write)
+    state = Val("v", "captured")
+    handle = checkpoint.save_all_states(wait=False)
+    assert not handle.done()
+    state.value = "mutated-after-snapshot"
+    gate.set()
+    state.value = None
+    assert checkpoint.load_state(state)  # joins the in-flight write
+    assert state.value == "captured"
+    assert handle.done()
+    assert handle.snapshot_s >= 0 and handle.write_s > 0
+    assert "v" in handle.per_state
+    assert "write_s" in handle.per_state["v"]
+
+
+def test_async_save_error_surfaces_on_wait(tmp_path, monkeypatch):
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    state = Val("v", 1)
+    checkpoint.save_all_states()
+
+    def bomb(self, snapshot, fileobj):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(Val, "write_snapshot", bomb)
+    handle = checkpoint.save_all_states(wait=False)
+    with pytest.raises(OSError):
+        handle.wait()
+    monkeypatch.setattr(
+        Val, "write_snapshot", checkpoint.State.write_snapshot
+    )
+    state.value = None
+    assert checkpoint.load_state(state)
+    assert state.value == 1, "previous checkpoint intact"

@@ -247,6 +247,139 @@ def test_elastic_preempt_rescale_resume_zero3_blocks(
     assert state.params["other"].shape[0] == 2
 
 
+def test_live_retune_no_restart_matches_checkpoint_restart(
+    tmp_path, monkeypatch
+):
+    """The live re-tune fast path: when the allocator changes only the
+    per-replica batch configuration — not the device set — the job
+    adopts it in-process. Must cost zero restarts, keep the dataloader
+    position, and produce the IDENTICAL training trajectory to the
+    checkpoint-restart path adopting the same configuration."""
+    from adaptdl_tpu import sched_hints
+
+    monkeypatch.setenv("ADAPTDL_NUM_NODES", "1")
+    monkeypatch.setenv("ADAPTDL_NUM_REPLICAS", "4")
+    monkeypatch.setenv("ADAPTDL_NUM_RESTARTS", "0")
+    dataset = _dataset()
+    new_config = {"atomicBsz": 16, "accumSteps": 1}
+
+    # The allocator's published decision, faked at the client fetch
+    # (the wire path — supervisor /config — is covered by the sched
+    # services tests).
+    remote = {"cfg": None}
+    monkeypatch.setattr(
+        sched_hints,
+        "fetch_job_config",
+        lambda job_id=None: (
+            {"batchConfig": dict(remote["cfg"])}
+            if remote["cfg"]
+            else None
+        ),
+    )
+    # Pin the LOCAL decision path to the initial split: this test is
+    # about the re-tune mechanism, and a mid-run goodput fit would
+    # move the batch size on wall-clock timing rather than on the
+    # faked allocator decision.
+    monkeypatch.setattr(metrics, "get_goodput_fn", lambda: None)
+
+    def build(name):
+        checkpoint._reset_registry()
+        epoch._reset_state()
+        metrics._reset_state()
+        mesh = create_mesh(devices=jax.devices()[:4])
+        trainer = ElasticTrainer(
+            loss_fn=_loss_fn,
+            params={"w": jnp.zeros(4), "b": jnp.zeros(())},
+            optimizer=optax.sgd(0.05),
+            init_batch_size=32,
+            scaling_rule=AdaScale(),
+            mesh=mesh,
+        )
+        holder = {"state": trainer.init_state()}
+        ck = trainer.make_checkpoint_state(
+            lambda: holder["state"],
+            lambda s: holder.__setitem__("state", s),
+        )
+        checkpoint.load_state(ck)
+        loader = AdaptiveDataLoader(dataset, batch_size=32, name=name)
+        loader.autoscale_batch_size(
+            256, local_bsz_bounds=(8, 64), gradient_accumulation=True
+        )
+        loader._reoptimize_every = 1
+        return trainer, holder, loader
+
+    def run_arm(name, live: bool):
+        """Steps 1-5 at the initial config; the new config takes
+        effect from step 6 — via in-process re-tune (live=True) or via
+        preempt -> checkpoint-restart (live=False). Returns (losses
+        from step 6 on, final w, final step count)."""
+        remote["cfg"] = None
+        _signal.set_exit_flag(False)
+        trainer, holder, loader = build(name)
+        losses, steps = [], 0
+
+        def loop():
+            nonlocal steps
+            for _ in epoch.remaining_epochs_until(1):
+                for batch in loader:
+                    holder["state"], m = trainer.run_step(
+                        holder["state"], batch, loader
+                    )
+                    steps += 1
+                    if steps > 5:
+                        losses.append(float(m["loss"]))
+                    if live and steps == 5:
+                        remote["cfg"] = new_config
+                    if not live and steps == 4:
+                        # Graceful preemption: the async exit-flag
+                        # agreement lags one step, so a flag raised
+                        # during step 4 exits after step 5 — aligning
+                        # both arms' switch point at step 6.
+                        _signal.set_exit_flag(True)
+
+        if live:
+            loop()
+            assert metrics.current_state().num_retunes >= 1
+            # Dataloader position continued mid-epoch (never reset).
+            return losses, np.asarray(holder["state"].params["w"])
+        with pytest.raises(SystemExit) as exc_info:
+            loop()
+        assert exc_info.value.code == 143
+        position = (loader.sampler.epoch, loader.sampler.index)
+        # Restarted incarnation: same replica count, allocator's new
+        # batch config published; resumes mid-epoch.
+        monkeypatch.setenv("ADAPTDL_NUM_RESTARTS", "1")
+        _signal.set_exit_flag(False)
+        remote["cfg"] = new_config
+        trainer, holder, loader = build(name)
+        assert (loader.sampler.epoch, loader.sampler.index) == position
+        for _ in epoch.remaining_epochs_until(1):
+            for batch in loader:
+                holder["state"], m = trainer.run_step(
+                    holder["state"], batch, loader
+                )
+                losses.append(float(m["loss"]))
+        monkeypatch.setenv("ADAPTDL_NUM_RESTARTS", "0")
+        return losses, np.asarray(holder["state"].params["w"])
+
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path / "live"))
+    losses_live, w_live = run_arm("retune-live", live=True)
+    monkeypatch.setenv(
+        "ADAPTDL_CHECKPOINT_PATH", str(tmp_path / "restart")
+    )
+    losses_restart, w_restart = run_arm("retune-restart", live=False)
+
+    # The re-tune actually changed the schedule (steps after 5 use the
+    # new config) and both paths saw the same number of steps.
+    assert losses_live, "no steps ran after the re-tune"
+    assert len(losses_live) == len(losses_restart)
+    # Identical trajectory: same losses, same final weights.
+    np.testing.assert_allclose(
+        losses_live, losses_restart, rtol=1e-6, atol=1e-7
+    )
+    np.testing.assert_allclose(w_live, w_restart, rtol=1e-6, atol=1e-7)
+
+
 def test_fixed_batch_size_run(tmp_path, monkeypatch):
     """No autoscaling: plain elastic DP training end-to-end."""
     monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))

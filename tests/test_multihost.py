@@ -18,7 +18,7 @@ import subprocess
 import sys
 
 import numpy as np
-import portpicker
+from adaptdl_tpu._compat import pick_unused_port
 import pytest
 
 WORKER = r"""
@@ -120,10 +120,10 @@ print(
 def _run_phases(tmp_path, extra_env=None):
     worker = tmp_path / "worker.py"
     worker.write_text(WORKER)
-    coord_port = portpicker.pick_unused_port()
+    coord_port = pick_unused_port()
 
     def run_phase(num_processes, devices_per_proc, restarts):
-        reducer_port = portpicker.pick_unused_port()
+        reducer_port = pick_unused_port()
         procs = []
         for rank in range(num_processes):
             env = dict(os.environ)
@@ -232,8 +232,8 @@ def test_dp_spanning_two_slices_records_num_nodes_2_fit_rows(tmp_path):
     adaptdl/adaptdl/goodput.py:31-49,245-259) is identified from."""
     worker = tmp_path / "worker.py"
     worker.write_text(WORKER)
-    coord_port = portpicker.pick_unused_port()
-    reducer_port = portpicker.pick_unused_port()
+    coord_port = pick_unused_port()
+    reducer_port = pick_unused_port()
     procs = []
     repo_root = os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))

@@ -5,7 +5,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, HTTPServer
 
-import portpicker
+from adaptdl_tpu._compat import pick_unused_port
 
 from adaptdl_tpu import _signal
 from adaptdl_tpu.sched import preemption
@@ -26,7 +26,7 @@ class FakeMetadata(BaseHTTPRequestHandler):
 
 def test_listener_sets_exit_flag_on_preemption():
     _signal.set_exit_flag(False)
-    port = portpicker.pick_unused_port()
+    port = pick_unused_port()
     server = HTTPServer(("127.0.0.1", port), FakeMetadata)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     url = f"http://127.0.0.1:{port}/preempted"

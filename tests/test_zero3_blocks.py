@@ -23,7 +23,10 @@ from adaptdl_tpu.parallel import zero3 as z3
 from adaptdl_tpu.parallel.mesh import DATA_AXIS
 from adaptdl_tpu.trainer import ElasticTrainer
 
-shard_map = jax.shard_map
+try:  # jax >= 0.6 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
 
 
 # ---- toy stacked-block MLP (fast paths) ------------------------------
