@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast bench bench-quick dryrun examples lint probe
+.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck probe
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -25,8 +25,16 @@ examples:
 	$(PY) examples/linear_regression.py --cpu --epochs 3
 	$(PY) tutorial/mnist_step_5.py --cpu --epochs 2
 
+# Full invariant lint: bytecode-compiles everything, then runs the
+# graftcheck passes (docs/static-analysis.md) in --fast smoke mode
+# (per-file cache; a warm run is sub-second, cold a few seconds).
 lint:
-	$(PY) -m compileall -q adaptdl_tpu examples tutorial tests bench.py __graft_entry__.py
+	$(PY) -m compileall -q adaptdl_tpu examples tutorial tests bench.py __graft_entry__.py tools
+	$(PY) -m tools.graftcheck --fast adaptdl_tpu
+
+# Cold, cache-free analysis (what CI's lint job runs).
+graftcheck:
+	$(PY) -m tools.graftcheck adaptdl_tpu
 
 probe:
 	timeout 180 $(PY) tools/tpu_probe.py || echo "probe: tunnel dead/cpu-only"

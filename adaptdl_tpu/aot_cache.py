@@ -59,14 +59,14 @@ _MAX_ENTRIES = 32
 
 def cache_dir() -> str | None:
     """Resolved cache directory, or None when disabled/unconfigured."""
-    knob = os.environ.get("ADAPTDL_AOT_CACHE", "")
+    from adaptdl_tpu import env
+
+    knob = env.aot_cache_knob()
     if knob.lower() in ("off", "0", "false", "none"):
         return None
     if knob:
         base = knob
     else:
-        from adaptdl_tpu import env
-
         base = env.checkpoint_path()
         if base is None:
             return None
@@ -194,7 +194,9 @@ def load(fp: str) -> Any | None:
 # In-flight background writers, so tests and the bench can wait for
 # entries to land deterministically (a real restarted process never
 # needs this — its entries were written by the previous incarnation).
-_writers: list[threading.Thread] = []
+# Mutated by every save_async caller AND drained by wait_for_writes
+# from tests/atexit; graftcheck enforces the lock (GC101).
+_writers: list[threading.Thread] = []  # guarded-by: _writers_lock
 _writers_lock = threading.Lock()
 _atexit_registered = False
 

@@ -53,16 +53,14 @@ def _discover_peers() -> dict[int, str] | None:
 def initialize_job(distributed: bool | None = None) -> None:
     """Initialize this process for (possibly multi-host) elastic
     training. Idempotent; safe to call in single-process jobs."""
-    import os
-
     _signal.install_handlers()
-    if "ADAPTDL_NUM_REPLICAS" not in os.environ:
+    if not env.num_replicas_is_set():
         # Standalone single-process run: one replica per local device,
         # so the dataloader's batch math and the trainer's default mesh
         # agree without any scheduler in the loop.
         import jax
 
-        os.environ["ADAPTDL_NUM_REPLICAS"] = str(len(jax.devices()))
+        env.set_num_replicas(len(jax.devices()))
     peers = None
     try:
         peers = _discover_peers()
@@ -109,7 +107,7 @@ def _enable_compilation_cache() -> None:
     """
     import os
 
-    knob = os.environ.get("ADAPTDL_COMPILE_CACHE", "")
+    knob = env.compile_cache_knob()
     if knob.lower() in ("off", "0", "false", "none"):
         return
     path = knob or env.share_path() or env.checkpoint_path()

@@ -187,8 +187,13 @@ class AsyncSaveHandle:
         self._done = threading.Event()
         self.snapshot_s = 0.0
         self.write_s = 0.0
+        # Per-state timings are written concurrently by the write
+        # phase's thread pool (one entry per state, but one shared
+        # dict) and may be read by the trainer thread while the
+        # background write is still in flight.
+        self._lock = threading.Lock()
         # name -> {"snapshot_s": ..., "write_s": ...}
-        self.per_state: dict[str, dict[str, float]] = {}
+        self.per_state: dict[str, dict[str, float]] = {}  # guarded-by: _lock
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -278,9 +283,10 @@ def save_all_states(wait: bool = True) -> AsyncSaveHandle:
         for state in states:
             t0 = time.monotonic()
             snapshots.append(state.snapshot())
-            handle.per_state[state.name] = {
-                "snapshot_s": time.monotonic() - t0
-            }
+            with handle._lock:
+                handle.per_state[state.name] = {
+                    "snapshot_s": time.monotonic() - t0
+                }
     handle.snapshot_s = time.monotonic() - start
     if not rank0:
         handle._done.set()
@@ -343,9 +349,12 @@ def _write_snapshots(
             state.write_snapshot(snap, f)
             f.flush()
             os.fsync(f.fileno())
-        handle.per_state.setdefault(state.name, {})["write_s"] = (
-            time.monotonic() - t0
-        )
+        # Pool threads share this dict: the lock (not GIL luck) makes
+        # the setdefault-then-assign pair atomic.
+        with handle._lock:
+            handle.per_state.setdefault(state.name, {})["write_s"] = (
+                time.monotonic() - t0
+            )
 
     try:
         if len(states) > 1:
@@ -394,8 +403,10 @@ def _record_save_metrics(handle: AsyncSaveHandle) -> None:
     try:
         from adaptdl_tpu import metrics as metrics_mod
 
+        with handle._lock:
+            per_state = dict(handle.per_state)
         metrics_mod.record_checkpoint_save(
-            handle.snapshot_s, handle.write_s, dict(handle.per_state)
+            handle.snapshot_s, handle.write_s, per_state
         )
     except Exception:  # noqa: BLE001 - observability is best-effort
         LOG.debug("failed to record checkpoint metrics", exc_info=True)

@@ -25,10 +25,31 @@ from __future__ import annotations
 
 import os
 
+# Env keys also WRITTEN by other modules (launchers assembling child
+# process environments, the tuner driving trials) import these
+# constants so the key spelling has exactly one home.
+TRIAL_CONFIG_KEY = "ADAPTDL_TRIAL_CONFIG"
+TRIAL_RESULT_KEY = "ADAPTDL_TRIAL_RESULT_FILE"
+
 
 def _get_int(name: str, default: int) -> int:
     value = os.environ.get(name)
     return int(value) if value not in (None, "") else default
+
+
+def _get_float(name: str, default: float) -> float:
+    value = os.environ.get(name)
+    return float(value) if value not in (None, "") else default
+
+
+def _get_opt_int(name: str) -> int | None:
+    value = os.environ.get(name)
+    return int(value) if value not in (None, "") else None
+
+
+def _get_opt_float(name: str) -> float | None:
+    value = os.environ.get(name)
+    return float(value) if value not in (None, "") else None
 
 
 def _get_str(name: str, default: str | None = None) -> str | None:
@@ -192,3 +213,139 @@ def coordinator_addr() -> str | None:
 def sched_version() -> str | None:
     """Scheduler semver, for trainer/scheduler compatibility checks."""
     return _get_str("ADAPTDL_SCHED_VERSION")
+
+
+def num_replicas_is_set() -> bool:
+    """Whether the scheduler (or launcher) exported a replica count.
+
+    Standalone runs bootstrap one replica per local device when unset
+    (:func:`set_num_replicas`)."""
+    return "ADAPTDL_NUM_REPLICAS" in os.environ
+
+
+def set_num_replicas(count: int) -> None:
+    """Export the replica count into this process's environment.
+
+    The ONE sanctioned env write outside a launcher: standalone
+    single-process runs (no scheduler) default to one replica per
+    local device so the dataloader's batch math and the trainer's
+    default mesh agree."""
+    os.environ["ADAPTDL_NUM_REPLICAS"] = str(int(count))
+
+
+def fit_interval() -> float:
+    """Seconds between perf refits / sched-hint posts (reference
+    cadence 30s, _metrics.py:60-66); override for tests and demos."""
+    return _get_float("ADAPTDL_FIT_INTERVAL", 30.0)
+
+
+def aot_cache_knob() -> str:
+    """Raw AOT-executable-cache knob: a path overrides the location,
+    ``off``/``0``/``false``/``none`` disables, empty means "beside the
+    checkpoints" (aot_cache.cache_dir resolves the policy)."""
+    return os.environ.get("ADAPTDL_AOT_CACHE", "")
+
+
+def compile_cache_knob() -> str:
+    """Raw XLA persistent-compilation-cache knob, same convention as
+    :func:`aot_cache_knob` (bootstrap resolves the policy)."""
+    return os.environ.get("ADAPTDL_COMPILE_CACHE", "")
+
+
+def trial_config_raw() -> str | None:
+    """This tuner trial's hyperparameters as a JSON string, set by the
+    trial scheduler (tune.py) in the worker's environment."""
+    return _get_str(TRIAL_CONFIG_KEY)
+
+
+def trial_result_file() -> str | None:
+    """JSON-lines path trial workers append result rows to."""
+    return _get_str(TRIAL_RESULT_KEY)
+
+
+# ---- scheduler-side knobs -------------------------------------------
+#
+# The raw reads live here so the whole ADAPTDL_* surface round-trips
+# through one module (graftcheck GC301 enforces it). These accessors
+# are deliberately raw — None when unset — so the scheduler's POLICY
+# (cluster-internal defaults, JSON validation) has exactly one home:
+# sched/config.py, the API the operator/supervisor/expander call.
+
+
+def namespace() -> str | None:
+    """Kubernetes namespace the operator manages (raw; sched/config
+    applies the default)."""
+    return _get_str("ADAPTDL_NAMESPACE")
+
+
+def job_image() -> str | None:
+    """Worker image for rendered job manifests (raw)."""
+    return _get_str("ADAPTDL_JOB_IMAGE")
+
+
+def supervisor_port() -> int | None:
+    """Port the supervisor's HTTP server binds (raw)."""
+    return _get_opt_int("ADAPTDL_SUPERVISOR_PORT")
+
+
+def webhook_port() -> int | None:
+    """Port the validating-webhook HTTPS server binds (raw)."""
+    return _get_opt_int("ADAPTDL_WEBHOOK_PORT")
+
+
+def webhook_cert() -> str | None:
+    """Path to the webhook's TLS serving certificate."""
+    return _get_str("ADAPTDL_WEBHOOK_CERT")
+
+
+def webhook_key() -> str | None:
+    """Path to the webhook's TLS private key."""
+    return _get_str("ADAPTDL_WEBHOOK_KEY")
+
+
+def checkpoint_claim() -> str | None:
+    """RWX PVC mounted into workers for checkpoints (raw)."""
+    return _get_str("ADAPTDL_CHECKPOINT_CLAIM")
+
+
+def allocator_interval() -> float | None:
+    """Seconds between full Pollux re-optimizations (raw)."""
+    return _get_opt_float("ADAPTDL_ALLOCATOR_INTERVAL")
+
+
+def max_worker_failures() -> int | None:
+    """Non-graceful worker failures tolerated before a job is Failed
+    (raw)."""
+    return _get_opt_int("ADAPTDL_MAX_FAILURES")
+
+
+def expander_min_slices() -> int | None:
+    """Floor for the cluster expander's desired slice count (raw)."""
+    return _get_opt_int("ADAPTDL_MIN_SLICES")
+
+
+def expander_max_slices() -> int | None:
+    """Ceiling for the cluster expander's desired slice count (raw)."""
+    return _get_opt_int("ADAPTDL_MAX_SLICES")
+
+
+def expander_scale_down_delay() -> float | None:
+    """Seconds a lower desired-slice count must persist before the
+    provisioner shrinks (raw)."""
+    return _get_opt_float("ADAPTDL_SCALE_DOWN_DELAY")
+
+
+def slice_template_raw() -> str | None:
+    """Provisionable slice shape as a raw JSON string (sched/config.py
+    parses and validates)."""
+    return _get_str("ADAPTDL_SLICE_TEMPLATE")
+
+
+def default_job_resources_raw() -> str | None:
+    """Per-replica resource-request default as a raw JSON string."""
+    return _get_str("ADAPTDL_DEFAULT_RESOURCES")
+
+
+def gke_node_pool_raw() -> str | None:
+    """GKE autoscaling target as a raw JSON string."""
+    return _get_str("ADAPTDL_GKE_NODE_POOL")

@@ -47,9 +47,7 @@ LOG = logging.getLogger(__name__)
 def _default_fit_interval() -> float:
     """Seconds between perf refits/hint posts (reference cadence 30s,
     _metrics.py:60-66); ADAPTDL_FIT_INTERVAL overrides (tests, demos)."""
-    import os
-
-    return float(os.environ.get("ADAPTDL_FIT_INTERVAL", "30"))
+    return env.fit_interval()
 
 
 @dataclass
@@ -73,11 +71,17 @@ class MetricsState:
     actually ran them.
     """
 
+    # Fields mutated after worker threads exist (the trainer step
+    # loop, the background fit thread, and the checkpoint writer
+    # thread all touch them) are guarded-by annotations enforced at
+    # lint time by graftcheck's lock-discipline pass (GC101).
     profile: dict[
         tuple[int, int, int, int, int, int, int, int], _ProfileEntry
-    ] = field(default_factory=lambda: defaultdict(_ProfileEntry))
-    perf_params: PerfParams | None = None
-    grad_params: GradParams | None = None
+    ] = field(  # guarded-by: _profile_lock
+        default_factory=lambda: defaultdict(_ProfileEntry)
+    )
+    perf_params: PerfParams | None = None  # guarded-by: _profile_lock
+    grad_params: GradParams | None = None  # guarded-by: _profile_lock
     init_batch_size: int | None = None
     max_batch_size: int | None = None
     local_bsz_bounds: tuple[int, int] | None = None
@@ -101,13 +105,19 @@ class MetricsState:
     # per-state breakdowns, and per-state restore durations from this
     # incarnation's startup. Together they price a rescale from
     # measurements instead of the policy's assumed restart penalty.
-    ckpt_snapshot_s: float | None = None
-    ckpt_write_s: float | None = None
-    ckpt_per_state: dict = field(default_factory=dict)
-    restore_per_state: dict = field(default_factory=dict)
+    # Written from the BACKGROUND WRITER thread, read from the fit
+    # thread — hence the guard.
+    ckpt_snapshot_s: float | None = None  # guarded-by: _profile_lock
+    ckpt_write_s: float | None = None  # guarded-by: _profile_lock
+    ckpt_per_state: dict = field(  # guarded-by: _profile_lock
+        default_factory=dict
+    )
+    restore_per_state: dict = field(  # guarded-by: _profile_lock
+        default_factory=dict
+    )
     # In-process (atomic_bsz, accum) re-tunes adopted without a
     # checkpoint-restart (the live re-tune fast path).
-    num_retunes: int = 0
+    num_retunes: int = 0  # guarded-by: _profile_lock
 
 
 _state = MetricsState()
@@ -277,23 +287,29 @@ def profile_step(
 def record_checkpoint_save(
     snapshot_s: float, write_s: float, per_state: dict
 ) -> None:
-    """Measured phase durations of the last completed save (called by
-    the checkpoint writer; snapshot is the training-blocking part,
-    write overlaps the next steps under the async pipeline)."""
-    _state.ckpt_snapshot_s = float(snapshot_s)
-    _state.ckpt_write_s = float(write_s)
-    _state.ckpt_per_state = dict(per_state)
+    """Measured phase durations of the last completed save. Called
+    from the BACKGROUND WRITER thread under the async pipeline
+    (checkpoint._record_save_metrics) while the fit thread may be
+    reading ``restart_stats`` — the lock keeps the three fields one
+    consistent observation (a torn read would pair a new snapshot
+    time with the previous save's write time)."""
+    with _profile_lock:
+        _state.ckpt_snapshot_s = float(snapshot_s)
+        _state.ckpt_write_s = float(write_s)
+        _state.ckpt_per_state = dict(per_state)
 
 
 def record_checkpoint_restore(name: str, seconds: float) -> None:
     """Measured restore duration of one state at incarnation start."""
-    _state.restore_per_state[name] = float(seconds)
+    with _profile_lock:
+        _state.restore_per_state[name] = float(seconds)
 
 
 def record_retune() -> None:
     """An in-process (atomic_bsz, accum) re-tune was adopted — a
     rescale that cost zero restarts."""
-    _state.num_retunes += 1
+    with _profile_lock:
+        _state.num_retunes += 1
 
 
 def restart_stats() -> dict | None:
@@ -302,26 +318,37 @@ def restart_stats() -> dict | None:
     over this incarnation's state restores, ``overlapFrac`` = the
     fraction of the save pipeline that runs off the training critical
     path (write / (snapshot + write)). None until something has been
-    measured."""
-    if _state.ckpt_snapshot_s is None and not _state.restore_per_state:
-        return None
-    stats: dict = {"numRetunes": _state.num_retunes}
-    if _state.ckpt_snapshot_s is not None:
-        snap, write = _state.ckpt_snapshot_s, _state.ckpt_write_s or 0.0
-        stats["snapshotS"] = round(snap, 4)
-        stats["writeS"] = round(write, 4)
-        if snap + write > 0:
-            stats["overlapFrac"] = round(write / (snap + write), 4)
-    if _state.restore_per_state:
-        stats["restoreS"] = round(
-            sum(_state.restore_per_state.values()), 4
-        )
-    return stats
+    measured. Runs on the fit thread; the lock pins one consistent
+    snapshot of the writer-thread-updated fields (summing
+    ``restore_per_state`` while a restore inserts would raise
+    "dict changed size during iteration")."""
+    with _profile_lock:
+        if (
+            _state.ckpt_snapshot_s is None
+            and not _state.restore_per_state
+        ):
+            return None
+        stats: dict = {"numRetunes": _state.num_retunes}
+        if _state.ckpt_snapshot_s is not None:
+            snap = _state.ckpt_snapshot_s
+            write = _state.ckpt_write_s or 0.0
+            stats["snapshotS"] = round(snap, 4)
+            stats["writeS"] = round(write, 4)
+            if snap + write > 0:
+                stats["overlapFrac"] = round(
+                    write / (snap + write), 4
+                )
+        if _state.restore_per_state:
+            stats["restoreS"] = round(
+                sum(_state.restore_per_state.values()), 4
+            )
+        return stats
 
 
 def update_grad_params(sqr: float, var: float) -> None:
     """Latest GNS estimates from the train step's fused statistics."""
-    _state.grad_params = GradParams(sqr=float(sqr), var=float(var))
+    with _profile_lock:
+        _state.grad_params = GradParams(sqr=float(sqr), var=float(var))
 
 
 def update_progress(progress: float) -> None:
@@ -438,8 +465,13 @@ def _ensure_atexit_join() -> None:
 def fit_and_report_now() -> None:
     """Refit perf params and (best-effort) post sched hints."""
     perf = _fit()
-    if perf is not None:
-        _state.perf_params = perf
+    with _profile_lock:
+        if perf is not None:
+            _state.perf_params = perf
+        # Snapshot the cross-thread fields once, under the lock; the
+        # hint assembly below works on the local copies.
+        perf_params = _state.perf_params
+        grad_params = _state.grad_params
     if _state.init_batch_size is None:
         return
     hints = sched_hints.empty_hints()
@@ -462,11 +494,11 @@ def fit_and_report_now() -> None:
         # restart decisions against these instead of an assumed
         # penalty (sched/allocator.job_info_from_hints).
         hints["restartStats"] = stats
-    if _state.grad_params is not None:
-        hints["gradParams"] = dict(_state.grad_params._asdict())
-    if _state.perf_params is not None:
+    if grad_params is not None:
+        hints["gradParams"] = dict(grad_params._asdict())
+    if perf_params is not None:
         hints["perfParams"] = {
-            k: float(v) for k, v in _state.perf_params._asdict().items()
+            k: float(v) for k, v in perf_params._asdict().items()
         }
     sched_hints.post_sched_hints(hints)
 
@@ -474,14 +506,17 @@ def fit_and_report_now() -> None:
 def get_goodput_fn() -> GoodputFunction | None:
     """Assembled from the latest fitted perf + grad params, or None
     until both exist (reference: _metrics.py:96-101)."""
+    with _profile_lock:
+        perf_params = _state.perf_params
+        grad_params = _state.grad_params
     if (
-        _state.perf_params is None
-        or _state.grad_params is None
+        perf_params is None
+        or grad_params is None
         or _state.init_batch_size is None
     ):
         return None
     return GoodputFunction(
-        _state.perf_params, _state.grad_params, _state.init_batch_size
+        perf_params, grad_params, _state.init_batch_size
     )
 
 
@@ -498,7 +533,14 @@ class _MetricsCheckpoint(checkpoint.State):
         pass
 
     def save(self, fileobj):
-        payload = {
+        # Snapshot phase runs on the trainer thread while the fit /
+        # writer threads may be live — take one consistent view.
+        with _profile_lock:
+            payload = self._payload_locked()
+        pickle.dump(payload, fileobj)
+
+    def _payload_locked(self):  # holds-lock: _profile_lock
+        return {
             "profile": dict(_state.profile),
             "perf_params": _state.perf_params,
             "grad_params": _state.grad_params,
@@ -523,7 +565,6 @@ class _MetricsCheckpoint(checkpoint.State):
             "ckpt_per_state": dict(_state.ckpt_per_state),
             "num_retunes": _state.num_retunes,
         }
-        pickle.dump(payload, fileobj)
 
     def load(self, fileobj):
         payload = pickle.load(fileobj)
@@ -544,9 +585,18 @@ class _MetricsCheckpoint(checkpoint.State):
                     n, r, sp, tp, ss, 1, old_micro if ss > 1 else 1, bsz
                 )
             profile[key] = entry
-        _state.profile = profile
-        _state.perf_params = payload["perf_params"]
-        _state.grad_params = payload["grad_params"]
+        # Restore runs at incarnation start, but a fit thread kicked
+        # by an early profile_step may already be reading.
+        with _profile_lock:
+            _state.profile = profile
+            _state.perf_params = payload["perf_params"]
+            _state.grad_params = payload["grad_params"]
+            _state.ckpt_snapshot_s = payload.get("ckpt_snapshot_s")
+            _state.ckpt_write_s = payload.get("ckpt_write_s")
+            _state.ckpt_per_state = dict(
+                payload.get("ckpt_per_state", {})
+            )
+            _state.num_retunes = int(payload.get("num_retunes", 0))
         _state.init_batch_size = payload["init_batch_size"]
         _state.max_batch_size = payload["max_batch_size"]
         _state.local_bsz_bounds = payload["local_bsz_bounds"]
@@ -561,10 +611,6 @@ class _MetricsCheckpoint(checkpoint.State):
             "max_pipeline_micro", max(8, old_micro)
         )
         _state.progress = payload["progress"]
-        _state.ckpt_snapshot_s = payload.get("ckpt_snapshot_s")
-        _state.ckpt_write_s = payload.get("ckpt_write_s")
-        _state.ckpt_per_state = dict(payload.get("ckpt_per_state", {}))
-        _state.num_retunes = int(payload.get("num_retunes", 0))
 
 
 def ensure_checkpoint_registered() -> None:

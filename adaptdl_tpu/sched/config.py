@@ -1,92 +1,101 @@
 """Consolidated scheduler configuration.
 
-One module owning every scheduler-side env knob (the reference keeps
-them in sched/adaptdl_sched/config.py:19-73, wired through a
-Helm-managed ConfigMap); previously these were scattered. Trainer-side
-knobs stay in ``adaptdl_tpu.env`` (the ``ADAPTDL_*`` worker contract).
+One module owning every scheduler-side knob's defaults and validation
+(the reference keeps them in sched/adaptdl_sched/config.py:19-73,
+wired through a Helm-managed ConfigMap); previously these were
+scattered. The raw ``ADAPTDL_*`` environment reads live in
+``adaptdl_tpu.env`` — the single module allowed to touch ``os.environ``
+(enforced by graftcheck's env-registry pass) and deliberately
+default-free on the scheduler keys — while THIS layer owns the
+scheduler's policy: every cluster-internal default below, and JSON
+parsing that fails loudly on malformed input.
 
 All getters read the environment at call time so tests can
-monkeypatch; JSON-valued knobs fail loudly on malformed input.
+monkeypatch.
 """
 
 from __future__ import annotations
 
 import json
-import os
 from typing import Any
+
+from adaptdl_tpu import env
 
 
 def namespace() -> str:
     """Namespace the operator manages."""
-    return os.environ.get("ADAPTDL_NAMESPACE", "default")
+    return env.namespace() or "default"
 
 
 def job_image() -> str:
     """Default worker image for rendered job manifests."""
-    return os.environ.get("ADAPTDL_JOB_IMAGE", "adaptdl-tpu:latest")
+    return env.job_image() or "adaptdl-tpu:latest"
 
 
 def supervisor_url() -> str:
     """Cluster-internal supervisor URL injected into worker pods."""
-    return os.environ.get(
-        "ADAPTDL_SUPERVISOR_URL", "http://adaptdl-supervisor:8080"
-    )
+    return env.supervisor_url() or "http://adaptdl-supervisor:8080"
 
 
 def supervisor_port() -> int:
-    return int(os.environ.get("ADAPTDL_SUPERVISOR_PORT", "8080"))
+    port = env.supervisor_port()
+    return 8080 if port is None else port
 
 
 def webhook_port() -> int:
-    return int(os.environ.get("ADAPTDL_WEBHOOK_PORT", "8443"))
+    port = env.webhook_port()
+    return 8443 if port is None else port
 
 
 def webhook_cert() -> str | None:
     """Path to the webhook's TLS serving cert (the API server only
     speaks HTTPS to webhooks)."""
-    return os.environ.get("ADAPTDL_WEBHOOK_CERT")
+    return env.webhook_cert()
 
 
 def webhook_key() -> str | None:
-    return os.environ.get("ADAPTDL_WEBHOOK_KEY")
+    return env.webhook_key()
 
 
 def checkpoint_claim() -> str:
     """RWX PVC mounted into workers for checkpoints."""
-    return os.environ.get(
-        "ADAPTDL_CHECKPOINT_CLAIM", "adaptdl-checkpoints"
-    )
+    return env.checkpoint_claim() or "adaptdl-checkpoints"
 
 
 def allocator_interval() -> float:
     """Seconds between full Pollux re-optimizations (reference: 60s,
     allocator.py:108-134)."""
-    return float(os.environ.get("ADAPTDL_ALLOCATOR_INTERVAL", "60"))
+    interval = env.allocator_interval()
+    return 60.0 if interval is None else interval
 
 
 def max_worker_failures() -> int:
     """Non-graceful worker failures tolerated before a job is Failed."""
-    return int(os.environ.get("ADAPTDL_MAX_FAILURES", "2"))
+    failures = env.max_worker_failures()
+    return 2 if failures is None else failures
 
 
 def expander_min_slices() -> int:
-    return int(os.environ.get("ADAPTDL_MIN_SLICES", "0"))
+    count = env.expander_min_slices()
+    return 0 if count is None else count
 
 
 def expander_max_slices() -> int:
-    return int(os.environ.get("ADAPTDL_MAX_SLICES", "64"))
+    count = env.expander_max_slices()
+    return 64 if count is None else count
 
 
 def expander_scale_down_delay() -> float:
     """Seconds a lower desired-slice count must persist before the
     provisioner shrinks (slices take minutes to come up)."""
-    return float(os.environ.get("ADAPTDL_SCALE_DOWN_DELAY", "300"))
+    delay = env.expander_scale_down_delay()
+    return 300.0 if delay is None else delay
 
 
 def slice_template() -> dict[str, Any]:
     """Shape of a provisionable slice (used when the live inventory is
     empty, e.g. scale-from-zero): JSON resources dict."""
-    raw = os.environ.get("ADAPTDL_SLICE_TEMPLATE")
+    raw = env.slice_template_raw()
     if not raw:
         return {"tpu": 8}
     return dict(json.loads(raw))
@@ -95,7 +104,7 @@ def slice_template() -> dict[str, Any]:
 def default_job_resources() -> dict[str, Any]:
     """Per-replica resource requests injected when a job spec omits
     them (reference: config.py's JSON default-resources knob)."""
-    raw = os.environ.get("ADAPTDL_DEFAULT_RESOURCES")
+    raw = env.default_job_resources_raw()
     if not raw:
         return {"tpu": 1}
     return dict(json.loads(raw))
@@ -105,7 +114,7 @@ def gke_node_pool() -> dict[str, str] | None:
     """GKE autoscaling target as JSON: {"project": ..., "location":
     ..., "cluster": ..., "node_pool": ...}; None disables actuation
     (the expander then only logs desired sizes)."""
-    raw = os.environ.get("ADAPTDL_GKE_NODE_POOL")
+    raw = env.gke_node_pool_raw()
     if not raw:
         return None
     parsed = dict(json.loads(raw))

@@ -32,7 +32,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import os
 import sys
 
 from adaptdl_tpu.sched.allocator import Allocator
@@ -477,6 +476,8 @@ class Operator:
             self.state.update(key, status="Running")
 
     def _worker_pod(self, name, record, rank, node_pool):
+        from adaptdl_tpu.sched import config as sched_config
+
         template = dict(record.spec.get("template") or {})
         spec = dict(template.get("spec") or {})
         containers = [dict(c) for c in spec.get("containers", [])]
@@ -502,10 +503,7 @@ class Operator:
             },
             {
                 "name": "ADAPTDL_SUPERVISOR_URL",
-                "value": os.environ.get(
-                    "ADAPTDL_SUPERVISOR_URL",
-                    "http://adaptdl-supervisor:8080",
-                ),
+                "value": sched_config.supervisor_url(),
             },
             {
                 "name": "ADAPTDL_SEQ_SHARDS",

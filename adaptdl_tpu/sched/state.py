@@ -84,15 +84,19 @@ class ClusterState:
     """Threadsafe job table with change notification."""
 
     def __init__(self):
-        self._jobs: dict[str, JobRecord] = {}
         self._cond = threading.Condition()
+        # The job table is THE cross-component contract: allocator,
+        # supervisor, runner, and operator threads all touch it, so
+        # every access goes through the condition's lock (graftcheck's
+        # lock-discipline pass enforces this, GC101).
+        self._jobs: dict[str, JobRecord] = {}  # guarded-by: _cond
         # Lifecycle metrics (reference: the controller's Prometheus
         # submission Counter and completion-time Summary,
         # sched/adaptdl_sched/controller.py:35-41): monotonic across
         # job deletion, served by the supervisor's /metrics.
-        self._submitted_total = 0
+        self._submitted_total = 0  # guarded-by: _cond
         # final status -> (count, sum_of_completion_seconds)
-        self._completions: dict[str, tuple[int, float]] = {}
+        self._completions: dict[str, tuple[int, float]] = {}  # guarded-by: _cond
 
     def create_job(self, key: str, spec: dict | None = None) -> JobRecord:
         with self._cond:
@@ -150,6 +154,31 @@ class ClusterState:
             if record is None or record.batch_config is None:
                 return None
             return dict(record.batch_config)
+
+    def get_config_snapshot(self, key: str) -> dict | None:
+        """The job's full current decision — allocation, topology,
+        batch config, re-tune counter, restart group — as ONE locked
+        snapshot. The supervisor's /config endpoint serves exactly
+        this: reading the fields off a live JobRecord after the lock
+        dropped could pair a new batchConfig with a same-length stale
+        allocation, which the loader's size guard cannot detect."""
+        with self._cond:
+            record = self._jobs.get(key)
+            if record is None:
+                return None
+            return {
+                "allocation": list(record.allocation),
+                "topology": (
+                    dict(record.topology) if record.topology else None
+                ),
+                "batchConfig": (
+                    dict(record.batch_config)
+                    if record.batch_config
+                    else None
+                ),
+                "retunes": record.retunes,
+                "group": record.group,
+            }
 
     def publish_retune(self, key: str, batch_config: dict) -> None:
         """Record a batch-config-only decision: updates the published

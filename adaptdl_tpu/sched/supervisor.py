@@ -98,18 +98,10 @@ class Supervisor(ThreadedHttpServer):
         in-process — the re-tune fast path). Jobs poll this from the
         dataloader's re-optimization cadence."""
         key = "{namespace}/{name}".format(**request.match_info)
-        record = self._state.get_job(key)
-        if record is None:
+        snapshot = self._state.get_config_snapshot(key)
+        if snapshot is None:
             return web.json_response({"error": "no such job"}, status=404)
-        return web.json_response(
-            {
-                "allocation": list(record.allocation),
-                "topology": record.topology,
-                "batchConfig": record.batch_config,
-                "retunes": record.retunes,
-                "group": record.group,
-            }
-        )
+        return web.json_response(snapshot)
 
     async def _healthz(self, request: web.Request) -> web.Response:
         return web.json_response({"ok": True})

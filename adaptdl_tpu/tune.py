@@ -47,10 +47,15 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
+from adaptdl_tpu import env
+
 LOG = logging.getLogger(__name__)
 
-_CONFIG_ENV = "ADAPTDL_TRIAL_CONFIG"
-_RESULT_ENV = "ADAPTDL_TRIAL_RESULT_FILE"
+# Key spellings live in env.py (the ADAPTDL_* registry); the driver
+# writes them into child-process environments below, workers read them
+# back through the typed accessors.
+_CONFIG_ENV = env.TRIAL_CONFIG_KEY
+_RESULT_ENV = env.TRIAL_RESULT_KEY
 
 
 # ---- the in-script trial API ----------------------------------------
@@ -58,7 +63,7 @@ _RESULT_ENV = "ADAPTDL_TRIAL_RESULT_FILE"
 
 def get_trial_config() -> dict[str, Any]:
     """This trial's hyperparameters (empty when not under the tuner)."""
-    raw = os.environ.get(_CONFIG_ENV)
+    raw = env.trial_config_raw()
     return json.loads(raw) if raw else {}
 
 
@@ -66,7 +71,7 @@ def report(**metrics: float) -> None:
     """Stream one result row to the trial scheduler (appends a JSON
     line; restarts simply keep appending, so results survive
     rescales)."""
-    path = os.environ.get(_RESULT_ENV)
+    path = env.trial_result_file()
     if not path:
         return
     with open(path, "a") as f:

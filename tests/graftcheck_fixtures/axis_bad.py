@@ -1,0 +1,27 @@
+"""Known-bad collective axes: names no mesh in this module binds."""
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def build(devices):
+    return Mesh(devices, ("data", "model"))
+
+
+def grad_sync(grads):
+    return lax.pmean(grads, "dat")  # line 15: GC401 typo'd axis
+
+
+def stage_sum(x):
+    return jax.lax.psum(x, "stage")  # line 19: GC401 undeclared axis
+
+
+def mixed(x):
+    return lax.psum(x, (DATA_AXIS, "expert"))  # line 23: GC401 ("expert")
+
+
+def spec():
+    return P("data", None)

@@ -1,0 +1,39 @@
+"""Known-good collective axes: zero findings expected."""
+
+import jax
+from jax import lax
+from jax.sharding import Mesh
+
+from adaptdl_tpu.parallel.mesh import DATA_AXIS
+
+SEQ_AXIS = "seq"
+
+
+def build(devices):
+    return Mesh(devices, ("data", "seq"))
+
+
+def grad_sync(grads):
+    # Literal bound by the Mesh construction above.
+    return lax.pmean(grads, "data")
+
+
+def seq_sync(x):
+    # Module *_AXIS constant.
+    return jax.lax.psum(x, SEQ_AXIS)
+
+
+def imported_axis(x):
+    # Imported *_AXIS constant: trusted by name.
+    return lax.pmean(x, DATA_AXIS)
+
+
+def parameterized(x, axis_name):
+    # The parameterized style the parallel/ modules use.
+    idx = lax.axis_index(axis_name)
+    return lax.psum(x, axis_name) + idx
+
+
+def not_a_collective(mapping):
+    # dict.get with a string is not lax.psum.
+    return mapping.get("whatever")

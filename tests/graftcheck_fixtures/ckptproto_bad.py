@@ -1,0 +1,35 @@
+"""Known-bad checkpoint protocol: asymmetric overrides, I/O in snapshot."""
+
+import pickle
+
+from adaptdl_tpu import checkpoint
+
+
+class SnapshotOnly(checkpoint.State):  # line 8: GC501
+    """Overrides snapshot but not write_snapshot: the inherited
+    default writes raw bytes, not this host tree."""
+
+    def snapshot(self):
+        return {"params": self.params}
+
+
+class WriteOnly(checkpoint.State):  # line 16: GC501
+    def write_snapshot(self, snapshot, fileobj):
+        pickle.dump(snapshot, fileobj)
+
+
+class SnapshotDoesIO(checkpoint.State):
+    """Both overridden (no GC501) but snapshot performs file I/O."""
+
+    def snapshot(self):
+        with open("/tmp/side-payload", "wb") as f:  # line 25: GC502
+            pickle.dump(self.params, f)  # line 26: GC502
+        return {"path": "/tmp/side-payload"}
+
+    def write_snapshot(self, snapshot, fileobj):
+        pickle.dump(snapshot, fileobj)
+
+
+class Indirect(SnapshotOnly):  # line 33: GC501 (transitive State base)
+    def snapshot(self):
+        return dict(self.__dict__)

@@ -1,0 +1,40 @@
+"""Known-good checkpoint protocol: zero findings expected."""
+
+import io
+import pickle
+
+from adaptdl_tpu import checkpoint
+
+
+class NeitherOverridden(checkpoint.State):
+    """The byte-stream default pair: save()/load() only."""
+
+    def save(self, fileobj):
+        fileobj.write(pickle.dumps(self.value))
+
+    def load(self, fileobj):
+        self.value = pickle.load(fileobj)
+
+
+class BothOverridden(checkpoint.State):
+    """Device-backed style: snapshot captures, write_snapshot writes."""
+
+    def snapshot(self):
+        # In-memory capture only (BytesIO is not file I/O).
+        buf = io.BytesIO()
+        buf.write(pickle.dumps(self.value))
+        return buf.getvalue()
+
+    def write_snapshot(self, snapshot, fileobj):
+        fileobj.write(snapshot)
+
+    def save(self, fileobj):
+        self.write_snapshot(self.snapshot(), fileobj)
+
+
+class NotAState:
+    """Same method names, unrelated base: out of scope."""
+
+    def snapshot(self):
+        with open("/tmp/whatever", "wb") as f:
+            f.write(b"fine")

@@ -1,0 +1,42 @@
+"""Known-bad env access: raw ADAPTDL_* reads/writes outside env.py."""
+
+import os
+
+_KEY = "ADAPTDL_INDIRECT_KNOB"
+
+
+def read_get():
+    return os.environ.get("ADAPTDL_CHECKPOINT_PATH")  # line 9: GC301
+
+
+def read_getenv():
+    return os.getenv("ADAPTDL_NUM_REPLICAS", "1")  # line 13: GC301
+
+
+def read_subscript():
+    return os.environ["ADAPTDL_JOB_ID"]  # line 17: GC301
+
+
+def read_membership():
+    return "ADAPTDL_MASTER_ADDR" in os.environ  # line 21: GC301
+
+
+def read_via_constant():
+    return os.environ.get(_KEY)  # line 25: GC301 (resolved constant)
+
+
+def write_subscript(value):
+    os.environ["ADAPTDL_NUM_REPLICAS"] = value  # line 29: GC302
+
+
+def write_setdefault():
+    os.environ.setdefault("ADAPTDL_SHARE_PATH", "/tmp")  # line 33: GC302
+
+
+def unrelated_key():
+    # Non-ADAPTDL keys are out of scope for the registry.
+    return os.environ.get("HOME")
+
+
+def read_fstring(suffix):
+    return os.environ.get(f"ADAPTDL_{suffix}")  # line 42: GC301

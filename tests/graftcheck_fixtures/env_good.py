@@ -1,0 +1,17 @@
+"""Known-good env access: zero findings expected."""
+
+from adaptdl_tpu import env
+
+
+def typed_reads():
+    return env.checkpoint_path(), env.num_replicas(), env.job_id()
+
+
+def child_env(config_json):
+    # Launchers assemble CHILD process environments in plain dicts:
+    # not an os.environ access, so not a finding.
+    child = {
+        "ADAPTDL_NUM_REPLICAS": "8",
+        env.TRIAL_CONFIG_KEY: config_json,
+    }
+    return child

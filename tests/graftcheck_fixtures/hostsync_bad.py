@@ -1,0 +1,29 @@
+"""Known-bad host syncs: blocking ops in traced / hot-path code."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def decorated_step(state, batch):
+    loss = (state - batch) ** 2
+    host = float(loss)  # line 10: GC201 float() on a tracer
+    np.asarray(loss)  # line 11: GC201 np.asarray in traced code
+    loss.block_until_ready()  # line 12: GC201
+    return host
+
+
+def shard_mapped_step(state, batch):
+    grads = state * batch
+    value = grads.item()  # line 18: GC201 .item() in traced code
+    jax.device_get(grads)  # line 19: GC201
+    return value
+
+
+wrapped = jax.jit(shard_mapped_step)
+
+
+def run_step(trainer, batch):  # graftcheck: hot-path
+    out = trainer.step(batch)
+    jax.block_until_ready(out)  # line 28: GC202 per-step stall
+    return float(out)  # line 29: GC202 per-step host pull

@@ -1,0 +1,25 @@
+"""Known-good host syncs: zero findings expected."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def decorated_step(state, batch):
+    # On-device math only: no host round-trips inside the trace.
+    loss = jnp.mean((state - batch) ** 2)
+    scale = jnp.asarray(2.0)  # jnp stays on device: fine
+    return loss * scale, float("inf")  # constant cast: fine
+
+
+def untraced_helper(results):
+    # Not traced, not hot-path: host reads are unrestricted.
+    return [float(x) for x in results]
+
+
+def run_step(trainer, batch):  # graftcheck: hot-path
+    out = trainer.step(batch)
+    if trainer.should_pull():
+        # graftcheck: disable=GC202 (gated: pulls every N steps)
+        jax.block_until_ready(out)
+    return out

@@ -1,0 +1,45 @@
+"""Known-bad lock discipline: every marked line is a GC101 finding."""
+
+import threading
+from dataclasses import dataclass, field
+
+_profile_lock = threading.Lock()
+_writers = []  # guarded-by: _writers_lock
+_writers_lock = threading.Lock()
+
+
+@dataclass
+class State:
+    profile: dict = field(  # guarded-by: _profile_lock
+        default_factory=dict
+    )
+    num_retunes: int = 0  # guarded-by: _profile_lock
+
+
+_state = State()
+
+
+def record_retune():
+    _state.num_retunes += 1  # line 23: GC101 write outside lock
+
+
+def read_profile():
+    return dict(_state.profile)  # line 27: GC101 read outside lock
+
+
+def append_writer(thread):
+    _writers.append(thread)  # line 31: GC101 global outside lock
+
+
+def wrong_lock():
+    with _profile_lock:
+        _writers.clear()  # line 36: GC101 held lock is not the guard
+
+
+def outer_with_nested_shadow():
+    def helper():
+        _writers = ["local"]  # helper-local: shadows only in helper
+        return _writers
+
+    helper()
+    return list(_writers)  # line 45: GC101 (outer scope NOT shadowed)

@@ -1,0 +1,50 @@
+"""Known-good lock discipline: zero findings expected."""
+
+import threading
+from dataclasses import dataclass, field
+
+_profile_lock = threading.Lock()
+_writers = []  # guarded-by: _writers_lock
+_writers_lock = threading.Lock()
+
+
+@dataclass
+class State:
+    profile: dict = field(  # guarded-by: _profile_lock
+        default_factory=dict
+    )
+    num_retunes: int = 0  # guarded-by: _profile_lock
+
+
+_state = State()
+
+
+def record_retune():
+    with _profile_lock:
+        _state.num_retunes += 1
+
+
+def read_profile():
+    with _profile_lock:
+        return dict(_state.profile)
+
+
+def append_writer(thread):
+    with _writers_lock:
+        _writers.append(thread)
+
+
+def _drain_locked():  # holds-lock: _writers_lock
+    pending = list(_writers)
+    _writers.clear()
+    return pending
+
+
+def shadowing(_writers):
+    # A local parameter shadowing the guarded global is not an access.
+    return len(_writers)
+
+
+def justified():
+    # graftcheck: disable=GC101 (single-threaded setup path)
+    return _state.num_retunes

@@ -1,0 +1,16 @@
+"""File-level suppression: GC301 is disabled for this whole file.
+
+# graftcheck: disable-file=GC301
+"""
+
+import os
+
+# graftcheck: disable-file=GC301
+
+
+def read_one():
+    return os.environ.get("ADAPTDL_CHECKPOINT_PATH")  # suppressed
+
+
+def write_one(value):
+    os.environ["ADAPTDL_JOB_ID"] = value  # line 16: GC302 still fires

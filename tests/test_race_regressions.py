@@ -1,0 +1,223 @@
+"""Regression tests for the cross-thread races graftcheck surfaced.
+
+PR 1 moved checkpoint writes, AOT-cache serialization, and perf
+refits onto background threads; the lock-discipline pass (GC101) then
+flagged the fields those threads share with the trainer thread. Each
+fix here gets a regression test:
+
+- ``metrics.record_checkpoint_save`` (writer thread) vs
+  ``metrics.restart_stats`` (fit thread): torn triple / dict-churn.
+- ``metrics.record_checkpoint_restore`` inserting while
+  ``restart_stats`` sums the dict ("changed size during iteration").
+- ``metrics.record_retune`` increments from many threads.
+- ``AsyncSaveHandle.per_state`` mutated by the write pool while read.
+
+The deterministic tests use the block-until-released pattern: grab
+the declared lock, start the mutator on a thread, and assert it
+cannot finish until the lock is dropped — i.e. the access really is
+under the lock the annotation declares. The stochastic hammer tests
+would only fail without the locks (rarely but catastrophically); with
+them they can never fail.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from adaptdl_tpu import checkpoint, metrics
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    metrics._reset_state()
+    yield
+    metrics._reset_state()
+
+
+def assert_blocks_on(lock, fn, *args):
+    """``fn`` must not complete while ``lock`` is held, and must
+    complete promptly once released."""
+    done = threading.Event()
+
+    def runner():
+        fn(*args)
+        done.set()
+
+    with lock:
+        thread = threading.Thread(target=runner, daemon=True)
+        thread.start()
+        assert not done.wait(0.15), (
+            f"{fn.__name__} completed while the declared lock was "
+            "held — it is not honoring the guard"
+        )
+    assert done.wait(5.0), f"{fn.__name__} never finished"
+    thread.join(5.0)
+
+
+def test_record_checkpoint_save_honors_profile_lock():
+    assert_blocks_on(
+        metrics._profile_lock,
+        metrics.record_checkpoint_save,
+        0.5,
+        1.5,
+        {"state": {"write_s": 1.0}},
+    )
+
+
+def test_record_checkpoint_restore_honors_profile_lock():
+    assert_blocks_on(
+        metrics._profile_lock,
+        metrics.record_checkpoint_restore,
+        "some_state",
+        0.25,
+    )
+
+
+def test_record_retune_honors_profile_lock():
+    assert_blocks_on(metrics._profile_lock, metrics.record_retune)
+
+
+def test_restart_stats_honors_profile_lock():
+    metrics.record_checkpoint_save(0.5, 1.5, {})
+    assert_blocks_on(metrics._profile_lock, metrics.restart_stats)
+
+
+def test_update_grad_params_honors_profile_lock():
+    assert_blocks_on(
+        metrics._profile_lock, metrics.update_grad_params, 1.0, 2.0
+    )
+
+
+def test_retune_counter_is_exact_under_contention():
+    """num_retunes += 1 from many threads must never lose an update
+    (the unlocked read-modify-write could)."""
+    threads = [
+        threading.Thread(
+            target=lambda: [metrics.record_retune() for _ in range(500)]
+        )
+        for _ in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert metrics.current_state().num_retunes == 8 * 500
+
+
+def test_restart_stats_consistent_while_restores_insert():
+    """Summing restore_per_state while record_checkpoint_restore
+    inserts raised RuntimeError('dictionary changed size during
+    iteration') before the lock; it must never now."""
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def inserter():
+        i = 0
+        while not stop.is_set():
+            metrics.record_checkpoint_restore(f"state-{i}", 0.001)
+            i += 1
+
+    def reader():
+        try:
+            while not stop.is_set():
+                metrics.restart_stats()
+        except BaseException as exc:  # noqa: BLE001 - the regression
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=inserter),
+        threading.Thread(target=inserter),
+        threading.Thread(target=reader),
+        threading.Thread(target=reader),
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(5.0)
+    assert errors == []
+
+
+def test_restart_stats_never_tears_the_save_triple():
+    """snapshotS/writeS/overlapFrac must come from ONE
+    record_checkpoint_save call: writers publish (k, 2k) pairs, so
+    any observation where writeS != 2*snapshotS is a torn read."""
+    stop = threading.Event()
+    torn: list[dict] = []
+
+    def writer():
+        k = 1
+        while not stop.is_set():
+            metrics.record_checkpoint_save(
+                float(k), 2.0 * k, {"s": {"write_s": float(k)}}
+            )
+            k += 1
+
+    def checker():
+        while not stop.is_set():
+            stats = metrics.restart_stats()
+            if stats and "snapshotS" in stats:
+                if stats["writeS"] != 2.0 * stats["snapshotS"]:
+                    torn.append(stats)
+
+    threads = [
+        threading.Thread(target=writer),
+        threading.Thread(target=checker),
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(5.0)
+    assert torn == []
+
+
+def test_async_save_handle_per_state_is_locked():
+    handle = checkpoint.AsyncSaveHandle()
+
+    def record():
+        with handle._lock:
+            handle.per_state["x"] = {"write_s": 1.0}
+
+    assert_blocks_on(handle._lock, record)
+
+
+def test_parallel_write_phase_populates_per_state(tmp_path, monkeypatch):
+    """End to end: a wait=False save with several states lands every
+    per-state timing through the pool threads, and the handle's dict
+    is complete after wait() — the metrics feed reads the same data."""
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    monkeypatch.setenv("ADAPTDL_REPLICA_RANK", "0")
+
+    class Blob(checkpoint.State):
+        def __init__(self, name):
+            super().__init__(name)
+            self.payload = name.encode() * 100
+
+        def save(self, fileobj):
+            fileobj.write(self.payload)
+
+        def load(self, fileobj):
+            self.payload = fileobj.read()
+
+    states = [Blob(f"blob-{i}") for i in range(6)]
+    try:
+        handle = checkpoint.save_all_states(wait=False)
+        handle.wait()
+        assert handle.done()
+        with handle._lock:
+            per_state = dict(handle.per_state)
+        assert set(per_state) == {s.name for s in states}
+        for timing in per_state.values():
+            assert "snapshot_s" in timing and "write_s" in timing
+        stats = metrics.restart_stats()
+        assert stats is not None and "snapshotS" in stats
+    finally:
+        checkpoint.wait_for_inflight_save()
+        for s in states:
+            s.unregister()

@@ -1,0 +1,44 @@
+"""graftcheck: invariant-aware static analysis for the elastic stack.
+
+A small Python-AST analysis framework with passes tuned to THIS
+codebase's cross-cutting invariants — the ones PR 1's concurrency work
+introduced and nothing else enforces:
+
+- lock discipline on state shared with the async checkpoint/AOT
+  writer threads (``# guarded-by:`` annotations),
+- no blocking device->host syncs inside jit-traced code or hot loops,
+- every ``ADAPTDL_*`` environment read round-trips through
+  ``adaptdl_tpu/env.py`` and every key is documented,
+- ``lax.psum``-family axis names match an axis some mesh/shard_map in
+  the module actually binds,
+- the ``State.snapshot``/``write_snapshot`` checkpoint protocol.
+
+Run as ``python -m tools.graftcheck adaptdl_tpu/`` (see ``--help``),
+or from ``make lint``. Findings carry ``file:line``, a rule id, and a
+fix hint; ``graftcheck_baseline.json`` allowlists deliberately
+deferred findings so CI fails only on new ones. See
+``docs/static-analysis.md`` for the rule catalog and the annotation /
+suppression conventions.
+"""
+
+from tools.graftcheck.core import (  # noqa: F401
+    Context,
+    Finding,
+    Pass,
+    SourceFile,
+    analyze_paths,
+    load_baseline,
+    new_findings,
+)
+from tools.graftcheck.passes import ALL_PASSES  # noqa: F401
+
+__all__ = [
+    "ALL_PASSES",
+    "Context",
+    "Finding",
+    "Pass",
+    "SourceFile",
+    "analyze_paths",
+    "load_baseline",
+    "new_findings",
+]

@@ -1,0 +1,172 @@
+"""CLI: ``python -m tools.graftcheck [paths] [options]``.
+
+Exit status: 0 = clean (no findings beyond the committed baseline),
+1 = new findings, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from tools.graftcheck.core import (
+    CACHE_FILE,
+    DEFAULT_BASELINE,
+    Context,
+    analyze_paths,
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+from tools.graftcheck.passes import ALL_PASSES, RULE_CATALOG
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.graftcheck",
+        description=(
+            "Invariant-aware static analysis for the elastic training "
+            "stack (lock discipline, host-sync hazards, env registry, "
+            "collective axes, checkpoint protocol)."
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=["adaptdl_tpu"],
+        help="files or directories to analyze (default: adaptdl_tpu)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        help=(
+            "baseline JSON allowlisting known findings "
+            f"(default: ./{DEFAULT_BASELINE} when present)"
+        ),
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write all current findings to the baseline and exit 0",
+    )
+    parser.add_argument(
+        "--fast",
+        action="store_true",
+        help=(
+            "smoke mode: reuse cached per-file results for files "
+            f"unchanged since the last run (cache: ./{CACHE_FILE})"
+        ),
+    )
+    parser.add_argument(
+        "--rules",
+        default=None,
+        help="comma-separated rule-id prefixes to report (e.g. GC1,GC301)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="finding output format",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalog and exit",
+    )
+    parser.add_argument(
+        "--docs-dir",
+        default=None,
+        help="docs directory for GC303 (default: ./docs when present)",
+    )
+    parser.add_argument(
+        "-q", "--quiet", action="store_true", help="findings only"
+    )
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(RULE_CATALOG):
+            name, desc = RULE_CATALOG[rule]
+            print(f"{rule}  [{name}]  {desc}")
+        return 0
+
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(
+                f"graftcheck: no such path: {path}", file=sys.stderr
+            )
+            return 2
+
+    docs_dir = args.docs_dir
+    if docs_dir is None and os.path.isdir("docs"):
+        docs_dir = "docs"
+    ctx = Context(root=os.getcwd(), docs_dir=docs_dir)
+
+    start = time.monotonic()
+    findings = analyze_paths(
+        args.paths,
+        ALL_PASSES,
+        ctx,
+        use_cache=args.fast,
+        cache_path=CACHE_FILE,
+    )
+    if args.rules:
+        prefixes = tuple(
+            p.strip() for p in args.rules.split(",") if p.strip()
+        )
+        findings = [
+            f for f in findings if f.rule.startswith(prefixes)
+        ]
+
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE
+        if os.path.isfile(DEFAULT_BASELINE)
+        else None
+    )
+    if args.write_baseline:
+        path = args.baseline or DEFAULT_BASELINE
+        write_baseline(path, findings)
+        if not args.quiet:
+            print(
+                f"graftcheck: wrote {len(findings)} finding(s) to "
+                f"{path}"
+            )
+        return 0
+
+    baseline = (
+        load_baseline(baseline_path) if baseline_path else set()
+    )
+    fresh = new_findings(findings, baseline)
+    suppressed = len(findings) - len(fresh)
+
+    if args.format == "json":
+        import json
+
+        print(
+            json.dumps(
+                [f.to_json() for f in fresh], indent=2, sort_keys=True
+            )
+        )
+    else:
+        for finding in fresh:
+            print(finding.render())
+    if not args.quiet:
+        elapsed = time.monotonic() - start
+        note = (
+            f" ({suppressed} baselined)" if suppressed else ""
+        )
+        print(
+            f"graftcheck: {len(fresh)} finding(s){note} in "
+            f"{elapsed:.2f}s",
+            file=sys.stderr,
+        )
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

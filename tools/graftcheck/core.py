@@ -1,0 +1,437 @@
+"""graftcheck engine: source model, pass protocol, baseline, cache.
+
+Pure stdlib (ast + tokenize) on purpose — the analyzer must import in
+any environment the repo builds in, never depend on jax, and stay fast
+enough (< 10s on the whole package, < 1s warm) to sit in ``make lint``
+and tier-1 CI without anyone routing around it.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+TOOL_VERSION = "1"
+
+
+def tool_fingerprint() -> str:
+    """Cache-busting version for --fast: TOOL_VERSION plus the
+    (name, mtime, size) of every graftcheck source file, so editing a
+    pass invalidates cached per-file results without anyone having to
+    remember a manual version bump."""
+    tool_dir = os.path.dirname(os.path.abspath(__file__))
+    parts = [TOOL_VERSION]
+    for dirpath, dirnames, filenames in os.walk(tool_dir):
+        dirnames[:] = sorted(
+            d for d in dirnames if d != "__pycache__"
+        )
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                stat = os.stat(path)
+            except OSError:  # pragma: no cover
+                continue
+            parts.append(
+                f"{os.path.relpath(path, tool_dir)}:"
+                f"{stat.st_mtime}:{stat.st_size}"
+            )
+    import hashlib
+
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()
+
+CACHE_FILE = ".graftcheck_cache.json"
+DEFAULT_BASELINE = "graftcheck_baseline.json"
+
+# ---- annotation / suppression grammar --------------------------------
+#
+# Trailing comments carry the machine-readable invariants:
+#
+#   x = {}              # guarded-by: _lock      declare a guarded field
+#   def f():            # holds-lock: _lock      caller holds the lock
+#   def step():         # graftcheck: hot-path   host syncs are findings
+#   risky()             # graftcheck: disable=GC101 (why it is safe)
+#   # graftcheck: disable-file=GC301             anywhere in the file
+#   # graftcheck: declare-axes=data,seq          extra mesh axes
+
+GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
+HOLDS_LOCK_RE = re.compile(r"#\s*holds-lock:\s*([A-Za-z_][\w.]*)")
+HOT_PATH_RE = re.compile(r"#\s*graftcheck:\s*hot-path\b")
+DISABLE_RE = re.compile(r"#\s*graftcheck:\s*disable=([A-Z0-9,\s]+)")
+DISABLE_FILE_RE = re.compile(
+    r"#\s*graftcheck:\s*disable-file=([A-Z0-9,\s]+)"
+)
+DECLARE_AXES_RE = re.compile(
+    r"#\s*graftcheck:\s*declare-axes=([\w,\s-]+)"
+)
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation, pointing at a source line with a fix hint."""
+
+    file: str  # path relative to the analysis root
+    line: int
+    col: int
+    rule: str
+    message: str
+    hint: str = ""
+
+    def render(self) -> str:
+        text = f"{self.file}:{self.line}:{self.col}: {self.rule} {self.message}"
+        if self.hint:
+            text += f"  [hint: {self.hint}]"
+        return text
+
+    def baseline_key(self) -> str:
+        return f"{self.file}:{self.rule}:{self.line}"
+
+    def to_json(self) -> dict:
+        return {
+            "file": self.file,
+            "line": self.line,
+            "col": self.col,
+            "rule": self.rule,
+            "message": self.message,
+            "hint": self.hint,
+        }
+
+
+class SourceFile:
+    """A parsed module plus the comment-borne annotations passes read."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        # line -> raw comment text (tokenize sees comments; ast doesn't)
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                io.StringIO(text).readline
+            ):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except tokenize.TokenizeError:  # pragma: no cover - ast parsed
+            pass
+        # suppressions
+        self.line_disables: dict[int, set[str]] = {}
+        self.file_disables: set[str] = set()
+        for line, comment in self.comments.items():
+            m = DISABLE_RE.search(comment)
+            if m:
+                self.line_disables[line] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                }
+            m = DISABLE_FILE_RE.search(comment)
+            if m:
+                self.file_disables |= {
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                }
+        # child -> parent links for enclosing-scope queries
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    # -- tree helpers --------------------------------------------------
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        while node in self.parents:
+            node = self.parents[node]
+            yield node
+
+    def enclosing_function(
+        self, node: ast.AST
+    ) -> ast.FunctionDef | ast.AsyncFunctionDef | None:
+        for anc in self.ancestors(node):
+            if isinstance(
+                anc, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                return anc
+        return None
+
+    def enclosing_functions(
+        self, node: ast.AST
+    ) -> list[ast.FunctionDef | ast.AsyncFunctionDef]:
+        return [
+            anc
+            for anc in self.ancestors(node)
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+
+    # -- comment helpers -----------------------------------------------
+
+    def statement_comment(self, stmt: ast.stmt) -> str:
+        """All comment text within a statement's line span (annotations
+        may sit at the end of any continuation line)."""
+        end = getattr(stmt, "end_lineno", stmt.lineno)
+        return " ".join(
+            self.comments.get(line, "")
+            for line in range(stmt.lineno, end + 1)
+            if line in self.comments
+        )
+
+    def def_header_comment(self, fn: ast.AST) -> str:
+        """Comment text on a def's decorator/signature header lines."""
+        start = fn.lineno
+        if getattr(fn, "decorator_list", None):
+            start = min(start, fn.decorator_list[0].lineno)
+        body_start = fn.body[0].lineno if fn.body else fn.lineno
+        return " ".join(
+            self.comments.get(line, "")
+            for line in range(start, body_start + 1)
+            if line in self.comments
+        )
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        """Suppressed by a trailing ``# graftcheck: disable=`` on the
+        finding's line, on a comment-only line directly above it, or
+        by a file-level ``disable-file=``."""
+        if finding.rule in self.file_disables:
+            return True
+        rules = self.line_disables.get(finding.line)
+        if rules is not None and finding.rule in rules:
+            return True
+        line = finding.line - 1
+        while (
+            1 <= line <= len(self.lines)
+            and self.lines[line - 1].lstrip().startswith("#")
+        ):
+            rules = self.line_disables.get(line)
+            if rules is not None and finding.rule in rules:
+                return True
+            line -= 1
+        return False
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class Context:
+    """Project-level knobs shared by all passes."""
+
+    root: str  # directory findings are reported relative to
+    docs_dir: str | None = None  # where GC303 looks for key mentions
+    options: dict[str, Any] = field(default_factory=dict)
+
+
+class Pass:
+    """Base class for analysis passes.
+
+    ``check_file`` runs per module; ``check_project`` runs once with
+    every parsed module (for cross-file rules) and is excluded from
+    the --fast per-file cache. A project-level pass that must see
+    specific modules even on a warm cache (where unchanged files skip
+    parsing) lists their path suffixes in ``project_files``.
+    """
+
+    name: str = "pass"
+    rules: dict[str, str] = {}
+    project_files: tuple[str, ...] = ()
+
+    def check_file(
+        self, sf: SourceFile, ctx: Context
+    ) -> list[Finding]:
+        return []
+
+    def check_project(
+        self, files: list[SourceFile], ctx: Context
+    ) -> list[Finding]:
+        return []
+
+
+# ---- engine ----------------------------------------------------------
+
+
+def iter_python_files(paths: Iterable[str]) -> list[str]:
+    out: list[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                out.append(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(
+                d
+                for d in dirnames
+                if not d.startswith(".") and d != "__pycache__"
+            )
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    out.append(os.path.join(dirpath, name))
+    return out
+
+
+def parse_file(path: str, root: str) -> SourceFile | None:
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    rel = os.path.relpath(path, root)
+    return SourceFile(path, rel, text)
+
+
+def analyze_paths(
+    paths: list[str],
+    passes: list[Pass],
+    ctx: Context,
+    use_cache: bool = False,
+    cache_path: str | None = None,
+    on_syntax_error: Callable[[str, SyntaxError], None] | None = None,
+) -> list[Finding]:
+    """Run every pass over every .py file under ``paths``.
+
+    With ``use_cache``, per-file findings for files whose (mtime, size)
+    are unchanged since the last run are reused; project-level rules
+    always recompute (they depend on files outside the cache key).
+    """
+    cache: dict[str, Any] = {}
+    cache_dirty = False
+    version = tool_fingerprint() if use_cache else TOOL_VERSION
+    if use_cache and cache_path:
+        try:
+            with open(cache_path, encoding="utf-8") as f:
+                loaded = json.load(f)
+            if loaded.get("version") == version:
+                cache = loaded.get("files", {})
+        except (OSError, ValueError):
+            cache = {}
+
+    # Path suffixes that project-level passes always need parsed,
+    # even when the per-file cache lets everything else skip parsing.
+    always_parse = tuple(
+        suffix for pazz in passes for suffix in pazz.project_files
+    )
+
+    findings: list[Finding] = []
+    parsed: list[SourceFile] = []
+    for path in iter_python_files(paths):
+        rel = os.path.relpath(path, ctx.root)
+        try:
+            stat = os.stat(path)
+        except OSError:
+            continue
+        entry = cache.get(rel)
+        cache_hit = (
+            use_cache
+            and entry is not None
+            and entry.get("mtime") == stat.st_mtime
+            and entry.get("size") == stat.st_size
+        )
+        if cache_hit and not rel.replace(os.sep, "/").endswith(
+            always_parse or ("\0",)
+        ):
+            # Warm path: cached findings, no parse at all — parsing
+            # dominates a clean run's cost.
+            findings.extend(
+                Finding(**item) for item in entry.get("findings", [])
+            )
+            continue
+        try:
+            sf = parse_file(path, ctx.root)
+        except SyntaxError as exc:
+            findings.append(
+                Finding(
+                    file=rel,
+                    line=exc.lineno or 1,
+                    col=(exc.offset or 1) - 1,
+                    rule="GC001",
+                    message=f"syntax error: {exc.msg}",
+                    hint="graftcheck only analyzes parseable modules",
+                )
+            )
+            if on_syntax_error is not None:
+                on_syntax_error(rel, exc)
+            continue
+        parsed.append(sf)
+        if cache_hit:
+            findings.extend(
+                Finding(**item) for item in entry.get("findings", [])
+            )
+            continue
+        file_findings: list[Finding] = []
+        for pazz in passes:
+            for finding in pazz.check_file(sf, ctx):
+                if not sf.is_suppressed(finding):
+                    file_findings.append(finding)
+        findings.extend(file_findings)
+        if use_cache:
+            cache[rel] = {
+                "mtime": stat.st_mtime,
+                "size": stat.st_size,
+                "findings": [f.to_json() for f in file_findings],
+            }
+            cache_dirty = True
+
+    by_rel = {sf.rel: sf for sf in parsed}
+    for pazz in passes:
+        for finding in pazz.check_project(parsed, ctx):
+            sf = by_rel.get(finding.file)
+            if sf is None or not sf.is_suppressed(finding):
+                findings.append(finding)
+
+    if use_cache and cache_path and cache_dirty:
+        try:
+            with open(cache_path, "w", encoding="utf-8") as f:
+                json.dump({"version": version, "files": cache}, f)
+        except OSError:  # pragma: no cover - cache is best-effort
+            pass
+    return sorted(findings)
+
+
+# ---- baseline --------------------------------------------------------
+
+
+def load_baseline(path: str) -> set[str]:
+    """Allowlisted finding keys (``file:rule:line``) from a committed
+    baseline; missing file means an empty baseline."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return set()
+    return {
+        f"{item['file']}:{item['rule']}:{item['line']}"
+        for item in data.get("findings", [])
+    }
+
+
+def write_baseline(path: str, findings: list[Finding]) -> None:
+    payload = {
+        "comment": (
+            "graftcheck baseline: pre-existing findings deliberately "
+            "deferred. CI fails only on findings NOT listed here. "
+            "Regenerate with: python -m tools.graftcheck "
+            "--write-baseline <paths>"
+        ),
+        "findings": [f.to_json() for f in findings],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def new_findings(
+    findings: list[Finding], baseline: set[str]
+) -> list[Finding]:
+    return [
+        f for f in findings if f.baseline_key() not in baseline
+    ]

@@ -1,0 +1,23 @@
+"""Pass registry: one instance of every graftcheck pass."""
+
+from tools.graftcheck.passes.checkpoint_protocol import (
+    CheckpointProtocolPass,
+)
+from tools.graftcheck.passes.collective_axis import CollectiveAxisPass
+from tools.graftcheck.passes.env_registry import EnvRegistryPass
+from tools.graftcheck.passes.host_sync import HostSyncPass
+from tools.graftcheck.passes.lock_discipline import LockDisciplinePass
+
+ALL_PASSES = [
+    LockDisciplinePass(),
+    HostSyncPass(),
+    EnvRegistryPass(),
+    CollectiveAxisPass(),
+    CheckpointProtocolPass(),
+]
+
+RULE_CATALOG = {
+    rule: (pazz.name, desc)
+    for pazz in ALL_PASSES
+    for rule, desc in pazz.rules.items()
+}

@@ -1,0 +1,181 @@
+"""GC5xx — the State.snapshot / write_snapshot protocol contract.
+
+The two-phase save pipeline (CheckFreq split, checkpoint.py) relies on
+every ``State`` subclass keeping its phases separable:
+
+- **GC501** — a subclass overriding ``snapshot`` without
+  ``write_snapshot`` (or vice versa): the default counterpart
+  serializes/consumes the *other* representation, so overriding one
+  side silently breaks the async writer (the classic regression is a
+  device-backed state whose snapshot returns a host tree that the
+  default ``write_snapshot`` then writes as raw bytes).
+- **GC502** — file I/O inside a ``snapshot`` body: snapshot runs on
+  the training thread and must only capture a point-in-time copy; all
+  I/O belongs in ``write_snapshot`` on the writer thread (or the
+  state's payload store), otherwise the snapshot phase re-acquires the
+  write cost the pipeline exists to move off the critical path.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftcheck.core import (
+    Context,
+    Finding,
+    Pass,
+    SourceFile,
+    dotted_name,
+)
+
+_IO_CALLS = {
+    "open",
+    "os.replace",
+    "os.rename",
+    "os.remove",
+    "os.unlink",
+    "os.makedirs",
+    "os.mkdir",
+    "os.fsync",
+    "os.link",
+    "os.symlink",
+    "shutil.copy",
+    "shutil.copy2",
+    "shutil.copyfile",
+    "shutil.copytree",
+    "shutil.move",
+    "shutil.rmtree",
+    "tempfile.mkstemp",
+    "tempfile.mkdtemp",
+    "tempfile.NamedTemporaryFile",
+    "tempfile.TemporaryFile",
+    "pickle.dump",
+    "json.dump",
+    "np.save",
+    "np.savez",
+    "numpy.save",
+    "numpy.savez",
+}
+
+# NOTE: bare ``.write()``/``.flush()`` method calls are deliberately
+# NOT flagged — snapshot legitimately serializes into in-memory
+# buffers (io.BytesIO), and a lint cannot see the receiver's type.
+# The signal for "snapshot touches the filesystem" is the call that
+# OBTAINS or syncs a real file: open/os/shutil/tempfile, or a
+# serializer handed a file it opened (pickle.dump/json.dump still
+# belong on the writer thread).
+_IO_METHODS: set[str] = set()
+
+
+def _state_classes(sf: SourceFile) -> list[ast.ClassDef]:
+    """ClassDefs that (transitively, within this module) inherit from
+    a base whose last dotted component is ``State``."""
+    classes = [
+        node
+        for node in ast.walk(sf.tree)
+        if isinstance(node, ast.ClassDef)
+    ]
+    by_name = {cls.name: cls for cls in classes}
+
+    def is_state(cls: ast.ClassDef, seen: frozenset = frozenset()) -> bool:
+        if cls.name in seen:
+            return False
+        for base in cls.bases:
+            name = dotted_name(base)
+            if name is None:
+                continue
+            last = name.rsplit(".", 1)[-1]
+            if last == "State":
+                return True
+            parent = by_name.get(last)
+            if parent is not None and is_state(
+                parent, seen | {cls.name}
+            ):
+                return True
+        return False
+
+    return [cls for cls in classes if is_state(cls)]
+
+
+class CheckpointProtocolPass(Pass):
+    name = "checkpoint-protocol"
+    rules = {
+        "GC501": (
+            "State subclass overrides only one of snapshot/"
+            "write_snapshot"
+        ),
+        "GC502": "file I/O inside a State.snapshot body",
+    }
+
+    def check_file(
+        self, sf: SourceFile, ctx: Context
+    ) -> list[Finding]:
+        findings: list[Finding] = []
+        for cls in _state_classes(sf):
+            methods = {
+                node.name: node
+                for node in cls.body
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                )
+            }
+            has_snap = "snapshot" in methods
+            has_write = "write_snapshot" in methods
+            if has_snap != has_write:
+                present = "snapshot" if has_snap else "write_snapshot"
+                missing = "write_snapshot" if has_snap else "snapshot"
+                findings.append(
+                    Finding(
+                        file=sf.rel,
+                        line=cls.lineno,
+                        col=cls.col_offset,
+                        rule="GC501",
+                        message=(
+                            f"State subclass {cls.name!r} overrides "
+                            f"{present!r} but not {missing!r}: the "
+                            "inherited default handles a different "
+                            "snapshot representation"
+                        ),
+                        hint=(
+                            f"override {missing!r} too (they are the "
+                            "two halves of one serialization contract)"
+                        ),
+                    )
+                )
+            snap = methods.get("snapshot")
+            if snap is None:
+                continue
+            for node in ast.walk(snap):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                desc = None
+                if name:
+                    tail2 = ".".join(name.split(".")[-2:])
+                    if name in _IO_CALLS or tail2 in _IO_CALLS:
+                        desc = name
+                if (
+                    desc is None
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _IO_METHODS
+                ):
+                    desc = f".{node.func.attr}()"
+                if desc is not None:
+                    findings.append(
+                        Finding(
+                            file=sf.rel,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            rule="GC502",
+                            message=(
+                                f"{desc} inside {cls.name}.snapshot: "
+                                "snapshot must only capture state, "
+                                "never perform I/O"
+                            ),
+                            hint=(
+                                "move serialization/writes into "
+                                "write_snapshot (writer thread)"
+                            ),
+                        )
+                    )
+        return findings

@@ -1,0 +1,207 @@
+"""GC4xx — collective axis names must be bound by a real mesh axis.
+
+A ``lax.psum("dat", ...)`` typo, or a collective hard-coding an axis
+the enclosing mesh no longer declares, fails only at trace time on the
+exact topology that exercises it — which for elastic jobs can be a
+rescale in production. Rule:
+
+- **GC401** — a ``lax.psum``/``pmean``/``pmax``/``all_gather``-family
+  call whose axis argument is a string literal that no
+  ``shard_map``/``pmap``/``Mesh`` construction *in the same module*
+  binds, no module-level ``*_AXIS``/``*_AXES`` constant defines, and
+  no file-level ``# graftcheck: declare-axes=...`` declares.
+
+Axis arguments that are function parameters, imported ``*_AXIS``
+constants, or locally computed values are trusted — the rule only
+fires on unresolvable hard-coded literals, so it stays quiet on the
+parameterized style the parallel/ modules use.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftcheck.core import (
+    DECLARE_AXES_RE,
+    Context,
+    Finding,
+    Pass,
+    SourceFile,
+    dotted_name,
+)
+
+# lax collectives taking an axis-name argument, with its position.
+_COLLECTIVES = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "psum_scatter": 1,
+    "all_gather": 1,
+    "all_to_all": 1,
+    "ppermute": 1,
+    "pshuffle": 1,
+    "pswapaxes": 1,
+    "pbroadcast": 1,
+    "pcast": 1,
+    "axis_index": 0,
+    "axis_size": 0,
+}
+
+# Calls whose argument subtrees bind/declare mesh axis names.
+_AXIS_BINDERS = {
+    "shard_map",
+    "pmap",
+    "xmap",
+    "Mesh",
+    "AbstractMesh",
+    "make_mesh",
+    "make_jax_mesh",
+    "build_mesh",
+    "mesh",
+    "PartitionSpec",
+    "NamedSharding",
+}
+
+_AXIS_KWARGS = {"axis_name", "axis_names", "axes"}
+
+
+def _last(name: str | None) -> str | None:
+    return None if name is None else name.rsplit(".", 1)[-1].lstrip("_")
+
+
+def _strings_in(node: ast.AST) -> set[str]:
+    return {
+        n.value
+        for n in ast.walk(node)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+    }
+
+
+def _declared_axes(sf: SourceFile) -> tuple[set[str], set[str]]:
+    """(axis name strings declared in this module, names of constants
+    or imports that stand for axis names)."""
+    axes: set[str] = set()
+    axis_consts: set[str] = set()
+    for comment in sf.comments.values():
+        m = DECLARE_AXES_RE.search(comment)
+        if m:
+            axes |= {
+                a.strip() for a in m.group(1).split(",") if a.strip()
+            }
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            if _last(dotted_name(node.func)) in _AXIS_BINDERS:
+                for arg in node.args:
+                    axes |= _strings_in(arg)
+                for kw in node.keywords:
+                    axes |= _strings_in(kw.value)
+        elif isinstance(node, ast.Assign):
+            for target in node.targets:
+                if not isinstance(target, ast.Name):
+                    continue
+                if target.id.endswith(("_AXIS", "_AXES", "_axis")):
+                    axis_consts.add(target.id)
+                    axes |= _strings_in(node.value)
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                name = alias.asname or alias.name
+                if name.endswith(("_AXIS", "_AXES", "_axis")):
+                    axis_consts.add(name)
+    return axes, axis_consts
+
+
+def _lax_imports(sf: SourceFile) -> set[str]:
+    """Bare names imported from jax.lax or the _compat shims."""
+    names: set[str] = set()
+    for imp in ast.walk(sf.tree):
+        if isinstance(imp, ast.ImportFrom) and imp.module and (
+            imp.module.endswith("lax") or "_compat" in imp.module
+        ):
+            for alias in imp.names:
+                names.add(alias.asname or alias.name)
+    return names
+
+
+def _is_lax_call(
+    lax_names: set[str], node: ast.Call
+) -> str | None:
+    """The collective's short name if this call is a lax collective."""
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    short = _last(name)
+    if short not in _COLLECTIVES:
+        return None
+    if isinstance(node.func, ast.Attribute):
+        base = dotted_name(node.func.value) or ""
+        if base.split(".")[-1] != "lax":
+            return None
+        return short
+    # Bare name: only if imported from jax.lax / the compat shims.
+    if isinstance(node.func, ast.Name) and node.func.id in lax_names:
+        return short
+    return None
+
+
+class CollectiveAxisPass(Pass):
+    name = "collective-axis"
+    rules = {
+        "GC401": (
+            "collective axis name bound by no mesh/shard_map in this "
+            "module"
+        ),
+    }
+
+    def check_file(
+        self, sf: SourceFile, ctx: Context
+    ) -> list[Finding]:
+        axes, _axis_consts = _declared_axes(sf)
+        lax_names = _lax_imports(sf)
+        findings: list[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            short = _is_lax_call(lax_names, node)
+            if short is None:
+                continue
+            pos = _COLLECTIVES[short]
+            axis_arg: ast.expr | None = None
+            for kw in node.keywords:
+                if kw.arg in _AXIS_KWARGS:
+                    axis_arg = kw.value
+                    break
+            if axis_arg is None and len(node.args) > pos:
+                axis_arg = node.args[pos]
+            if axis_arg is None:
+                continue
+            # Only unresolvable string literals are findings: Name
+            # atoms (parameters, *_AXIS constants, locals) are trusted
+            # by design — see the module docstring's trust boundary.
+            for atom in ast.walk(axis_arg):
+                if not isinstance(atom, ast.Constant):
+                    continue
+                if not isinstance(atom.value, str):
+                    continue
+                if atom.value in axes:
+                    continue
+                findings.append(
+                    Finding(
+                        file=sf.rel,
+                        line=atom.lineno,
+                        col=atom.col_offset,
+                        rule="GC401",
+                        message=(
+                            f"axis {atom.value!r} in lax.{short} is "
+                            "bound by no shard_map/pmap/Mesh in this "
+                            "module"
+                        ),
+                        hint=(
+                            "pass the axis in as a parameter, use a "
+                            "*_AXIS constant, or declare it: "
+                            "`# graftcheck: declare-axes="
+                            f"{atom.value}`"
+                        ),
+                    )
+                )
+        return findings

@@ -1,0 +1,236 @@
+"""GC3xx — the ADAPTDL_* environment surface goes through env.py.
+
+Scheduler->job communication is env vars (the worker contract), so a
+raw ``os.environ`` read of an ``ADAPTDL_*`` key scattered in a random
+module is an undocumented, untyped protocol extension. Three rules:
+
+- **GC301** — ``os.environ.get``/``os.getenv``/``os.environ[...]``/
+  ``"X" in os.environ`` *read* of an ``ADAPTDL_*`` key outside the
+  registry module(s): use (or add) a typed accessor in
+  ``adaptdl_tpu/env.py``.
+- **GC302** — raw *write* (``os.environ[k] = ...``, ``setdefault``,
+  ``pop``, ``del``) of an ``ADAPTDL_*`` key outside the registry.
+- **GC303** — a key read inside the registry that no file under
+  ``docs/`` mentions: the env surface stays documented. (Project-level
+  rule; needs ``Context.docs_dir``.)
+
+Keys referenced through module-level string constants
+(``_CONFIG_ENV = "ADAPTDL_..."``) are resolved. Writes into plain
+dicts destined for child-process environments are not flagged — the
+launchers legitimately assemble those.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tools.graftcheck.core import (
+    Context,
+    Finding,
+    Pass,
+    SourceFile,
+    dotted_name,
+)
+
+_KEY_RE = re.compile(r"^ADAPTDL_[A-Z0-9_]+$")
+
+_READ_METHODS = {"get"}
+_WRITE_METHODS = {"setdefault", "pop", "update"}
+
+
+def _is_adaptdl_key(key: str) -> bool:
+    """Literal keys must fully match; a resolved f-string prefix
+    (``f"ADAPTDL_{x}"`` -> ``"ADAPTDL_*"``) counts when the static
+    prefix already commits to the ADAPTDL_ namespace."""
+    if key.endswith("*"):
+        return key[:-1].startswith("ADAPTDL_")
+    return bool(_KEY_RE.match(key))
+
+
+def _module_str_constants(sf: SourceFile) -> dict[str, str]:
+    consts: dict[str, str] = {}
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Constant
+        ):
+            if isinstance(node.value.value, str):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        consts[target.id] = node.value.value
+    return consts
+
+
+def _resolve_key(
+    node: ast.expr | None, consts: dict[str, str]
+) -> str | None:
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and isinstance(
+            first.value, str
+        ):
+            # A formatted key with an ADAPTDL_ prefix still counts.
+            return first.value + "*"
+    return None
+
+
+def _is_environ(node: ast.expr) -> bool:
+    name = dotted_name(node)
+    return name in ("os.environ", "environ")
+
+
+class EnvRegistryPass(Pass):
+    name = "env-registry"
+    rules = {
+        "GC301": "raw ADAPTDL_* environment read outside env.py",
+        "GC302": "raw ADAPTDL_* environment write outside env.py",
+        "GC303": "env key read in env.py but documented nowhere in docs/",
+    }
+    # GC303 must see the registry module even on a warm --fast cache.
+    project_files = ("env.py",)
+
+    def _env_modules(self, ctx: Context) -> tuple[str, ...]:
+        return tuple(
+            ctx.options.get(
+                "env_modules", ("adaptdl_tpu/env.py", "env.py")
+            )
+        )
+
+    def _is_registry(self, sf: SourceFile, ctx: Context) -> bool:
+        rel = sf.rel.replace(os.sep, "/")
+        return any(
+            rel == mod or rel.endswith("/" + mod)
+            for mod in self._env_modules(ctx)
+        )
+
+    def check_file(
+        self, sf: SourceFile, ctx: Context
+    ) -> list[Finding]:
+        if self._is_registry(sf, ctx):
+            return []
+        consts = _module_str_constants(sf)
+        findings: list[Finding] = []
+
+        def flag(node: ast.AST, key: str, write: bool) -> None:
+            rule = "GC302" if write else "GC301"
+            action = "write" if write else "read"
+            findings.append(
+                Finding(
+                    file=sf.rel,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=rule,
+                    message=(
+                        f"raw environment {action} of {key!r} outside "
+                        "the env registry"
+                    ),
+                    hint=(
+                        "route through a typed accessor in "
+                        "adaptdl_tpu/env.py (add one if missing)"
+                    ),
+                )
+            )
+
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name in ("os.getenv", "getenv"):
+                    key = _resolve_key(
+                        node.args[0] if node.args else None, consts
+                    )
+                    if key and _is_adaptdl_key(key):
+                        flag(node, key, write=False)
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and _is_environ(node.func.value)
+                    and node.func.attr
+                    in (_READ_METHODS | _WRITE_METHODS)
+                ):
+                    key = _resolve_key(
+                        node.args[0] if node.args else None, consts
+                    )
+                    if key and _is_adaptdl_key(key):
+                        flag(
+                            node,
+                            key,
+                            write=node.func.attr in _WRITE_METHODS,
+                        )
+            elif isinstance(node, ast.Subscript) and _is_environ(
+                node.value
+            ):
+                key = _resolve_key(node.slice, consts)
+                if key and _is_adaptdl_key(key):
+                    flag(
+                        node,
+                        key,
+                        write=isinstance(
+                            node.ctx, (ast.Store, ast.Del)
+                        ),
+                    )
+            elif isinstance(node, ast.Compare) and any(
+                isinstance(op, (ast.In, ast.NotIn))
+                for op in node.ops
+            ):
+                if node.comparators and _is_environ(
+                    node.comparators[-1]
+                ):
+                    key = _resolve_key(node.left, consts)
+                    if key and _is_adaptdl_key(key):
+                        flag(node, key, write=False)
+        return findings
+
+    def check_project(
+        self, files: list[SourceFile], ctx: Context
+    ) -> list[Finding]:
+        if ctx.docs_dir is None or not os.path.isdir(ctx.docs_dir):
+            return []
+        docs_text = ""
+        for dirpath, _dirnames, filenames in os.walk(ctx.docs_dir):
+            for name in sorted(filenames):
+                if name.endswith((".md", ".rst", ".txt")):
+                    try:
+                        with open(
+                            os.path.join(dirpath, name),
+                            encoding="utf-8",
+                        ) as f:
+                            docs_text += f.read()
+                    except OSError:  # pragma: no cover
+                        continue
+        findings: list[Finding] = []
+        for sf in files:
+            if not self._is_registry(sf, ctx):
+                continue
+            seen: set[str] = set()
+            for node in ast.walk(sf.tree):
+                if (
+                    isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and _KEY_RE.match(node.value)
+                    and node.value not in seen
+                ):
+                    seen.add(node.value)
+                    if node.value not in docs_text:
+                        findings.append(
+                            Finding(
+                                file=sf.rel,
+                                line=node.lineno,
+                                col=node.col_offset,
+                                rule="GC303",
+                                message=(
+                                    f"env key {node.value!r} is read "
+                                    "by the registry but never "
+                                    "documented under docs/"
+                                ),
+                                hint=(
+                                    "add it to docs/environment.md"
+                                ),
+                            )
+                        )
+        return findings

@@ -1,0 +1,207 @@
+"""GC2xx — blocking device->host syncs where they must not happen.
+
+Two rules:
+
+- **GC201** — a blocking host-sync operation (``.item()``,
+  ``float()``/``int()`` on a non-constant, ``np.asarray``/``np.array``,
+  ``jax.device_get``, ``block_until_ready``) inside *traced* code: a
+  function decorated with ``jit``/``pjit``/``pmap``, or passed by name
+  to ``jax.jit``/``shard_map``/``pmap`` anywhere in the module. On a
+  tracer these either raise ``ConcretizationTypeError`` at trace time
+  or silently force a device round-trip per call.
+- **GC202** — the same operations inside a function annotated
+  ``# graftcheck: hot-path`` (the per-step loop): each one stalls the
+  XLA dispatch pipeline, which is exactly the regression class the
+  async rescale work (PR 1) exists to avoid. Deliberate, throttled
+  pulls carry an inline ``disable=GC202 (why)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftcheck.core import (
+    HOT_PATH_RE,
+    Context,
+    Finding,
+    Pass,
+    SourceFile,
+    dotted_name,
+)
+
+# Callables that hand a function to the tracer.
+_TRACING_ENTRY_POINTS = {
+    "jit",
+    "pjit",
+    "pmap",
+    "shard_map",
+    "xmap",
+    "checkpoint",  # jax.checkpoint / remat also trace
+    "remat",
+}
+
+# Attribute methods that block on device values.
+_BLOCKING_METHODS = {"item", "block_until_ready", "tolist"}
+
+# Dotted callables that block (matched on the last two components).
+_BLOCKING_CALLS = {
+    "jax.device_get",
+    "jax.block_until_ready",
+    "np.asarray",
+    "np.array",
+    "numpy.asarray",
+    "numpy.array",
+    "onp.asarray",
+    "onp.array",
+}
+
+_CAST_BUILTINS = {"float", "int", "bool"}
+
+
+def _call_last(node: ast.Call) -> str | None:
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    return name.rsplit(".", 1)[-1].lstrip("_")
+
+
+def _collect_traced(sf: SourceFile) -> set[ast.AST]:
+    """Function defs that end up inside a trace, detected from
+    decorators and from by-name first arguments to jit/shard_map."""
+    defs_by_name: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    traced: set[ast.AST] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = dotted_name(target)
+                # @partial(jax.jit, ...) hides the entry point in arg 0
+                if (
+                    name
+                    and name.rsplit(".", 1)[-1] == "partial"
+                    and isinstance(dec, ast.Call)
+                    and dec.args
+                ):
+                    name = dotted_name(dec.args[0])
+                if (
+                    name
+                    and name.rsplit(".", 1)[-1].lstrip("_")
+                    in _TRACING_ENTRY_POINTS
+                ):
+                    traced.add(node)
+        elif isinstance(node, ast.Call):
+            last = _call_last(node)
+            if last in _TRACING_ENTRY_POINTS and node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Name):
+                    traced.update(defs_by_name.get(first.id, []))
+    return traced
+
+
+def _blocking_ops(
+    fn: ast.AST, sf: SourceFile
+) -> list[tuple[ast.Call, str]]:
+    """(call, description) for every blocking host-sync op lexically
+    inside ``fn``."""
+    out: list[tuple[ast.Call, str]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            # Argument count is irrelevant: numpy's indexed
+            # ``arr.item(0)`` blocks exactly like ``arr.item()``.
+            if func.attr in _BLOCKING_METHODS:
+                out.append((node, f".{func.attr}()"))
+                continue
+        name = dotted_name(func)
+        if name:
+            tail2 = ".".join(name.split(".")[-2:])
+            if (
+                tail2 in _BLOCKING_CALLS
+                or name in _BLOCKING_CALLS
+            ):
+                out.append((node, name))
+                continue
+        if (
+            isinstance(func, ast.Name)
+            and func.id in _CAST_BUILTINS
+            and len(node.args) == 1
+            and not isinstance(node.args[0], ast.Constant)
+        ):
+            out.append((node, f"{func.id}()"))
+    return out
+
+
+class HostSyncPass(Pass):
+    name = "host-sync"
+    rules = {
+        "GC201": "blocking device->host sync inside jit-traced code",
+        "GC202": "blocking device->host sync in a hot-path function",
+    }
+
+    def check_file(
+        self, sf: SourceFile, ctx: Context
+    ) -> list[Finding]:
+        findings: list[Finding] = []
+        traced = _collect_traced(sf)
+        hot = {
+            node
+            for node in ast.walk(sf.tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and HOT_PATH_RE.search(sf.def_header_comment(node))
+        }
+        seen: set[tuple[int, int, str]] = set()
+        for fn in traced:
+            for call, desc in _blocking_ops(fn, sf):
+                key = (call.lineno, call.col_offset, "GC201")
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(
+                    Finding(
+                        file=sf.rel,
+                        line=call.lineno,
+                        col=call.col_offset,
+                        rule="GC201",
+                        message=(
+                            f"{desc} inside traced function "
+                            f"{getattr(fn, 'name', '?')!r} blocks on "
+                            "(or fails to trace) a device value"
+                        ),
+                        hint=(
+                            "compute on-device (jnp.*) or move the "
+                            "host read outside the jitted step"
+                        ),
+                    )
+                )
+        for fn in hot:
+            if fn in traced:
+                continue
+            for call, desc in _blocking_ops(fn, sf):
+                key = (call.lineno, call.col_offset, "GC202")
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(
+                    Finding(
+                        file=sf.rel,
+                        line=call.lineno,
+                        col=call.col_offset,
+                        rule="GC202",
+                        message=(
+                            f"{desc} in hot-path function "
+                            f"{fn.name!r} stalls the async dispatch "
+                            "pipeline every step"
+                        ),
+                        hint=(
+                            "batch/throttle the host pull, or justify "
+                            "with `# graftcheck: disable=GC202 (why)`"
+                        ),
+                    )
+                )
+        return findings

@@ -1,0 +1,277 @@
+"""GC1xx — lock discipline / race lint.
+
+State shared between the trainer thread and the async checkpoint/AOT
+writer threads is declared with a trailing ``# guarded-by: <lock>``
+annotation on its defining statement:
+
+- a module-level assignment guards that GLOBAL by name,
+- a class-body field (dataclass) or a ``self.attr = ...`` assignment
+  guards that ATTRIBUTE name module-wide.
+
+Every subsequent read or write of a guarded name in the same module
+must sit lexically inside ``with <lock>:`` (matching the lock's last
+dotted component — ``with self._cond:`` and ``with _profile_lock:``
+both count) or inside a function annotated ``# holds-lock: <lock>``
+(for helpers documented as called with the lock held).
+
+This is a lexical lint, not an escape analysis: it cannot see
+happens-before edges like "written before Thread.start()", so
+deliberate lock-free accesses carry an inline
+``# graftcheck: disable=GC101 (why)`` — which is exactly the audit
+trail we want on every such site.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from tools.graftcheck.core import (
+    GUARDED_BY_RE,
+    HOLDS_LOCK_RE,
+    Context,
+    Finding,
+    Pass,
+    SourceFile,
+    dotted_name,
+)
+
+
+@dataclass(frozen=True)
+class _Guard:
+    kind: str  # "global" | "attr"
+    field: str
+    lock: str  # last dotted component of the lock expression
+    decl_line: int
+    decl_end: int
+
+
+def _target_names(stmt: ast.stmt) -> list[ast.expr]:
+    if isinstance(stmt, ast.Assign):
+        return stmt.targets
+    if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        return [stmt.target]
+    return []
+
+
+def _collect_guards(sf: SourceFile) -> tuple[list[_Guard], list[Finding]]:
+    guards: list[_Guard] = []
+    problems: list[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        m = GUARDED_BY_RE.search(sf.statement_comment(node))
+        if not m:
+            continue
+        lock = m.group(1).rsplit(".", 1)[-1]
+        parent = sf.parents.get(node)
+        end = getattr(node, "end_lineno", node.lineno)
+        for target in _target_names(node):
+            if isinstance(target, ast.Name):
+                if isinstance(parent, ast.ClassDef):
+                    # dataclass-style field declaration
+                    guards.append(
+                        _Guard("attr", target.id, lock, node.lineno, end)
+                    )
+                elif isinstance(parent, ast.Module):
+                    guards.append(
+                        _Guard(
+                            "global", target.id, lock, node.lineno, end
+                        )
+                    )
+                else:
+                    problems.append(
+                        Finding(
+                            file=sf.rel,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            rule="GC102",
+                            message=(
+                                "guarded-by annotation on a local "
+                                f"variable {target.id!r} has no effect"
+                            ),
+                            hint=(
+                                "annotate the module global, class "
+                                "field, or self.<attr> assignment"
+                            ),
+                        )
+                    )
+            elif (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                guards.append(
+                    _Guard("attr", target.attr, lock, node.lineno, end)
+                )
+    return guards, problems
+
+
+def _with_locks(sf: SourceFile, node: ast.AST) -> set[str]:
+    """Last dotted components of every lock held at ``node`` via
+    lexically-enclosing ``with`` statements or holds-lock functions."""
+    held: set[str] = set()
+    for anc in sf.ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                expr = item.context_expr
+                # `with lock:` or `with cond:` — also unwrap
+                # `lock.acquire()`-style calls conservatively.
+                if isinstance(expr, ast.Call):
+                    expr = expr.func
+                name = dotted_name(expr)
+                if name:
+                    held.add(name.rsplit(".", 1)[-1])
+        elif isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for m in HOLDS_LOCK_RE.finditer(
+                sf.def_header_comment(anc)
+            ):
+                held.add(m.group(1).rsplit(".", 1)[-1])
+    return held
+
+
+def _function_locals(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+) -> set[str]:
+    """Names bound in ``fn``'s OWN scope (params + assignments +
+    nested def/class names), minus ``global``/``nonlocal``
+    declarations — used to skip accesses that shadow a guarded
+    global. Must not descend into nested function/class bodies: a
+    name bound only inside a nested def is NOT a local of ``fn``, and
+    treating it as one would silently disable the race lint for
+    exactly the closures that spawn writer threads."""
+    args = fn.args
+    names = {
+        a.arg
+        for a in (
+            list(args.posonlyargs)
+            + list(args.args)
+            + list(args.kwonlyargs)
+        )
+    }
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    escaped: set[str] = set()
+    stack: list[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            escaped.update(node.names)
+            continue
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            names.add(node.id)
+        elif isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+        ):
+            # The nested def's NAME binds here; its body is another
+            # scope (decorators/defaults do evaluate here, but names
+            # they bind are rare enough to ignore).
+            names.add(node.name)
+            continue
+        elif isinstance(node, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return names - escaped
+
+
+class LockDisciplinePass(Pass):
+    name = "lock-discipline"
+    rules = {
+        "GC101": (
+            "access to a guarded field outside its declared lock"
+        ),
+        "GC102": "malformed or ineffective guarded-by annotation",
+    }
+
+    def check_file(
+        self, sf: SourceFile, ctx: Context
+    ) -> list[Finding]:
+        guards, findings = _collect_guards(sf)
+        if not guards:
+            return findings
+        global_guards = {
+            g.field: g for g in guards if g.kind == "global"
+        }
+        attr_guards = {g.field: g for g in guards if g.kind == "attr"}
+        module_names = set()
+        for n in ast.walk(sf.tree):
+            if isinstance(n, ast.Name):
+                module_names.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                module_names.add(n.attr)
+        for g in guards:
+            if g.lock not in module_names:
+                findings.append(
+                    Finding(
+                        file=sf.rel,
+                        line=g.decl_line,
+                        col=0,
+                        rule="GC102",
+                        message=(
+                            f"guarded-by lock {g.lock!r} for field "
+                            f"{g.field!r} is never mentioned in this "
+                            "module"
+                        ),
+                        hint="fix the annotation or define the lock",
+                    )
+                )
+
+        locals_cache: dict[ast.AST, set[str]] = {}
+
+        def shadowed(node: ast.AST, name: str) -> bool:
+            for fn in sf.enclosing_functions(node):
+                if fn not in locals_cache:
+                    locals_cache[fn] = _function_locals(fn)
+                if name in locals_cache[fn]:
+                    return True
+            return False
+
+        for node in ast.walk(sf.tree):
+            guard: _Guard | None = None
+            if isinstance(node, ast.Name) and node.id in global_guards:
+                guard = global_guards[node.id]
+                if shadowed(node, node.id):
+                    continue
+            elif (
+                isinstance(node, ast.Attribute)
+                and node.attr in attr_guards
+            ):
+                guard = attr_guards[node.attr]
+            if guard is None:
+                continue
+            if guard.decl_line <= node.lineno <= guard.decl_end:
+                continue  # the annotated defining statement itself
+            # `global NAME` declarations aren't accesses (they are
+            # ast.Global, never ast.Name) — nothing to skip here.
+            if guard.lock in _with_locks(sf, node):
+                continue
+            access = (
+                "write"
+                if isinstance(node.ctx, (ast.Store, ast.Del))
+                else "read"
+            )
+            findings.append(
+                Finding(
+                    file=sf.rel,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule="GC101",
+                    message=(
+                        f"{access} of {guard.field!r} (guarded-by "
+                        f"{guard.lock}, line {guard.decl_line}) "
+                        f"outside `with {guard.lock}:`"
+                    ),
+                    hint=(
+                        f"wrap in `with {guard.lock}:`, mark the "
+                        f"enclosing def `# holds-lock: {guard.lock}`, "
+                        "or justify with `# graftcheck: "
+                        "disable=GC101 (reason)`"
+                    ),
+                )
+            )
+        return findings
