@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck probe
+.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos probe
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -35,6 +35,14 @@ lint:
 # Cold, cache-free analysis (what CI's lint job runs).
 graftcheck:
 	$(PY) -m tools.graftcheck adaptdl_tpu
+
+# The chaos suite (docs/robustness.md): seeded fault schedules through
+# every injection point — kill-during-save, RPC drop/latency,
+# supervisor blackout, payload corruption, runner retry budgets.
+# Fixed seed so a failure replays exactly.
+chaos:
+	$(CPU_ENV) ADAPTDL_FAULT_SEED=1234 $(PY) -m pytest \
+	    tests/test_chaos.py -q --durations=10
 
 probe:
 	timeout 180 $(PY) tools/tpu_probe.py || echo "probe: tunnel dead/cpu-only"

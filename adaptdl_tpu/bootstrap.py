@@ -17,17 +17,37 @@ init). The TPU-native sequence:
 from __future__ import annotations
 
 import logging
+import threading
 
-from adaptdl_tpu import _signal, collective, env
+from adaptdl_tpu import _signal, collective, env, rpc, sched_hints
 
 LOG = logging.getLogger(__name__)
 
+# Rendezvous retry budgets. Registration is small and idempotent, so
+# it retries aggressively through transient supervisor blips (a 143
+# restart storm is exactly when the supervisor is busiest); discover
+# is a long poll with its own server-side timeout, so it gets few
+# client-side attempts but a generous overall deadline.
+_REGISTER_ATTEMPTS = 6
+_REGISTER_DEADLINE = 120.0
+_DISCOVER_ATTEMPTS = 3
+_DISCOVER_DEADLINE = 700.0
+
 
 def _discover_peers() -> dict[int, str] | None:
-    """Register with the supervisor and wait for all peer processes."""
-    import socket
+    """Register with the supervisor and wait for all peer processes.
 
-    import requests
+    Both calls ride the resilient rpc client: a transient supervisor
+    error (connection reset, 5xx, restart blip) is retried with
+    backoff inside a bounded deadline instead of raising out of
+    ``initialize_job`` and killing the worker. Re-registration is
+    idempotent — the supervisor keys workers by (group, rank) and
+    overwrites the address — so a worker restarted after exit-143 (or
+    a retry that raced a success) can blindly register again. A 404
+    is retried too: after a supervisor restart the runner re-creates
+    the job record a moment after workers come back.
+    """
+    import socket
 
     url = env.supervisor_url()
     job = env.job_id()
@@ -36,18 +56,60 @@ def _discover_peers() -> dict[int, str] | None:
     group = env.num_restarts()
     rank = env.process_rank()
     address = f"{socket.gethostbyname(socket.gethostname())}"
-    requests.put(
+    client = rpc.default_client()
+    client.put(
         f"{url}/register/{job}/{group}/{rank}",
         json={"address": address},
-        timeout=30,
+        endpoint=f"register/{job}",
+        timeout=(5, 30),
+        attempts=_REGISTER_ATTEMPTS,
+        deadline=_REGISTER_DEADLINE,
+        retry_statuses=rpc.RETRY_STATUSES + (404,),
     ).raise_for_status()
-    response = requests.get(
+    response = client.get(
         f"{url}/discover/{job}/{group}",
         params={"replicas": env.num_processes()},
-        timeout=330,
+        endpoint=f"discover/{job}",
+        timeout=(5, 330),
+        attempts=_DISCOVER_ATTEMPTS,
+        deadline=_DISCOVER_DEADLINE,
     )
     response.raise_for_status()
     return {int(r): addr for r, addr in response.json().items()}
+
+
+_heartbeat_stop: threading.Event | None = None
+
+
+def start_heartbeat() -> threading.Event | None:
+    """Start the liveness-heartbeat daemon thread (idempotent).
+
+    Workers renew their supervisor lease every
+    ``ADAPTDL_HEARTBEAT_INTERVAL`` seconds; hint posts and config
+    fetches also renew it as a side effect (piggybacked liveness), so
+    this thread only matters when a worker is alive but not talking —
+    e.g. rank > 0, or a long compile. Returns the stop event, or None
+    when heartbeating is not applicable (no supervisor, disabled)."""
+    global _heartbeat_stop
+    interval = env.heartbeat_interval()
+    if not env.supervisor_url() or not env.job_id() or interval <= 0:
+        return None
+    if _heartbeat_stop is not None and not _heartbeat_stop.is_set():
+        return _heartbeat_stop
+    stop = threading.Event()
+    rank = env.process_rank()
+
+    def loop():
+        sched_hints.send_heartbeat(rank=rank)
+        while not stop.wait(interval):
+            sched_hints.send_heartbeat(rank=rank)
+
+    thread = threading.Thread(
+        target=loop, name="adaptdl-heartbeat", daemon=True
+    )
+    thread.start()
+    _heartbeat_stop = stop
+    return stop
 
 
 def initialize_job(distributed: bool | None = None) -> None:
@@ -66,6 +128,7 @@ def initialize_job(distributed: bool | None = None) -> None:
         peers = _discover_peers()
     except Exception:  # noqa: BLE001 - rendezvous is best-effort local
         LOG.exception("supervisor discovery failed; continuing solo")
+    start_heartbeat()
     if not collective.initialized():
         master = peers.get(0) if peers else None
         collective.initialize(

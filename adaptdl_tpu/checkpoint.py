@@ -15,7 +15,10 @@ Saving is a two-phase pipeline (the CheckFreq FAST'21 split):
    the copies of every state overlap each other; the phase returns as
    soon as the host copies exist and training's next step may run.
 2. **write** — a writer serializes all the snapshots in parallel into
-   a fresh temp dir, atomically renames it to the next versioned name,
+   a fresh temp dir, records an integrity ``manifest.json`` (per-state
+   sha256 + size, verified again on load — see
+   :func:`_verify_state_payload`), atomically renames it to the next
+   versioned name,
    fsyncs the parent directory (so the completed save survives power
    loss, not just process kill), prunes superseded dirs, and runs the
    per-state :meth:`State.commit` hooks. With ``wait=False`` the whole
@@ -38,7 +41,9 @@ different slice sizes.)
 
 from __future__ import annotations
 
+import hashlib
 import io
+import json
 import logging
 import os
 import re
@@ -49,9 +54,16 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import IO, Any
 
-from adaptdl_tpu import env
+from adaptdl_tpu import env, faults
 
 LOG = logging.getLogger(__name__)
+
+# Per-version integrity manifest, written inside the atomic-rename
+# window: name -> sha256/size of every state payload in the dir. A
+# bit-flipped or truncated payload then fails verification at load
+# time instead of deserializing into silent garbage (Check-N-Run's
+# argument: checksums are what make frequent checkpoints trustworthy).
+MANIFEST_NAME = "manifest.json"
 
 # Parallel per-state serialization width for the write phase.
 _WRITE_THREADS = 4
@@ -325,6 +337,58 @@ def save_all_states(wait: bool = True) -> AsyncSaveHandle:
     return handle
 
 
+class _HashingWriter:
+    """File wrapper that sha256s the byte stream as it is written.
+
+    If a ``write_snapshot`` implementation mutates the file any other
+    way — ``seek`` (then overwrite), ``truncate`` — the running
+    digest no longer matches the file; the writer marks itself dirty
+    and the caller falls back to re-hashing the finished file from
+    disk (``State`` is user-extensible, so a wrong-but-recorded
+    digest would brick every restore of that state).
+    """
+
+    def __init__(self, fileobj: IO[bytes]):
+        self._f = fileobj
+        self._sha = hashlib.sha256()
+        self.size = 0
+        self.seeked = False
+
+    def write(self, data) -> int:
+        view = memoryview(data)
+        self._sha.update(view)
+        self.size += view.nbytes
+        return self._f.write(data)
+
+    def writelines(self, lines) -> None:
+        for line in lines:
+            self.write(line)
+
+    def seek(self, *args, **kwargs):
+        self.seeked = True
+        return self._f.seek(*args, **kwargs)
+
+    def truncate(self, *args, **kwargs):
+        self.seeked = True
+        return self._f.truncate(*args, **kwargs)
+
+    def hexdigest(self) -> str:
+        return self._sha.hexdigest()
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+
+def _hash_file(path: str) -> tuple[str, int]:
+    sha = hashlib.sha256()
+    size = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            sha.update(chunk)
+            size += len(chunk)
+    return sha.hexdigest(), size
+
+
 def _write_snapshots(
     root: str,
     restart: int,
@@ -333,8 +397,8 @@ def _write_snapshots(
     handle: AsyncSaveHandle,
 ) -> None:
     """The write phase: parallel per-state serialization into a fresh
-    temp dir, atomic rename to the next versioned name, parent-dir
-    fsync, prune, commit hooks."""
+    temp dir, integrity manifest, atomic rename to the next versioned
+    name, parent-dir fsync, prune, commit hooks."""
     os.makedirs(root, exist_ok=True)
     existing = _list_checkpoints(root)
     # Write into a fresh temp dir on the same filesystem, then atomically
@@ -342,13 +406,26 @@ def _write_snapshots(
     # is only deleted after this one fully exists, so a kill at any point
     # leaves at least one complete checkpoint on disk.
     tmpdir = tempfile.mkdtemp(prefix=_TMP_PREFIX, dir=root)
+    digest_lock = threading.Lock()
+    # name -> {"sha256": ..., "bytes": ...}; pool threads fill it
+    # under digest_lock.
+    digests: dict[str, dict[str, Any]] = {}
 
     def write_one(state: "State", snap: Any) -> None:
         t0 = time.monotonic()
-        with open(os.path.join(tmpdir, state.name), "wb") as f:
-            state.write_snapshot(snap, f)
+        faults.maybe_fail("ckpt.write.state")
+        path = os.path.join(tmpdir, state.name)
+        with open(path, "wb") as f:
+            writer = _HashingWriter(f)
+            state.write_snapshot(snap, writer)
             f.flush()
             os.fsync(f.fileno())
+        if writer.seeked:
+            sha, size = _hash_file(path)
+        else:
+            sha, size = writer.hexdigest(), writer.size
+        with digest_lock:
+            digests[state.name] = {"sha256": sha, "bytes": size}
         # Pool threads share this dict: the lock (not GIL luck) makes
         # the setdefault-then-assign pair atomic.
         with handle._lock:
@@ -371,12 +448,32 @@ def _write_snapshots(
         elif states:
             write_one(states[0], snapshots[0])
         seq = next_save_seq(existing, restart)
+        # Integrity manifest, written INSIDE the rename window: a
+        # renamed checkpoint always carries the digests of exactly the
+        # payloads it contains, so load_state can prove (not assume)
+        # completeness and integrity.
+        faults.maybe_fail("ckpt.manifest.write")
+        manifest_path = os.path.join(tmpdir, MANIFEST_NAME)
+        with open(manifest_path, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "version": 1,
+                    "restart": restart,
+                    "seq": seq,
+                    "states": digests,
+                },
+                f,
+                sort_keys=True,
+            )
+            f.flush()
+            os.fsync(f.fileno())
         final = os.path.join(root, f"checkpoint-{restart}.{seq}")
         # The state files' directory ENTRIES live in tmpdir's own
         # directory inode: without this fsync a power loss after the
         # rename could leave a complete-looking checkpoint dir with
-        # missing files (which load_state would silently skip).
+        # missing files (which the manifest now catches at load).
         _fsync_dir(tmpdir)
+        faults.maybe_fail("ckpt.write.pre_rename")
         os.replace(tmpdir, final)
     except BaseException:
         shutil.rmtree(tmpdir, ignore_errors=True)
@@ -385,6 +482,7 @@ def _write_snapshots(
     # without this a power loss after "success" could roll back to the
     # pre-save state (or worse, to the pruned state below).
     _fsync_dir(root)
+    faults.maybe_fail("ckpt.write.post_rename")
     # Prune everything superseded by the save that just completed,
     # including temp dirs abandoned by crashed incarnations.
     for _, _, path in existing:
@@ -424,6 +522,68 @@ _bad_dirs: set[str] = set()
 _loaded_from: dict[str, str] = {}
 
 
+def read_manifest(ckpt: str) -> dict | None:
+    """The checkpoint dir's integrity manifest: a dict, ``None`` when
+    absent (pre-manifest checkpoint), or raises ``ValueError`` when
+    present but unparseable/malformed — the dir then cannot be
+    trusted at all."""
+    path = os.path.join(ckpt, MANIFEST_NAME)
+    try:
+        with open(path, encoding="utf-8") as f:
+            manifest = json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as exc:
+        raise ValueError(f"unreadable manifest in {ckpt}: {exc}")
+    if not isinstance(manifest, dict) or not isinstance(
+        manifest.get("states"), dict
+    ):
+        raise ValueError(f"malformed manifest in {ckpt}")
+    return manifest
+
+
+def _verify_state_payload(ckpt: str, name: str) -> str:
+    """Integrity verdict for one state's payload in one checkpoint
+    dir: ``"ok"`` (safe to load), ``"skip"`` (state not in this
+    checkpoint — try an older dir, dir stays trusted), or
+    ``"corrupt"`` (the dir lies about this state — poison it)."""
+    path = os.path.join(ckpt, name)
+    present = os.path.isfile(path)
+    if not env.checkpoint_verify():
+        return "ok" if present else "skip"
+    try:
+        manifest = read_manifest(ckpt)
+    except ValueError:
+        LOG.warning("corrupt manifest in %s", ckpt, exc_info=True)
+        return "corrupt"
+    if manifest is None:
+        # Pre-manifest checkpoint: nothing to verify against —
+        # load_state's exception fallback still applies.
+        return "ok" if present else "skip"
+    entry = manifest["states"].get(name)
+    if entry is None:
+        # The save that produced this dir did not include this state:
+        # a payload file claiming otherwise was not written by it.
+        return "corrupt" if present else "skip"
+    if not present:
+        # Listed but missing: the dir is incomplete (e.g. lost file
+        # entries after a partial sync) — nothing in it is trustworthy.
+        return "corrupt"
+    try:
+        sha, size = _hash_file(path)
+    except OSError:
+        return "corrupt"
+    if size != entry.get("bytes") or sha != entry.get("sha256"):
+        LOG.warning(
+            "integrity mismatch for state %r in %s: "
+            "size %d vs %s, sha256 %s vs %s",
+            name, ckpt, size, entry.get("bytes"),
+            sha, entry.get("sha256"),
+        )
+        return "corrupt"
+    return "ok"
+
+
 class CheckpointUnreadableError(RuntimeError):
     """Checkpoints exist on disk but none could be restored.
 
@@ -458,9 +618,24 @@ def load_state(state: State) -> bool:
     for _, _, ckpt in reversed(_list_checkpoints(root)):
         if ckpt in _bad_dirs:
             continue
-        path = os.path.join(ckpt, state.name)
-        if not os.path.isfile(path):
+        # Prove the payload before deserializing it: a bit-flipped or
+        # truncated file fails its manifest digest here instead of
+        # loading as silent garbage (pickle and np.load happily accept
+        # many corruptions).
+        verdict = _verify_state_payload(ckpt, state.name)
+        if verdict == "corrupt":
+            attempted = True
+            LOG.warning(
+                "checkpoint %s failed integrity verification for "
+                "state %r; falling back to an older checkpoint",
+                ckpt,
+                state.name,
+            )
+            _poison_dir(ckpt)
             continue
+        if verdict == "skip":
+            continue
+        path = os.path.join(ckpt, state.name)
         t0 = time.monotonic()
         try:
             with open(path, "rb") as f:

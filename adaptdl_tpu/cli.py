@@ -169,9 +169,15 @@ def _cmd_ls(args) -> int:
             file=sys.stderr,
         )
         return 2
-    import requests
+    from adaptdl_tpu import rpc
 
-    text = requests.get(f"{args.supervisor}/metrics", timeout=10).text
+    text = rpc.default_client().get(
+        f"{args.supervisor}/metrics",
+        endpoint="cli/metrics",
+        timeout=10,
+        attempts=3,
+        deadline=30.0,
+    ).text
     print(text, end="")
     return 0
 
@@ -228,10 +234,14 @@ def _ls_k8s(args) -> int:
 
 
 def _cmd_hints(args) -> int:
-    import requests
+    from adaptdl_tpu import rpc
 
-    response = requests.get(
-        f"{args.supervisor}/hints/{args.job}", timeout=10
+    response = rpc.default_client().get(
+        f"{args.supervisor}/hints/{args.job}",
+        endpoint="cli/hints",
+        timeout=10,
+        attempts=3,
+        deadline=30.0,
     )
     print(json.dumps(response.json(), indent=2))
     return 0

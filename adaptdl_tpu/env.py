@@ -252,6 +252,42 @@ def compile_cache_knob() -> str:
     return os.environ.get("ADAPTDL_COMPILE_CACHE", "")
 
 
+def fault_spec_raw() -> str | None:
+    """Fault-injection schedule for chaos testing, as the raw spec
+    string (``faults.py`` parses the grammar). Unset — the production
+    state — compiles every injection point to a no-op."""
+    return _get_str("ADAPTDL_FAULT_SPEC")
+
+
+def fault_seed() -> int:
+    """Seed for the fault schedule's probabilistic clauses, so a
+    chaos run's failures replay exactly."""
+    return _get_int("ADAPTDL_FAULT_SEED", 0)
+
+
+def heartbeat_interval() -> float:
+    """Seconds between worker liveness heartbeats to the supervisor
+    (0 disables the dedicated heartbeat thread; liveness then rides
+    only on piggybacked hint/config traffic)."""
+    return _get_float("ADAPTDL_HEARTBEAT_INTERVAL", 20.0)
+
+
+def lease_ttl() -> float:
+    """Seconds a worker's liveness lease stays valid without renewal
+    before the supervisor declares it dead, marks the job degraded,
+    and triggers reallocation (0 disables lease expiry)."""
+    return _get_float("ADAPTDL_LEASE_TTL", 120.0)
+
+
+def checkpoint_verify() -> bool:
+    """Whether ``load_state`` verifies per-state sha256/size against
+    the checkpoint's integrity manifest before restoring (``off``/
+    ``0``/``false``/``none`` disables — restores then trust storage,
+    pre-manifest behavior)."""
+    knob = os.environ.get("ADAPTDL_CKPT_VERIFY", "")
+    return knob.lower() not in ("off", "0", "false", "none")
+
+
 def trial_config_raw() -> str | None:
     """This tuner trial's hyperparameters as a JSON string, set by the
     trial scheduler (tune.py) in the worker's environment."""

@@ -1,0 +1,273 @@
+"""Deterministic fault injection for the elastic control plane.
+
+Checkpoint-restart elasticity is only trustworthy if the failure paths
+are exercised, not just the happy paths — Check-N-Run (NSDI'22) makes
+the same argument for checksummed checkpointing at scale. This module
+is the chaos harness's substrate: named *injection points* threaded
+through the checkpoint write pipeline, the RPC client, the supervisor
+handlers, and the runners. Production code calls
+``faults.maybe_fail("ckpt.write.pre_rename")`` at each point; with no
+fault schedule installed that call is a single global read and an
+immediate return, so the instrumented paths cost nothing in real runs.
+
+A schedule comes from ``ADAPTDL_FAULT_SPEC`` (or
+:func:`configure` in-process) — semicolon-separated clauses:
+
+    <point>=<action>[:<value>][@<n>[+] | %<p>]
+
+- ``fail`` — raise :class:`InjectedFault` (a dropped RPC, a dying
+  writer); ``fail@3`` only on the 3rd hit of the point, ``fail@3+``
+  on the 3rd and every later hit, ``fail%0.2`` with probability 0.2.
+- ``exit`` — ``os._exit(1)``: a hard kill at exactly this point
+  (kill-during-save windows), same ``@``/``%`` qualifiers.
+- ``sleep:S`` — inject S seconds of latency (slow RPCs, slow
+  storage), same qualifiers: ``rpc.request.send=sleep:0.5%0.1``.
+
+Hit counts are per point name and process-wide; probability decisions
+are derived from ``ADAPTDL_FAULT_SEED`` + the point name + the hit
+index, so a given (spec, seed) replays the exact same fault schedule
+— chaos failures reproduce.
+
+Every point name used by the codebase must be registered in
+:data:`INJECTION_POINTS` below; graftcheck rule GC602 flags literal
+``maybe_fail`` names missing from this catalog, and an active schedule
+rejects unknown names at parse time (a typo'd clause must fail loudly,
+not silently never fire).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import threading
+import time
+
+from adaptdl_tpu import env
+
+LOG = logging.getLogger(__name__)
+
+# The injection-point catalog: every ``maybe_fail`` site in the
+# package, by name. Keep this a plain literal dict — graftcheck's
+# GC602 pass parses it statically to validate call sites.
+INJECTION_POINTS = {
+    # checkpoint write pipeline (checkpoint._write_snapshots)
+    "ckpt.write.state": "per-state serialization into the temp dir",
+    "ckpt.manifest.write": "integrity manifest write, pre-rename",
+    "ckpt.write.pre_rename": "after all writes, before the atomic rename",
+    "ckpt.write.post_rename": "after the rename, before pruning",
+    # sharded payload store (sharded_checkpoint.sync)
+    "ckpt.sharded.payload": "orbax payload save into the versioned dir",
+    # resilient RPC client (rpc.RpcClient.request)
+    "rpc.request.send": "before each HTTP attempt leaves the client",
+    "rpc.response.recv": "after a response arrives, before it is returned",
+    # supervisor handlers (sched.supervisor; injected faults become 500s)
+    "sup.register.pre": "worker registration handler",
+    "sup.discover.pre": "rendezvous long-poll handler",
+    "sup.hints.pre": "sched-hints intake handler",
+    "sup.config.pre": "job-config snapshot handler",
+    "sup.heartbeat.pre": "heartbeat lease-renewal handler",
+    # worker lifecycle backends (sched.local_runner / sched.multi_runner)
+    "runner.launch.pre": "before a worker subprocess launch",
+    "runner.supervise.poll": "each supervision poll cycle",
+}
+
+
+class InjectedFault(RuntimeError):
+    """A failure raised by the fault-injection schedule."""
+
+
+class _Clause:
+    """One parsed spec clause: an action with its firing qualifier."""
+
+    __slots__ = ("point", "action", "value", "nth", "nth_plus", "prob")
+
+    def __init__(self, point, action, value, nth, nth_plus, prob):
+        self.point = point
+        self.action = action  # "fail" | "exit" | "sleep"
+        self.value = value  # sleep seconds (0.0 otherwise)
+        self.nth = nth  # fire on this 1-based hit (None = every hit)
+        self.nth_plus = nth_plus  # with nth: fire on every hit >= nth
+        self.prob = prob  # fire with this probability (None = always)
+
+    def should_fire(self, hit: int, seed: int) -> bool:
+        if self.nth is not None:
+            if self.nth_plus:
+                if hit < self.nth:
+                    return False
+            elif hit != self.nth:
+                return False
+        if self.prob is not None:
+            return _decision(seed, self.point, hit) < self.prob
+        return True
+
+
+def _decision(seed: int, point: str, hit: int) -> float:
+    """Deterministic uniform [0, 1) draw for (seed, point, hit) —
+    ``random.Random`` state would be shared across points and
+    ``hash()`` is salted per process, so neither replays."""
+    digest = hashlib.sha256(
+        f"{seed}|{point}|{hit}".encode()
+    ).digest()
+    return int.from_bytes(digest[:8], "big") / float(1 << 64)
+
+
+def _parse_clause(text: str) -> _Clause:
+    point, sep, action_text = text.partition("=")
+    point = point.strip()
+    action_text = action_text.strip()
+    if not sep or not point or not action_text:
+        raise ValueError(f"fault clause must be point=action: {text!r}")
+    if point not in INJECTION_POINTS:
+        raise ValueError(
+            f"unknown injection point {point!r} (see "
+            "adaptdl_tpu/faults.py INJECTION_POINTS)"
+        )
+    nth = None
+    nth_plus = False
+    prob = None
+    if "@" in action_text:
+        action_text, _, qual = action_text.partition("@")
+        qual = qual.strip()
+        nth_plus = qual.endswith("+")
+        nth = int(qual.rstrip("+"))
+        if nth < 1:
+            raise ValueError(f"@N must be >= 1 in {text!r}")
+    elif "%" in action_text:
+        action_text, _, qual = action_text.partition("%")
+        prob = float(qual)
+        if not 0.0 <= prob <= 1.0:
+            raise ValueError(f"%p must be in [0, 1] in {text!r}")
+    action, _, value_text = action_text.strip().partition(":")
+    action = action.strip()
+    if action not in ("fail", "exit", "sleep"):
+        raise ValueError(
+            f"unknown fault action {action!r} in {text!r} "
+            "(expected fail, exit, or sleep)"
+        )
+    value = 0.0
+    if action == "sleep":
+        if not value_text:
+            raise ValueError(f"sleep needs seconds (sleep:S) in {text!r}")
+        value = float(value_text)
+    elif value_text:
+        raise ValueError(f"{action} takes no value in {text!r}")
+    return _Clause(point, action, value, nth, nth_plus, prob)
+
+
+class _Schedule:
+    """A parsed fault spec plus its per-point hit counters."""
+
+    def __init__(self, spec: str, seed: int):
+        self.spec = spec
+        self.seed = seed
+        self.clauses: dict[str, list[_Clause]] = {}
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            clause = _parse_clause(part)
+            self.clauses.setdefault(clause.point, []).append(clause)
+        self._lock = threading.Lock()
+        # Hit counters are bumped from every instrumented thread
+        # (trainer, checkpoint writer, supervisor event loop).
+        self._hits: dict[str, int] = {}  # guarded-by: _lock
+
+    def hit_count(self, point: str) -> int:
+        with self._lock:
+            return self._hits.get(point, 0)
+
+    def fire(self, point: str) -> None:
+        if point not in INJECTION_POINTS:
+            raise ValueError(
+                f"maybe_fail called with unregistered point {point!r}"
+            )
+        with self._lock:
+            hit = self._hits.get(point, 0) + 1
+            self._hits[point] = hit
+        for clause in self.clauses.get(point, ()):
+            if not clause.should_fire(hit, self.seed):
+                continue
+            if clause.action == "sleep":
+                LOG.debug(
+                    "fault injection: sleep %.3fs at %s (hit %d)",
+                    clause.value, point, hit,
+                )
+                time.sleep(clause.value)
+            elif clause.action == "exit":
+                LOG.warning(
+                    "fault injection: hard exit at %s (hit %d)",
+                    point, hit,
+                )
+                os._exit(1)
+            else:
+                LOG.debug(
+                    "fault injection: fail at %s (hit %d)", point, hit
+                )
+                raise InjectedFault(f"{point} (hit {hit})")
+
+
+# The active schedule. None = fault injection disabled, which is the
+# production state: maybe_fail is then one global load + return.
+# Written only by configure()/reset() (test setup / process init);
+# instrumented threads only read it, and a torn read is impossible for
+# a single reference assignment.
+_schedule: _Schedule | None = None
+_env_loaded = False
+
+
+def configure(spec: str | None, seed: int | None = None) -> None:
+    """Install (or clear, with ``spec=None``) a fault schedule
+    in-process, overriding ``ADAPTDL_FAULT_SPEC``."""
+    global _schedule, _env_loaded
+    _env_loaded = True
+    _schedule = (
+        _Schedule(spec, seed if seed is not None else env.fault_seed())
+        if spec
+        else None
+    )
+
+
+def reset() -> None:
+    """Clear any schedule and re-arm the env-driven lazy load
+    (test teardown)."""
+    global _schedule, _env_loaded
+    _schedule = None
+    _env_loaded = False
+
+
+def _load_from_env() -> None:
+    global _schedule, _env_loaded
+    _env_loaded = True
+    spec = env.fault_spec_raw()
+    if spec:
+        _schedule = _Schedule(spec, env.fault_seed())
+        LOG.warning(
+            "fault injection ACTIVE: spec=%r seed=%d",
+            spec, _schedule.seed,
+        )
+
+
+def is_active() -> bool:
+    if not _env_loaded:
+        _load_from_env()
+    return _schedule is not None
+
+
+def hit_count(point: str) -> int:
+    """How many times ``point`` has been reached under the active
+    schedule (0 when inactive) — chaos tests assert on this."""
+    schedule = _schedule
+    return schedule.hit_count(point) if schedule is not None else 0
+
+
+def maybe_fail(point: str) -> None:
+    """Reach injection point ``point``: no-op without a schedule;
+    otherwise count the hit and run any matching clause (raise
+    :class:`InjectedFault`, ``os._exit``, or sleep)."""
+    if not _env_loaded:
+        _load_from_env()
+    schedule = _schedule
+    if schedule is None:
+        return
+    schedule.fire(point)

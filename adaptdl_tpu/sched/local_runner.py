@@ -30,6 +30,7 @@ import subprocess
 import sys
 import time
 
+from adaptdl_tpu import faults
 from adaptdl_tpu._compat import pick_unused_port
 
 from adaptdl_tpu._signal import GRACEFUL_EXIT_CODE
@@ -146,13 +147,24 @@ class LocalElasticRunner:
                     topology,
                 )
                 self.state.update(self.job_name, status="Running")
-                proc = subprocess.Popen(
-                    [sys.executable, self.script],
-                    env=self._job_env(num_replicas, topology),
-                )
-                code, signalled = self._supervise(
-                    proc, allocation, topology
-                )
+                try:
+                    # An injected fault here models a failed worker
+                    # launch (image pull error, node gone) — it rides
+                    # the same retry budget as a crashing worker.
+                    faults.maybe_fail("runner.launch.pre")
+                    proc = subprocess.Popen(
+                        [sys.executable, self.script],
+                        env=self._job_env(num_replicas, topology),
+                    )
+                except faults.InjectedFault:
+                    LOG.warning(
+                        "injected launch failure for %s", self.job_name
+                    )
+                    code, signalled = 1, False
+                else:
+                    code, signalled = self._supervise(
+                        proc, allocation, topology
+                    )
                 if code == 0:
                     self.state.update(self.job_name, status="Succeeded")
                     return 0
@@ -201,6 +213,9 @@ class LocalElasticRunner:
         if record is not None:
             seen_retunes = record.retunes
         while True:
+            # Chaos hook: inject latency into the supervision cadence
+            # (a starved controller must still converge, just later).
+            faults.maybe_fail("runner.supervise.poll")
             code = proc.poll()
             if code is not None:
                 return code, signalled

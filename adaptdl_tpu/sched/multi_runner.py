@@ -26,6 +26,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from adaptdl_tpu import faults
 from adaptdl_tpu._compat import pick_unused_port
 
 from adaptdl_tpu._signal import GRACEFUL_EXIT_CODE
@@ -178,14 +179,22 @@ class MultiJobRunner:
             # No-op if stop_job already made the status terminal
             # (ClusterState keeps terminal statuses sticky).
             self.state.update(job.name, status="Running")
-            proc = subprocess.Popen(
-                [sys.executable, job.script],
-                env=self._job_env(job, num_replicas, topology),
-            )
-            self.procs[job.name] = proc
-            code, signalled = self._supervise(
-                proc, job, allocation, topology
-            )
+            try:
+                # Same injected-launch-failure path as the local
+                # runner: counted against the job's retry budget.
+                faults.maybe_fail("runner.launch.pre")
+                proc = subprocess.Popen(
+                    [sys.executable, job.script],
+                    env=self._job_env(job, num_replicas, topology),
+                )
+            except faults.InjectedFault:
+                LOG.warning("injected launch failure for %s", job.name)
+                code, signalled = 1, False
+            else:
+                self.procs[job.name] = proc
+                code, signalled = self._supervise(
+                    proc, job, allocation, topology
+                )
             if code == 0:
                 self.state.update(job.name, status="Succeeded")
                 self.exit_codes[job.name] = 0

@@ -15,7 +15,7 @@ from __future__ import annotations
 import logging
 import threading
 
-from adaptdl_tpu import _signal
+from adaptdl_tpu import _signal, rpc
 
 LOG = logging.getLogger(__name__)
 
@@ -26,11 +26,21 @@ _HEADERS = {"Metadata-Flavor": "Google"}
 
 
 def poll_once(url: str = GCE_PREEMPTED_URL, timeout: float = 2.0) -> bool:
-    """True if the metadata server reports this VM as preempted."""
-    import requests
+    """True if the metadata server reports this VM as preempted.
 
+    Rides the rpc client with a single attempt and no circuit breaker:
+    the listener's own interval IS the retry loop, and skipping polls
+    during a breaker cooldown could delay a real preemption notice —
+    on GCE the metadata server is local and reliable, and off GCE
+    every poll fails identically either way."""
     try:
-        response = requests.get(url, headers=_HEADERS, timeout=timeout)
+        response = rpc.default_client().get(
+            url,
+            headers=_HEADERS,
+            timeout=timeout,
+            attempts=1,
+            use_circuit=False,
+        )
         return response.status_code == 200 and (
             response.text.strip().upper() == "TRUE"
         )

@@ -68,6 +68,18 @@ class JobRecord:
     # rank -> address ("host:port"), registered by running workers.
     workers: dict[int, str] = field(default_factory=dict)
     group: int = 0  # restart group; workers of older groups are stale
+    # rank -> monotonic lease deadline, renewed by worker heartbeats
+    # (and piggybacked on register/hints/config traffic). A rank with
+    # no lease entry has never heartbeat and is never expired — lease
+    # enforcement only binds workers that opted into liveness.
+    leases: dict[int, float] = field(default_factory=dict)
+    # True once a lease expired for this incarnation: the job is
+    # running short-handed (or hung) and a reallocation was triggered.
+    # Cleared when the degradation is SERVED — the allocator re-grants
+    # an allocation, or the next restart group registers — so the
+    # degraded window on /metrics measures time-to-replacement (a
+    # surviving rank's heartbeats must not mask a missing peer).
+    degraded: bool = False
     # Non-graceful worker failures so far (exit-143 rescales and
     # evictions never count); the controller gives up past its budget.
     failures: int = 0
@@ -230,20 +242,80 @@ class ClusterState:
                             time.time() - record.creation_timestamp, 0.0
                         ),
                     )
+                if name == "allocation" and value and record.degraded:
+                    # The allocator re-placed the job: the lease
+                    # expiry that withdrew the allocation is served.
+                    record.degraded = False
                 setattr(record, name, value)
             self._cond.notify_all()
 
     def register_worker(
         self, key: str, group: int, rank: int, address: str
-    ) -> None:
+    ) -> bool:
+        """Record a worker's address; returns whether the
+        registration was ACCEPTED into the current restart group (a
+        stale-group retry arriving after a rescale is ignored, and
+        must not e.g. earn a liveness lease for a rank the new
+        incarnation doesn't have)."""
         with self._cond:
             record = self._jobs[key]
             if group > record.group:
                 record.group = group
                 record.workers = {}
-            if group == record.group:
+                # A fresh incarnation starts with a clean liveness
+                # slate: old-group leases (and the degraded verdict
+                # they produced) describe processes that are gone.
+                record.leases = {}
+                record.degraded = False
+            accepted = group == record.group
+            if accepted:
                 record.workers[rank] = address
             self._cond.notify_all()
+            return accepted
+
+    def renew_lease(self, key: str, rank: int, ttl: float) -> bool:
+        """Extend ``rank``'s liveness lease by ``ttl`` seconds from
+        now; False if the job is unknown. Called by the supervisor on
+        heartbeats and piggybacked on register/hints/config traffic."""
+        with self._cond:
+            record = self._jobs.get(key)
+            if record is None:
+                return False
+            if ttl > 0:
+                record.leases[rank] = time.monotonic() + ttl
+            return True
+
+    def expire_stale_leases(
+        self, now: float | None = None
+    ) -> list[tuple[str, int]]:
+        """Expire every lease whose deadline has passed on a Running
+        job: the dead rank is dropped from the worker table, the job
+        is marked ``degraded``, and its allocation is withdrawn — the
+        signal every worker backend already reacts to — so the
+        allocator re-places the job on its next cycle instead of the
+        cluster waiting forever on a vanished worker. Returns the
+        (job, rank) pairs expired."""
+        now = time.monotonic() if now is None else now
+        expired: list[tuple[str, int]] = []
+        with self._cond:
+            for key, record in self._jobs.items():
+                if record.status in FINISHED:
+                    continue
+                stale = [
+                    rank
+                    for rank, deadline in record.leases.items()
+                    if deadline < now
+                ]
+                for rank in stale:
+                    del record.leases[rank]
+                    record.workers.pop(rank, None)
+                    expired.append((key, rank))
+                if stale and not record.degraded:
+                    record.degraded = True
+                    record.allocation = []
+            if expired:
+                self._cond.notify_all()
+        return expired
 
     def wait_for(self, predicate, timeout: float | None = None) -> bool:
         """Block until ``predicate(jobs_dict)`` is true (or timeout)."""

@@ -9,7 +9,17 @@ backend-agnostic; reference: sched/adaptdl_sched/supervisor.py:45-80):
 - ``PUT /register/{namespace}/{name}/{group}/{rank}`` — worker
   self-registration (the k8s backend gets this from pod IPs instead).
 - ``PUT /hints/{namespace}/{name}`` — validated sched-hints intake.
+- ``PUT /heartbeat/{namespace}/{name}/{rank}`` — liveness lease
+  renewal (register/hints/config traffic also renews, so heartbeats
+  piggyback on whatever the worker is already saying).
 - ``GET /hints/{namespace}/{name}``, ``GET /healthz``.
+
+Liveness: each worker rank holds a lease of ``lease_ttl`` seconds; a
+background sweeper expires stale leases, marks the job degraded, and
+withdraws its allocation so the allocator re-places it — a vanished
+worker costs one TTL, not forever. Handlers are also fault-injection
+points (``sup.*.pre``): the chaos suite turns injected faults into
+500s to prove the client side retries through supervisor blips.
 
 Runs its own thread + aiohttp event loop so trainers and the local
 runner can use it without an async main.
@@ -18,11 +28,12 @@ runner can use it without an async main.
 from __future__ import annotations
 
 import asyncio
+import functools
 import logging
 
 from aiohttp import web
 
-from adaptdl_tpu import sched_hints
+from adaptdl_tpu import env, faults, sched_hints
 from adaptdl_tpu.sched.http_server import ThreadedHttpServer
 from adaptdl_tpu.sched.state import ClusterState
 
@@ -32,13 +43,55 @@ _POLL_INTERVAL = 0.25
 _DISCOVER_TIMEOUT = 300.0
 
 
+def _faultable(point: str):
+    """Route a handler through a named injection point: an injected
+    fault becomes a 500 — exactly the transient supervisor error the
+    resilient rpc client must absorb."""
+
+    def decorate(handler):
+        @functools.wraps(handler)
+        async def wrapped(self, request: web.Request) -> web.Response:
+            try:
+                faults.maybe_fail(point)
+            except faults.InjectedFault as exc:
+                return web.json_response(
+                    {"error": f"injected fault: {exc}"}, status=500
+                )
+            return await handler(self, request)
+
+        return wrapped
+
+    return decorate
+
+
 class Supervisor(ThreadedHttpServer):
-    def __init__(self, state: ClusterState, host="127.0.0.1", port=0):
+    def __init__(
+        self,
+        state: ClusterState,
+        host="127.0.0.1",
+        port=0,
+        lease_ttl: float | None = None,
+        sweep_interval: float | None = None,
+    ):
         super().__init__(host=host, port=port)
         self._state = state
+        self._lease_ttl = (
+            env.lease_ttl() if lease_ttl is None else lease_ttl
+        )
+        self._sweep_interval = (
+            sweep_interval
+            if sweep_interval is not None
+            else max(min(self._lease_ttl / 4.0, 5.0), 0.05)
+        )
+
+    def _renew(self, key: str, rank: int) -> None:
+        """Piggybacked lease renewal: any authenticated-enough traffic
+        from a worker proves it alive."""
+        self._state.renew_lease(key, rank, self._lease_ttl)
 
     # -- handlers -----------------------------------------------------
 
+    @_faultable("sup.discover.pre")
     async def _discover(self, request: web.Request) -> web.Response:
         key = "{namespace}/{name}".format(**request.match_info)
         group = int(request.match_info["group"])
@@ -62,6 +115,7 @@ class Supervisor(ThreadedHttpServer):
                 )
             await asyncio.sleep(_POLL_INTERVAL)
 
+    @_faultable("sup.register.pre")
     async def _register(self, request: web.Request) -> web.Response:
         key = "{namespace}/{name}".format(**request.match_info)
         group = int(request.match_info["group"])
@@ -69,9 +123,25 @@ class Supervisor(ThreadedHttpServer):
         body = await request.json()
         if self._state.get_job(key) is None:
             return web.json_response({"error": "no such job"}, status=404)
-        self._state.register_worker(key, group, rank, body["address"])
+        if self._state.register_worker(key, group, rank, body["address"]):
+            # Only an ACCEPTED registration earns a lease: a
+            # stale-group retry must not plant a phantom lease for a
+            # rank the current incarnation doesn't run (its expiry
+            # would degrade a healthy job).
+            self._renew(key, rank)
         return web.json_response({"ok": True})
 
+    @_faultable("sup.heartbeat.pre")
+    async def _heartbeat(self, request: web.Request) -> web.Response:
+        key = "{namespace}/{name}".format(**request.match_info)
+        rank = int(request.match_info["rank"])
+        if not self._state.renew_lease(key, rank, self._lease_ttl):
+            return web.json_response({"error": "no such job"}, status=404)
+        return web.json_response(
+            {"ok": True, "ttl": self._lease_ttl}
+        )
+
+    @_faultable("sup.hints.pre")
     async def _put_hints(self, request: web.Request) -> web.Response:
         key = "{namespace}/{name}".format(**request.match_info)
         hints = await request.json()
@@ -82,6 +152,9 @@ class Supervisor(ThreadedHttpServer):
         if self._state.get_job(key) is None:
             return web.json_response({"error": "no such job"}, status=404)
         self._state.update(key, hints=hints)
+        # Hints are posted from rank 0's fit thread: count them as a
+        # liveness beat so chatty jobs never need a dedicated beat.
+        self._renew(key, 0)
         return web.json_response({"ok": True})
 
     async def _get_hints(self, request: web.Request) -> web.Response:
@@ -91,6 +164,7 @@ class Supervisor(ThreadedHttpServer):
             return web.json_response({"error": "no such job"}, status=404)
         return web.json_response(record.hints or {})
 
+    @_faultable("sup.config.pre")
     async def _get_config(self, request: web.Request) -> web.Response:
         """The cluster's current decision for a job, as one snapshot:
         allocation + topology (changes mean checkpoint-restart) and
@@ -101,6 +175,9 @@ class Supervisor(ThreadedHttpServer):
         snapshot = self._state.get_config_snapshot(key)
         if snapshot is None:
             return web.json_response({"error": "no such job"}, status=404)
+        # Config polls run on rank 0's re-optimization cadence — more
+        # piggybacked liveness.
+        self._renew(key, 0)
         return web.json_response(snapshot)
 
     async def _healthz(self, request: web.Request) -> web.Response:
@@ -114,6 +191,7 @@ class Supervisor(ThreadedHttpServer):
         lines = [
             "# TYPE adaptdl_jobs gauge",
             "# TYPE adaptdl_job_replicas gauge",
+            "# TYPE adaptdl_job_degraded gauge",
             "# TYPE adaptdl_job_batch_size gauge",
             "# TYPE adaptdl_job_retunes_total counter",
             "# TYPE adaptdl_job_submissions_total counter",
@@ -150,6 +228,10 @@ class Supervisor(ThreadedHttpServer):
             lines.append(
                 f"adaptdl_job_retunes_total{{{label}}} {record.retunes}"
             )
+            lines.append(
+                f"adaptdl_job_degraded{{{label}}} "
+                f"{int(record.degraded)}"
+            )
             hints = record.hints or {}
             if hints.get("initBatchSize"):
                 lines.append(
@@ -163,6 +245,43 @@ class Supervisor(ThreadedHttpServer):
 
     # -- lifecycle ----------------------------------------------------
 
+    async def _lease_sweeper(self, app: web.Application) -> None:
+        """Expire stale worker leases on a fixed cadence (skipped
+        entirely when the TTL is 0 — lease enforcement disabled)."""
+        if self._lease_ttl <= 0:
+            return
+        try:
+            while True:
+                await asyncio.sleep(self._sweep_interval)
+                try:
+                    expired = self._state.expire_stale_leases()
+                except Exception:  # noqa: BLE001 - sweeper must survive
+                    LOG.exception("lease sweep failed")
+                    continue
+                for key, rank in expired:
+                    LOG.warning(
+                        "lease expired for %s rank %d: job marked "
+                        "degraded, allocation withdrawn for "
+                        "re-placement",
+                        key, rank,
+                    )
+        except asyncio.CancelledError:
+            pass
+
+    async def _start_sweeper(self, app: web.Application) -> None:
+        self._sweeper_task = asyncio.ensure_future(
+            self._lease_sweeper(app)
+        )
+
+    async def _stop_sweeper(self, app: web.Application) -> None:
+        task = getattr(self, "_sweeper_task", None)
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
     def build_app(self) -> web.Application:
         app = web.Application()
         app.add_routes(
@@ -174,6 +293,10 @@ class Supervisor(ThreadedHttpServer):
                     "/register/{namespace}/{name}/{group}/{rank}",
                     self._register,
                 ),
+                web.put(
+                    "/heartbeat/{namespace}/{name}/{rank}",
+                    self._heartbeat,
+                ),
                 web.put("/hints/{namespace}/{name}", self._put_hints),
                 web.get("/hints/{namespace}/{name}", self._get_hints),
                 web.get("/config/{namespace}/{name}", self._get_config),
@@ -181,5 +304,7 @@ class Supervisor(ThreadedHttpServer):
                 web.get("/metrics", self._metrics),
             ]
         )
+        app.on_startup.append(self._start_sweeper)
+        app.on_cleanup.append(self._stop_sweeper)
         return app
 

@@ -10,10 +10,9 @@ adaptdl/adaptdl/sched_hints.py:33-59).
 from __future__ import annotations
 
 import logging
-import time
 from typing import Any
 
-from adaptdl_tpu import env
+from adaptdl_tpu import env, rpc
 from adaptdl_tpu.goodput import GradParams, PerfParams
 
 LOG = logging.getLogger(__name__)
@@ -86,10 +85,14 @@ def validate_hints(hints: dict[str, Any]) -> None:
         raise ValueError("restartStats must be an object")
 
 
-# After a failed /config fetch, skip further fetches for this long —
-# a dead supervisor must not tax every re-optimization cycle.
+# After a failed /config fetch, the rpc client's circuit breaker
+# skips further fetches for this long — a dead supervisor must not
+# tax every re-optimization cycle. Unlike the old module-global
+# backoff timestamp (one unsynchronized float shared by every job in
+# the process), circuit state lives in the rpc client, per endpoint
+# and under a lock: job A's dead config endpoint never blacks out
+# job B's fetches, and the training thread races nothing.
 _FETCH_BACKOFF_S = 60.0
-_fetch_backoff_until = 0.0
 
 
 def fetch_job_config(job_id: str | None = None) -> dict | None:
@@ -102,25 +105,25 @@ def fetch_job_config(job_id: str | None = None) -> dict | None:
     job_id = job_id if job_id is not None else env.job_id()
     if not url or not job_id:
         return None
-    global _fetch_backoff_until
-    now = time.monotonic()
-    if now < _fetch_backoff_until:
-        return None
     try:
-        import requests
-
-        # Sub-second connect budget: this runs on the training thread
-        # (rank 0, re-optimization cadence) — an unreachable
-        # supervisor must cost a fraction of a step, not seconds.
-        response = requests.get(
-            f"{url}/config/{job_id}", timeout=(0.5, 2)
+        # Sub-second connect budget and a single attempt: this runs on
+        # the training thread (rank 0, re-optimization cadence) — an
+        # unreachable supervisor must cost a fraction of a step, not
+        # seconds, and the circuit breaker (threshold 1) absorbs the
+        # cost of the next _FETCH_BACKOFF_S worth of cycles entirely.
+        response = rpc.default_client().get(
+            f"{url}/config/{job_id}",
+            endpoint=f"config/{job_id}",
+            timeout=(0.5, 2),
+            attempts=1,
+            circuit_threshold=1,
+            circuit_cooldown=_FETCH_BACKOFF_S,
         )
         response.raise_for_status()
         payload = response.json()
         return payload if isinstance(payload, dict) else None
     except Exception as exc:  # noqa: BLE001 - best effort by design
         LOG.debug("failed to fetch job config: %s", exc)
-        _fetch_backoff_until = now + _FETCH_BACKOFF_S
         return None
 
 
@@ -138,13 +141,43 @@ def post_sched_hints(
         return False
     validate_hints(hints)
     try:
-        import requests
-
-        response = requests.put(
-            f"{url}/hints/{job_id}", json=hints, timeout=10
+        response = rpc.default_client().put(
+            f"{url}/hints/{job_id}",
+            endpoint=f"hints/{job_id}",
+            json=hints,
+            timeout=(2, 10),
+            attempts=2,
+            deadline=30.0,
         )
         response.raise_for_status()
         return True
     except Exception as exc:  # noqa: BLE001 - best effort by design
         LOG.warning("failed to post sched hints: %s", exc)
+        return False
+
+
+def send_heartbeat(
+    rank: int | None = None, job_id: str | None = None
+) -> bool:
+    """PUT a liveness heartbeat for this worker's lease; False on any
+    failure (best-effort — a missed beat only matters if a lease TTL
+    worth of them are missed in a row)."""
+    url = env.supervisor_url()
+    job_id = job_id if job_id is not None else env.job_id()
+    if not url or not job_id:
+        return False
+    rank = env.process_rank() if rank is None else rank
+    try:
+        response = rpc.default_client().put(
+            f"{url}/heartbeat/{job_id}/{rank}",
+            endpoint=f"heartbeat/{job_id}",
+            timeout=(0.5, 2),
+            attempts=1,
+            circuit_threshold=3,
+            circuit_cooldown=30.0,
+        )
+        response.raise_for_status()
+        return True
+    except Exception as exc:  # noqa: BLE001 - best effort by design
+        LOG.debug("heartbeat failed: %s", exc)
         return False

@@ -32,7 +32,7 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from adaptdl_tpu import checkpoint, env
+from adaptdl_tpu import checkpoint, env, faults
 
 
 def _sharded_root() -> str:
@@ -310,6 +310,11 @@ class ShardedTrainerCheckpoint(checkpoint.State):
                 )
             )
         path = _next_payload_dir(self.name)
+        # A fault here (kill/latency mid-payload-write) leaves only a
+        # fresh versioned dir no registry checkpoint references — the
+        # previous complete (pointer, payload) pair stays restorable,
+        # and the chaos suite proves it.
+        faults.maybe_fail("ckpt.sharded.payload")
         checkpointer = ocp.StandardCheckpointer()
         checkpointer.save(path, state)
         if env.num_processes() > 1:

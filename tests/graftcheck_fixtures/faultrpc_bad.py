@@ -1,0 +1,27 @@
+"""Known-bad RPC/fault hygiene: raw requests + unregistered points."""
+
+import requests  # line 3: GC601
+from requests import get  # line 4: GC601
+
+from adaptdl_tpu import faults
+
+
+def raw_call(url):
+    return requests.get(url, timeout=5)  # line 10: GC601
+
+
+def raw_put(url, payload):
+    response = requests.put(url, json=payload)  # line 14: GC601
+    return response.status_code
+
+
+def typo_point():
+    faults.maybe_fail("ckpt.write.pre_renam")  # line 19: GC602
+
+
+def unknown_point():
+    faults.maybe_fail("made.up.point")  # line 23: GC602
+
+
+def aliased_import(url):
+    return get(url)  # the import itself is the finding, not the call

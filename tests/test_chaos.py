@@ -1,0 +1,929 @@
+"""Chaos suite: the control plane under seeded injected failure.
+
+Every scenario drives production code through the deterministic fault
+registry (adaptdl_tpu/faults.py) — kill-during-save in each crash
+window, dropped/slow/blacked-out RPCs, supervisor 500 blips, worker
+lease expiry, truncated and bit-flipped checkpoint payloads, corrupted
+manifests, injected launch failures against the runner retry budget.
+Checkpoint scenarios assert *state equality* against an undisturbed
+run, not just completion. Fixed seeds make every failure replayable
+(`make chaos` pins ADAPTDL_FAULT_SEED).
+
+The subprocess-heavy end-to-end scenario is marked ``slow`` so tier-1
+stays within its time budget; CI's chaos job runs the whole file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from adaptdl_tpu import checkpoint, faults, rpc, sched_hints
+from adaptdl_tpu._compat import pick_unused_port
+from adaptdl_tpu.sched.state import ClusterState
+from adaptdl_tpu.sched.supervisor import Supervisor
+
+pytestmark = pytest.mark.chaos
+
+SEED = 1234
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    """Each test owns the process-wide fault schedule and rpc circuit
+    state."""
+    faults.reset()
+    rpc.reset_default_client()
+    yield
+    faults.reset()
+    rpc.reset_default_client()
+
+
+@pytest.fixture
+def cluster():
+    state = ClusterState()
+    state.create_job("chaos/job", spec={"max_replicas": 8})
+    supervisor = Supervisor(state)
+    url = supervisor.start()
+    yield state, url
+    supervisor.stop()
+
+
+# ---- fault registry ---------------------------------------------------
+
+
+def test_fault_spec_nth_and_always():
+    faults.configure("rpc.request.send=fail@2", seed=SEED)
+    faults.maybe_fail("rpc.request.send")  # hit 1
+    with pytest.raises(faults.InjectedFault):
+        faults.maybe_fail("rpc.request.send")  # hit 2 fires
+    faults.maybe_fail("rpc.request.send")  # hit 3 clean again
+    assert faults.hit_count("rpc.request.send") == 3
+
+    faults.configure("rpc.request.send=fail@2+", seed=SEED)
+    faults.maybe_fail("rpc.request.send")
+    for _ in range(3):  # every hit >= 2 fires
+        with pytest.raises(faults.InjectedFault):
+            faults.maybe_fail("rpc.request.send")
+
+
+def test_fault_probability_replays_with_seed():
+    def run(seed):
+        faults.configure("rpc.request.send=fail%0.5", seed=seed)
+        fired = []
+        for _ in range(32):
+            try:
+                faults.maybe_fail("rpc.request.send")
+                fired.append(0)
+            except faults.InjectedFault:
+                fired.append(1)
+        return fired
+
+    first, second = run(SEED), run(SEED)
+    assert first == second, "same (spec, seed) must replay exactly"
+    assert 0 < sum(first) < 32, "p=0.5 fires sometimes, not always"
+    assert run(SEED + 1) != first, "a different seed reschedules"
+
+
+def test_fault_sleep_injects_latency():
+    faults.configure("rpc.request.send=sleep:0.05", seed=SEED)
+    start = time.monotonic()
+    faults.maybe_fail("rpc.request.send")
+    assert time.monotonic() - start >= 0.05
+
+
+def test_fault_spec_rejects_unknown_points_and_actions():
+    with pytest.raises(ValueError):
+        faults.configure("no.such.point=fail")
+    with pytest.raises(ValueError):
+        faults.configure("rpc.request.send=explode")
+    with pytest.raises(ValueError):
+        faults.configure("rpc.request.send=sleep")  # needs :S
+    with pytest.raises(ValueError):
+        faults.configure("rpc.request.send=fail%1.5")
+
+
+def test_inactive_schedule_is_noop():
+    assert not faults.is_active()
+    faults.maybe_fail("rpc.request.send")  # must not raise or count
+    assert faults.hit_count("rpc.request.send") == 0
+
+
+def test_fault_spec_loads_lazily_from_env(monkeypatch):
+    """The subprocess entry path: workers get their schedule from
+    ADAPTDL_FAULT_SPEC/ADAPTDL_FAULT_SEED without any code change."""
+    monkeypatch.setenv(
+        "ADAPTDL_FAULT_SPEC", "rpc.request.send=fail@1"
+    )
+    monkeypatch.setenv("ADAPTDL_FAULT_SEED", str(SEED))
+    faults.reset()  # re-arm the lazy env load
+    assert faults.is_active()
+    with pytest.raises(faults.InjectedFault):
+        faults.maybe_fail("rpc.request.send")
+    faults.maybe_fail("rpc.request.send")  # hit 2 is clean
+
+
+# ---- resilient rpc client ---------------------------------------------
+
+
+def test_rpc_retries_through_dropped_requests(cluster):
+    _, url = cluster
+    faults.configure("rpc.request.send=fail@1", seed=SEED)
+    client = rpc.RpcClient(sleep=lambda s: None)
+    response = client.get(
+        f"{url}/healthz", endpoint="healthz", attempts=3
+    )
+    assert response.json() == {"ok": True}
+    assert faults.hit_count("rpc.request.send") == 2, "one retry"
+
+
+def test_rpc_deadline_bounds_total_time():
+    port = pick_unused_port()
+    client = rpc.RpcClient()
+    start = time.monotonic()
+    with pytest.raises(rpc.RpcError):
+        client.get(
+            f"http://127.0.0.1:{port}/x",
+            endpoint="dead",
+            attempts=100,
+            deadline=1.0,
+            timeout=(0.2, 0.5),
+        )
+    assert time.monotonic() - start < 5.0
+
+
+def test_rpc_circuit_opens_and_half_open_probe_recovers(cluster):
+    _, url = cluster
+    client = rpc.RpcClient(sleep=lambda s: None)
+    faults.configure("rpc.request.send=fail", seed=SEED)
+    with pytest.raises(rpc.RpcError):
+        client.get(
+            f"{url}/healthz",
+            endpoint="hz",
+            attempts=1,
+            circuit_threshold=1,
+            circuit_cooldown=0.2,
+        )
+    # Open: rejected without touching the network.
+    hits = faults.hit_count("rpc.request.send")
+    with pytest.raises(rpc.CircuitOpenError):
+        client.get(
+            f"{url}/healthz",
+            endpoint="hz",
+            attempts=1,
+            circuit_threshold=1,
+            circuit_cooldown=0.2,
+        )
+    assert faults.hit_count("rpc.request.send") == hits
+    # Cooldown lapses; the probe succeeds and closes the circuit.
+    time.sleep(0.25)
+    faults.configure(None)
+    response = client.get(
+        f"{url}/healthz",
+        endpoint="hz",
+        attempts=1,
+        circuit_threshold=1,
+        circuit_cooldown=0.2,
+    )
+    assert response.status_code == 200
+    assert client.circuit_state("hz") == (0, 0.0)
+
+
+def test_rpc_does_not_retry_client_errors(cluster):
+    _, url = cluster
+    client = rpc.RpcClient(sleep=lambda s: None)
+    response = client.get(
+        f"{url}/hints/chaos/nope", endpoint="hints404", attempts=3
+    )
+    assert response.status_code == 404
+    # The endpoint answered: 4xx is a circuit success, not a failure.
+    assert client.circuit_state("hints404")[0] == 0
+
+
+def test_fetch_job_config_circuit_is_per_job(monkeypatch):
+    """Regression for the old module-global backoff: one job's dead
+    config endpoint must not black out other jobs' fetches."""
+    monkeypatch.setenv(
+        "ADAPTDL_SUPERVISOR_URL", "http://127.0.0.1:9"
+    )
+    faults.configure("rpc.request.send=fail", seed=SEED)
+    assert sched_hints.fetch_job_config("a/x") is None
+    assert faults.hit_count("rpc.request.send") == 1
+    # Job a/x's circuit (threshold 1) is now open: no network attempt.
+    assert sched_hints.fetch_job_config("a/x") is None
+    assert faults.hit_count("rpc.request.send") == 1
+    # A different job still gets its attempt.
+    assert sched_hints.fetch_job_config("b/y") is None
+    assert faults.hit_count("rpc.request.send") == 2
+
+
+def test_supervisor_blackout_is_absorbed_everywhere(monkeypatch):
+    """With the supervisor gone entirely, every best-effort path
+    returns its failure value — nothing raises, nothing hangs."""
+    from adaptdl_tpu.sched import preemption
+
+    port = pick_unused_port()
+    monkeypatch.setenv(
+        "ADAPTDL_SUPERVISOR_URL", f"http://127.0.0.1:{port}"
+    )
+    monkeypatch.setenv("ADAPTDL_JOB_ID", "chaos/gone")
+    start = time.monotonic()
+    assert sched_hints.fetch_job_config() is None
+    assert sched_hints.post_sched_hints(sched_hints.empty_hints()) is False
+    assert sched_hints.send_heartbeat() is False
+    assert (
+        preemption.poll_once(f"http://127.0.0.1:{port}/preempted")
+        is False
+    )
+    assert time.monotonic() - start < 10.0
+
+
+# ---- rendezvous under supervisor blips --------------------------------
+
+
+def _rendezvous_env(monkeypatch, url, job="chaos/job"):
+    monkeypatch.setenv("ADAPTDL_SUPERVISOR_URL", url)
+    monkeypatch.setenv("ADAPTDL_JOB_ID", job)
+    monkeypatch.setenv("ADAPTDL_NUM_PROCESSES", "2")
+    monkeypatch.setenv("ADAPTDL_PROCESS_RANK", "0")
+    monkeypatch.setenv("ADAPTDL_NUM_RESTARTS", "0")
+
+
+def test_discover_peers_retries_through_500_blips(
+    cluster, monkeypatch
+):
+    from adaptdl_tpu import bootstrap
+
+    state, url = cluster
+    _rendezvous_env(monkeypatch, url)
+    state.register_worker("chaos/job", 0, 1, "10.0.0.2")
+    # First register AND first discover attempt each get a 500.
+    faults.configure(
+        "sup.register.pre=fail@1;sup.discover.pre=fail@1", seed=SEED
+    )
+    peers = bootstrap._discover_peers()
+    assert set(peers) == {0, 1}
+    assert faults.hit_count("sup.register.pre") == 2
+    assert faults.hit_count("sup.discover.pre") == 2
+
+
+def test_discover_peers_reregistration_is_idempotent(
+    cluster, monkeypatch
+):
+    """A worker restarted (or a retry racing its own success) blindly
+    registers again: same group + rank overwrites, nothing breaks."""
+    from adaptdl_tpu import bootstrap
+
+    state, url = cluster
+    _rendezvous_env(monkeypatch, url)
+    state.register_worker("chaos/job", 0, 1, "10.0.0.2")
+    assert set(bootstrap._discover_peers()) == {0, 1}
+    assert set(bootstrap._discover_peers()) == {0, 1}
+    assert set(state.get_job("chaos/job").workers) == {0, 1}
+
+
+def test_discover_peers_fails_in_bounded_time(monkeypatch):
+    from adaptdl_tpu import bootstrap
+
+    port = pick_unused_port()
+    _rendezvous_env(monkeypatch, f"http://127.0.0.1:{port}")
+    monkeypatch.setattr(bootstrap, "_REGISTER_ATTEMPTS", 3)
+    monkeypatch.setattr(bootstrap, "_REGISTER_DEADLINE", 2.0)
+    start = time.monotonic()
+    with pytest.raises(Exception):
+        bootstrap._discover_peers()
+    assert time.monotonic() - start < 10.0
+
+
+# ---- heartbeat leases -------------------------------------------------
+
+
+def test_lease_expiry_marks_degraded_and_triggers_reallocation(
+    monkeypatch,
+):
+    state = ClusterState()
+    state.create_job("chaos/job", spec={})
+    supervisor = Supervisor(state, lease_ttl=0.4, sweep_interval=0.1)
+    url = supervisor.start()
+    try:
+        monkeypatch.setenv("ADAPTDL_SUPERVISOR_URL", url)
+        monkeypatch.setenv("ADAPTDL_JOB_ID", "chaos/job")
+        state.update(
+            "chaos/job", allocation=["local"] * 2, status="Running"
+        )
+        assert sched_hints.send_heartbeat(rank=0)
+        assert 0 in state.get_job("chaos/job").leases
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            record = state.get_job("chaos/job")
+            if record.degraded:
+                break
+            time.sleep(0.05)
+        record = state.get_job("chaos/job")
+        assert record.degraded, "lease expiry must mark the job"
+        assert record.allocation == [], "allocation withdrawn"
+        assert record.workers == {}
+        # A surviving rank's heartbeat must NOT mask the missing
+        # peer: the gauge stays up until the job is re-placed.
+        assert sched_hints.send_heartbeat(rank=0)
+        assert state.get_job("chaos/job").degraded
+        text = rpc.default_client().get(f"{url}/metrics").text
+        assert 'adaptdl_job_degraded{job="chaos/job"} 1' in text
+        # The allocator re-grants an allocation: degradation served.
+        state.update("chaos/job", allocation=["local"] * 2)
+        assert not state.get_job("chaos/job").degraded
+        text = rpc.default_client().get(f"{url}/metrics").text
+        assert 'adaptdl_job_degraded{job="chaos/job"} 0' in text
+    finally:
+        supervisor.stop()
+
+
+def test_heartbeats_piggyback_on_hints_and_config_traffic(
+    monkeypatch,
+):
+    state = ClusterState()
+    state.create_job("chaos/job", spec={})
+    supervisor = Supervisor(state, lease_ttl=0.6, sweep_interval=0.1)
+    url = supervisor.start()
+    try:
+        monkeypatch.setenv("ADAPTDL_SUPERVISOR_URL", url)
+        monkeypatch.setenv("ADAPTDL_JOB_ID", "chaos/job")
+        state.update("chaos/job", status="Running")
+        # No dedicated heartbeat: hint posts and config fetches renew
+        # the lease, so a chatty job never expires.
+        for _ in range(4):
+            assert sched_hints.post_sched_hints(
+                sched_hints.empty_hints()
+            )
+            assert sched_hints.fetch_job_config() is not None
+            time.sleep(0.2)
+        record = state.get_job("chaos/job")
+        assert not record.degraded
+        assert 0 in record.leases
+    finally:
+        supervisor.stop()
+
+
+def test_stale_group_registration_earns_no_lease(monkeypatch):
+    """A delayed register retry from a pre-rescale incarnation must
+    not plant a lease for a rank the new incarnation doesn't run —
+    its guaranteed expiry would degrade a healthy job."""
+    state = ClusterState()
+    state.create_job("chaos/job", spec={})
+    supervisor = Supervisor(state, lease_ttl=30.0, sweep_interval=5.0)
+    url = supervisor.start()
+    try:
+        client = rpc.default_client()
+        # Group 1 (current incarnation) registers rank 0.
+        client.put(
+            f"{url}/register/chaos/job/1/0",
+            json={"address": "10.0.0.1"},
+        ).raise_for_status()
+        # A group-0 straggler retries its old registration for rank 3.
+        client.put(
+            f"{url}/register/chaos/job/0/3",
+            json={"address": "10.0.0.9"},
+        ).raise_for_status()
+        record = state.get_job("chaos/job")
+        assert set(record.workers) == {0}
+        assert set(record.leases) == {0}, "no phantom lease for rank 3"
+    finally:
+        supervisor.stop()
+
+
+def test_heartbeat_unknown_job_is_404_even_with_expiry_disabled():
+    state = ClusterState()
+    supervisor = Supervisor(state, lease_ttl=0.0)
+    url = supervisor.start()
+    try:
+        response = rpc.default_client().put(
+            f"{url}/heartbeat/chaos/nope/0", attempts=1
+        )
+        assert response.status_code == 404
+    finally:
+        supervisor.stop()
+
+
+def test_workers_without_leases_are_never_expired():
+    state = ClusterState()
+    state.create_job("chaos/job", spec={})
+    state.update(
+        "chaos/job", allocation=["local"], status="Running"
+    )
+    state.register_worker("chaos/job", 0, 0, "10.0.0.1")
+    record = state.get_job("chaos/job")
+    record.leases.clear()  # as if liveness was never opted into
+    assert state.expire_stale_leases() == []
+    record = state.get_job("chaos/job")
+    assert not record.degraded and record.allocation == ["local"]
+
+
+# ---- checkpoint integrity ---------------------------------------------
+
+
+class _BlobState(checkpoint.State):
+    def __init__(self, name, payload: bytes):
+        super().__init__(name)
+        self.payload = payload
+
+    def save(self, fileobj):
+        fileobj.write(self.payload)
+
+    def load(self, fileobj):
+        self.payload = fileobj.read()
+
+
+@pytest.fixture
+def ckpt_dir(tmp_path, monkeypatch):
+    path = tmp_path / "ckpt"
+    path.mkdir()
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(path))
+    return str(path)
+
+
+def _two_versions(ckpt_dir):
+    """Two complete on-disk checkpoint versions of states a and b —
+    the crash-between-rename-and-prune layout — by injecting a fault
+    after the second save's rename but before its prune."""
+    a = _BlobState("alpha", b"a-v1")
+    b = _BlobState("beta", b"b-v1")
+    checkpoint.save_all_states()
+    a.payload, b.payload = b"a-v2", b"b-v2"
+    faults.configure("ckpt.write.post_rename=fail@1", seed=SEED)
+    with pytest.raises(faults.InjectedFault):
+        checkpoint.save_all_states()
+    faults.configure(None)
+    dirs = [
+        d for _, _, d in checkpoint.scan_versioned_dirs(
+            ckpt_dir, checkpoint._CKPT_DIR_PATTERN
+        )
+    ]
+    assert len(dirs) == 2, dirs
+    return a, b, dirs
+
+
+def test_manifest_written_inside_rename_window(ckpt_dir):
+    state = _BlobState("alpha", b"payload")
+    checkpoint.save_all_states()
+    latest = checkpoint.latest_checkpoint_dir(ckpt_dir)
+    manifest = json.load(
+        open(os.path.join(latest, checkpoint.MANIFEST_NAME))
+    )
+    entry = manifest["states"]["alpha"]
+    assert entry["bytes"] == len(b"payload")
+    import hashlib
+
+    assert entry["sha256"] == hashlib.sha256(b"payload").hexdigest()
+    state.payload = b"x"
+    assert checkpoint.load_state(state)
+    assert state.payload == b"payload"
+
+
+def test_bitflip_same_size_falls_back_to_intact_version(ckpt_dir):
+    """THE headline scenario: a bit-flipped payload used to load as
+    silent garbage (size unchanged, pickle/np happy); the manifest
+    digest now catches it and recovery is version-consistent."""
+    a, b, (old, new) = _two_versions(ckpt_dir)
+    blob = bytearray(open(os.path.join(new, "beta"), "rb").read())
+    blob[0] ^= 0xFF
+    open(os.path.join(new, "beta"), "wb").write(bytes(blob))
+    assert checkpoint.load_state(a) and a.payload == b"a-v2"
+    # beta's corruption poisons v2; BOTH states settle on v1.
+    assert checkpoint.load_state(b) and b.payload == b"b-v1"
+    assert a.payload == b"a-v1", "version consistency across states"
+
+
+def test_truncated_payload_falls_back(ckpt_dir):
+    a, b, (old, new) = _two_versions(ckpt_dir)
+    path = os.path.join(new, "beta")
+    open(path, "wb").write(open(path, "rb").read()[:-2])
+    assert checkpoint.load_state(b) and b.payload == b"b-v1"
+
+
+def test_corrupted_manifest_falls_back(ckpt_dir):
+    a, b, (old, new) = _two_versions(ckpt_dir)
+    open(os.path.join(new, checkpoint.MANIFEST_NAME), "w").write(
+        "{not json"
+    )
+    assert checkpoint.load_state(a) and a.payload == b"a-v1"
+
+
+def test_listed_but_missing_file_poisons_dir(ckpt_dir):
+    a, b, (old, new) = _two_versions(ckpt_dir)
+    os.unlink(os.path.join(new, "beta"))
+    assert checkpoint.load_state(b) and b.payload == b"b-v1"
+    assert checkpoint.load_state(a) and a.payload == b"a-v1"
+
+
+def test_premanifest_checkpoint_still_loads(ckpt_dir):
+    state = _BlobState("alpha", b"old-world")
+    checkpoint.save_all_states()
+    latest = checkpoint.latest_checkpoint_dir(ckpt_dir)
+    os.unlink(os.path.join(latest, checkpoint.MANIFEST_NAME))
+    state.payload = b"x"
+    assert checkpoint.load_state(state)
+    assert state.payload == b"old-world"
+
+
+def test_corruption_with_no_fallback_refuses_cold_start(ckpt_dir):
+    state = _BlobState("alpha", b"only-version")
+    checkpoint.save_all_states()
+    latest = checkpoint.latest_checkpoint_dir(ckpt_dir)
+    blob = bytearray(open(os.path.join(latest, "alpha"), "rb").read())
+    blob[-1] ^= 0x01
+    open(os.path.join(latest, "alpha"), "wb").write(bytes(blob))
+    with pytest.raises(checkpoint.CheckpointUnreadableError):
+        checkpoint.load_state(state)
+
+
+def test_verify_can_be_disabled(ckpt_dir, monkeypatch):
+    state = _BlobState("alpha", b"payload")
+    checkpoint.save_all_states()
+    latest = checkpoint.latest_checkpoint_dir(ckpt_dir)
+    blob = bytearray(open(os.path.join(latest, "alpha"), "rb").read())
+    blob[0] ^= 0xFF
+    open(os.path.join(latest, "alpha"), "wb").write(bytes(blob))
+    monkeypatch.setenv("ADAPTDL_CKPT_VERIFY", "off")
+    state.payload = b"x"
+    assert checkpoint.load_state(state)
+    assert state.payload == bytes(blob), "off = pre-manifest trust"
+
+
+# ---- kill-during-save windows -----------------------------------------
+
+
+@pytest.mark.parametrize(
+    "point",
+    [
+        "ckpt.write.state",
+        "ckpt.manifest.write",
+        "ckpt.write.pre_rename",
+    ],
+)
+def test_save_killed_in_every_window_keeps_previous_intact(
+    ckpt_dir, point
+):
+    state = _BlobState("alpha", b"v1")
+    checkpoint.save_all_states()
+    state.payload = b"v2"
+    faults.configure(f"{point}=fail@1", seed=SEED)
+    with pytest.raises(faults.InjectedFault):
+        checkpoint.save_all_states()
+    state.payload = b"garbage"
+    assert checkpoint.load_state(state)
+    assert state.payload == b"v1", "previous checkpoint intact"
+    # The consumed fault lets the next save land normally.
+    state.payload = b"v3"
+    checkpoint.save_all_states()
+    state.payload = b"garbage"
+    assert checkpoint.load_state(state)
+    assert state.payload == b"v3"
+    # No leaked temp dirs after the successful save's prune.
+    leftovers = [
+        e for e in os.listdir(ckpt_dir)
+        if e.startswith(checkpoint._TMP_PREFIX)
+    ]
+    assert leftovers == []
+
+
+def test_background_save_killed_midwrite_is_logged_not_fatal(
+    ckpt_dir,
+):
+    state = _BlobState("alpha", b"v1")
+    checkpoint.save_all_states()
+    state.payload = b"v2"
+    # configure() starts a fresh schedule: this background write's
+    # state serialization is hit 1 of the new counter.
+    faults.configure("ckpt.write.state=fail@1", seed=SEED)
+    handle = checkpoint.save_all_states(wait=False)
+    with pytest.raises(faults.InjectedFault):
+        handle.wait()
+    # The next load joins the failed write, logs, and restores the
+    # previous complete version.
+    state.payload = b"garbage"
+    assert checkpoint.load_state(state)
+    assert state.payload == b"v1"
+
+
+# ---- loss equality: chaos run == undisturbed run ----------------------
+
+
+class _TrainerSim:
+    """Deterministic stand-in trainer: the update depends only on
+    (weights, step), so any correct checkpoint-resume reproduces the
+    undisturbed trajectory bit-for-bit."""
+
+    def __init__(self):
+        self.w = np.zeros(8, dtype=np.float64)
+        self.step = 0
+
+    def train_step(self):
+        rng = np.random.default_rng(self.step)
+        grad = rng.normal(size=self.w.shape)
+        self.w = self.w - 0.01 * grad + 0.001 * np.sin(self.w)
+        self.step += 1
+
+
+class _SimState(checkpoint.State):
+    def __init__(self, sim):
+        super().__init__("chaos_sim")
+        self.sim = sim
+
+    def save(self, fileobj):
+        np.save(fileobj, self.sim.w, allow_pickle=False)
+        fileobj.write(self.sim.step.to_bytes(8, "big"))
+
+    def load(self, fileobj):
+        # np.load wants a seekable tail-free stream; split manually.
+        blob = fileobj.read()
+        import io
+
+        self.sim.w = np.load(
+            io.BytesIO(blob[:-8]), allow_pickle=False
+        )
+        self.sim.step = int.from_bytes(blob[-8:], "big")
+
+
+def _run_sim(total_steps, save_every, crash_at=None):
+    """Train to ``total_steps`` with periodic async saves; at
+    ``crash_at`` simulate a process death + restart (fresh objects,
+    restore from disk)."""
+    sim = _TrainerSim()
+    state = _SimState(sim)
+    checkpoint.load_state(state)
+    while sim.step < total_steps:
+        sim.train_step()
+        if sim.step % save_every == 0:
+            checkpoint.save_all_states(wait=False)
+        if crash_at is not None and sim.step == crash_at:
+            # Everything in memory dies with the process...
+            checkpoint._reset_registry()
+            # ...and the next incarnation restores and continues.
+            return _run_sim(total_steps, save_every, crash_at=None)
+    checkpoint.save_all_states()
+    return sim.w.copy(), sim.step
+
+
+def test_chaos_training_matches_undisturbed_final_state(
+    tmp_path, monkeypatch
+):
+    """Kill-during-save mid-run + crash-restart: the final state must
+    EQUAL the undisturbed run's, not merely 'look trained'."""
+    baseline_dir = tmp_path / "baseline"
+    chaos_dir = tmp_path / "chaos"
+    baseline_dir.mkdir()
+    chaos_dir.mkdir()
+
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(baseline_dir))
+    w_base, steps_base = _run_sim(total_steps=30, save_every=5)
+    checkpoint._reset_registry()
+
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(chaos_dir))
+    # The 3rd save dies before its rename (a background-writer kill:
+    # logged, previous checkpoint intact), and the process "crashes"
+    # at step 17 — restart resumes from the newest intact version.
+    faults.configure("ckpt.write.pre_rename=fail@3", seed=SEED)
+    w_chaos, steps_chaos = _run_sim(
+        total_steps=30, save_every=5, crash_at=17
+    )
+    assert steps_chaos == steps_base == 30
+    np.testing.assert_array_equal(w_chaos, w_base)
+
+
+def test_chaos_training_with_corruption_between_incarnations(
+    tmp_path, monkeypatch
+):
+    """Crash + bit-flip the newest surviving checkpoint: resume falls
+    back a version further and STILL reproduces the undisturbed run."""
+    baseline_dir = tmp_path / "baseline"
+    chaos_dir = tmp_path / "chaos"
+    baseline_dir.mkdir()
+    chaos_dir.mkdir()
+
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(baseline_dir))
+    w_base, _ = _run_sim(total_steps=24, save_every=4)
+    checkpoint._reset_registry()
+
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(chaos_dir))
+    sim = _TrainerSim()
+    _SimState(sim)  # registered; the registry holds the reference
+    while sim.step < 14:
+        sim.train_step()
+        if sim.step % 4 == 0:
+            # post_rename kill on save 3 (step 12): prune skipped, so
+            # steps 8 AND 12 versions both survive on disk.
+            if sim.step == 12:
+                faults.configure(
+                    "ckpt.write.post_rename=fail@1", seed=SEED
+                )
+                handle = checkpoint.save_all_states(wait=False)
+                with pytest.raises(faults.InjectedFault):
+                    handle.wait()
+                faults.configure(None)
+            else:
+                checkpoint.save_all_states()
+    # Process dies at step 14; storage flips a bit in the newest dir.
+    checkpoint._reset_registry()
+    newest = checkpoint.latest_checkpoint_dir(str(chaos_dir))
+    payload = os.path.join(newest, "chaos_sim")
+    blob = bytearray(open(payload, "rb").read())
+    blob[4] ^= 0x10
+    open(payload, "wb").write(bytes(blob))
+
+    sim = _TrainerSim()
+    state = _SimState(sim)
+    assert checkpoint.load_state(state)
+    assert sim.step == 8, "fell back past the corrupted step-12 save"
+    while sim.step < 24:
+        sim.train_step()
+    np.testing.assert_array_equal(sim.w, w_base)
+
+
+# ---- runner retry budget under injected failure -----------------------
+
+
+def _trivial_script(tmp_path):
+    script = tmp_path / "ok.py"
+    script.write_text("print('ok')\n")
+    return str(script)
+
+
+def test_local_runner_survives_injected_launch_failure(tmp_path):
+    from adaptdl_tpu.sched.local_runner import LocalElasticRunner
+
+    faults.configure("runner.launch.pre=fail@1", seed=SEED)
+    runner = LocalElasticRunner(
+        _trivial_script(tmp_path),
+        num_chips=2,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        job_name="chaos/launch-blip",
+        allocator_interval=60.0,
+        pop_size=8,
+        generations=4,
+    )
+    assert runner.run() == 0
+    record = runner.state.get_job("chaos/launch-blip")
+    assert record.status == "Succeeded"
+    assert runner.restarts == 1, "one failed launch, one relaunch"
+
+
+def test_local_runner_retry_budget_exhausts_to_failed(tmp_path):
+    from adaptdl_tpu.sched.local_runner import LocalElasticRunner
+
+    faults.configure("runner.launch.pre=fail", seed=SEED)
+    runner = LocalElasticRunner(
+        _trivial_script(tmp_path),
+        num_chips=2,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        job_name="chaos/doomed",
+        allocator_interval=60.0,
+        max_failures=2,
+        pop_size=8,
+        generations=4,
+    )
+    code = runner.run()
+    assert code != 0
+    assert runner.state.get_job("chaos/doomed").status == "Failed"
+    assert faults.hit_count("runner.launch.pre") == 3, "budget + 1"
+
+
+def test_multi_runner_counts_injected_launch_failures(tmp_path):
+    from adaptdl_tpu.sched.multi_runner import JobSpec, MultiJobRunner
+
+    faults.configure("runner.launch.pre=fail", seed=SEED)
+    runner = MultiJobRunner(
+        [
+            JobSpec(
+                name="chaos/mj",
+                script=_trivial_script(tmp_path),
+                checkpoint_dir=str(tmp_path / "ckpt"),
+            )
+        ],
+        num_chips=2,
+        allocator_interval=60.0,
+        max_failures=1,
+        pop_size=8,
+        generations=4,
+    )
+    codes = runner.run()
+    assert codes["chaos/mj"] != 0
+    assert runner.state.get_job("chaos/mj").status == "Failed"
+
+
+# ---- end-to-end: training survives a seeded chaos schedule ------------
+
+
+CHAOS_TRAIN_SCRIPT = textwrap.dedent(
+    """
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+    from adaptdl_tpu import _signal, checkpoint, env, epoch, faults, metrics
+    from adaptdl_tpu.data import AdaptiveDataLoader
+    from adaptdl_tpu.parallel import create_mesh
+    from adaptdl_tpu.scaling_rules import AdaScale
+    from adaptdl_tpu.trainer import ElasticTrainer
+
+    _signal.install_handlers()
+    # Chaos: incarnation 0 is hard-killed at its 2nd checkpoint's
+    # pre-rename (a kill-during-save); later incarnations run with a
+    # 5% RPC drop + injected latency, which best-effort paths absorb.
+    if env.num_restarts() == 0:
+        faults.configure("ckpt.write.pre_rename=exit@2", seed=1234)
+    else:
+        faults.configure(
+            "rpc.request.send=fail%0.05;"
+            "rpc.request.send=sleep:0.02%0.2",
+            seed=1234,
+        )
+    TRUE_W = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(512, 4)).astype(np.float32)
+    y = x @ TRUE_W + 0.05 * rng.normal(size=512).astype(np.float32)
+
+    mesh = create_mesh(devices=jax.devices()[: env.num_replicas()])
+    trainer = ElasticTrainer(
+        loss_fn=lambda p, b, r: jnp.mean(
+            (b["x"] @ p["w"] + p["b"] - b["y"]) ** 2
+        ),
+        params={"w": jnp.zeros(4), "b": jnp.zeros(())},
+        optimizer=optax.sgd(0.05),
+        init_batch_size=32,
+        scaling_rule=AdaScale(),
+        mesh=mesh,
+    )
+    trainer.metrics_every = 2
+    holder = {"state": trainer.init_state()}
+    ck = trainer.make_checkpoint_state(
+        lambda: holder["state"],
+        lambda s: holder.__setitem__("state", s),
+    )
+    checkpoint.load_state(ck)
+    metrics.ensure_checkpoint_registered()
+    loader = AdaptiveDataLoader({"x": x, "y": y}, batch_size=32,
+                                name="chaos-loader")
+    loader.autoscale_batch_size(256, local_bsz_bounds=(8, 64),
+                                gradient_accumulation=True)
+    for e in epoch.remaining_epochs_until(40):
+        for batch in loader:
+            holder["state"], m = trainer.run_step(
+                holder["state"], batch, loader
+            )
+    final_w = np.asarray(holder["state"].params["w"])
+    assert np.allclose(final_w, TRUE_W, atol=0.25), final_w
+    print("CHAOS-TRAINED", int(holder["state"].step))
+    """
+)
+
+
+@pytest.mark.slow
+def test_end_to_end_chaos_run_completes_training(tmp_path):
+    """The whole loop under chaos: the worker is hard-killed during a
+    checkpoint save (incarnation 0), restarts under a lossy RPC
+    schedule, resumes from the intact checkpoint, and still converges
+    — the runner charges the kill to the retry budget, not the job's
+    correctness."""
+    from adaptdl_tpu.sched.local_runner import LocalElasticRunner
+
+    script = tmp_path / "train.py"
+    script.write_text(CHAOS_TRAIN_SCRIPT)
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    runner = LocalElasticRunner(
+        str(script),
+        num_chips=4,
+        checkpoint_dir=str(ckpt),
+        job_name="chaos/e2e",
+        allocator_interval=2.0,
+        max_failures=2,
+        extra_env={
+            "PYTHONPATH": os.environ.get("PYTHONPATH", "")
+            + os.pathsep
+            + os.getcwd(),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "ADAPTDL_FIT_INTERVAL": "1",
+            "ADAPTDL_CKPT_EVERY_STEPS": "4",
+            "ADAPTDL_HEARTBEAT_INTERVAL": "1",
+        },
+    )
+    code = runner.run()
+    assert code == 0
+    record = runner.state.get_job("chaos/e2e")
+    assert record.status == "Succeeded"
+    assert runner.restarts >= 1, "the injected kill forced a restart"
+    # The kill was non-graceful: it must have consumed retry budget
+    # (restarts alone could also come from rescales, so only check
+    # the job recovered rather than never failing).
+    leftover = [
+        e
+        for e in os.listdir(ckpt)
+        if e.startswith(checkpoint._TMP_PREFIX)
+    ]
+    assert leftover == [], "no abandoned temp dirs after recovery"

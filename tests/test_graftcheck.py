@@ -99,6 +99,52 @@ def test_checkpoint_protocol_good():
     assert run_on("ckptproto_good.py") == []
 
 
+def test_fault_rpc_bad():
+    findings = run_on("faultrpc_bad.py")
+    assert rule_lines(findings, "GC601") == [3, 4, 10, 14]
+    assert rule_lines(findings, "GC602") == [19, 23]
+    assert {f.rule for f in findings} == {"GC601", "GC602"}
+
+
+def test_fault_rpc_good():
+    assert run_on("faultrpc_good.py") == []
+
+
+def test_fault_rpc_catalog_tracks_faults_module(tmp_path):
+    """GC602 judges against the REAL faults.py catalog: a root with no
+    faults module yields no (unjudgeable) findings, and a root whose
+    catalog contains the fixture's 'typo' name accepts it."""
+    fixtures = os.path.join(tmp_path, "tests", "graftcheck_fixtures")
+    os.makedirs(fixtures)
+    import shutil
+
+    shutil.copy(
+        os.path.join(FIXTURES, "faultrpc_bad.py"),
+        os.path.join(fixtures, "faultrpc_bad.py"),
+    )
+    # No faults module under this root: GC601 still fires, GC602 not.
+    ctx = Context(root=str(tmp_path))
+    findings = analyze_paths(
+        [os.path.join(fixtures, "faultrpc_bad.py")], ALL_PASSES, ctx
+    )
+    assert rule_lines(findings, "GC601") == [3, 4, 10, 14]
+    assert rule_lines(findings, "GC602") == []
+    # A catalog registering the names makes them legal.
+    pkg = os.path.join(tmp_path, "adaptdl_tpu")
+    os.makedirs(pkg)
+    with open(os.path.join(pkg, "faults.py"), "w") as f:
+        f.write(
+            "INJECTION_POINTS = {\n"
+            '    "ckpt.write.pre_renam": "x",\n'
+            '    "made.up.point": "y",\n'
+            "}\n"
+        )
+    findings = analyze_paths(
+        [os.path.join(fixtures, "faultrpc_bad.py")], ALL_PASSES, ctx
+    )
+    assert rule_lines(findings, "GC602") == []
+
+
 def test_file_level_suppression():
     findings = run_on("suppress_file.py")
     assert rule_lines(findings, "GC302") == [16]

@@ -1,13 +1,16 @@
 """Preemption listener test with a fake metadata endpoint (reference
 strategy: aws/test_worker.py runs with a mocked metadata server)."""
 
+import contextlib
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, HTTPServer
 
+import pytest
+
 from adaptdl_tpu._compat import pick_unused_port
 
-from adaptdl_tpu import _signal
+from adaptdl_tpu import _signal, faults
 from adaptdl_tpu.sched import preemption
 
 
@@ -22,6 +25,26 @@ class FakeMetadata(BaseHTTPRequestHandler):
 
     def log_message(self, *args):
         pass
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@contextlib.contextmanager
+def fake_metadata_server(preempted=False):
+    FakeMetadata.preempted = preempted
+    port = pick_unused_port()
+    server = HTTPServer(("127.0.0.1", port), FakeMetadata)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        yield f"http://127.0.0.1:{port}/preempted"
+    finally:
+        server.shutdown()
+        FakeMetadata.preempted = False
 
 
 def test_listener_sets_exit_flag_on_preemption():
@@ -44,3 +67,52 @@ def test_listener_sets_exit_flag_on_preemption():
     finally:
         server.shutdown()
         _signal.set_exit_flag(False)
+
+
+def test_poll_once_absorbs_dropped_rpcs():
+    """An injected RPC drop (or any transport failure) means "not
+    preempted", never an exception into the listener thread."""
+    with fake_metadata_server(preempted=True) as url:
+        faults.configure("rpc.request.send=fail")
+        assert preemption.poll_once(url) is False
+        # The drop clears; the real answer comes through again.
+        faults.configure(None)
+        assert preemption.poll_once(url) is True
+
+
+def test_poll_once_survives_injected_latency():
+    with fake_metadata_server(preempted=True) as url:
+        faults.configure("rpc.request.send=sleep:0.05")
+        assert preemption.poll_once(url) is True
+
+
+def test_poll_once_unreachable_endpoint_is_false():
+    port = pick_unused_port()
+    assert (
+        preemption.poll_once(f"http://127.0.0.1:{port}/preempted")
+        is False
+    )
+
+
+def test_listener_keeps_polling_through_dropped_rpcs():
+    """A flaky metadata path must not kill the listener: drops are
+    absorbed poll after poll, and the notice still lands once the
+    path clears."""
+    _signal.set_exit_flag(False)
+    with fake_metadata_server(preempted=True) as url:
+        try:
+            faults.configure("rpc.request.send=fail@1+", seed=1)
+            stop = preemption.start_listener(url, interval=0.05)
+            time.sleep(0.3)
+            assert not _signal.get_exit_flag(), "drops absorbed"
+            faults.configure(None)
+            deadline = time.time() + 5
+            while (
+                not _signal.get_exit_flag()
+                and time.time() < deadline
+            ):
+                time.sleep(0.05)
+            assert _signal.get_exit_flag()
+            stop.set()
+        finally:
+            _signal.set_exit_flag(False)

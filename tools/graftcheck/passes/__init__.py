@@ -5,6 +5,7 @@ from tools.graftcheck.passes.checkpoint_protocol import (
 )
 from tools.graftcheck.passes.collective_axis import CollectiveAxisPass
 from tools.graftcheck.passes.env_registry import EnvRegistryPass
+from tools.graftcheck.passes.fault_rpc import FaultRpcPass
 from tools.graftcheck.passes.host_sync import HostSyncPass
 from tools.graftcheck.passes.lock_discipline import LockDisciplinePass
 
@@ -14,6 +15,7 @@ ALL_PASSES = [
     EnvRegistryPass(),
     CollectiveAxisPass(),
     CheckpointProtocolPass(),
+    FaultRpcPass(),
 ]
 
 RULE_CATALOG = {

@@ -1,0 +1,199 @@
+"""GC6xx — control-plane RPC and fault-injection hygiene.
+
+The chaos-hardening contract has two halves that drift silently
+without enforcement:
+
+- **GC601** — raw ``requests`` usage (an ``import requests``, a
+  ``from requests import ...``, or a ``requests.xxx(...)`` call)
+  outside the resilient client module ``adaptdl_tpu/rpc.py``. An
+  ad-hoc ``requests`` call has no retries, no deadline, no circuit
+  breaker, and is invisible to the fault-injection schedule — every
+  control-plane HTTP call goes through ``rpc.RpcClient``.
+- **GC602** — a ``faults.maybe_fail("<name>")`` call whose literal
+  point name is not registered in the ``INJECTION_POINTS`` catalog in
+  ``adaptdl_tpu/faults.py``. A typo'd point can never fire (the chaos
+  schedule would silently not cover the path it claims to), so the
+  catalog is the single source of truth; it is parsed statically from
+  the faults module — keep it a plain literal dict.
+
+Non-literal point names (variables) are not checkable statically and
+are left to the runtime check in ``faults._Schedule.fire``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.graftcheck.core import (
+    Context,
+    Finding,
+    Pass,
+    SourceFile,
+    dotted_name,
+)
+
+
+def _load_catalog(path: str) -> set[str] | None:
+    """The INJECTION_POINTS keys from the faults module, or None when
+    the module (or the literal) cannot be found."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "INJECTION_POINTS"
+            for t in node.targets
+        ):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return None
+        return {
+            key.value
+            for key in node.value.keys
+            if isinstance(key, ast.Constant)
+            and isinstance(key.value, str)
+        }
+    return None
+
+
+class FaultRpcPass(Pass):
+    name = "fault-rpc"
+    rules = {
+        "GC601": "raw requests usage outside the rpc client module",
+        "GC602": (
+            "fault-injection point not registered in faults.py"
+        ),
+    }
+
+    def __init__(self):
+        # (path, mtime, size) -> catalog; the pass instance outlives
+        # one analyze run (ALL_PASSES is module-level), so key the
+        # cache on the file's identity, not just its path.
+        self._catalog_cache: dict[tuple, set[str] | None] = {}
+
+    def _rpc_modules(self, ctx: Context) -> tuple[str, ...]:
+        return tuple(
+            ctx.options.get(
+                "rpc_modules", ("adaptdl_tpu/rpc.py", "rpc.py")
+            )
+        )
+
+    def _is_rpc_module(self, sf: SourceFile, ctx: Context) -> bool:
+        rel = sf.rel.replace(os.sep, "/")
+        return any(
+            rel == mod or rel.endswith("/" + mod)
+            for mod in self._rpc_modules(ctx)
+        )
+
+    def _catalog(self, ctx: Context) -> set[str] | None:
+        path = os.path.join(
+            ctx.root,
+            ctx.options.get("faults_module", "adaptdl_tpu/faults.py"),
+        )
+        try:
+            stat = os.stat(path)
+        except OSError:
+            return None
+        key = (path, stat.st_mtime, stat.st_size)
+        if key not in self._catalog_cache:
+            self._catalog_cache.clear()  # one live entry is enough
+            self._catalog_cache[key] = _load_catalog(path)
+        return self._catalog_cache[key]
+
+    def check_file(
+        self, sf: SourceFile, ctx: Context
+    ) -> list[Finding]:
+        findings: list[Finding] = []
+        if not self._is_rpc_module(sf, ctx):
+            findings.extend(self._check_requests(sf))
+        findings.extend(self._check_points(sf, ctx))
+        return findings
+
+    # -- GC601 ---------------------------------------------------------
+
+    def _check_requests(self, sf: SourceFile) -> list[Finding]:
+        findings: list[Finding] = []
+
+        def flag(node: ast.AST, what: str) -> None:
+            findings.append(
+                Finding(
+                    file=sf.rel,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule="GC601",
+                    message=(
+                        f"{what} outside the rpc client module"
+                    ),
+                    hint=(
+                        "route control-plane HTTP through "
+                        "adaptdl_tpu.rpc (retries, deadlines, "
+                        "circuit breaker, fault injection)"
+                    ),
+                )
+            )
+
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.split(".")[0] == "requests":
+                        flag(node, "raw `import requests`")
+            elif isinstance(node, ast.ImportFrom):
+                if (
+                    node.module or ""
+                ).split(".")[0] == "requests" and node.level == 0:
+                    flag(node, "raw `from requests import`")
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name and name.split(".")[0] == "requests" and (
+                    "." in name
+                ):
+                    flag(node, f"raw `{name}(...)` call")
+        return findings
+
+    # -- GC602 ---------------------------------------------------------
+
+    def _check_points(
+        self, sf: SourceFile, ctx: Context
+    ) -> list[Finding]:
+        catalog = self._catalog(ctx)
+        if catalog is None:
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is None or name.split(".")[-1] != "maybe_fail":
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if not (
+                isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)
+            ):
+                continue
+            if arg.value in catalog:
+                continue
+            findings.append(
+                Finding(
+                    file=sf.rel,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule="GC602",
+                    message=(
+                        f"injection point {arg.value!r} is not "
+                        "registered in faults.INJECTION_POINTS"
+                    ),
+                    hint=(
+                        "add it to the INJECTION_POINTS catalog in "
+                        "adaptdl_tpu/faults.py (or fix the typo)"
+                    ),
+                )
+            )
+        return findings
