@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos probe
+.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched probe
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -43,6 +43,14 @@ graftcheck:
 chaos:
 	$(CPU_ENV) ADAPTDL_FAULT_SEED=1234 $(PY) -m pytest \
 	    tests/test_chaos.py -q --durations=10
+
+# Durable-supervisor / transactional-rescale chaos: journal crash
+# consistency (supervisor hard-killed mid-journal-write), recovery +
+# worker reattach with zero job restarts, commit-timeout rollback,
+# slot strikes/quarantine. Same fixed seed as `chaos`.
+chaos-sched:
+	$(CPU_ENV) ADAPTDL_FAULT_SEED=1234 $(PY) -m pytest \
+	    tests/test_chaos_sched.py -q --durations=10
 
 probe:
 	timeout 180 $(PY) tools/tpu_probe.py || echo "probe: tunnel dead/cpu-only"

@@ -59,7 +59,10 @@ def _discover_peers() -> dict[int, str] | None:
     client = rpc.default_client()
     client.put(
         f"{url}/register/{job}/{group}/{rank}",
-        json={"address": address},
+        # The process count is the supervisor's commit quorum for a
+        # pending allocation epoch: the new allocation only commits
+        # once this many ranks have proven liveness.
+        json={"address": address, "processes": env.num_processes()},
         endpoint=f"register/{job}",
         timeout=(5, 30),
         attempts=_REGISTER_ATTEMPTS,

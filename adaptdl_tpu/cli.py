@@ -22,6 +22,7 @@ keeps the same verb surface with two backends:
 Usage:
     adaptdl-tpu submit train.py --checkpoint-dir /ckpt [--chips N]
     adaptdl-tpu ls --supervisor http://HOST:PORT
+    adaptdl-tpu status --supervisor http://HOST:PORT
     adaptdl-tpu logs default/my-job -f        # cluster pods
     adaptdl-tpu logs --log-file /ckpt/job.log # local file
     adaptdl-tpu cp default/my-job:checkpoint-3.0 ./out   # from PVC
@@ -220,6 +221,11 @@ def _ls_k8s(args) -> int:
                 _age(meta.get("creationTimestamp", "")),
             )
         )
+    _print_table(rows)
+    return 0
+
+
+def _print_table(rows: list[tuple]) -> None:
     widths = [
         max(len(row[col]) for row in rows)
         for col in range(len(rows[0]))
@@ -229,6 +235,74 @@ def _ls_k8s(args) -> int:
             "  ".join(
                 cell.ljust(width) for cell, width in zip(row, widths)
             ).rstrip()
+        )
+
+
+def _cmd_status(args) -> int:
+    """Operator view of a live supervisor: per-job phase with the
+    degraded flag, allocation epoch/state (pending = a transactional
+    rescale awaiting its commit quorum), and lease ages — plus slot
+    strikes/quarantine and recovery info, so the reason an allocation
+    was withdrawn or rolled back is visible instead of implied."""
+    from adaptdl_tpu import rpc
+
+    payload = rpc.default_client().get(
+        f"{args.supervisor}/status",
+        endpoint="cli/status",
+        timeout=10,
+        attempts=3,
+        deadline=30.0,
+    ).json()
+    rows = [
+        (
+            "JOB", "PHASE", "REPLICAS", "DEGRADED", "ALLOC",
+            "RESTARTS", "LEASES",
+        )
+    ]
+    for key, job in sorted(payload.get("jobs", {}).items()):
+        ages = job.get("leaseAgeS", {})
+        leases = ",".join(
+            f"{rank}:{int(age)}s"
+            for rank, age in sorted(
+                ages.items(), key=lambda kv: int(kv[0])
+            )
+        )
+        rows.append(
+            (
+                key,
+                str(job.get("status", "?")),
+                str(job.get("replicas", 0)),
+                "yes" if job.get("degraded") else "no",
+                f"{job.get('allocEpoch', 0)}/"
+                f"{job.get('allocState', '?')}",
+                str(job.get("restarts", 0)),
+                leases or "-",
+            )
+        )
+    _print_table(rows)
+    quarantined = payload.get("quarantinedSlots", {})
+    strikes = payload.get("slotStrikes", {})
+    if quarantined or strikes:
+        print()
+        rows = [("SLOT", "STRIKES", "QUARANTINED")]
+        for slot in sorted(set(quarantined) | set(strikes)):
+            remaining = quarantined.get(slot)
+            rows.append(
+                (
+                    slot,
+                    str(strikes.get(slot, 0)),
+                    f"{int(remaining)}s left"
+                    if remaining is not None
+                    else "no",
+                )
+            )
+        _print_table(rows)
+    recovery = payload.get("recovery") or {}
+    if recovery.get("recoveries"):
+        print(
+            f"\nsupervisor recoveries: {recovery['recoveries']} "
+            f"(last replay {recovery.get('lastRecoveryS') or 0:.3f}s, "
+            f"{recovery.get('tornRecords', 0)} torn records dropped)"
         )
     return 0
 
@@ -604,6 +678,15 @@ def main(argv=None) -> int:
     )
     p.add_argument("--namespace", default="default")
     p.set_defaults(fn=_cmd_ls)
+
+    p = sub.add_parser(
+        "status",
+        help="operator view of a live supervisor: per-job phase, "
+        "degraded flag, allocation epoch/state, lease ages, slot "
+        "strikes/quarantine, recovery info",
+    )
+    p.add_argument("--supervisor", required=True)
+    p.set_defaults(fn=_cmd_status)
 
     p = sub.add_parser("hints", help="show a job's posted sched hints")
     p.add_argument("job", help="namespace/name")

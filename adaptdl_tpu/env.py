@@ -279,6 +279,45 @@ def lease_ttl() -> float:
     return _get_float("ADAPTDL_LEASE_TTL", 120.0)
 
 
+def sched_state_dir() -> str | None:
+    """Directory for the supervisor's durable cluster state (write-
+    ahead journal + periodic snapshots). Unset — the default — keeps
+    ``ClusterState`` purely in-memory; set, every mutation is journaled
+    with an fsync and a restarted supervisor replays snapshot+journal
+    to recover jobs, allocations, and leases."""
+    return _get_str("ADAPTDL_SCHED_STATE_DIR")
+
+
+def alloc_commit_timeout() -> float:
+    """Seconds a newly published allocation has to prove itself — all
+    expected worker processes of the new group registering/heartbeating
+    — before the supervisor rolls the job back to its last-committed
+    allocation and strikes the failing slots (0 disables transactional
+    rescale: allocations commit immediately, the pre-PR-5 behavior)."""
+    return _get_float("ADAPTDL_ALLOC_COMMIT_TIMEOUT", 300.0)
+
+
+def slot_strike_limit() -> int:
+    """Consecutive failed-allocation strikes against a slot before it
+    is quarantined (the allocator stops placing jobs on it until a
+    timed un-quarantine probe)."""
+    return _get_int("ADAPTDL_SLOT_STRIKE_LIMIT", 3)
+
+
+def slot_quarantine_s() -> float:
+    """Seconds a struck-out slot stays quarantined before one probe
+    allocation is allowed again (a single new strike re-quarantines)."""
+    return _get_float("ADAPTDL_SLOT_QUARANTINE_S", 300.0)
+
+
+def sched_reconcile_window() -> float:
+    """Seconds after a supervisor recovery during which recovered
+    worker leases are granted a grace deadline and the sweeper may not
+    expire anyone — workers get this long to re-register/heartbeat
+    against the recovered records before liveness enforcement resumes."""
+    return _get_float("ADAPTDL_SCHED_RECONCILE_WINDOW", 30.0)
+
+
 def checkpoint_verify() -> bool:
     """Whether ``load_state`` verifies per-state sha256/size against
     the checkpoint's integrity manifest before restoring (``off``/

@@ -70,6 +70,13 @@ INJECTION_POINTS = {
     # worker lifecycle backends (sched.local_runner / sched.multi_runner)
     "runner.launch.pre": "before a worker subprocess launch",
     "runner.supervise.poll": "each supervision poll cycle",
+    # durable cluster state (sched.journal / sched.state)
+    "sched.journal_write": "before a journal record is written+fsynced",
+    "sched.snapshot_write": "before a state snapshot is written",
+    "sched.recovery_replay": "at the start of snapshot+journal replay",
+    # transactional rescale (sched.state commit path; an injected
+    # fault SUPPRESSES the commit signal so the epoch times out)
+    "alloc.commit_timeout": "before an allocation epoch commits",
 }
 
 

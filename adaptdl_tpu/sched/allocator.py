@@ -171,7 +171,17 @@ class Allocator:
             if self._expander is not None:
                 self._expander.request(0)
             return {}
+        # Slots struck out by failed allocation epochs are off the
+        # table until their un-quarantine probe: re-placing a job on
+        # a slot that just crash-looped it would burn the retry
+        # budget re-proving the same failure.
+        quarantined = set(self._state.quarantined_slots())
         nodes = self._current_nodes()
+        if quarantined:
+            LOG.info(
+                "excluding quarantined slots from placement: %s",
+                sorted(quarantined),
+            )
         if not nodes:
             # Scaled to zero with pending work: the policy cannot run
             # on an empty inventory (it would report desired=0 and
@@ -181,7 +191,7 @@ class Allocator:
                 self._expander.request(1)
             return {}
         allocations, desired = self._policy.optimize(
-            jobs, nodes, base, self._template
+            jobs, nodes, base, self._template, quarantined=quarantined
         )
         if self._expander is not None:
             self._expander.request(desired)

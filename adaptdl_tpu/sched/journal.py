@@ -1,0 +1,203 @@
+"""Durable cluster-state storage: write-ahead journal + snapshots.
+
+The reference's controller survives restarts because the AdaptDLJob
+CRD lives in a durable k8s API server (reference:
+sched/adaptdl_sched/controller.py checkpoint-restart contract); our
+in-process :class:`~adaptdl_tpu.sched.state.ClusterState` had no such
+substrate, so a supervisor crash lost every job, lease, allocation,
+and retune config. This module is that substrate, lifted out of etcd:
+
+- ``journal.jsonl`` — one JSON record per state mutation, appended and
+  **fsynced before the mutation is applied** (write-ahead ordering: a
+  crash between journal and apply loses an un-acknowledged mutation,
+  never acknowledges a lost one).
+- ``snapshot.json`` — a full state dump written atomically
+  (tmp + fsync + rename + dir fsync) every ``snapshot_every`` appends,
+  after which the journal is truncated, bounding replay time.
+
+Recovery (:meth:`StateJournal.load`) reads the snapshot, then replays
+journal records in order. A torn trailing record — the expected
+artifact of dying mid-append — is dropped with a warning AND the file
+is truncated back to the valid prefix, so post-recovery appends never
+concatenate onto the partial line (which would silently cut off every
+later acknowledged record at the NEXT recovery). Every record carries
+a monotonic ``seq``; the snapshot records the ``last_seq`` it covers,
+and replay skips records at or below it — a crash between the
+snapshot's atomic replace and the journal truncation therefore
+replays nothing twice (double-applying a rollback would double-strike
+healthy slots). A corrupt snapshot raises
+:class:`JournalCorruptError` loudly instead of silently booting an
+empty cluster (the snapshot write is atomic, so a bad one means
+storage-level corruption an operator must see).
+
+Fault-injection points (``sched.journal_write``,
+``sched.snapshot_write``, ``sched.recovery_replay``) let the chaos
+suite kill the supervisor at exactly these windows.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+from adaptdl_tpu import faults
+
+LOG = logging.getLogger(__name__)
+
+JOURNAL_NAME = "journal.jsonl"
+SNAPSHOT_NAME = "snapshot.json"
+
+
+class JournalCorruptError(RuntimeError):
+    """The snapshot is unreadable: recovery cannot be trusted."""
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class StateJournal:
+    """Append-only mutation log + periodic snapshot for one cluster.
+
+    Not internally locked: every method is called under the owning
+    ``ClusterState``'s condition lock, which also serializes append
+    ordering with the in-memory mutations it journals.
+    """
+
+    def __init__(self, state_dir: str, snapshot_every: int = 256):
+        os.makedirs(state_dir, exist_ok=True)
+        self.state_dir = state_dir
+        self.journal_path = os.path.join(state_dir, JOURNAL_NAME)
+        self.snapshot_path = os.path.join(state_dir, SNAPSHOT_NAME)
+        self._snapshot_every = max(int(snapshot_every), 1)
+        self._appends_since_snapshot = 0
+        # Monotonic record sequence; primed by load() so a recovered
+        # journal keeps counting where the previous life stopped.
+        self._seq = 0
+        self._fh = None
+
+    # -- write path ----------------------------------------------------
+
+    def append(self, record: dict) -> None:
+        """Durably append one mutation record (fsync before return)."""
+        faults.maybe_fail("sched.journal_write")
+        if self._fh is None:
+            self._fh = open(self.journal_path, "a", encoding="utf-8")
+        self._seq += 1
+        record = dict(record, seq=self._seq)
+        self._fh.write(json.dumps(record, sort_keys=True) + "\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._appends_since_snapshot += 1
+
+    def snapshot_due(self) -> bool:
+        return self._appends_since_snapshot >= self._snapshot_every
+
+    def write_snapshot(self, payload: dict) -> None:
+        """Atomically replace the snapshot and truncate the journal.
+
+        Ordering matters: the journal is truncated only after the new
+        snapshot is durably in place, so a crash at any point leaves
+        either (old snapshot + full journal) or (new snapshot + empty
+        journal) — never a gap.
+        """
+        faults.maybe_fail("sched.snapshot_write")
+        tmp = self.snapshot_path + ".tmp"
+        # The snapshot covers every record appended so far: replay
+        # skips journal records at or below last_seq, so a crash
+        # between the replace below and the truncation never
+        # double-applies them.
+        payload = dict(payload, last_seq=self._seq)
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snapshot_path)
+        _fsync_dir(self.state_dir)
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        with open(self.journal_path, "w", encoding="utf-8") as f:
+            f.flush()
+            os.fsync(f.fileno())
+        self._appends_since_snapshot = 0
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    # -- recovery ------------------------------------------------------
+
+    def load(self) -> tuple[dict | None, list[dict], int]:
+        """Read (snapshot, journal records to replay, torn count).
+
+        The journal is replayed up to the first torn line — one that
+        does not parse, or lacks its trailing newline (the fsync that
+        would have acknowledged it never returned) — and the file is
+        truncated back to that valid prefix so later appends never
+        concatenate onto the partial line. Records whose ``seq`` the
+        snapshot already covers (a crash landed between the snapshot
+        replace and the journal truncation) are skipped, never
+        double-applied.
+        """
+        faults.maybe_fail("sched.recovery_replay")
+        snapshot = None
+        if os.path.exists(self.snapshot_path):
+            try:
+                with open(self.snapshot_path, encoding="utf-8") as f:
+                    snapshot = json.load(f)
+            except (ValueError, OSError) as exc:
+                raise JournalCorruptError(
+                    f"unreadable state snapshot {self.snapshot_path}: "
+                    f"{exc}"
+                ) from exc
+        last_seq = int((snapshot or {}).get("last_seq", 0))
+        self._seq = last_seq
+        records: list[dict] = []
+        kept = 0
+        torn = 0
+        if os.path.exists(self.journal_path):
+            valid_bytes = 0
+            with open(self.journal_path, "rb") as f:
+                for lineno, raw in enumerate(f, 1):
+                    try:
+                        record = json.loads(raw.decode("utf-8"))
+                    except (ValueError, UnicodeDecodeError):
+                        record = None
+                    if not isinstance(record, dict) or not raw.endswith(
+                        b"\n"
+                    ):
+                        torn += 1
+                        LOG.warning(
+                            "dropping torn journal record at %s:%d "
+                            "(recovering the acknowledged prefix)",
+                            self.journal_path, lineno,
+                        )
+                        break
+                    valid_bytes += len(raw)
+                    kept += 1
+                    seq = int(record.get("seq", last_seq + 1))
+                    self._seq = max(self._seq, seq)
+                    if seq <= last_seq:
+                        # Already baked into the snapshot: the crash
+                        # hit between snapshot replace and journal
+                        # truncation.
+                        continue
+                    records.append(record)
+            if torn:
+                with open(self.journal_path, "r+b") as f:
+                    f.truncate(valid_bytes)
+                    f.flush()
+                    os.fsync(f.fileno())
+        # The recovered journal's length counts toward the rotation
+        # threshold: a crash-looping supervisor that never reaches
+        # snapshot_every appends per incarnation must still rotate,
+        # or the journal (and replay time) grows without bound.
+        self._appends_since_snapshot = kept
+        return snapshot, records, torn

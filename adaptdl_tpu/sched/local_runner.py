@@ -36,7 +36,11 @@ from adaptdl_tpu._compat import pick_unused_port
 from adaptdl_tpu._signal import GRACEFUL_EXIT_CODE
 from adaptdl_tpu.sched.allocator import Allocator
 from adaptdl_tpu.sched.policy import NodeInfo, PolluxPolicy
-from adaptdl_tpu.sched.state import ClusterState, normalize_topology
+from adaptdl_tpu.sched.state import (
+    FINISHED,
+    ClusterState,
+    normalize_topology,
+)
 from adaptdl_tpu.sched.supervisor import Supervisor
 
 LOG = logging.getLogger(__name__)
@@ -57,6 +61,7 @@ class LocalElasticRunner:
         pop_size: int = 24,
         generations: int = 20,
         term_grace_period: float = 120.0,
+        state_dir: str | None = None,
     ):
         self.term_grace_period = term_grace_period
         self.script = script
@@ -67,8 +72,11 @@ class LocalElasticRunner:
         self.min_replicas = min_replicas
         self.max_failures = max_failures
         self.extra_env = dict(extra_env or {})
-        self.restarts = 0
-        self.state = ClusterState()
+        # ``state_dir`` (default: ADAPTDL_SCHED_STATE_DIR) makes the
+        # controller crash-restartable: ClusterState journals every
+        # mutation and a rerun recovers the job record instead of
+        # starting over.
+        self.state = ClusterState(state_dir=state_dir)
         spec = {
             "resources": {"tpu": 1},
             "min_replicas": min_replicas,
@@ -78,7 +86,22 @@ class LocalElasticRunner:
         from adaptdl_tpu.sched.validator import validate_job_spec
 
         validate_job_spec(spec)
-        self.state.create_job(job_name, spec=spec)
+        recovered = self.state.get_job(job_name)
+        if recovered is not None and recovered.status in FINISHED:
+            # Re-running a job that already finished: that run's
+            # record is history, not something to resume.
+            self.state.remove_job(job_name)
+            recovered = None
+        if recovered is None:
+            self.state.create_job(job_name, spec=spec)
+            self.restarts = 0
+        else:
+            # Recovered mid-run: keep allocations/hints/leases, adopt
+            # the current spec, and bump the restart counter so the
+            # next launch can never reuse (and clobber) a checkpoint
+            # version index an earlier incarnation may have written.
+            self.state.update(job_name, spec=spec)
+            self.restarts = recovered.restarts + 1
         self.supervisor = Supervisor(self.state)
         nodes = {"local": NodeInfo(resources={"tpu": num_chips})}
         self.allocator = Allocator(
@@ -146,7 +169,13 @@ class LocalElasticRunner:
                     self.restarts,
                     topology,
                 )
-                self.state.update(self.job_name, status="Running")
+                self.state.update(
+                    self.job_name,
+                    status="Running",
+                    # Persisted so a crash-restarted controller resumes
+                    # the counter instead of reusing version indices.
+                    restarts=self.restarts,
+                )
                 try:
                     # An injected fault here models a failed worker
                     # launch (image pull error, node gone) — it rides

@@ -32,7 +32,11 @@ from adaptdl_tpu._compat import pick_unused_port
 from adaptdl_tpu._signal import GRACEFUL_EXIT_CODE
 from adaptdl_tpu.sched.allocator import Allocator
 from adaptdl_tpu.sched.policy import NodeInfo, PolluxPolicy
-from adaptdl_tpu.sched.state import ClusterState, normalize_topology
+from adaptdl_tpu.sched.state import (
+    FINISHED,
+    ClusterState,
+    normalize_topology,
+)
 from adaptdl_tpu.sched.supervisor import Supervisor
 from adaptdl_tpu.sched.validator import validate_job_spec
 
@@ -59,12 +63,17 @@ class MultiJobRunner:
         term_grace_period: float = 120.0,
         pop_size: int = 24,
         generations: int = 20,
+        state_dir: str | None = None,
     ):
         self.jobs = {job.name: job for job in jobs}
         self.num_chips = num_chips
         self.max_failures = max_failures
         self.term_grace_period = term_grace_period
-        self.state = ClusterState()
+        # Durable when state_dir (or ADAPTDL_SCHED_STATE_DIR) is set:
+        # a crash-restarted runner recovers every job's record —
+        # allocation, hints, restart counter — from the journal.
+        self.state = ClusterState(state_dir=state_dir)
+        recovered_restarts: dict[str, int] = {}
         for job in jobs:
             spec = {
                 "resources": {"tpu": 1},
@@ -73,7 +82,28 @@ class MultiJobRunner:
                 "preemptible": True,
             }
             validate_job_spec(spec)
-            self.state.create_job(job.name, spec=spec)
+            record = self.state.get_job(job.name)
+            if record is not None and record.status in FINISHED:
+                self.state.remove_job(job.name)
+                record = None
+            if record is None:
+                self.state.create_job(job.name, spec=spec)
+            else:
+                self.state.update(job.name, spec=spec)
+                # Never reuse a checkpoint version index a previous
+                # controller incarnation may have handed out.
+                recovered_restarts[job.name] = record.restarts + 1
+        # Recovered jobs absent from THIS run's job list have no
+        # supervising thread: left in place they would compete for
+        # chips forever (the allocator iterates the state, not our
+        # thread table).
+        for key in list(self.state.jobs()):
+            if key not in self.jobs:
+                LOG.info(
+                    "dropping recovered job %s: not in this runner's "
+                    "job list", key,
+                )
+                self.state.remove_job(key)
         self.supervisor = Supervisor(self.state)
         self.allocator = Allocator(
             self.state,
@@ -85,7 +115,8 @@ class MultiJobRunner:
         )
         self.exit_codes: dict[str, int] = {}
         self.restart_counts: dict[str, int] = {
-            job.name: 0 for job in jobs
+            job.name: recovered_restarts.get(job.name, 0)
+            for job in jobs
         }
         self._stopped: set[str] = set()
         # Live worker process per job (soak/fault-injection harnesses
@@ -177,8 +208,14 @@ class MultiJobRunner:
                 topology,
             )
             # No-op if stop_job already made the status terminal
-            # (ClusterState keeps terminal statuses sticky).
-            self.state.update(job.name, status="Running")
+            # (ClusterState keeps terminal statuses sticky). The
+            # restart counter is persisted alongside so a recovered
+            # controller resumes it.
+            self.state.update(
+                job.name,
+                status="Running",
+                restarts=self.restart_counts[job.name],
+            )
             try:
                 # Same injected-launch-failure path as the local
                 # runner: counted against the job's retry budget.

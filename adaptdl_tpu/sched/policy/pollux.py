@@ -50,10 +50,20 @@ class PolluxPolicy:
 
     # -- single-job arrival (cheap path) ------------------------------
 
-    def allocate_job(self, job_info: JobInfo, nodes: dict) -> list:
+    def allocate_job(
+        self, job_info: JobInfo, nodes: dict, quarantined=()
+    ) -> list:
         """First-fit of a newly arrived job's min_replicas (reference:
-        pollux.py:43-70)."""
+        pollux.py:43-70). ``quarantined`` slots are skipped — they
+        struck out of the transactional-rescale commit loop and must
+        not host placements until their un-quarantine probe."""
         want = max(job_info.min_replicas, 1)
+        if quarantined:
+            nodes = {
+                name: node
+                for name, node in nodes.items()
+                if name not in quarantined
+            }
         for name, node in _sorted_nodes(nodes).items():
             fits = min(
                 node.resources.get(rtype, 0) // amount
@@ -66,7 +76,14 @@ class PolluxPolicy:
 
     # -- full optimization cycle --------------------------------------
 
-    def optimize(self, jobs, nodes, base_allocations, node_template):
+    def optimize(
+        self,
+        jobs,
+        nodes,
+        base_allocations,
+        node_template,
+        quarantined=(),
+    ):
         """One Pollux cycle.
 
         Args:
@@ -74,10 +91,35 @@ class PolluxPolicy:
           nodes: {node_key: NodeInfo} existing slices.
           base_allocations: {job_key: [node_key per replica]} current.
           node_template: NodeInfo for a provisionable slice.
+          quarantined: slot keys the search must not place jobs on
+            (struck out of the transactional-rescale commit loop).
+            Dropping them from the inventory also drops any base
+            allocation entries they held, so preemptible incumbents
+            migrate off a quarantined slot instead of being pinned to
+            it. A slot a NON-preemptible incumbent still runs on stays
+            in the inventory — ``repair`` pins such jobs to their base
+            allocation verbatim, so dropping the slot would silently
+            truncate an allocation the policy promises not to touch
+            (shrinking and restarting a non-preemptible job) — but is
+            blocked for every other job until its un-quarantine probe.
 
         Returns:
           (allocations, desired_nodes)
         """
+        blocked_slots: set = set()
+        if quarantined:
+            protected = {
+                slot
+                for key, job in jobs.items()
+                if not job.preemptible
+                for slot in base_allocations.get(key, [])
+            }
+            nodes = {
+                key: node
+                for key, node in nodes.items()
+                if key not in quarantined or key in protected
+            }
+            blocked_slots = set(quarantined) & protected
         if not jobs or not nodes:
             return {}, len(nodes)
 
@@ -106,7 +148,14 @@ class PolluxPolicy:
                 if node_key in node_index:
                     base_state[j, node_index[node_key]] += 1
 
-        problem = _Problem(job_list, node_list, base_state)
+        blocked = np.zeros((len(jobs), len(node_list)), dtype=bool)
+        for slot in blocked_slots:
+            if slot in node_index:
+                for j, (key, job) in enumerate(jobs.items()):
+                    if not pinned(key, job):
+                        blocked[j, node_index[slot]] = True
+
+        problem = _Problem(job_list, node_list, base_state, blocked=blocked)
         seeds = self._seed_population(jobs, nodes, base_state, node_list)
         population, F, front = nsga2.minimize(
             evaluate=problem.evaluate,
@@ -296,11 +345,14 @@ def _select_within_budget(values, max_nodes):
 class _Problem:
     """Objectives + variation operators over allocation matrices."""
 
-    def __init__(self, jobs, nodes, base_state):
+    def __init__(self, jobs, nodes, base_state, blocked=None):
         self.jobs = jobs
         self.nodes = nodes
         self.base_state = base_state
         self.shape = base_state.shape
+        # (jobs, nodes) placements repair must zero: quarantined slots
+        # kept in the inventory only for a pinned incumbent's sake.
+        self._blocked = blocked
         num_jobs, num_nodes = self.shape
         self._pinned = np.array(
             [
@@ -435,6 +487,8 @@ class _Problem:
         pop = states.shape[0]
         # Pinned jobs keep their base allocation verbatim.
         states[:, self._pinned] = self.base_state[self._pinned]
+        if self._blocked is not None and self._blocked.any():
+            states[:, self._blocked] = 0
         # A distributed job owns its slices' ICI: on every slice, keep
         # only the first distributed job (in the sorted priority
         # order), clearing later claimants. "Distributed" = more than

@@ -9,17 +9,27 @@ backend-agnostic; reference: sched/adaptdl_sched/supervisor.py:45-80):
 - ``PUT /register/{namespace}/{name}/{group}/{rank}`` — worker
   self-registration (the k8s backend gets this from pod IPs instead).
 - ``PUT /hints/{namespace}/{name}`` — validated sched-hints intake.
-- ``PUT /heartbeat/{namespace}/{name}/{rank}`` — liveness lease
-  renewal (register/hints/config traffic also renews, so heartbeats
-  piggyback on whatever the worker is already saying).
+- ``PUT /heartbeat/{namespace}/{name}/{rank}[?group=N]`` — liveness
+  lease renewal (register/hints/config traffic also renews, so
+  heartbeats piggyback on whatever the worker is already saying). The
+  optional ``group`` lets the state layer reject a doomed
+  incarnation's dying beats and lets single-process jobs — which
+  never register — prove a pending allocation epoch alive.
 - ``GET /hints/{namespace}/{name}``, ``GET /healthz``.
+- ``GET /status`` — operator-facing JSON: per-job phase, degraded
+  flag, allocation epoch/state, lease ages, plus slot strikes,
+  quarantine, and recovery info (the ``adaptdl-tpu status`` CLI).
 
 Liveness: each worker rank holds a lease of ``lease_ttl`` seconds; a
 background sweeper expires stale leases, marks the job degraded, and
 withdraws its allocation so the allocator re-places it — a vanished
-worker costs one TTL, not forever. Handlers are also fault-injection
-points (``sup.*.pre``): the chaos suite turns injected faults into
-500s to prove the client side retries through supervisor blips.
+worker costs one TTL, not forever. The same sweeper drives the
+transactional-rescale clock: pending allocation epochs whose commit
+deadline lapsed are rolled back to the last-committed allocation
+(``ClusterState.expire_overdue_allocations``). Handlers are also
+fault-injection points (``sup.*.pre``): the chaos suite turns
+injected faults into 500s to prove the client side retries through
+supervisor blips.
 
 Runs its own thread + aiohttp event loop so trainers and the local
 runner can use it without an async main.
@@ -64,6 +74,12 @@ def _faultable(point: str):
     return decorate
 
 
+def _group_param(request: web.Request) -> int | None:
+    """The worker's restart group, when the request reports it."""
+    raw = request.query.get("group")
+    return int(raw) if raw not in (None, "") else None
+
+
 class Supervisor(ThreadedHttpServer):
     def __init__(
         self,
@@ -78,16 +94,38 @@ class Supervisor(ThreadedHttpServer):
         self._lease_ttl = (
             env.lease_ttl() if lease_ttl is None else lease_ttl
         )
+        # Default cadence: a quarter of whichever expiry clock is
+        # active (lease TTL, else the allocation-commit timeout).
+        clock = self._lease_ttl
+        if clock <= 0:
+            clock = getattr(state, "alloc_commit_timeout", 0.0)
         self._sweep_interval = (
             sweep_interval
             if sweep_interval is not None
-            else max(min(self._lease_ttl / 4.0, 5.0), 0.05)
+            else max(min(clock / 4.0, 5.0), 0.05)
         )
 
-    def _renew(self, key: str, rank: int) -> None:
+    def _renew(
+        self, key: str, rank: int, group: int | None = None
+    ) -> None:
         """Piggybacked lease renewal: any authenticated-enough traffic
-        from a worker proves it alive."""
-        self._state.renew_lease(key, rank, self._lease_ttl)
+        from a worker proves it alive. ``group`` (when the request
+        reports it) gets the same stale-incarnation guard as a
+        heartbeat — a doomed incarnation's hints/config traffic must
+        not renew leases or satisfy the commit quorum of the
+        allocation epoch replacing it."""
+        self._state.renew_lease(key, rank, self._lease_ttl, group=group)
+
+    @staticmethod
+    async def _offload(fn, *args, **kwargs):
+        """Run a journaled state mutation off the event loop: every
+        journal append fsyncs (and each 256th rewrites a full
+        snapshot), so running it inline would stall heartbeats and
+        discover long-polls behind disk latency. ``ClusterState`` is
+        lock-protected, so executor threads are safe callers."""
+        return await asyncio.get_event_loop().run_in_executor(
+            None, functools.partial(fn, *args, **kwargs)
+        )
 
     # -- handlers -----------------------------------------------------
 
@@ -123,19 +161,39 @@ class Supervisor(ThreadedHttpServer):
         body = await request.json()
         if self._state.get_job(key) is None:
             return web.json_response({"error": "no such job"}, status=404)
-        if self._state.register_worker(key, group, rank, body["address"]):
-            # Only an ACCEPTED registration earns a lease: a
-            # stale-group retry must not plant a phantom lease for a
-            # rank the current incarnation doesn't run (its expiry
-            # would degrade a healthy job).
-            self._renew(key, rank)
+
+        def mutate() -> None:
+            if self._state.register_worker(
+                key,
+                group,
+                rank,
+                body["address"],
+                # Reported process count = the commit quorum for a
+                # pending allocation epoch (how many ranks must prove
+                # liveness).
+                processes=body.get("processes"),
+            ):
+                # Only an ACCEPTED registration earns a lease: a
+                # stale-group retry must not plant a phantom lease for
+                # a rank the current incarnation doesn't run (its
+                # expiry would degrade a healthy job).
+                self._renew(key, rank)
+
+        await self._offload(mutate)
         return web.json_response({"ok": True})
 
     @_faultable("sup.heartbeat.pre")
     async def _heartbeat(self, request: web.Request) -> web.Response:
         key = "{namespace}/{name}".format(**request.match_info)
         rank = int(request.match_info["rank"])
-        if not self._state.renew_lease(key, rank, self._lease_ttl):
+        group = _group_param(request)
+        if not await self._offload(
+            self._state.renew_lease,
+            key,
+            rank,
+            self._lease_ttl,
+            group=group,
+        ):
             return web.json_response({"error": "no such job"}, status=404)
         return web.json_response(
             {"ok": True, "ttl": self._lease_ttl}
@@ -151,10 +209,16 @@ class Supervisor(ThreadedHttpServer):
             return web.json_response({"error": str(exc)}, status=400)
         if self._state.get_job(key) is None:
             return web.json_response({"error": "no such job"}, status=404)
-        self._state.update(key, hints=hints)
-        # Hints are posted from rank 0's fit thread: count them as a
-        # liveness beat so chatty jobs never need a dedicated beat.
-        self._renew(key, 0)
+        group = _group_param(request)
+
+        def mutate() -> None:
+            self._state.update(key, hints=hints)
+            # Hints are posted from rank 0's fit thread: count them as
+            # a liveness beat so chatty jobs never need a dedicated
+            # beat.
+            self._renew(key, 0, group=group)
+
+        await self._offload(mutate)
         return web.json_response({"ok": True})
 
     async def _get_hints(self, request: web.Request) -> web.Response:
@@ -172,16 +236,49 @@ class Supervisor(ThreadedHttpServer):
         in-process — the re-tune fast path). Jobs poll this from the
         dataloader's re-optimization cadence."""
         key = "{namespace}/{name}".format(**request.match_info)
-        snapshot = self._state.get_config_snapshot(key)
+        group = _group_param(request)
+
+        def fetch():
+            snapshot = self._state.get_config_snapshot(key)
+            if snapshot is not None:
+                # Config polls run on rank 0's re-optimization cadence
+                # — more piggybacked liveness (first lease = journal).
+                self._renew(key, 0, group=group)
+            return snapshot
+
+        snapshot = await self._offload(fetch)
         if snapshot is None:
             return web.json_response({"error": "no such job"}, status=404)
-        # Config polls run on rank 0's re-optimization cadence — more
-        # piggybacked liveness.
-        self._renew(key, 0)
         return web.json_response(snapshot)
 
     async def _healthz(self, request: web.Request) -> web.Response:
         return web.json_response({"ok": True})
+
+    async def _status(self, request: web.Request) -> web.Response:
+        """Operator-facing cluster view: per-job phase + degraded flag
+        + allocation epoch/state + lease ages, slot strikes and
+        quarantine, and durable-state recovery info — what
+        ``adaptdl-tpu status`` renders so an operator can see WHY an
+        allocation was withdrawn or rolled back."""
+        payload = self._state.status_snapshot()
+        for job in payload["jobs"].values():
+            # Remaining seconds -> age since last renewal (operators
+            # reason about "how long since this rank last spoke").
+            job["leaseAgeS"] = {
+                rank: round(max(self._lease_ttl - remaining, 0.0), 3)
+                for rank, remaining in job.pop(
+                    "leaseRemainingS"
+                ).items()
+            }
+        health = self._state.slot_health()
+        payload["slotStrikes"] = health["strikes"]
+        payload["quarantinedSlots"] = {
+            slot: round(remaining, 3)
+            for slot, remaining in health["quarantined"].items()
+        }
+        payload["rollbacks"] = health["rollbacks"]
+        payload["recovery"] = self._state.recovery_info()
+        return web.json_response(payload)
 
     async def _metrics(self, request: web.Request) -> web.Response:
         """Prometheus text exposition (reference exports job counters
@@ -238,6 +335,50 @@ class Supervisor(ThreadedHttpServer):
                     f"adaptdl_job_batch_size{{{label}}} "
                     f"{hints['initBatchSize']}"
                 )
+            lines.append(
+                f"adaptdl_alloc_epoch{{{label}}} {record.alloc_epoch}"
+            )
+            lines.append(
+                f"adaptdl_alloc_pending{{{label}}} "
+                f"{int(record.alloc_state == 'pending')}"
+            )
+        # Transactional-rescale + durable-state observability: the
+        # rollback/quarantine gauges the chaos acceptance checks read.
+        health = self._state.slot_health()
+        lines.append("# TYPE adaptdl_alloc_rollbacks_total counter")
+        for key, count in sorted(health["rollbacks"].items()):
+            lines.append(
+                f'adaptdl_alloc_rollbacks_total{{job="{key}"}} {count}'
+            )
+        lines.append("# TYPE adaptdl_slot_strikes gauge")
+        for slot, count in sorted(health["strikes"].items()):
+            lines.append(
+                f'adaptdl_slot_strikes{{slot="{slot}"}} {count}'
+            )
+        lines.append("# TYPE adaptdl_slot_quarantined gauge")
+        for slot in sorted(health["quarantined"]):
+            lines.append(
+                f'adaptdl_slot_quarantined{{slot="{slot}"}} 1'
+            )
+        recovery = self._state.recovery_info()
+        lines.append("# TYPE adaptdl_supervisor_recoveries_total counter")
+        lines.append(
+            f"adaptdl_supervisor_recoveries_total "
+            f"{recovery['recoveries']}"
+        )
+        if recovery["lastRecoveryS"] is not None:
+            lines.append(
+                "# TYPE adaptdl_supervisor_recovery_seconds gauge"
+            )
+            lines.append(
+                f"adaptdl_supervisor_recovery_seconds "
+                f"{recovery['lastRecoveryS']:.4f}"
+            )
+        lines.append("# TYPE adaptdl_journal_torn_records_total counter")
+        lines.append(
+            f"adaptdl_journal_torn_records_total "
+            f"{recovery['tornRecords']}"
+        )
         return web.Response(
             text="\n".join(lines) + "\n",
             content_type="text/plain",
@@ -246,17 +387,26 @@ class Supervisor(ThreadedHttpServer):
     # -- lifecycle ----------------------------------------------------
 
     async def _lease_sweeper(self, app: web.Application) -> None:
-        """Expire stale worker leases on a fixed cadence (skipped
-        entirely when the TTL is 0 — lease enforcement disabled)."""
-        if self._lease_ttl <= 0:
+        """Expire stale worker leases AND overdue allocation epochs on
+        a fixed cadence. Skipped entirely only when both clocks are
+        disabled (lease TTL 0 and commit timeout 0)."""
+        commit_timeout = getattr(
+            self._state, "alloc_commit_timeout", 0.0
+        )
+        if self._lease_ttl <= 0 and commit_timeout <= 0:
             return
         try:
             while True:
                 await asyncio.sleep(self._sweep_interval)
                 try:
-                    expired = self._state.expire_stale_leases()
+                    expired = (
+                        self._state.expire_stale_leases()
+                        if self._lease_ttl > 0
+                        else []
+                    )
+                    rolled = self._state.expire_overdue_allocations()
                 except Exception:  # noqa: BLE001 - sweeper must survive
-                    LOG.exception("lease sweep failed")
+                    LOG.exception("lease/epoch sweep failed")
                     continue
                 for key, rank in expired:
                     LOG.warning(
@@ -264,6 +414,13 @@ class Supervisor(ThreadedHttpServer):
                         "degraded, allocation withdrawn for "
                         "re-placement",
                         key, rank,
+                    )
+                for key in rolled:
+                    LOG.warning(
+                        "allocation epoch for %s missed its commit "
+                        "deadline: rolled back to the last-committed "
+                        "allocation, failing slots struck",
+                        key,
                     )
         except asyncio.CancelledError:
             pass
@@ -301,6 +458,7 @@ class Supervisor(ThreadedHttpServer):
                 web.get("/hints/{namespace}/{name}", self._get_hints),
                 web.get("/config/{namespace}/{name}", self._get_config),
                 web.get("/healthz", self._healthz),
+                web.get("/status", self._status),
                 web.get("/metrics", self._metrics),
             ]
         )

@@ -114,6 +114,12 @@ def fetch_job_config(job_id: str | None = None) -> dict | None:
         response = rpc.default_client().get(
             f"{url}/config/{job_id}",
             endpoint=f"config/{job_id}",
+            # The restart group rides along so the supervisor's
+            # piggybacked lease renewal can reject polls from a
+            # superseded incarnation (they must not keep its leases
+            # alive or count toward a successor epoch's commit
+            # quorum).
+            params={"group": env.num_restarts()},
             timeout=(0.5, 2),
             attempts=1,
             circuit_threshold=1,
@@ -145,6 +151,8 @@ def post_sched_hints(
             f"{url}/hints/{job_id}",
             endpoint=f"hints/{job_id}",
             json=hints,
+            # Same stale-incarnation guard as heartbeats/config polls.
+            params={"group": env.num_restarts()},
             timeout=(2, 10),
             attempts=2,
             deadline=30.0,
@@ -157,20 +165,28 @@ def post_sched_hints(
 
 
 def send_heartbeat(
-    rank: int | None = None, job_id: str | None = None
+    rank: int | None = None,
+    job_id: str | None = None,
+    group: int | None = None,
 ) -> bool:
     """PUT a liveness heartbeat for this worker's lease; False on any
     failure (best-effort — a missed beat only matters if a lease TTL
-    worth of them are missed in a row)."""
+    worth of them are missed in a row). The restart group rides along
+    so the supervisor can tell a doomed incarnation's dying beats from
+    its successor's — and so single-process jobs, which never
+    register, can still prove a pending allocation epoch alive
+    (transactional rescale's commit quorum)."""
     url = env.supervisor_url()
     job_id = job_id if job_id is not None else env.job_id()
     if not url or not job_id:
         return False
     rank = env.process_rank() if rank is None else rank
+    group = env.num_restarts() if group is None else group
     try:
         response = rpc.default_client().put(
             f"{url}/heartbeat/{job_id}/{rank}",
             endpoint=f"heartbeat/{job_id}",
+            params={"group": group},
             timeout=(0.5, 2),
             attempts=1,
             circuit_threshold=3,

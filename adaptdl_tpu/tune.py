@@ -47,7 +47,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
-from adaptdl_tpu import env
+from adaptdl_tpu import _signal, env
 
 LOG = logging.getLogger(__name__)
 
@@ -67,15 +67,44 @@ def get_trial_config() -> dict[str, Any]:
     return json.loads(raw) if raw else {}
 
 
+def _gate_path(result_file: str) -> str:
+    """The scheduler-owned rung gate beside a trial's result file: it
+    holds the number of results the trial may post before PAUSING for
+    a promotion (the reference trial scheduler's PAUSE-at-rung,
+    adaptdl_trial_sched.py). Absent = ungated (plain runs)."""
+    return result_file + ".gate"
+
+
 def report(**metrics: float) -> None:
     """Stream one result row to the trial scheduler (appends a JSON
     line; restarts simply keep appending, so results survive
-    rescales)."""
+    rescales). Under a :class:`TrialScheduler`, a trial that has
+    filled its current rung then WAITS here until the scheduler
+    promotes it (or stops it — SIGTERM raises the graceful-exit flag
+    and the wait returns so the normal checkpoint-and-exit path
+    runs). The pause is what makes early stopping a guarantee rather
+    than a race: a hopeless trial cannot sprint through its rungs
+    faster than the scheduler can judge them."""
     path = env.trial_result_file()
     if not path:
         return
     with open(path, "a") as f:
         f.write(json.dumps(metrics) + "\n")
+    # Count our rows AFTER the append (restarts resume the count).
+    with open(path) as f:
+        reported = sum(1 for line in f if line.strip())
+    gate = _gate_path(path)
+    while not _signal.get_exit_flag():
+        try:
+            with open(gate) as f:
+                allowed = int(f.read().strip() or 0)
+        except FileNotFoundError:
+            return  # no scheduler gate: never block
+        except ValueError:
+            allowed = 0  # torn write: re-read next cycle
+        if allowed <= 0 or reported < allowed:
+            return
+        time.sleep(0.05)
 
 
 # ---- driver side ----------------------------------------------------
@@ -163,6 +192,12 @@ class TrialScheduler:
                 checkpoint_root, f"{trial_id}.results.jsonl"
             )
             open(result_file, "w").close()
+            # Arm the rung gate: the trial runs freely to the first
+            # rung, then PAUSES in tune.report until a halving
+            # decision promotes (or stops) it — early stopping by
+            # construction, not by the monitor thread winning a race.
+            with open(_gate_path(result_file), "w") as f:
+                f.write(str(self.grace_results))
             self.trials[f"tune/{trial_id}"] = Trial(
                 trial_id, config, result_file
             )
@@ -212,21 +247,58 @@ class TrialScheduler:
                 elif record.status == "Succeeded":
                     trial.status = "DONE"
 
+    def _promote(self, trial: Trial, allowed: int | None) -> None:
+        """Let a surviving trial run past its rung gate: ``allowed``
+        result rows before the next pause (None = remove the gate
+        entirely — no peer is left to judge it against)."""
+        gate = _gate_path(trial.result_file)
+        try:
+            if allowed is None:
+                os.remove(gate)
+            else:
+                with open(gate, "w") as f:
+                    f.write(str(allowed))
+        except OSError:  # pragma: no cover - gate is advisory
+            pass
+
     def _maybe_halve(self) -> None:
-        """Successive halving: once every live trial has posted the
-        rung's worth of results, stop the worst trials (reference
-        decision point: adaptdl_trial_sched.py PAUSE/STOP on result)."""
+        """Successive halving at rung barriers (reference decision
+        point: adaptdl_trial_sched.py PAUSE/STOP on result). Trials
+        PAUSE in :func:`report` when they fill their current rung, so
+        a hopeless trial can never sprint to completion before the
+        monitor looks — early stopping is a guarantee, not a race
+        against scheduler-thread starvation. Once every RUNNING trial
+        has reached the rung, the worst are stopped and the survivors
+        promoted to the next rung. Trials that already FINISHED (at a
+        rung they were promoted through) stay in the scoring pool;
+        only running trials block completeness or can be stopped."""
         live = [
             (key, t)
             for key, t in self.trials.items()
             if t.status == "RUNNING"
         ]
-        if len(live) <= 1:
+        if not live:
             return
-        scored = []
-        for key, trial in live:
+        for _, trial in live:
             if len(trial.results) < self._next_rung:
                 return  # rung not complete yet
+        done = [
+            (key, t)
+            for key, t in self.trials.items()
+            if t.status == "DONE"
+            and len(t.results) >= self._next_rung
+        ]
+        pool = live + done
+        if len(pool) <= 1:
+            # Every other trial is terminal below this rung (failed,
+            # stopped, or finished short): nobody is left to judge
+            # the survivor against — ungate it so it can't deadlock
+            # at a barrier no decision will ever open.
+            for _, trial in live:
+                self._promote(trial, None)
+            return
+        scored = []
+        for key, trial in pool:
             scored.append((trial.last(self.metric), key))
         if any(score is None for score, _ in scored):
             return
@@ -234,6 +306,8 @@ class TrialScheduler:
         scored.sort(key=lambda kv: kv[0], reverse=reverse)
         keep = -(-len(scored) // self.reduction_factor)  # ceil
         for score, key in scored[keep:]:
+            if self.trials[key].status != "RUNNING":
+                continue  # a finished loser cannot be stopped
             LOG.info(
                 "halving: stopping %s (%s=%s)", key, self.metric, score
             )
@@ -241,6 +315,9 @@ class TrialScheduler:
             self.stopped_trials.append(key)
             self.runner.stop_job(key)
         self._next_rung *= self.reduction_factor
+        for _, key in scored[:keep]:
+            if self.trials[key].status == "RUNNING":
+                self._promote(self.trials[key], self._next_rung)
 
     def run(self) -> Trial:
         """Run to completion; returns the best trial."""

@@ -1,0 +1,22 @@
+"""Known-bad journal discipline: annotated-but-volatile mutators and
+unannotated appenders."""
+
+
+class FakeState:
+    def __init__(self):
+        self._jobs = {}
+        self._journal = None
+
+    def _journal_append(self, op):
+        if self._journal is not None:
+            self._journal.append(op)
+
+    def create_thing(self, key):  # journaled         line 14: GC603
+        # Annotated as a durable mutator but never journals: this
+        # mutation silently evaporates in a supervisor crash.
+        self._jobs[key] = {"status": "Pending"}
+
+    def sneaky_mutation(self, key):
+        # Journals without the annotation: the mutator catalog lies.
+        self._journal_append({"op": "sneaky", "key": key})  # line 21: GC604
+        self._jobs[key]["status"] = "Running"

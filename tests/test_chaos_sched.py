@@ -1,0 +1,1197 @@
+"""Chaos suite for the durable supervisor + transactional rescale.
+
+PR 3 hardened the worker side of the RPC boundary; this suite proves
+the other side: the supervisor's cluster state survives hard kills
+(write-ahead journal + snapshot replay, `docs/robustness.md`
+"Supervisor recovery"), workers reattach through a supervisor restart
+with zero job restarts and exact loss equality against an undisturbed
+run, and allocation changes are transactional — a new allocation that
+never proves liveness rolls back to the last-committed one, striking
+and eventually quarantining the failing slots (visible on /metrics).
+
+Fixed seeds make every failure replayable (`make chaos-sched` pins
+ADAPTDL_FAULT_SEED). The subprocess end-to-end variant — a real
+supervisor process hard-killed mid-journal-write by fault injection —
+is marked ``slow``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from adaptdl_tpu import checkpoint, faults, rpc, sched_hints
+from adaptdl_tpu._compat import pick_unused_port
+from adaptdl_tpu.sched.journal import JournalCorruptError, StateJournal
+from adaptdl_tpu.sched.state import ClusterState
+from adaptdl_tpu.sched.supervisor import Supervisor
+
+pytestmark = pytest.mark.chaos
+
+SEED = 1234
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    faults.reset()
+    rpc.reset_default_client()
+    yield
+    faults.reset()
+    rpc.reset_default_client()
+
+
+def _state(tmp_path, **kwargs):
+    kwargs.setdefault("alloc_commit_timeout", 0.3)
+    kwargs.setdefault("slot_strike_limit", 2)
+    kwargs.setdefault("slot_quarantine_s", 60.0)
+    kwargs.setdefault("reconcile_window", 0.5)
+    return ClusterState(state_dir=str(tmp_path / "sched"), **kwargs)
+
+
+# ---- journal + recovery ----------------------------------------------
+
+
+def test_recovery_restores_jobs_allocations_leases_retunes(tmp_path):
+    state = _state(tmp_path)
+    state.create_job("ns/a", spec={"max_replicas": 8})
+    state.update(
+        "ns/a",
+        allocation=["slice-0"] * 2,
+        topology={"seqShards": 2},
+        status="Running",
+        hints={"initBatchSize": 64},
+    )
+    state.renew_lease("ns/a", 0, 30.0, group=0)  # commits the epoch
+    assert state.publish_retune(
+        "ns/a", {"atomicBsz": 32, "accumSteps": 1}
+    )
+    state.create_job("ns/b")
+    state.update("ns/b", status="Succeeded")
+
+    recovered = _state(tmp_path)
+    a = recovered.get_job("ns/a")
+    assert a.allocation == ["slice-0"] * 2
+    assert a.topology == {"seqShards": 2}
+    assert a.status == "Running"
+    assert a.hints == {"initBatchSize": 64}
+    assert a.batch_config == {"atomicBsz": 32, "accumSteps": 1}
+    assert a.retunes == 1
+    assert a.alloc_state == "committed"
+    assert a.committed_allocation == ["slice-0"] * 2
+    assert sorted(a.leases) == [0], "lease-holding ranks recovered"
+    assert recovered.get_job("ns/b").status == "Succeeded"
+    metrics = recovered.lifecycle_metrics()
+    assert metrics["submitted_total"] == 2
+    assert metrics["completions"]["Succeeded"][0] == 1
+    info = recovered.recovery_info()
+    assert info["recoveries"] == 1
+    assert info["tornRecords"] == 0
+
+
+def test_snapshot_rotation_bounds_journal_and_recovers(tmp_path):
+    state = _state(tmp_path, snapshot_every=10)
+    state.create_job("ns/a")
+    for i in range(40):
+        state.update("ns/a", hints={"initBatchSize": i})
+    snap = tmp_path / "sched" / "snapshot.json"
+    journal = tmp_path / "sched" / "journal.jsonl"
+    assert snap.is_file(), "snapshot rotated in"
+    lines = journal.read_text().splitlines()
+    assert len(lines) <= 10, "journal truncated at rotation"
+
+    recovered = _state(tmp_path, snapshot_every=10)
+    assert recovered.get_job("ns/a").hints == {"initBatchSize": 39}
+    assert recovered.lifecycle_metrics()["submitted_total"] == 1
+
+
+def test_torn_journal_tail_recovers_acknowledged_prefix(tmp_path):
+    state = _state(tmp_path)
+    state.create_job("ns/a")
+    state.update("ns/a", allocation=["s0"], status="Running")
+    state.update("ns/a", hints={"initBatchSize": 8})
+    journal = tmp_path / "sched" / "journal.jsonl"
+    with open(journal, "a", encoding="utf-8") as f:
+        f.write('{"op": "update", "key": "ns/a", "fi')  # torn write
+
+    recovered = _state(tmp_path)
+    record = recovered.get_job("ns/a")
+    assert record.allocation == ["s0"]
+    assert record.hints == {"initBatchSize": 8}
+    assert recovered.recovery_info()["tornRecords"] == 1
+
+
+def test_appends_after_torn_recovery_survive_next_recovery(tmp_path):
+    """Recovery must truncate the torn tail before re-appending:
+    otherwise the next record concatenates onto the partial line and
+    the SECOND recovery silently drops every acknowledged mutation
+    after it."""
+    state = _state(tmp_path)
+    state.create_job("ns/a")
+    journal = tmp_path / "sched" / "journal.jsonl"
+    with open(journal, "a", encoding="utf-8") as f:
+        f.write('{"op": "update", "key": "ns/a", "fi')  # torn write
+
+    middle = _state(tmp_path)  # recovery 1: drops the torn tail...
+    middle.create_job("ns/b")  # ...then acknowledges a NEW mutation
+    assert middle.recovery_info()["tornRecords"] == 1
+
+    final = _state(tmp_path)  # recovery 2 must still see ns/b
+    assert final.get_job("ns/b") is not None, (
+        "an acknowledged post-recovery mutation was lost to tail "
+        "concatenation"
+    )
+    assert final.recovery_info()["tornRecords"] == 0
+
+
+def test_crash_between_snapshot_and_truncation_replays_nothing_twice(
+    tmp_path,
+):
+    """The (new snapshot + full old journal) crash layout: every
+    journal record the snapshot already covers must be skipped by
+    seq — double-applying an alloc_rollback would double-strike (and
+    early-quarantine) healthy slots."""
+    state = _state(tmp_path, snapshot_every=1000)
+    state.create_job("ns/a")
+    state.update("ns/a", allocation=["good"], status="Running")
+    state.renew_lease("ns/a", 0, 30.0, group=0)
+    state.update("ns/a", allocation=["bad"])
+    state.expire_overdue_allocations(now=time.monotonic() + 1.0)
+    assert state.slot_health()["strikes"] == {"bad": 1}
+    journal_path = tmp_path / "sched" / "journal.jsonl"
+    pre_rotation = journal_path.read_bytes()
+    # Trigger a rotation, then reconstruct the crash-between layout:
+    # the new snapshot is in place but the journal was never
+    # truncated.
+    state._journal._snapshot_every = 1
+    state.update("ns/a", hints={"initBatchSize": 1})
+    post_rotation = journal_path.read_bytes()
+    journal_path.write_bytes(pre_rotation + post_rotation)
+
+    recovered = _state(tmp_path, snapshot_every=1000)
+    health = recovered.slot_health()
+    assert health["strikes"] == {"bad": 1}, (
+        f"snapshot-covered records were double-applied: {health}"
+    )
+    assert health["rollbacks"] == {"ns/a": 1}
+    assert recovered.lifecycle_metrics()["submitted_total"] == 1
+    assert recovered.get_job("ns/a").hints == {"initBatchSize": 1}
+
+
+def test_group_bump_resets_commit_quorum(tmp_path):
+    """A job rescaled from multi-process to single-process: the stale
+    4-rank quorum must not outlive the incarnation that declared it,
+    or the single-process successor's epochs never commit and healthy
+    slots get struck out."""
+    state = _state(tmp_path)
+    state.create_job("ns/a")
+    state.update("ns/a", allocation=["s0"] * 4, status="Running")
+    state.register_worker("ns/a", 0, 0, "10.0.0.1", processes=2)
+    state.register_worker("ns/a", 0, 1, "10.0.0.2", processes=2)
+    state.renew_lease("ns/a", 0, 30.0)
+    state.renew_lease("ns/a", 1, 30.0)
+    assert state.get_job("ns/a").alloc_state == "committed"
+    # Rescale down to a single-process shape; the successor only
+    # heartbeats (single-process jobs never register).
+    state.update("ns/a", allocation=["s1"])
+    assert state.get_job("ns/a").alloc_state == "pending"
+    state.renew_lease("ns/a", 0, 30.0, group=1)
+    record = state.get_job("ns/a")
+    assert record.expected_processes == 1
+    assert record.alloc_state == "committed", (
+        "single-process successor could not reach the stale quorum"
+    )
+
+
+def test_corrupt_snapshot_raises_loudly(tmp_path):
+    state = _state(tmp_path, snapshot_every=2)
+    state.create_job("ns/a")
+    for i in range(6):
+        state.update("ns/a", hints={"initBatchSize": i})
+    snap = tmp_path / "sched" / "snapshot.json"
+    assert snap.is_file()
+    snap.write_text("{not json")
+    with pytest.raises(JournalCorruptError):
+        _state(tmp_path, snapshot_every=2)
+
+
+def test_journal_fault_point_blocks_mutation(tmp_path):
+    """WAL ordering under an injected journal failure: the mutation
+    that could not be journaled must not apply in memory either."""
+    state = _state(tmp_path)
+    state.create_job("ns/a")
+    faults.configure("sched.journal_write=fail@1", seed=SEED)
+    with pytest.raises(faults.InjectedFault):
+        state.update("ns/a", status="Running")
+    faults.configure(None)
+    assert state.get_job("ns/a").status == "Pending"
+    recovered = _state(tmp_path)
+    assert recovered.get_job("ns/a").status == "Pending"
+
+
+def test_reconciliation_window_blocks_expiry_until_reattach(tmp_path):
+    state = _state(tmp_path, reconcile_window=0.4)
+    state.create_job("ns/a")
+    state.update("ns/a", allocation=["s0"], status="Running")
+    state.renew_lease("ns/a", 0, 0.05)
+    time.sleep(0.1)  # the lease is stale on disk
+
+    recovered = _state(tmp_path, reconcile_window=0.4)
+    # Inside the window nothing may be expired, even though the
+    # recovered lease's original deadline has long passed.
+    assert recovered.expire_stale_leases() == []
+    assert recovered.get_job("ns/a").allocation == ["s0"]
+    # The worker reattaches (idempotent re-register / heartbeat)...
+    assert recovered.renew_lease("ns/a", 0, 30.0)
+    time.sleep(0.45)
+    # ...and survives past the window; an unattached rank would not.
+    assert recovered.expire_stale_leases() == []
+    assert not recovered.get_job("ns/a").degraded
+
+
+def test_unrenewed_recovered_lease_expires_after_grace(tmp_path):
+    state = _state(tmp_path, reconcile_window=0.2)
+    state.create_job("ns/a")
+    state.update("ns/a", allocation=["s0"], status="Running")
+    state.renew_lease("ns/a", 0, 30.0)
+
+    recovered = _state(tmp_path, reconcile_window=0.2)
+    deadline = time.time() + 5
+    expired = []
+    while time.time() < deadline and not expired:
+        expired = recovered.expire_stale_leases()
+        time.sleep(0.05)
+    assert expired == [("ns/a", 0)], (
+        "a recovered rank that never reattached expires once the "
+        "reconciliation grace lapses"
+    )
+    assert recovered.get_job("ns/a").degraded
+
+
+# ---- transactional rescale -------------------------------------------
+
+
+def test_first_allocation_commits_on_first_liveness(tmp_path):
+    state = _state(tmp_path)
+    state.create_job("ns/a")
+    state.update("ns/a", allocation=["s0"] * 2, status="Running")
+    record = state.get_job("ns/a")
+    assert record.alloc_state == "pending"
+    assert record.committed_allocation == []
+    # Nothing was alive at prepare: the first incarnation's own
+    # liveness commits (no group bump required).
+    state.renew_lease("ns/a", 0, 30.0, group=0)
+    record = state.get_job("ns/a")
+    assert record.alloc_state == "committed"
+    assert record.committed_allocation == ["s0"] * 2
+
+
+def test_rescale_commit_requires_successor_group(tmp_path):
+    state = _state(tmp_path)
+    state.create_job("ns/a")
+    state.update("ns/a", allocation=["s0"], status="Running")
+    state.renew_lease("ns/a", 0, 30.0, group=0)  # commit epoch 1
+    state.update("ns/a", allocation=["s0", "s0"])
+    assert state.get_job("ns/a").alloc_state == "pending"
+    # The doomed incarnation's dying heartbeats must NOT commit the
+    # allocation that replaces it.
+    state.renew_lease("ns/a", 0, 30.0, group=0)
+    assert state.get_job("ns/a").alloc_state == "pending"
+    # Its successor's liveness does.
+    state.renew_lease("ns/a", 0, 30.0, group=1)
+    record = state.get_job("ns/a")
+    assert record.alloc_state == "committed"
+    assert record.committed_allocation == ["s0", "s0"]
+    assert record.group == 1
+
+
+def test_multiprocess_commit_waits_for_full_quorum(tmp_path):
+    state = _state(tmp_path)
+    state.create_job("ns/a")
+    state.update("ns/a", allocation=["s0"] * 4, status="Running")
+    # Rank 0 of a 2-process group registers: half the quorum.
+    state.register_worker("ns/a", 0, 0, "10.0.0.1", processes=2)
+    state.renew_lease("ns/a", 0, 30.0)
+    assert state.get_job("ns/a").alloc_state == "pending"
+    state.register_worker("ns/a", 0, 1, "10.0.0.2", processes=2)
+    state.renew_lease("ns/a", 1, 30.0)
+    assert state.get_job("ns/a").alloc_state == "committed"
+
+
+def test_commit_timeout_rolls_back_and_quarantines(tmp_path):
+    """THE rollback scenario: a crash-looping new allocation (its
+    workers never prove liveness) rolls back to the last-committed
+    allocation — including the matching topology/batch config, never
+    a mixed pair — and consecutive strikes quarantine the slot."""
+    state = _state(tmp_path)  # strike limit 2
+    state.create_job("ns/a")
+    state.update(
+        "ns/a",
+        allocation=["good"] * 2,
+        topology={"seqShards": 2},
+        batch_config={"atomicBsz": 16, "accumSteps": 1},
+        status="Running",
+    )
+    state.renew_lease("ns/a", 0, 30.0, group=0)  # commit
+    for attempt in range(2):
+        state.update(
+            "ns/a",
+            allocation=["bad"] * 2,
+            topology={"seqShards": 1},
+            batch_config={"atomicBsz": 64, "accumSteps": 1},
+        )
+        assert state.get_job("ns/a").alloc_state == "pending"
+        # Nobody from the new allocation ever shows up.
+        rolled = state.expire_overdue_allocations(
+            now=time.monotonic() + 1.0
+        )
+        assert rolled == ["ns/a"]
+        record = state.get_job("ns/a")
+        assert record.allocation == ["good"] * 2
+        assert record.topology == {"seqShards": 2}
+        assert record.batch_config == {
+            "atomicBsz": 16, "accumSteps": 1,
+        }, "batch config rolled back WITH the allocation"
+        assert record.alloc_state == "committed"
+    health = state.slot_health()
+    assert health["rollbacks"]["ns/a"] == 2
+    assert state.quarantined_slots() == ["bad"]
+    assert "good" not in health["strikes"], (
+        "slots of the committed allocation are never struck"
+    )
+    # Rollback + quarantine survive a supervisor crash too.
+    recovered = _state(tmp_path)
+    assert recovered.get_job("ns/a").allocation == ["good"] * 2
+    assert recovered.quarantined_slots() == ["bad"]
+
+
+def test_commit_suppressed_by_injected_fault_forces_rollback(tmp_path):
+    """The alloc.commit_timeout injection point: healthy workers, but
+    the commit signal is suppressed — the epoch must time out and roll
+    back exactly like a crash-looping allocation."""
+    state = _state(tmp_path)
+    state.create_job("ns/a")
+    faults.configure("alloc.commit_timeout=fail", seed=SEED)
+    state.update("ns/a", allocation=["s0"], status="Running")
+    state.renew_lease("ns/a", 0, 30.0, group=0)
+    assert state.get_job("ns/a").alloc_state == "pending", (
+        "commit suppressed by the fault schedule"
+    )
+    assert faults.hit_count("alloc.commit_timeout") >= 1
+    rolled = state.expire_overdue_allocations(
+        now=time.monotonic() + 1.0
+    )
+    assert rolled == ["ns/a"]
+    assert state.get_job("ns/a").allocation == [], (
+        "no committed allocation existed: rollback is to empty"
+    )
+    faults.configure(None)
+
+
+def test_commit_quorum_reachable_with_lease_enforcement_disabled(
+    tmp_path,
+):
+    """ADAPTDL_LEASE_TTL=0 (lease enforcement off) must not leave
+    allocation epochs uncommittable: a heartbeat with ttl 0 plants no
+    lease but still counts as commit-quorum liveness — otherwise
+    every epoch would time out, roll back, and quarantine healthy
+    slots forever."""
+    state = _state(tmp_path)
+    state.create_job("ns/a")
+    state.update("ns/a", allocation=["s0"] * 2, status="Running")
+    assert state.get_job("ns/a").alloc_state == "pending"
+    assert state.renew_lease("ns/a", 0, 0.0, group=0)
+    record = state.get_job("ns/a")
+    assert record.alloc_state == "committed"
+    assert record.leases == {}, "no instantly-stale lease planted"
+    # The group-bump path works leaseless too (a rescale commit).
+    state.update("ns/a", allocation=["s1"] * 2)
+    state.renew_lease("ns/a", 0, 0.0, group=0)  # doomed incarnation
+    assert state.get_job("ns/a").alloc_state == "pending"
+    state.renew_lease("ns/a", 0, 0.0, group=1)  # its successor
+    assert state.get_job("ns/a").alloc_state == "committed"
+
+
+def test_quarantine_survives_snapshot_rotation(tmp_path):
+    """The quarantine table must round-trip through snapshot.json:
+    once the journal is truncated at rotation, the alloc_rollback ops
+    that created the quarantine are gone — the snapshot is the only
+    record left."""
+    state = _state(tmp_path, snapshot_every=4)
+    state.create_job("ns/a")
+    state.update("ns/a", allocation=["good"], status="Running")
+    state.renew_lease("ns/a", 0, 30.0, group=0)
+    for _ in range(2):  # strike limit 2 -> quarantined
+        state.update("ns/a", allocation=["bad"])
+        state.expire_overdue_allocations(now=time.monotonic() + 1.0)
+    assert state.quarantined_slots() == ["bad"]
+    # Force rotations past the rollback ops.
+    for i in range(10):
+        state.update("ns/a", hints={"initBatchSize": i})
+    snapshot = json.load(open(tmp_path / "sched" / "snapshot.json"))
+    assert snapshot["quarantined"] == ["bad"]
+
+    recovered = _state(tmp_path, snapshot_every=4)
+    assert recovered.quarantined_slots() == ["bad"], (
+        "quarantine lost across recovery: the allocator would "
+        "re-place jobs on the known-bad slot"
+    )
+
+
+def test_crash_looping_supervisor_journal_stays_bounded(tmp_path):
+    """A supervisor that crashes every few mutations (fewer than
+    snapshot_every per incarnation) must still rotate: the recovered
+    journal length counts toward the threshold, or replay time grows
+    without bound across restarts."""
+    for generation in range(15):
+        state = _state(tmp_path, snapshot_every=8)
+        if state.get_job("ns/a") is None:
+            state.create_job("ns/a")
+        state.update(
+            "ns/a", hints={"initBatchSize": generation}
+        )  # a couple of mutations, then "crash"
+        del state
+    journal = tmp_path / "sched" / "journal.jsonl"
+    lines = journal.read_text().splitlines()
+    assert len(lines) <= 8, (
+        f"journal grew to {len(lines)} records across crash-loop "
+        "restarts — rotation never fired"
+    )
+    assert (tmp_path / "sched" / "snapshot.json").is_file()
+    recovered = _state(tmp_path, snapshot_every=8)
+    assert recovered.get_job("ns/a").hints == {"initBatchSize": 14}
+
+
+def test_topology_only_rescale_opens_epoch_and_rolls_back(tmp_path):
+    """A topology change on the SAME slot list restarts workers just
+    like a device-set change (the runners compare normalized
+    topologies), so it needs the same commit/rollback protection —
+    and a rollback must restore the last PROVEN topology."""
+    state = _state(tmp_path)
+    state.create_job("ns/a")
+    state.update(
+        "ns/a",
+        allocation=["s0"] * 4,
+        topology={"seqShards": 1},
+        status="Running",
+    )
+    state.renew_lease("ns/a", 0, 30.0, group=0)  # commit T1
+    # Same chips, new factorization: must open an epoch.
+    state.update(
+        "ns/a", allocation=["s0"] * 4, topology={"seqShards": 4}
+    )
+    record = state.get_job("ns/a")
+    assert record.alloc_state == "pending"
+    assert record.topology == {"seqShards": 4}
+    # The new mesh never comes up: rollback restores T1 with the
+    # same allocation.
+    rolled = state.expire_overdue_allocations(
+        now=time.monotonic() + 1.0
+    )
+    assert rolled == ["ns/a"]
+    record = state.get_job("ns/a")
+    assert record.allocation == ["s0"] * 4
+    assert record.topology == {"seqShards": 1}
+    assert record.alloc_state == "committed"
+
+
+def test_multi_runner_drops_recovered_jobs_not_in_job_list(tmp_path):
+    """A recovered job absent from the rerun's job list has no
+    supervising thread: it must be pruned, not left competing for
+    chips forever."""
+    from adaptdl_tpu.sched.multi_runner import JobSpec, MultiJobRunner
+
+    state_dir = str(tmp_path / "sched")
+    spec_a = JobSpec(
+        name="m/a", script="a.py", checkpoint_dir=str(tmp_path)
+    )
+    spec_b = JobSpec(
+        name="m/b", script="b.py", checkpoint_dir=str(tmp_path)
+    )
+    first = MultiJobRunner(
+        [spec_a, spec_b], num_chips=2, state_dir=state_dir
+    )
+    first.state.update("m/a", status="Running", restarts=3)
+    del first  # controller "crashes"
+
+    second = MultiJobRunner(
+        [spec_b], num_chips=2, state_dir=state_dir
+    )
+    assert second.state.get_job("m/a") is None, (
+        "unlisted recovered job must not linger in the allocator's "
+        "view"
+    )
+    assert second.state.get_job("m/b") is not None
+
+
+def test_unquarantine_probe_readmits_then_rebenches(tmp_path):
+    state = _state(tmp_path, slot_quarantine_s=0.2)
+    state.create_job("ns/a")
+    state.update("ns/a", allocation=["good"], status="Running")
+    state.renew_lease("ns/a", 0, 30.0, group=0)
+    for _ in range(2):  # strike limit 2 -> quarantined
+        state.update("ns/a", allocation=["bad"])
+        state.expire_overdue_allocations(now=time.monotonic() + 1.0)
+    assert state.quarantined_slots() == ["bad"]
+    time.sleep(0.25)
+    assert state.quarantined_slots() == [], "probe window open"
+    assert state.slot_health()["strikes"]["bad"] == 1, (
+        "strikes primed one below the limit"
+    )
+    # One more failed epoch re-benches immediately.
+    state.update("ns/a", allocation=["bad"])
+    state.expire_overdue_allocations(now=time.monotonic() + 1.0)
+    assert state.quarantined_slots() == ["bad"]
+
+
+def test_allocator_excludes_quarantined_slots(tmp_path):
+    from adaptdl_tpu.sched.allocator import Allocator
+    from adaptdl_tpu.sched.policy import NodeInfo, PolluxPolicy
+
+    state = _state(tmp_path)
+    state.create_job("ns/a", spec={"min_replicas": 1, "max_replicas": 2})
+    state.update("ns/a", status="Running")
+    state.renew_lease("ns/a", 0, 30.0, group=0)
+    # Strike slice-1 out.
+    state.update("ns/a", allocation=["slice-1"])
+    state.expire_overdue_allocations(now=time.monotonic() + 1.0)
+    state.update("ns/a", allocation=["slice-1"])
+    state.expire_overdue_allocations(now=time.monotonic() + 1.0)
+    assert state.quarantined_slots() == ["slice-1"]
+    allocator = Allocator(
+        state,
+        {
+            "slice-0": NodeInfo(resources={"tpu": 4}),
+            "slice-1": NodeInfo(resources={"tpu": 4}),
+        },
+        policy=PolluxPolicy(pop_size=16, generations=10),
+    )
+    for _ in range(3):
+        allocations = allocator.optimize_once()
+        placed = set(allocations.get("ns/a", []))
+        assert "slice-1" not in placed, (
+            "the allocator kept re-placing onto the poisoned slot"
+        )
+
+
+# ---- supervisor restart: reattach + loss equality --------------------
+
+
+def test_supervisor_restart_workers_reattach_without_group_bump(
+    tmp_path,
+):
+    """Hard-kill the supervisor (in-memory state discarded, WAL only)
+    between registrations: the restarted supervisor recovers the job
+    and the worker's idempotent re-registration lands in the SAME
+    restart group — no job restart is ever requested."""
+    port = pick_unused_port()
+    state_dir = str(tmp_path / "sched")
+
+    def boot():
+        st = ClusterState(
+            state_dir=state_dir,
+            alloc_commit_timeout=30.0,
+            reconcile_window=1.0,
+        )
+        if st.get_job("c/sup") is None:
+            st.create_job("c/sup", spec={})
+            st.update(
+                "c/sup", allocation=["local"] * 2, status="Running"
+            )
+        sup = Supervisor(
+            st, port=port, lease_ttl=5.0, sweep_interval=0.2
+        )
+        sup.start()
+        return st, sup
+
+    state, supervisor = boot()
+    url = f"http://127.0.0.1:{port}"
+    client = rpc.default_client()
+    client.put(
+        f"{url}/register/c/sup/0/0",
+        json={"address": "10.0.0.1", "processes": 2},
+    ).raise_for_status()
+    client.put(
+        f"{url}/register/c/sup/0/1",
+        json={"address": "10.0.0.2", "processes": 2},
+    ).raise_for_status()
+    assert state.get_job("c/sup").alloc_state == "committed"
+
+    # Hard kill: the HTTP face dies and the in-memory table is
+    # dropped un-flushed — only the write-ahead journal survives.
+    supervisor.stop()
+    del state
+    state, supervisor = boot()
+    try:
+        record = state.get_job("c/sup")
+        assert record.allocation == ["local"] * 2
+        assert record.workers == {0: "10.0.0.1", 1: "10.0.0.2"}
+        assert record.alloc_state == "committed"
+        # Workers blindly re-register (their rpc client retried
+        # through the blackout): same group, accepted, no bump.
+        client.put(
+            f"{url}/register/c/sup/0/0",
+            json={"address": "10.0.0.1", "processes": 2},
+        ).raise_for_status()
+        got = client.get(
+            f"{url}/discover/c/sup/0", params={"replicas": 2}
+        ).json()
+        assert got == {"0": "10.0.0.1", "1": "10.0.0.2"}
+        assert state.get_job("c/sup").group == 0, "no restart group bump"
+        # The sweeper ran throughout and expired nobody.
+        time.sleep(0.5)
+        assert not state.get_job("c/sup").degraded
+        text = client.get(f"{url}/metrics").text
+        assert "adaptdl_supervisor_recoveries_total 1" in text
+        assert "adaptdl_supervisor_recovery_seconds" in text
+    finally:
+        supervisor.stop()
+
+
+class _TrainerSim:
+    """Deterministic stand-in trainer: the update depends only on
+    (weights, step), so any correct recovery reproduces the
+    undisturbed trajectory bit-for-bit."""
+
+    def __init__(self):
+        self.w = np.zeros(8, dtype=np.float64)
+        self.step = 0
+
+    def train_step(self):
+        rng = np.random.default_rng(self.step)
+        grad = rng.normal(size=self.w.shape)
+        self.w = self.w - 0.01 * grad + 0.001 * np.sin(self.w)
+        self.step += 1
+
+
+class _SimState(checkpoint.State):
+    def __init__(self, sim):
+        super().__init__("sched_chaos_sim")
+        self.sim = sim
+
+    def save(self, fileobj):
+        np.save(fileobj, self.sim.w, allow_pickle=False)
+        fileobj.write(self.sim.step.to_bytes(8, "big"))
+
+    def load(self, fileobj):
+        blob = fileobj.read()
+        import io
+
+        self.sim.w = np.load(io.BytesIO(blob[:-8]), allow_pickle=False)
+        self.sim.step = int.from_bytes(blob[-8:], "big")
+
+
+def _run_supervised_sim(
+    tmp_path, monkeypatch, tag, kill_at=None, total_steps=30
+):
+    """A worker-like training loop against a REAL supervisor over
+    HTTP: heartbeats + config polls every step; an observed
+    allocation change forces a checkpoint-restart (counted). Two
+    scripted rescales happen at steps 8 and 20; ``kill_at`` hard-kills
+    the supervisor between them and restarts it from the journal."""
+    job = "c/equal"
+    state_dir = str(tmp_path / f"sched-{tag}")
+    ckpt_dir = tmp_path / f"ckpt-{tag}"
+    ckpt_dir.mkdir()
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(ckpt_dir))
+    port = pick_unused_port()
+    monkeypatch.setenv(
+        "ADAPTDL_SUPERVISOR_URL", f"http://127.0.0.1:{port}"
+    )
+    monkeypatch.setenv("ADAPTDL_JOB_ID", job)
+
+    def boot():
+        st = ClusterState(
+            state_dir=state_dir,
+            alloc_commit_timeout=30.0,
+            reconcile_window=1.0,
+        )
+        if st.get_job(job) is None:
+            st.create_job(job, spec={})
+            st.update(job, allocation=["local"] * 2, status="Running")
+        sup = Supervisor(
+            st, port=port, lease_ttl=10.0, sweep_interval=0.2
+        )
+        sup.start()
+        return st, sup
+
+    state, supervisor = boot()
+    checkpoint._reset_registry()
+    sim = _TrainerSim()
+    sim_state = _SimState(sim)
+    checkpoint.load_state(sim_state)
+    group = 0
+    restarts = 0
+    seen_alloc = None
+    try:
+        while sim.step < total_steps:
+            step = sim.step
+            assert sched_hints.send_heartbeat(rank=0, group=group)
+            config = sched_hints.fetch_job_config()
+            if config is not None and config["allocation"]:
+                alloc = config["allocation"]
+                if seen_alloc is None:
+                    seen_alloc = alloc
+                elif alloc != seen_alloc:
+                    # Rescale: checkpoint, die, restart, restore —
+                    # the next incarnation heartbeats a bumped group
+                    # (committing the pending epoch).
+                    checkpoint.save_all_states()
+                    checkpoint._reset_registry()
+                    sim = _TrainerSim()
+                    sim_state = _SimState(sim)
+                    checkpoint.load_state(sim_state)
+                    restarts += 1
+                    group += 1
+                    seen_alloc = alloc
+            sim.train_step()
+            if step == 8:
+                state.update(job, allocation=["local"] * 3)
+            if step == 20:
+                state.update(job, allocation=["local"] * 2)
+            if kill_at is not None and step == kill_at:
+                # Hard kill between the two rescales: in-memory state
+                # gone, WAL only; restart recovers from the journal.
+                supervisor.stop()
+                state, supervisor = boot()
+        record = state.get_job(job)
+        return sim.w.copy(), restarts, list(record.allocation)
+    finally:
+        supervisor.stop()
+        checkpoint._reset_registry()
+
+
+def test_supervisor_killed_between_rescales_loss_equality(
+    tmp_path, monkeypatch
+):
+    """Acceptance: supervisor hard-killed between two rescales and
+    restarted from the journal — every worker reattaches with zero
+    EXTRA job restarts (the two scripted rescales only), and the
+    final trained state EQUALS the undisturbed run's."""
+    w_base, restarts_base, alloc_base = _run_supervised_sim(
+        tmp_path, monkeypatch, "base", kill_at=None
+    )
+    rpc.reset_default_client()
+    w_chaos, restarts_chaos, alloc_chaos = _run_supervised_sim(
+        tmp_path, monkeypatch, "chaos", kill_at=14
+    )
+    assert restarts_base == restarts_chaos == 2, (
+        "the supervisor restart must not cost a single extra job "
+        "restart"
+    )
+    assert alloc_chaos == alloc_base == ["local"] * 2
+    np.testing.assert_array_equal(w_chaos, w_base)
+
+
+# ---- subprocess crash consistency ------------------------------------
+
+
+_MUTATION_SCRIPT = textwrap.dedent(
+    """
+    import sys
+    from adaptdl_tpu.sched.state import ClusterState
+
+    state = ClusterState(
+        state_dir=sys.argv[1], alloc_commit_timeout=0.0
+    )
+    state.create_job("c/j", spec={"max_replicas": 4})
+    for i in range(1, 30):
+        state.update(
+            "c/j",
+            allocation=["slot"] * (i % 4 + 1),
+            status="Running",
+            hints={"initBatchSize": i},
+        )
+    print("DONE")
+    """
+)
+
+
+@pytest.mark.parametrize("kill_at", [1, 2, 7, 19])
+def test_journal_write_crash_recovers_exact_prefix(tmp_path, kill_at):
+    """A supervisor process hard-killed (fault-injected os._exit) at
+    its Nth journal write: recovery yields EXACTLY the state after
+    N-1 acknowledged mutations — the op that never hit the journal
+    was never acknowledged, and nothing acknowledged is lost."""
+    state_dir = str(tmp_path / "sched")
+    script = tmp_path / "mutate.py"
+    script.write_text(_MUTATION_SCRIPT)
+    env = dict(
+        os.environ,
+        ADAPTDL_FAULT_SPEC=f"sched.journal_write=exit@{kill_at}",
+        ADAPTDL_FAULT_SEED=str(SEED),
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    proc = subprocess.run(
+        [sys.executable, str(script), state_dir],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1, "the injected exit killed the child"
+    assert "DONE" not in proc.stdout
+
+    recovered = ClusterState(
+        state_dir=state_dir, alloc_commit_timeout=0.0
+    )
+    # Replay the same script against a pure in-memory state, stopping
+    # at the acknowledged prefix (kill_at - 1 mutations).
+    expected = ClusterState(alloc_commit_timeout=0.0)
+    applied = 0
+    if applied < kill_at - 1:
+        expected.create_job("c/j", spec={"max_replicas": 4})
+        applied += 1
+    i = 1
+    while applied < kill_at - 1:
+        expected.update(
+            "c/j",
+            allocation=["slot"] * (i % 4 + 1),
+            status="Running",
+            hints={"initBatchSize": i},
+        )
+        applied += 1
+        i += 1
+    want = expected.get_job("c/j")
+    got = recovered.get_job("c/j")
+    if want is None:
+        assert got is None
+    else:
+        assert got is not None
+        assert got.allocation == want.allocation
+        assert got.status == want.status
+        assert got.hints == want.hints
+    assert (
+        recovered.lifecycle_metrics()["submitted_total"]
+        == expected.lifecycle_metrics()["submitted_total"]
+    )
+
+
+_SUPERVISOR_SCRIPT = textwrap.dedent(
+    """
+    import sys, time
+    from adaptdl_tpu.sched.state import ClusterState
+    from adaptdl_tpu.sched.supervisor import Supervisor
+
+    state_dir, port = sys.argv[1], int(sys.argv[2])
+    state = ClusterState(
+        state_dir=state_dir,
+        alloc_commit_timeout=30.0,
+        reconcile_window=1.0,
+    )
+    if state.get_job("c/e2e") is None:
+        state.create_job("c/e2e", spec={})
+        state.update(
+            "c/e2e", allocation=["local"] * 1, status="Running"
+        )
+    supervisor = Supervisor(
+        state, port=port, lease_ttl=10.0, sweep_interval=0.2
+    )
+    supervisor.start()
+    print("READY", flush=True)
+    while True:
+        time.sleep(0.5)
+    """
+)
+
+
+@pytest.mark.slow
+def test_supervisor_process_hard_killed_e2e(tmp_path):
+    """End to end with a REAL supervisor process: fault injection
+    os._exit()s it mid-journal-write while a worker registers; the
+    relaunched process recovers from the journal, the worker's
+    retried registration reattaches in the same group, and the epoch
+    commits — /status and /metrics agree."""
+    state_dir = str(tmp_path / "sched")
+    port = pick_unused_port()
+    script = tmp_path / "supervisor.py"
+    script.write_text(_SUPERVISOR_SCRIPT)
+    url = f"http://127.0.0.1:{port}"
+    base_env = dict(
+        os.environ,
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+
+    def launch(fault_spec=None):
+        env = dict(base_env)
+        if fault_spec:
+            env["ADAPTDL_FAULT_SPEC"] = fault_spec
+            env["ADAPTDL_FAULT_SEED"] = str(SEED)
+        proc = subprocess.Popen(
+            [sys.executable, str(script), state_dir, str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            text=True,
+        )
+        assert proc.stdout.readline().strip() == "READY"
+        return proc
+
+    client = rpc.default_client()
+    # Journal writes in the child: 1 create, 2 update; the worker's
+    # registration drives 3 (register) and then the epoch commit is
+    # write 4 — where the injected exit fires, killing the supervisor
+    # with the registration journaled but the commit lost.
+    proc = launch(fault_spec="sched.journal_write=exit@4")
+    try:
+        with pytest.raises(rpc.RpcError):
+            client.put(
+                f"{url}/register/c/e2e/0/0",
+                json={"address": "10.0.0.1", "processes": 1},
+                attempts=1,
+            )
+        assert proc.wait(timeout=30) == 1, "hard-killed mid-commit"
+
+        # Relaunch clean: recovery from the journal.
+        proc = launch()
+        status = client.get(f"{url}/status").json()
+        job = status["jobs"]["c/e2e"]
+        assert job["status"] == "Running"
+        assert job["replicas"] == 1
+        # The commit record never landed: the epoch is still pending.
+        assert job["allocState"] == "pending"
+        # The worker retries its registration (idempotent, same
+        # group) and the epoch commits this time.
+        client.put(
+            f"{url}/register/c/e2e/0/0",
+            json={"address": "10.0.0.1", "processes": 1},
+        ).raise_for_status()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            status = client.get(f"{url}/status").json()
+            if status["jobs"]["c/e2e"]["allocState"] == "committed":
+                break
+            time.sleep(0.2)
+        assert status["jobs"]["c/e2e"]["allocState"] == "committed"
+        assert status["jobs"]["c/e2e"]["workers"] == 1
+        assert status["recovery"]["recoveries"] == 1
+        text = client.get(f"{url}/metrics").text
+        assert "adaptdl_supervisor_recoveries_total 1" in text
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+
+
+# ---- /metrics + /status surfacing ------------------------------------
+
+
+def test_rollback_and_quarantine_visible_on_metrics_and_status(
+    tmp_path,
+):
+    """Acceptance: a crash-looping new allocation's rollback and the
+    resulting slot quarantine are visible on /metrics (and /status)
+    — the supervisor's own sweeper does the rolling back."""
+    state = ClusterState(
+        state_dir=str(tmp_path / "sched"),
+        alloc_commit_timeout=0.3,
+        slot_strike_limit=2,
+        slot_quarantine_s=60.0,
+        reconcile_window=0.0,
+    )
+    state.create_job("c/roll", spec={})
+    supervisor = Supervisor(
+        state, lease_ttl=0.0, sweep_interval=0.1
+    )
+    url = supervisor.start()
+    try:
+        state.update(
+            "c/roll", allocation=["good"], status="Running"
+        )
+        state.renew_lease("c/roll", 0, 30.0, group=0)  # commit
+        client = rpc.default_client()
+        for _ in range(2):
+            state.update("c/roll", allocation=["bad"] * 2)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if (
+                    state.get_job("c/roll").allocation == ["good"]
+                ):
+                    break
+                time.sleep(0.05)
+            assert state.get_job("c/roll").allocation == ["good"], (
+                "the sweeper rolled back to the last-committed "
+                "allocation"
+            )
+        text = client.get(f"{url}/metrics").text
+        assert 'adaptdl_alloc_rollbacks_total{job="c/roll"} 2' in text
+        assert 'adaptdl_slot_quarantined{slot="bad"} 1' in text
+        assert 'adaptdl_slot_strikes{slot="bad"} 2' in text
+        assert 'adaptdl_alloc_pending{job="c/roll"} 0' in text
+        status = client.get(f"{url}/status").json()
+        assert status["quarantinedSlots"].keys() == {"bad"}
+        assert status["rollbacks"] == {"c/roll": 2}
+        assert status["jobs"]["c/roll"]["allocState"] == "committed"
+    finally:
+        supervisor.stop()
+
+
+def test_status_endpoint_shows_degraded_and_lease_ages(tmp_path):
+    state = ClusterState(
+        state_dir=str(tmp_path / "sched"),
+        alloc_commit_timeout=0.0,
+        reconcile_window=0.0,
+    )
+    state.create_job("c/deg", spec={})
+    supervisor = Supervisor(
+        state, lease_ttl=0.4, sweep_interval=0.1
+    )
+    url = supervisor.start()
+    try:
+        state.update(
+            "c/deg", allocation=["local"] * 2, status="Running"
+        )
+        client = rpc.default_client()
+        client.put(f"{url}/heartbeat/c/deg/0").raise_for_status()
+        status = client.get(f"{url}/status").json()
+        job = status["jobs"]["c/deg"]
+        assert job["degraded"] is False
+        assert "0" in job["leaseAgeS"]
+        assert job["leaseAgeS"]["0"] < 0.4
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            status = client.get(f"{url}/status").json()
+            if status["jobs"]["c/deg"]["degraded"]:
+                break
+            time.sleep(0.05)
+        job = status["jobs"]["c/deg"]
+        assert job["degraded"] is True, "lease expiry surfaced"
+        assert job["replicas"] == 0, "allocation withdrawal surfaced"
+        assert job["leaseAgeS"] == {}, "the dead rank's lease is gone"
+    finally:
+        supervisor.stop()
+
+
+def test_stale_incarnation_piggyback_cannot_commit_successor_epoch(
+    tmp_path,
+):
+    """Hints/config traffic reports the worker's restart group, and
+    the supervisor's piggybacked lease renewal gives it the same
+    stale-incarnation guard as a heartbeat: after a PARTIAL
+    successor-group registration (rank 1 up, rank 0 crashed on
+    launch), the doomed old group's rank-0 traffic must not
+    substitute for the successor's missing rank 0 and commit an
+    allocation epoch whose actual worker is dead."""
+    state = ClusterState(
+        state_dir=str(tmp_path / "sched"),
+        alloc_commit_timeout=30.0,
+        reconcile_window=0.0,
+    )
+    state.create_job("c/stale", spec={})
+    supervisor = Supervisor(state, lease_ttl=30.0, sweep_interval=5.0)
+    url = supervisor.start()
+    try:
+        client = rpc.default_client()
+        state.update(
+            "c/stale", allocation=["s0", "s1"], status="Running"
+        )
+        for rank, addr in ((0, "10.0.0.1"), (1, "10.0.0.2")):
+            client.put(
+                f"{url}/register/c/stale/0/{rank}",
+                json={"address": addr, "processes": 2},
+            ).raise_for_status()
+        assert state.get_job("c/stale").alloc_state == "committed"
+        # Rescale while group 0 is alive: the new epoch may only be
+        # proven by the successor incarnation.
+        state.update("c/stale", allocation=["s2", "s3"])
+        assert state.get_job("c/stale").alloc_state == "pending"
+        client.put(
+            f"{url}/register/c/stale/1/1",
+            json={"address": "10.0.0.3", "processes": 2},
+        ).raise_for_status()
+        assert state.get_job("c/stale").alloc_state == "pending"
+        # Group 0's rank 0 is still draining (finishing a checkpoint,
+        # posting hints, polling config): its piggybacked renewals
+        # must not fill the successor's rank-0 quorum slot.
+        client.put(
+            f"{url}/hints/c/stale", json={}, params={"group": 0}
+        ).raise_for_status()
+        client.get(
+            f"{url}/config/c/stale", params={"group": 0}
+        ).raise_for_status()
+        record = state.get_job("c/stale")
+        assert record.alloc_state == "pending", (
+            "stale incarnation's traffic committed the epoch "
+            "replacing it"
+        )
+        assert record.group == 1
+        # The successor's own rank 0 completes the quorum.
+        client.put(
+            f"{url}/register/c/stale/1/0",
+            json={"address": "10.0.0.4", "processes": 2},
+        ).raise_for_status()
+        assert state.get_job("c/stale").alloc_state == "committed"
+    finally:
+        supervisor.stop()
+
+
+def test_quarantine_keeps_nonpreemptible_incumbents_whole(tmp_path):
+    """A quarantined slot leaves the placement inventory — but a
+    NON-preemptible job still running on it must keep its allocation
+    verbatim (the policy pins such jobs), not have the quarantined
+    replicas silently truncated away, which would shrink and restart
+    a job the policy promises never to touch."""
+    from adaptdl_tpu.sched.allocator import JobInfo, NodeInfo
+    from adaptdl_tpu.sched.policy.pollux import PolluxPolicy
+
+    def job(preemptible):
+        return JobInfo(
+            resources={"pods": 1},
+            speedup_fn=lambda n, r: np.asarray(r, dtype=float),
+            creation_timestamp=0.0,
+            min_replicas=1,
+            max_replicas=4,
+            preemptible=preemptible,
+        )
+
+    nodes = {
+        f"s{i}": NodeInfo(
+            resources={"pods": 4}, preemptible=False
+        )
+        for i in range(3)
+    }
+    template = NodeInfo(resources={"pods": 4}, preemptible=False)
+    policy = PolluxPolicy(pop_size=16, generations=10)
+    allocations, _ = policy.optimize(
+        {"ns/pinned": job(preemptible=False)},
+        nodes,
+        {"ns/pinned": ["s0", "s1"]},
+        template,
+        quarantined={"s1"},
+    )
+    # The incumbent keeps both replicas, including the one on the
+    # quarantined slot.
+    assert sorted(allocations["ns/pinned"]) == ["s0", "s1"]
+
+    # A preemptible job alongside it must not be placed on the
+    # still-quarantined slot the incumbent protects.
+    allocations, _ = policy.optimize(
+        {
+            "ns/pinned": job(preemptible=False),
+            "ns/other": job(preemptible=True),
+        },
+        nodes,
+        {"ns/pinned": ["s0", "s1"]},
+        template,
+        quarantined={"s1"},
+    )
+    assert sorted(allocations["ns/pinned"]) == ["s0", "s1"]
+    assert "s1" not in allocations.get("ns/other", [])
+
+
+def test_journal_file_is_json_lines(tmp_path):
+    """The journal format documented in docs/robustness.md: one JSON
+    object per line with an "op" key."""
+    state = _state(tmp_path)
+    state.create_job("ns/a", spec={})
+    state.update("ns/a", status="Running")
+    journal = StateJournal(str(tmp_path / "sched"))
+    snapshot, records, torn = journal.load()
+    assert snapshot is None and torn == 0
+    assert [r["op"] for r in records] == ["create_job", "update"]
+    assert records[0]["key"] == "ns/a"

@@ -191,6 +191,62 @@ def test_ls_and_hints_against_live_supervisor(capsys):
         supervisor.stop()
 
 
+def test_status_surfaces_degraded_leases_and_quarantine(capsys):
+    """The PR-3 degraded flag and lease ages (and the PR-5 epoch
+    state / quarantine) are visible to operators: `adaptdl-tpu
+    status` renders them from the supervisor's /status endpoint, so
+    the REASON an allocation was withdrawn is one command away."""
+    from adaptdl_tpu.sched.state import ClusterState
+    from adaptdl_tpu.sched.supervisor import Supervisor
+
+    state = ClusterState(alloc_commit_timeout=0.3, slot_strike_limit=1)
+    state.create_job("ns/ok", spec={"max_replicas": 4})
+    state.create_job("ns/sick", spec={"max_replicas": 4})
+    state.create_job("ns/flap", spec={"max_replicas": 4})
+    supervisor = Supervisor(state, lease_ttl=120.0)
+    url = supervisor.start()
+    try:
+        import time as _time
+
+        # ns/ok: committed allocation with a live lease.
+        state.update("ns/ok", allocation=["s0"] * 2, status="Running")
+        state.renew_lease("ns/ok", 0, 120.0, group=0)
+        # ns/sick: degraded — its lease expired, the sweeper withdrew
+        # the allocation, and nothing has re-placed it yet.
+        state.update("ns/sick", allocation=["s1"], status="Running")
+        state.renew_lease("ns/sick", 0, 0.001, group=0)
+        _time.sleep(0.01)
+        assert state.expire_stale_leases() == [("ns/sick", 0)]
+        # ns/flap: a committed allocation rescaled onto a slot whose
+        # workers never come up — rollback + quarantine (limit 1).
+        state.update("ns/flap", allocation=["good"], status="Running")
+        state.renew_lease("ns/flap", 0, 120.0, group=0)
+        state.update("ns/flap", allocation=["bad"])
+        assert state.expire_overdue_allocations(
+            now=_time.monotonic() + 1.0
+        ) == ["ns/flap"]
+        assert main(["status", "--supervisor", url]) == 0
+        out = capsys.readouterr().out
+        lines = out.splitlines()
+        assert lines[0].split() == [
+            "JOB", "PHASE", "REPLICAS", "DEGRADED", "ALLOC",
+            "RESTARTS", "LEASES",
+        ]
+        ok_row = next(l for l in lines if l.startswith("ns/ok"))
+        assert "no" in ok_row.split()
+        assert "1/committed" in ok_row
+        assert "0:0s" in ok_row, "lease age rendered per rank"
+        sick_row = next(l for l in lines if l.startswith("ns/sick"))
+        assert "yes" in sick_row.split(), "degraded flag surfaced"
+        # Slot health table: the struck-out slot and its quarantine.
+        assert any(
+            l.split()[:2] == ["bad", "1"] for l in lines if l.strip()
+        ), out
+        assert "QUARANTINED" in out
+    finally:
+        supervisor.stop()
+
+
 def test_logs_and_cp(tmp_path, capfd):
     log = tmp_path / "job.log"
     log.write_text("".join(f"line-{i}\n" for i in range(100)))

@@ -110,6 +110,17 @@ def test_fault_rpc_good():
     assert run_on("faultrpc_good.py") == []
 
 
+def test_journal_discipline_bad():
+    findings = run_on("journaled_bad.py")
+    assert rule_lines(findings, "GC603") == [14]
+    assert rule_lines(findings, "GC604") == [21]
+    assert {f.rule for f in findings} == {"GC603", "GC604"}
+
+
+def test_journal_discipline_good():
+    assert run_on("journaled_good.py") == []
+
+
 def test_fault_rpc_catalog_tracks_faults_module(tmp_path):
     """GC602 judges against the REAL faults.py catalog: a root with no
     faults module yields no (unjudgeable) findings, and a root whose
@@ -202,6 +213,35 @@ def test_package_annotations_are_present():
         guards, _ = _collect_guards(sf)
         declared = {g.field for g in guards}
         assert fields <= declared, (rel, declared)
+
+
+def test_cluster_state_mutators_stay_journaled():
+    """The durable-state contract only has teeth while the mutator
+    set stays annotated: a refactor that silently drops `# journaled`
+    from a ClusterState mutator (making part of the cluster state
+    volatile again) must fail here, not in a crash."""
+    from tools.graftcheck.core import parse_file
+    from tools.graftcheck.passes.journal_discipline import (
+        JournalDisciplinePass,
+    )
+
+    sf = parse_file(
+        os.path.join(REPO, "adaptdl_tpu", "sched", "state.py"), REPO
+    )
+    annotated = JournalDisciplinePass().journaled_methods(sf)
+    expected = {
+        "create_job",
+        "remove_job",
+        "update",
+        "publish_retune",
+        "register_worker",
+        "renew_lease",
+        "expire_stale_leases",
+        "expire_overdue_allocations",
+        "_maybe_commit_locked",
+        "_recover",
+    }
+    assert expected <= annotated, annotated
 
 
 def test_analyzer_speed_budget():

@@ -12,6 +12,13 @@ fix here gets a regression test:
 - ``metrics.record_retune`` increments from many threads.
 - ``AsyncSaveHandle.per_state`` mutated by the write pool while read.
 
+PR 5 adds the PR1×PR3 seam: a lease expiry (sweeper thread) arriving
+while a live re-tune (allocator thread ``publish_retune`` → worker
+``GET /config``) is in flight must not pair a stale batch config with
+the withdrawn/rolled-back allocation — ``publish_retune`` refuses to
+publish onto a withdrawn or degraded job, and ``get_config_snapshot``
+stays one locked read.
+
 The deterministic tests use the block-until-released pattern: grab
 the declared lock, start the mutator on a thread, and assert it
 cannot finish until the lock is dropped — i.e. the access really is
@@ -175,6 +182,120 @@ def test_restart_stats_never_tears_the_save_triple():
     for t in threads:
         t.join(5.0)
     assert torn == []
+
+
+def test_publish_retune_refuses_withdrawn_allocation():
+    """THE seam scenario, deterministically: the allocator decides a
+    re-tune for a live allocation; before it publishes, a lease
+    expiry withdraws that allocation. The late publish must be
+    refused — otherwise the /config snapshot would pair the stale
+    batch config with whatever allocation replaces the withdrawn one
+    (the loader's size guard cannot catch a same-size replacement)."""
+    from adaptdl_tpu.sched.state import ClusterState
+
+    state = ClusterState(alloc_commit_timeout=0.0)
+    state.create_job("ns/a", spec={})
+    state.update("ns/a", allocation=["s0"] * 2, status="Running")
+    assert state.publish_retune(
+        "ns/a", {"atomicBsz": 32, "accumSteps": 1}
+    ), "re-tunes publish normally while allocated"
+    # A lease expires: the sweeper withdraws the allocation.
+    state.renew_lease("ns/a", 0, 0.001)
+    time.sleep(0.01)
+    assert state.expire_stale_leases() == [("ns/a", 0)]
+    # The allocator's already-decided re-tune lands AFTER the
+    # withdrawal: refused, nothing published, counter unmoved.
+    assert not state.publish_retune(
+        "ns/a", {"atomicBsz": 64, "accumSteps": 2}
+    )
+    snapshot = state.get_config_snapshot("ns/a")
+    assert snapshot["allocation"] == []
+    assert snapshot["batchConfig"] == {
+        "atomicBsz": 32, "accumSteps": 1,
+    }, "the stale re-tune did not overwrite the published config"
+    assert snapshot["retunes"] == 1
+    # Re-placement serves the degradation; publishing works again.
+    state.update("ns/a", allocation=["s1"] * 2)
+    assert state.publish_retune(
+        "ns/a", {"atomicBsz": 64, "accumSteps": 2}
+    )
+    assert state.get_config_snapshot("ns/a")["retunes"] == 2
+
+
+def test_config_snapshot_and_mutators_honor_state_lock():
+    """The /config read and both racing mutators all block on the ONE
+    condition lock — the lexical guarantee behind the seam fix."""
+    from adaptdl_tpu.sched.state import ClusterState
+
+    state = ClusterState(alloc_commit_timeout=0.0)
+    state.create_job("ns/a", spec={})
+    state.update("ns/a", allocation=["s0"], status="Running")
+    assert_blocks_on(
+        state._cond, state.get_config_snapshot, "ns/a"
+    )
+    assert_blocks_on(
+        state._cond,
+        state.publish_retune,
+        "ns/a",
+        {"atomicBsz": 8, "accumSteps": 1},
+    )
+    assert_blocks_on(state._cond, state.expire_stale_leases)
+
+
+def test_retune_pair_atomic_under_expiry_and_config_hammer():
+    """Hammer the seam: one thread publishes re-tunes, one cycles
+    lease-expiry withdrawals and re-placements, readers poll the
+    /config snapshot. Every observed snapshot must be internally
+    consistent: the published batch config's marker always equals the
+    retunes counter (they are written as one atomic pair), and a
+    snapshot may never show a config marker ahead of the counter —
+    the torn pairing the one-locked-snapshot contract forbids."""
+    from adaptdl_tpu.sched.state import ClusterState
+
+    state = ClusterState(alloc_commit_timeout=0.0)
+    state.create_job("ns/a", spec={})
+    state.update("ns/a", allocation=["s0"] * 2, status="Running")
+    stop = threading.Event()
+    violations: list[dict] = []
+
+    def publisher():
+        count = 0
+        while not stop.is_set():
+            if state.publish_retune(
+                "ns/a", {"atomicBsz": count + 1, "accumSteps": 1}
+            ):
+                count += 1
+
+    def withdrawer():
+        while not stop.is_set():
+            state.renew_lease("ns/a", 0, 0.0001)
+            time.sleep(0.001)
+            state.expire_stale_leases()
+            time.sleep(0.002)
+            state.update("ns/a", allocation=["s0"] * 2)
+
+    def reader():
+        while not stop.is_set():
+            snapshot = state.get_config_snapshot("ns/a")
+            config = snapshot["batchConfig"]
+            if config is not None and (
+                config["atomicBsz"] != snapshot["retunes"]
+            ):
+                violations.append(snapshot)
+
+    threads = [
+        threading.Thread(target=publisher),
+        threading.Thread(target=withdrawer),
+        threading.Thread(target=reader),
+        threading.Thread(target=reader),
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(5.0)
+    assert violations == []
 
 
 def test_async_save_handle_per_state_is_locked():

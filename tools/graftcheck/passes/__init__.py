@@ -7,6 +7,9 @@ from tools.graftcheck.passes.collective_axis import CollectiveAxisPass
 from tools.graftcheck.passes.env_registry import EnvRegistryPass
 from tools.graftcheck.passes.fault_rpc import FaultRpcPass
 from tools.graftcheck.passes.host_sync import HostSyncPass
+from tools.graftcheck.passes.journal_discipline import (
+    JournalDisciplinePass,
+)
 from tools.graftcheck.passes.lock_discipline import LockDisciplinePass
 
 ALL_PASSES = [
@@ -16,6 +19,7 @@ ALL_PASSES = [
     CollectiveAxisPass(),
     CheckpointProtocolPass(),
     FaultRpcPass(),
+    JournalDisciplinePass(),
 ]
 
 RULE_CATALOG = {

@@ -1,0 +1,136 @@
+"""GC6xx (cont.) — durable-state journal discipline.
+
+``ClusterState`` is write-ahead journaled: a supervisor crash replays
+snapshot+journal, so any mutating method that forgets to append a
+journal record silently makes part of the cluster state volatile
+again — exactly the bug class that only shows up in a crash. The
+contract is annotation-driven, like the lock-discipline pass:
+
+- every mutating method carries a trailing ``# journaled`` annotation
+  on its ``def`` header and must contain a ``self._journal_append(...)``
+  (or ``journal_append``) call — **GC603** flags an annotated method
+  with no append (the mutation would not survive a crash);
+- symmetrically, a ``_journal_append`` call in a method NOT annotated
+  ``# journaled`` is **GC604** — the annotation is the greppable
+  catalog of mutators, and an unannotated appender means the catalog
+  lies.
+
+Apply/replay helpers (``_apply_*_locked``) deliberately mutate without
+journaling — they are the replay side of records already journaled —
+and never call ``_journal_append``, so neither rule fires on them.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.graftcheck.core import (
+    Context,
+    Finding,
+    Pass,
+    SourceFile,
+    dotted_name,
+)
+
+JOURNALED_RE = re.compile(r"#\s*journaled\b")
+
+_APPEND_NAMES = ("_journal_append", "journal_append")
+
+
+def _is_append_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    return bool(name) and name.split(".")[-1] in _APPEND_NAMES
+
+
+class JournalDisciplinePass(Pass):
+    name = "journal-discipline"
+    rules = {
+        "GC603": (
+            "journaled-annotated method never appends to the journal"
+        ),
+        "GC604": (
+            "journal append in a method not annotated # journaled"
+        ),
+    }
+
+    def journaled_methods(self, sf: SourceFile) -> set[str]:
+        """Names of ``# journaled``-annotated defs (used by tests to
+        assert the expected mutator catalog stays annotated)."""
+        names = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and JOURNALED_RE.search(sf.def_header_comment(node)):
+                names.add(node.name)
+        return names
+
+    def check_file(
+        self, sf: SourceFile, ctx: Context
+    ) -> list[Finding]:
+        findings: list[Finding] = []
+        annotated: dict[ast.AST, bool] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                annotated[node] = bool(
+                    JOURNALED_RE.search(sf.def_header_comment(node))
+                )
+        # Each append call is attributed to its innermost enclosing
+        # def; an annotation on ANY enclosing def covers it (closures
+        # spawned inside an annotated mutator are its implementation).
+        covered: set[ast.AST] = set()
+        for node in ast.walk(sf.tree):
+            if not _is_append_call(node):
+                continue
+            enclosing = sf.enclosing_functions(node)
+            covered.update(enclosing)
+            if any(annotated.get(fn) for fn in enclosing):
+                continue
+            inner = enclosing[0] if enclosing else None
+            if inner is not None and inner.name in _APPEND_NAMES:
+                continue  # the appender helper itself
+            findings.append(
+                Finding(
+                    file=sf.rel,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule="GC604",
+                    message=(
+                        "journal append in "
+                        f"{inner.name if inner else '<module>'!r}, "
+                        "which is not annotated # journaled"
+                    ),
+                    hint=(
+                        "annotate the def header with `# journaled` "
+                        "— the annotation is the catalog of "
+                        "durable-state mutators"
+                    ),
+                )
+            )
+        for fn, is_annotated in annotated.items():
+            if not is_annotated or fn in covered:
+                continue
+            findings.append(
+                Finding(
+                    file=sf.rel,
+                    line=fn.lineno,
+                    col=fn.col_offset,
+                    rule="GC603",
+                    message=(
+                        f"method {fn.name!r} is annotated # journaled "
+                        "but never appends a journal record — the "
+                        "mutation would not survive a supervisor crash"
+                    ),
+                    hint=(
+                        "journal the mutation via "
+                        "self._journal_append({...}) before applying "
+                        "it, or drop the annotation if the method "
+                        "does not mutate durable state"
+                    ),
+                )
+            )
+        return findings
