@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched probe
+.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched trace-gate probe
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -51,6 +51,15 @@ chaos:
 chaos-sched:
 	$(CPU_ENV) ADAPTDL_FAULT_SEED=1234 $(PY) -m pytest \
 	    tests/test_chaos_sched.py -q --durations=10
+
+# graftscope gates (docs/observability.md): tracing on vs off on the
+# CPU harness step loop must cost < 1% step time, the span ring
+# buffer must stay bounded under a multi-threaded hammer, and the
+# supervisor's /metrics must pass the exposition-format conformance
+# parser.
+trace-gate:
+	$(CPU_ENV) $(PY) -m pytest tests/test_trace.py -q \
+	    -k "overhead or bounded or conformant" --durations=5
 
 probe:
 	timeout 180 $(PY) tools/tpu_probe.py || echo "probe: tunnel dead/cpu-only"

@@ -274,6 +274,9 @@ def _prune(directory: str) -> None:
         for name in os.listdir(directory):
             path = os.path.join(directory, name)
             if name.startswith("_tmp-aot-"):
+                # graftcheck: disable=GC701 (file mtimes are wall-clock
+                # values; comparing them against monotonic time would
+                # be wrong, and no span measures this housekeeping)
                 if time.time() - os.path.getmtime(path) > 3600:
                     os.remove(path)
                 continue
@@ -289,10 +292,17 @@ def load_or_compile(trainer: Any, key: tuple, jitted: Any, args: tuple):
     """The train step's first-call path: return a cached executable if
     the fingerprint hits, else AOT-compile through ``jitted`` and
     persist the result in the background."""
+    from adaptdl_tpu import trace
+
     fp = fingerprint(trainer, key, args)
-    compiled = load(fp)
+    with trace.span("aot.lookup", fingerprint=fp[:12]) as attrs:
+        compiled = load(fp)
+        attrs["hit"] = compiled is not None
     if compiled is not None:
+        trace.event("aot.hit")
         return compiled
-    compiled = jitted.lower(*args).compile()
+    trace.event("aot.miss")
+    with trace.span("aot.compile", fingerprint=fp[:12]):
+        compiled = jitted.lower(*args).compile()
     save_async(fp, compiled)
     return compiled

@@ -19,7 +19,7 @@ from __future__ import annotations
 import logging
 import threading
 
-from adaptdl_tpu import _signal, collective, env, rpc, sched_hints
+from adaptdl_tpu import _signal, collective, env, rpc, sched_hints, trace
 
 LOG = logging.getLogger(__name__)
 
@@ -82,6 +82,11 @@ def _discover_peers() -> dict[int, str] | None:
 
 
 _heartbeat_stop: threading.Event | None = None
+# The restart->first-step span opens at most once per incarnation:
+# initialize_job is documented idempotent, and a repeat call must not
+# re-arm a span that would then "measure" an arbitrary mid-training
+# interval at the next profiled step.
+_restart_span_armed = False
 
 
 def start_heartbeat() -> threading.Event | None:
@@ -106,6 +111,11 @@ def start_heartbeat() -> threading.Event | None:
         sched_hints.send_heartbeat(rank=rank)
         while not stop.wait(interval):
             sched_hints.send_heartbeat(rank=rank)
+            # Every rank's buffered spans reach the supervisor on the
+            # heartbeat cadence — the hint-cadence flush only runs on
+            # rank 0's fit thread, and a straggling rank>0 restore is
+            # exactly what a rescale trace must be able to show.
+            trace.flush_to_supervisor()
 
     thread = threading.Thread(
         target=loop, name="adaptdl-heartbeat", daemon=True
@@ -118,42 +128,58 @@ def start_heartbeat() -> threading.Event | None:
 def initialize_job(distributed: bool | None = None) -> None:
     """Initialize this process for (possibly multi-host) elastic
     training. Idempotent; safe to call in single-process jobs."""
-    _signal.install_handlers()
-    if not env.num_replicas_is_set():
-        # Standalone single-process run: one replica per local device,
-        # so the dataloader's batch math and the trainer's default mesh
-        # agree without any scheduler in the loop.
-        import jax
-
-        env.set_num_replicas(len(jax.devices()))
-    peers = None
-    try:
-        peers = _discover_peers()
-    except Exception:  # noqa: BLE001 - rendezvous is best-effort local
-        LOG.exception("supervisor discovery failed; continuing solo")
-    start_heartbeat()
-    if not collective.initialized():
-        master = peers.get(0) if peers else None
-        collective.initialize(
-            master_addr=master or env.master_addr(),
-            master_port=env.master_port(),
-            replica_rank=env.process_rank(),
-            num_replicas=env.num_processes(),
+    global _restart_span_armed
+    # Adopt the rescale trace context the launcher exported
+    # (ADAPTDL_TRACEPARENT) BEFORE anything records a span: the
+    # restore/first-step spans of this incarnation must land in the
+    # same trace as the allocator decision that restarted it.
+    trace.init_from_env()
+    if not _restart_span_armed:
+        _restart_span_armed = True
+        # The restart->first-step window: opened here, closed by the
+        # first profiled train step (metrics.profile_step) — the
+        # end-to-end restart cost a rescale trace must account for.
+        trace.begin_pending(
+            "restart.first_step", restarts=env.num_restarts()
         )
-    should_distribute = (
-        distributed
-        if distributed is not None
-        else env.num_processes() > 1 and env.coordinator_addr() is not None
-    )
-    if should_distribute:
-        import jax
+    with trace.span("bootstrap.init", restarts=env.num_restarts()):
+        _signal.install_handlers()
+        if not env.num_replicas_is_set():
+            # Standalone single-process run: one replica per local
+            # device, so the dataloader's batch math and the trainer's
+            # default mesh agree without any scheduler in the loop.
+            import jax
 
-        jax.distributed.initialize(
-            coordinator_address=env.coordinator_addr(),
-            num_processes=env.num_processes(),
-            process_id=env.process_rank(),
+            env.set_num_replicas(len(jax.devices()))
+        peers = None
+        try:
+            peers = _discover_peers()
+        except Exception:  # noqa: BLE001 - rendezvous best-effort local
+            LOG.exception("supervisor discovery failed; continuing solo")
+        start_heartbeat()
+        if not collective.initialized():
+            master = peers.get(0) if peers else None
+            collective.initialize(
+                master_addr=master or env.master_addr(),
+                master_port=env.master_port(),
+                replica_rank=env.process_rank(),
+                num_replicas=env.num_processes(),
+            )
+        should_distribute = (
+            distributed
+            if distributed is not None
+            else env.num_processes() > 1
+            and env.coordinator_addr() is not None
         )
-    _enable_compilation_cache()
+        if should_distribute:
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=env.coordinator_addr(),
+                num_processes=env.num_processes(),
+                process_id=env.process_rank(),
+            )
+        _enable_compilation_cache()
 
 
 def _enable_compilation_cache() -> None:

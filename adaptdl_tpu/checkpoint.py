@@ -54,7 +54,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import IO, Any
 
-from adaptdl_tpu import env, faults
+from adaptdl_tpu import env, faults, trace
 
 LOG = logging.getLogger(__name__)
 
@@ -286,28 +286,41 @@ def save_all_states(wait: bool = True) -> AsyncSaveHandle:
     states = list(_registry.values())
     handle = AsyncSaveHandle()
     start = time.monotonic()
-    for state in states:
-        state.sync()
-    root = env.checkpoint_path()
-    rank0 = root is not None and env.replica_rank() == 0
-    snapshots: list[Any] = []
-    if rank0:
+    with trace.span(
+        "ckpt.snapshot", states=len(states), wait=wait
+    ):
         for state in states:
-            t0 = time.monotonic()
-            snapshots.append(state.snapshot())
-            with handle._lock:
-                handle.per_state[state.name] = {
-                    "snapshot_s": time.monotonic() - t0
-                }
+            state.sync()
+        root = env.checkpoint_path()
+        rank0 = root is not None and env.replica_rank() == 0
+        snapshots: list[Any] = []
+        if rank0:
+            for state in states:
+                t0 = time.monotonic()
+                snapshots.append(state.snapshot())
+                with handle._lock:
+                    handle.per_state[state.name] = {
+                        "snapshot_s": time.monotonic() - t0
+                    }
     handle.snapshot_s = time.monotonic() - start
     if not rank0:
         handle._done.set()
         return handle
     restart = env.num_restarts()
+    # The write phase may run on the background writer thread; pin its
+    # span to the save's trace context explicitly so both phases land
+    # in the same trace regardless of which thread finishes the write.
+    save_traceparent = trace.current_traceparent()
 
     def _write() -> None:
         t0 = time.monotonic()
-        _write_snapshots(root, restart, states, snapshots, handle)
+        with trace.span(
+            "ckpt.write",
+            traceparent=save_traceparent,
+            states=len(states),
+            background=not wait,
+        ):
+            _write_snapshots(root, restart, states, snapshots, handle)
         handle.write_s = time.monotonic() - t0
         _record_save_metrics(handle)
 
@@ -638,8 +651,9 @@ def load_state(state: State) -> bool:
         path = os.path.join(ckpt, state.name)
         t0 = time.monotonic()
         try:
-            with open(path, "rb") as f:
-                state.load(f)
+            with trace.span("ckpt.restore", state=state.name):
+                with open(path, "rb") as f:
+                    state.load(f)
         except Exception:  # noqa: BLE001 - any unreadable payload
             attempted = True
             LOG.warning(

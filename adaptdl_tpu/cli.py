@@ -23,6 +23,8 @@ Usage:
     adaptdl-tpu submit train.py --checkpoint-dir /ckpt [--chips N]
     adaptdl-tpu ls --supervisor http://HOST:PORT
     adaptdl-tpu status --supervisor http://HOST:PORT
+    adaptdl-tpu trace ns/job --supervisor http://HOST:PORT \
+        --perfetto out.json
     adaptdl-tpu logs default/my-job -f        # cluster pods
     adaptdl-tpu logs --log-file /ckpt/job.log # local file
     adaptdl-tpu cp default/my-job:checkpoint-3.0 ./out   # from PVC
@@ -303,6 +305,75 @@ def _cmd_status(args) -> int:
             f"\nsupervisor recoveries: {recovery['recoveries']} "
             f"(last replay {recovery.get('lastRecoveryS') or 0:.3f}s, "
             f"{recovery.get('tornRecords', 0)} torn records dropped)"
+        )
+    return 0
+
+
+def _cmd_trace(args) -> int:
+    """Render a job's stitched rescale trace (graftscope): fetch the
+    supervisor's merged worker+supervisor span view, pick one trace
+    (the current decision's, else the newest, else --trace-id), print
+    the phase waterfall with per-phase totals, and optionally write
+    the Chrome/Perfetto ``trace_event`` file."""
+    from adaptdl_tpu import rpc, trace
+
+    payload = rpc.default_client().get(
+        f"{args.supervisor}/trace/{args.job}",
+        endpoint="cli/trace",
+        timeout=10,
+        attempts=3,
+        deadline=30.0,
+    ).json()
+    spans = payload.get("spans") or []
+    if not spans:
+        print(f"no spans recorded for {args.job}", file=sys.stderr)
+        return 1
+    by_trace: dict[str, list] = {}
+    for rec in spans:
+        by_trace.setdefault(rec.get("trace", "?"), []).append(rec)
+    if args.all:
+        selected = spans
+        trace_id = f"(all {len(by_trace)} traces)"
+    else:
+        trace_id = None
+        if args.trace_id:
+            trace_id = args.trace_id
+            if trace_id not in by_trace:
+                print(
+                    f"trace {trace_id} not found; known: "
+                    f"{sorted(by_trace)}",
+                    file=sys.stderr,
+                )
+                return 1
+        else:
+            parsed = trace.parse_traceparent(
+                payload.get("traceParent")
+            )
+            if parsed is not None and parsed[0] in by_trace:
+                # The current decision's trace: what an operator asking
+                # "where did the LAST rescale spend its time" wants.
+                trace_id = parsed[0]
+            else:
+                trace_id = max(
+                    by_trace,
+                    key=lambda t: max(
+                        float(r.get("ts", 0.0)) for r in by_trace[t]
+                    ),
+                )
+        selected = by_trace[trace_id]
+    print(f"job {args.job}  trace {trace_id}  {len(selected)} span(s)")
+    print(trace.render_waterfall(selected))
+    summary = trace.phase_summary(selected)
+    if summary:
+        print("\nper-phase medians:")
+        for name in sorted(summary):
+            print(f"  {name:<28} {summary[name] * 1e3:>10.2f} ms")
+    if args.perfetto:
+        with open(args.perfetto, "w", encoding="utf-8") as f:
+            json.dump(trace.to_perfetto(selected), f)
+        print(
+            f"\nwrote Perfetto trace_event JSON to {args.perfetto} "
+            "(load in ui.perfetto.dev or chrome://tracing)"
         )
     return 0
 
@@ -687,6 +758,34 @@ def main(argv=None) -> int:
     )
     p.add_argument("--supervisor", required=True)
     p.set_defaults(fn=_cmd_status)
+
+    p = sub.add_parser(
+        "trace",
+        help="render a job's stitched rescale trace (phase "
+        "waterfall + per-phase medians; --perfetto writes the "
+        "Chrome/Perfetto trace_event file)",
+    )
+    p.add_argument("job", help="namespace/name")
+    p.add_argument("--supervisor", required=True)
+    p.add_argument(
+        "--trace-id",
+        default=None,
+        help="render this trace id (default: the current decision's "
+        "trace, else the newest)",
+    )
+    p.add_argument(
+        "--perfetto",
+        default=None,
+        metavar="FILE",
+        help="also write the selected spans as Chrome/Perfetto "
+        "trace_event JSON",
+    )
+    p.add_argument(
+        "--all",
+        action="store_true",
+        help="render every stored span, not just one trace",
+    )
+    p.set_defaults(fn=_cmd_trace)
 
     p = sub.add_parser("hints", help="show a job's posted sched hints")
     p.add_argument("job", help="namespace/name")

@@ -252,6 +252,38 @@ def compile_cache_knob() -> str:
     return os.environ.get("ADAPTDL_COMPILE_CACHE", "")
 
 
+def trace_enabled() -> bool:
+    """Whether the graftscope tracing subsystem records spans
+    (``off``/``0``/``false``/``none`` disables — every ``trace.span``
+    then costs one global read and an immediate return)."""
+    knob = os.environ.get("ADAPTDL_TRACE", "")
+    return knob.lower() not in ("off", "0", "false", "none")
+
+
+def trace_dir() -> str | None:
+    """Directory for the per-job structured trace journal (JSONL, one
+    finished span/event per line). Unset — the default — keeps spans
+    in the in-memory ring buffer only; set, every finished span is
+    appended so a killed incarnation's spans survive for the next one
+    (the cross-restart half of a rescale trace)."""
+    return _get_str("ADAPTDL_TRACE_DIR")
+
+
+def trace_buffer_size() -> int:
+    """Bounded capacity of the in-memory span ring buffer (oldest
+    spans are evicted first; the buffer can never grow past this)."""
+    return max(_get_int("ADAPTDL_TRACE_BUFFER", 4096), 1)
+
+
+def traceparent() -> str | None:
+    """W3C ``traceparent`` inherited across the checkpoint-restart
+    boundary: the launcher exports the rescale decision's trace
+    context here so the restarted incarnation's restore/first-step
+    spans land in the SAME trace as the allocator's decision and the
+    doomed incarnation's final save."""
+    return _get_str("ADAPTDL_TRACEPARENT")
+
+
 def fault_spec_raw() -> str | None:
     """Fault-injection schedule for chaos testing, as the raw spec
     string (``faults.py`` parses the grammar). Unset — the production

@@ -67,6 +67,7 @@ INJECTION_POINTS = {
     "sup.hints.pre": "sched-hints intake handler",
     "sup.config.pre": "job-config snapshot handler",
     "sup.heartbeat.pre": "heartbeat lease-renewal handler",
+    "sup.trace.pre": "worker trace-span intake handler (graftscope)",
     # worker lifecycle backends (sched.local_runner / sched.multi_runner)
     "runner.launch.pre": "before a worker subprocess launch",
     "runner.supervise.poll": "each supervision poll cycle",

@@ -34,7 +34,7 @@ from typing import Any
 
 import numpy as np
 
-from adaptdl_tpu import checkpoint, env, sched_hints
+from adaptdl_tpu import checkpoint, env, sched_hints, trace
 from adaptdl_tpu.goodput import (
     GoodputFunction,
     GradParams,
@@ -259,6 +259,13 @@ def profile_step(
     The optim-time observation is the step time minus the modelled
     accumulation micro-steps, clamped to stay positive.
     """
+    # First profiled step of this incarnation closes the
+    # restart->first-step span bootstrap opened (a no-op ever after):
+    # the tail of the rescale timeline, measured where the step
+    # actually ran rather than where the restart was requested.
+    trace.end_pending(
+        "restart.first_step", atomic_bsz=int(atomic_bsz)
+    )
     key = _profile_key(atomic_bsz)
     with _profile_lock:
         entry = _state.profile[key]
@@ -501,6 +508,10 @@ def fit_and_report_now() -> None:
             k: float(v) for k, v in perf_params._asdict().items()
         }
     sched_hints.post_sched_hints(hints)
+    # Piggyback the trace flush on the hint cadence: the worker's
+    # buffered spans reach the supervisor's per-job trace store (and
+    # its /metrics histograms) without a dedicated reporting thread.
+    trace.flush_to_supervisor()
 
 
 def get_goodput_fn() -> GoodputFunction | None:

@@ -22,7 +22,14 @@ the call sites get for free:
   every other job's fetches;
 - **fault-injection points** (``rpc.request.send`` /
   ``rpc.response.recv``) so the chaos suite can drop, delay, or
-  garble any control-plane RPC deterministically (faults.py).
+  garble any control-plane RPC deterministically (faults.py);
+- **tracing** (graftscope, trace.py): every logical call records an
+  ``rpc.request`` span (endpoint, attempts, status), each retry an
+  ``rpc.retry`` event and each circuit rejection an
+  ``rpc.circuit_open`` event, and the current W3C ``traceparent``
+  rides the request headers — so a rescale trace stitches through
+  the control plane. ``traced=False`` opts a call out (the trace
+  flush itself must not generate spans).
 
 The reference tolerates none of this (its supervisor calls are single
 unretried ``requests`` calls, adaptdl/adaptdl/env.py-era idiom);
@@ -37,7 +44,7 @@ import random
 import threading
 import time
 
-from adaptdl_tpu import faults
+from adaptdl_tpu import faults, trace
 
 LOG = logging.getLogger(__name__)
 
@@ -169,6 +176,7 @@ class RpcClient:
         circuit_threshold: int = 3,
         circuit_cooldown: float = 60.0,
         use_circuit: bool = True,
+        traced: bool = True,
     ):
         """Issue one logical RPC; returns the ``requests.Response``.
 
@@ -179,23 +187,66 @@ class RpcClient:
         network when the endpoint's circuit is open, :class:`RpcError`
         when every attempt failed. Non-retryable HTTP statuses are
         returned to the caller (use ``raise_for_status``), and count
-        as circuit successes — the endpoint answered.
+        as circuit successes — the endpoint answered. ``traced=False``
+        opts the call out of span recording AND traceparent header
+        injection (the trace-flush RPC itself).
         """
+        key = endpoint if endpoint is not None else f"{method} {url}"
+        if not traced:
+            return self._request_attempts(
+                method, url, key, params, json, headers, timeout,
+                attempts, deadline, backoff, max_backoff,
+                retry_statuses, circuit_threshold, circuit_cooldown,
+                use_circuit, traced=False,
+            )
+        with trace.span(
+            "rpc.request", endpoint=key, method=method
+        ) as span_attrs:
+            # Propagate the current trace context on the wire so the
+            # supervisor can stitch this call into the same timeline.
+            headers = dict(headers or {})
+            headers.setdefault(
+                "traceparent", trace.current_traceparent()
+            )
+            response = self._request_attempts(
+                method, url, key, params, json, headers, timeout,
+                attempts, deadline, backoff, max_backoff,
+                retry_statuses, circuit_threshold, circuit_cooldown,
+                use_circuit, traced=True, span_attrs=span_attrs,
+            )
+            span_attrs["status"] = response.status_code
+            return response
+
+    def _request_attempts(
+        self,
+        method, url, key, params, json, headers, timeout, attempts,
+        deadline, backoff, max_backoff, retry_statuses,
+        circuit_threshold, circuit_cooldown, use_circuit,
+        traced, span_attrs=None,
+    ):
         import requests
 
-        key = endpoint if endpoint is not None else f"{method} {url}"
         if use_circuit:
-            self._check_circuit(
-                key, circuit_threshold, circuit_cooldown
-            )
+            try:
+                self._check_circuit(
+                    key, circuit_threshold, circuit_cooldown
+                )
+            except CircuitOpenError:
+                if traced:
+                    trace.event("rpc.circuit_open", endpoint=key)
+                raise
         overall = (
             time.monotonic() + deadline if deadline is not None else None
         )
         last_error: Exception | None = None
         last_response = None
+        tries = 0
         for attempt in range(max(attempts, 1)):
             if overall is not None and time.monotonic() >= overall:
                 break
+            tries = attempt + 1
+            if traced and attempt > 0:
+                trace.event("rpc.retry", endpoint=key)
             try:
                 faults.maybe_fail("rpc.request.send")
                 response = requests.request(
@@ -222,6 +273,8 @@ class RpcClient:
                 if response.status_code not in retry_statuses:
                     if use_circuit:
                         self._record(key, ok=True)
+                    if span_attrs is not None:
+                        span_attrs["attempts"] = tries
                     return response
                 last_response = response
                 last_error = None
@@ -240,6 +293,8 @@ class RpcClient:
                 self._sleep(delay)
         if use_circuit:
             self._record(key, ok=False)
+        if span_attrs is not None:
+            span_attrs["attempts"] = tries
         if last_response is not None:
             raise RpcError(
                 f"{method} {url} failed with status "

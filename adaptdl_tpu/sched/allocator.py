@@ -13,10 +13,10 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 
 import numpy as np
 
+from adaptdl_tpu import trace
 from adaptdl_tpu.goodput import GoodputFunction, GradParams, PerfParams
 from adaptdl_tpu.sched.policy import (
     JobInfo,
@@ -156,6 +156,15 @@ class Allocator:
         return self._nodes() if callable(self._nodes) else self._nodes
 
     def optimize_once(self) -> dict[str, list[str]]:
+        # The decision latency of one full Pollux cycle — the number
+        # the thousand-job control plane's SLO will be written against.
+        with trace.span("alloc.decide") as decide_attrs:
+            allocations = self._optimize_once_traced(decide_attrs)
+        return allocations
+
+    def _optimize_once_traced(
+        self, decide_attrs: dict
+    ) -> dict[str, list[str]]:
         jobs = {}
         base = {}
         for key, record in self._state.jobs().items():
@@ -192,6 +201,10 @@ class Allocator:
             return {}
         allocations, desired = self._policy.optimize(
             jobs, nodes, base, self._template, quarantined=quarantined
+        )
+        decide_attrs["jobs"] = len(jobs)
+        decide_attrs["slots"] = sum(
+            info.resources.get("tpu", 0) for info in nodes.values()
         )
         if self._expander is not None:
             self._expander.request(desired)
@@ -238,11 +251,25 @@ class Allocator:
             if reallocate:
                 LOG.info("allocation %s: %s -> %s (topology %s)", key,
                          record.allocation, alloc, topology)
+                # Mint a fresh trace for this rescale decision: the
+                # launcher exports it (ADAPTDL_TRACEPARENT) to the new
+                # incarnation and /config serves it to the doomed one,
+                # so every span of this rescale — decide, epoch
+                # prepare/commit, final save, restore, first step —
+                # shares one trace id.
+                traceparent = trace.new_traceparent()
+                trace.event(
+                    "alloc.publish",
+                    traceparent=traceparent,
+                    job=key,
+                    replicas=len(alloc),
+                )
                 self._state.update(
                     key,
                     allocation=alloc,
                     topology=topology,
                     batch_config=batch_config,
+                    trace_parent=traceparent,
                 )
             elif (
                 batch_config is not None

@@ -41,7 +41,7 @@ import json
 import logging
 import os
 
-from adaptdl_tpu import faults
+from adaptdl_tpu import faults, trace
 
 LOG = logging.getLogger(__name__)
 
@@ -85,15 +85,27 @@ class StateJournal:
 
     def append(self, record: dict) -> None:
         """Durably append one mutation record (fsync before return)."""
-        faults.maybe_fail("sched.journal_write")
-        if self._fh is None:
-            self._fh = open(self.journal_path, "a", encoding="utf-8")
-        self._seq += 1
-        record = dict(record, seq=self._seq)
-        self._fh.write(json.dumps(record, sort_keys=True) + "\n")
-        self._fh.flush()
-        os.fsync(self._fh.fileno())
-        self._appends_since_snapshot += 1
+        # The span covers write+fsync — the latency every journaled
+        # supervisor mutation pays on its critical path (and the term
+        # group-commit batching would attack; measure before
+        # optimizing). ``job``/``op`` attrs let a per-job trace pick
+        # its own appends out of the shared journal stream.
+        with trace.span(
+            "journal.append",
+            job=record.get("key", ""),
+            op=record.get("op", ""),
+        ):
+            faults.maybe_fail("sched.journal_write")
+            if self._fh is None:
+                self._fh = open(
+                    self.journal_path, "a", encoding="utf-8"
+                )
+            self._seq += 1
+            record = dict(record, seq=self._seq)
+            self._fh.write(json.dumps(record, sort_keys=True) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._appends_since_snapshot += 1
 
     def snapshot_due(self) -> bool:
         return self._appends_since_snapshot >= self._snapshot_every
@@ -107,6 +119,10 @@ class StateJournal:
         journal) — never a gap.
         """
         faults.maybe_fail("sched.snapshot_write")
+        with trace.span("journal.snapshot"):
+            self._write_snapshot(payload)
+
+    def _write_snapshot(self, payload: dict) -> None:
         tmp = self.snapshot_path + ".tmp"
         # The snapshot covers every record appended so far: replay
         # skips journal records at or below last_seq, so a crash

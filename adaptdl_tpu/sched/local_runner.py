@@ -130,6 +130,12 @@ class LocalElasticRunner:
                 "ADAPTDL_SUPERVISOR_URL": self.supervisor.url,
             }
         )
+        record = self.state.get_job(self.job_name)
+        if record is not None and record.trace_parent:
+            # Cross the checkpoint-restart boundary: the new
+            # incarnation's restore/first-step spans join the trace of
+            # the allocator decision that restarted it (graftscope).
+            env["ADAPTDL_TRACEPARENT"] = record.trace_parent
         topology = topology or {}
         env["ADAPTDL_SEQ_SHARDS"] = str(topology.get("seqShards", 1))
         env["ADAPTDL_MODEL_SHARDS"] = str(topology.get("modelShards", 1))

@@ -161,6 +161,11 @@ class MultiJobRunner:
                 "ADAPTDL_SUPERVISOR_URL": self.supervisor.url,
             }
         )
+        record = self.state.get_job(job.name)
+        if record is not None and record.trace_parent:
+            # Same graftscope propagation as the single-job runner:
+            # the new incarnation joins the rescale decision's trace.
+            env["ADAPTDL_TRACEPARENT"] = record.trace_parent
         topology = topology or {}
         env["ADAPTDL_SEQ_SHARDS"] = str(topology.get("seqShards", 1))
         env["ADAPTDL_MODEL_SHARDS"] = str(

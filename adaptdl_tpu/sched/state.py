@@ -40,7 +40,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
-from adaptdl_tpu import env, faults
+from adaptdl_tpu import env, faults, trace
 from adaptdl_tpu.sched.journal import StateJournal
 
 LOG = logging.getLogger(__name__)
@@ -150,6 +150,16 @@ class JobRecord:
     # to betray a live incarnation, but its beats land here, so its
     # replacement still needs successor-group proof. Transient.
     alive_ranks: set[int] = field(default_factory=set)
+    # W3C traceparent of the rescale decision behind the current
+    # launch config (graftscope): the allocator mints it, the
+    # launcher exports it as ADAPTDL_TRACEPARENT, and /config serves
+    # it — so worker spans on both sides of the restart stitch into
+    # the supervisor's epoch timeline.
+    trace_parent: str | None = None
+    # Monotonic stamp of the last epoch prepare (transient): the
+    # commit/rollback span's start, so the epoch's prepare->verdict
+    # window is measured, not inferred.
+    alloc_prepared_at: float | None = None
 
 
 def _job_to_dict(record: JobRecord) -> dict:
@@ -182,6 +192,7 @@ def _job_to_dict(record: JobRecord) -> dict:
         "alloc_state": record.alloc_state,
         "alloc_prepare_group": record.alloc_prepare_group,
         "alloc_require_bump": record.alloc_require_bump,
+        "trace_parent": record.trace_parent,
     }
 
 
@@ -229,6 +240,7 @@ def _job_from_dict(payload: dict) -> JobRecord:
     record.alloc_require_bump = bool(
         payload.get("alloc_require_bump", False)
     )
+    record.trace_parent = payload.get("trace_parent")
     return record
 
 
@@ -287,6 +299,9 @@ class ClusterState:
         self._quarantined: dict[str, float] = {}  # guarded-by: _cond
         self._rollbacks: dict[str, int] = {}  # guarded-by: _cond
         # Durability / recovery bookkeeping.
+        # True only inside recovery's replay loop: replayed ops are
+        # history and must not re-record trace events/spans.
+        self._replaying = False  # guarded-by: _cond
         self._reconcile_until = 0.0  # guarded-by: _cond
         self._recoveries = 0  # guarded-by: _cond
         self._last_recovery_s: float | None = None  # guarded-by: _cond
@@ -378,13 +393,18 @@ class ClusterState:
                     snapshot.get("jobs") or {}
                 ).items():
                     self._jobs[key] = _job_from_dict(payload)
-            for op in records:
-                try:
-                    self._apply_locked(op)
-                except Exception:  # noqa: BLE001 - prefix recovery
-                    LOG.exception(
-                        "skipping unreplayable journal record %r", op
-                    )
+            self._replaying = True
+            try:
+                for op in records:
+                    try:
+                        self._apply_locked(op)
+                    except Exception:  # noqa: BLE001 - prefix recovery
+                        LOG.exception(
+                            "skipping unreplayable journal record %r",
+                            op,
+                        )
+            finally:
+                self._replaying = False
             self._torn_records = torn
             now = time.monotonic()
             if self._jobs:
@@ -518,6 +538,17 @@ class ClusterState:
                         record.alloc_deadline = (
                             now + self._commit_timeout
                         )
+                        record.alloc_prepared_at = now
+                        if not self._replaying:
+                            trace.event(
+                                "epoch.prepare",
+                                traceparent=fields.get(
+                                    "trace_parent",
+                                    record.trace_parent,
+                                ),
+                                job=record.key,
+                                epoch=record.alloc_epoch,
+                            )
                     elif value:
                         # Transactional rescale disabled: trust it.
                         record.alloc_epoch += 1
@@ -649,6 +680,18 @@ class ClusterState:
         record.alloc_state = "committed"
         record.alloc_deadline = None
         record.alloc_fresh = set()
+        # The epoch's prepare->commit window, as a span in the job's
+        # rescale trace (skipped during recovery replay, where the
+        # prepare stamp died with the old process anyway).
+        if not self._replaying and record.alloc_prepared_at is not None:
+            trace.record_span(
+                "epoch.commit",
+                time.monotonic() - record.alloc_prepared_at,
+                traceparent=record.trace_parent,
+                job=record.key,
+                epoch=record.alloc_epoch,
+            )
+        record.alloc_prepared_at = None
         # Consecutive-failure semantics: a slot that just hosted a
         # successful commit earns a clean slate.
         for slot in set(record.allocation):
@@ -670,6 +713,15 @@ class ClusterState:
         record.alloc_state = "committed"
         record.alloc_deadline = None
         record.alloc_fresh = set()
+        if not self._replaying and record.alloc_prepared_at is not None:
+            trace.record_span(
+                "epoch.rollback",
+                time.monotonic() - record.alloc_prepared_at,
+                traceparent=record.trace_parent,
+                job=record.key,
+                epoch=record.alloc_epoch,
+            )
+        record.alloc_prepared_at = None
         self._rollbacks[op["key"]] = (
             self._rollbacks.get(op["key"], 0) + 1
         )
@@ -1003,6 +1055,11 @@ class ClusterState:
                 ),
                 "retunes": record.retunes,
                 "group": record.group,
+                # The decision's trace context: a live worker that
+                # polls /config can adopt it, so its final save (the
+                # rescale "prepare" on the worker side) lands in the
+                # same trace as the restart that follows.
+                "traceParent": record.trace_parent,
             }
 
     def jobs(self) -> dict[str, JobRecord]:
@@ -1087,12 +1144,18 @@ class ClusterState:
             return {"jobs": jobs}
 
     def wait_for(self, predicate, timeout: float | None = None) -> bool:
-        """Block until ``predicate(jobs_dict)`` is true (or timeout)."""
-        deadline = None if timeout is None else time.time() + timeout
+        """Block until ``predicate(jobs_dict)`` is true (or timeout).
+        The deadline is monotonic — a wall-clock step (NTP slew,
+        suspend/resume) must not stretch or cut the wait."""
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
         with self._cond:
             while not predicate(self._jobs):
                 remaining = (
-                    None if deadline is None else deadline - time.time()
+                    None
+                    if deadline is None
+                    else deadline - time.monotonic()
                 )
                 if remaining is not None and remaining <= 0:
                     return False

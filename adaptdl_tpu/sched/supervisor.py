@@ -19,6 +19,21 @@ backend-agnostic; reference: sched/adaptdl_sched/supervisor.py:45-80):
 - ``GET /status`` — operator-facing JSON: per-job phase, degraded
   flag, allocation epoch/state, lease ages, plus slot strikes,
   quarantine, and recovery info (the ``adaptdl-tpu status`` CLI).
+- ``PUT /trace/{namespace}/{name}`` — graftscope span intake: workers
+  flush their buffered rescale-lifecycle spans here (piggybacked on
+  the sched-hints cadence); the supervisor stores them per job (a
+  bounded ring) and folds their durations into its /metrics
+  histograms.
+- ``GET /trace/{namespace}/{name}`` — the stitched per-job timeline:
+  worker-posted spans merged with this process's own spans for the
+  job (allocator decide/publish, epoch prepare/commit/rollback,
+  journal appends), deduplicated by span id. The ``adaptdl-tpu
+  trace`` CLI renders it as a phase waterfall and a Perfetto file.
+
+``/metrics`` is assembled with :class:`trace.PromBuilder`, so every
+series carries ``# HELP``/``# TYPE`` and escaped label values — the
+Prometheus exposition-format conformance test parses the output with
+a strict grammar and fails on any malformed series.
 
 Liveness: each worker rank holds a lease of ``lease_ttl`` seconds; a
 background sweeper expires stale leases, marks the job degraded, and
@@ -40,10 +55,13 @@ from __future__ import annotations
 import asyncio
 import functools
 import logging
+import os
+import threading
+from collections import deque
 
 from aiohttp import web
 
-from adaptdl_tpu import env, faults, sched_hints
+from adaptdl_tpu import env, faults, sched_hints, trace
 from adaptdl_tpu.sched.http_server import ThreadedHttpServer
 from adaptdl_tpu.sched.state import ClusterState
 
@@ -94,6 +112,11 @@ class Supervisor(ThreadedHttpServer):
         self._lease_ttl = (
             env.lease_ttl() if lease_ttl is None else lease_ttl
         )
+        # Per-job store of worker-posted trace spans (graftscope).
+        # Bounded like the in-process ring buffer; written by the
+        # trace-intake executor thread, read by GET /trace.
+        self._trace_lock = threading.Lock()
+        self._trace_store: dict[str, deque] = {}  # guarded-by: _trace_lock
         # Default cadence: a quarter of whichever expiry clock is
         # active (lease TTL, else the allocation-commit timeout).
         clock = self._lease_ttl
@@ -280,107 +303,289 @@ class Supervisor(ThreadedHttpServer):
         payload["recovery"] = self._state.recovery_info()
         return web.json_response(payload)
 
+    # -- graftscope: worker span intake + stitched per-job timeline --
+
+    @staticmethod
+    def _valid_span_record(rec) -> bool:
+        """Intake-side schema guard: everything downstream float()s
+        ``dur``/``ts`` and strings ``name``/``span`` — a poison record
+        must bounce here as a 400, not 500 every later GET."""
+        return (
+            isinstance(rec, dict)
+            and isinstance(rec.get("name"), str)
+            and bool(rec.get("name"))
+            and isinstance(rec.get("dur", 0.0), (int, float))
+            and isinstance(rec.get("ts", 0.0), (int, float))
+        )
+
+    @_faultable("sup.trace.pre")
+    async def _put_trace(self, request: web.Request) -> web.Response:
+        key = "{namespace}/{name}".format(**request.match_info)
+        try:
+            body = await request.json()
+        except ValueError:
+            return web.json_response(
+                {"error": "body must be JSON"}, status=400
+            )
+        spans = (body or {}).get("spans")
+        if not isinstance(spans, list) or not all(
+            self._valid_span_record(rec) for rec in spans
+        ):
+            return web.json_response(
+                {"error": "body must be {\"spans\": [{...}, ...]}"},
+                status=400,
+            )
+        if self._state.get_job(key) is None:
+            return web.json_response(
+                {"error": "no such job"}, status=404
+            )
+
+        def absorb() -> list:
+            # Idempotent intake: a worker whose flush response was
+            # lost re-sends the same batch — only spans not already in
+            # the store are appended and observed, so retries can't
+            # double-count histogram durations or duplicate the
+            # waterfall.
+            with self._trace_lock:
+                store = self._trace_store.get(key)
+                if store is None:
+                    store = deque(maxlen=env.trace_buffer_size())
+                    self._trace_store[key] = store
+                seen = {rec.get("span") for rec in store}
+                fresh = []
+                for rec in spans:
+                    span_id = rec.get("span")
+                    if span_id is not None and span_id in seen:
+                        continue
+                    seen.add(span_id)
+                    fresh.append(rec)
+                store.extend(fresh)
+            # Fold the worker-side phase durations into THIS process's
+            # Prometheus registry: /metrics then covers both halves of
+            # a rescale from one scrape point. Spans this very process
+            # recorded (an in-process worker flushing to its own
+            # supervisor) were observed at record time — absorbing
+            # them again would double-count the histograms.
+            trace.absorb(
+                [rec for rec in fresh if rec.get("pid") != os.getpid()]
+            )
+            return fresh
+
+        fresh = await self._offload(absorb)
+        return web.json_response({"ok": True, "accepted": len(fresh)})
+
+    def _job_trace_spans(self, key: str) -> list[dict]:
+        """Worker-posted spans merged with this process's own spans
+        for the job, deduplicated by span id (in-process workers flush
+        spans the local buffer also holds)."""
+        with self._trace_lock:
+            store = self._trace_store.get(key)
+            merged = list(store) if store else []
+        seen = {rec.get("span") for rec in merged}
+        local = trace.snapshot_spans()
+        # Pass 1: spans explicitly tagged with the job. Pass 2: any
+        # span sharing a trace id with the job's spans (the rescale
+        # trace stitches supervisor-side spans that carry no job attr).
+        tagged = [
+            rec
+            for rec in local
+            if (rec.get("attrs") or {}).get("job") == key
+            and rec.get("span") not in seen
+        ]
+        merged.extend(tagged)
+        seen.update(rec.get("span") for rec in tagged)
+        trace_ids = {rec.get("trace") for rec in merged}
+        record = self._state.get_job(key)
+        if record is not None and record.trace_parent:
+            parsed = trace.parse_traceparent(record.trace_parent)
+            if parsed is not None:
+                trace_ids.add(parsed[0])
+        merged.extend(
+            rec
+            for rec in local
+            if rec.get("trace") in trace_ids
+            and rec.get("span") not in seen
+        )
+        merged.sort(key=lambda rec: float(rec.get("ts", 0.0)))
+        return merged
+
+    async def _get_trace(self, request: web.Request) -> web.Response:
+        key = "{namespace}/{name}".format(**request.match_info)
+        record = self._state.get_job(key)
+        if record is None:
+            return web.json_response(
+                {"error": "no such job"}, status=404
+            )
+        spans = await self._offload(self._job_trace_spans, key)
+        return web.json_response(
+            {
+                "job": key,
+                "traceParent": record.trace_parent,
+                "spans": spans,
+            }
+        )
+
     async def _metrics(self, request: web.Request) -> web.Response:
         """Prometheus text exposition (reference exports job counters
         from the controller on :9091, controller.py:35-41; here the
-        supervisor serves cluster-visible gauges directly)."""
+        supervisor serves cluster-visible gauges directly). Built with
+        :class:`trace.PromBuilder` so HELP/TYPE coverage and label
+        escaping hold for every series by construction."""
+        b = trace.PromBuilder()
+        b.family(
+            "adaptdl_jobs", "gauge", "Known jobs by lifecycle status."
+        )
+        b.family(
+            "adaptdl_job_replicas",
+            "gauge",
+            "Chips currently allocated to each job.",
+        )
+        b.family(
+            "adaptdl_job_degraded",
+            "gauge",
+            "1 while a job runs short-handed after a lease expiry.",
+        )
+        b.family(
+            "adaptdl_job_batch_size",
+            "gauge",
+            "Initial global batch size from the job's sched hints.",
+        )
+        b.family(
+            "adaptdl_job_retunes_total",
+            "counter",
+            "Live batch-config re-tunes adopted without a restart.",
+        )
+        b.family(
+            "adaptdl_job_submissions_total",
+            "counter",
+            "Jobs ever submitted to this cluster.",
+        )
+        b.family(
+            "adaptdl_job_completion_seconds",
+            "summary",
+            "Time from submission to a terminal status.",
+        )
+        b.family(
+            "adaptdl_alloc_epoch",
+            "gauge",
+            "Allocation epoch counter (bumped at every prepare).",
+        )
+        b.family(
+            "adaptdl_alloc_pending",
+            "gauge",
+            "1 while an allocation epoch awaits its commit quorum.",
+        )
+        b.family(
+            "adaptdl_alloc_rollbacks_total",
+            "counter",
+            "Allocation epochs rolled back at the commit deadline.",
+        )
+        b.family(
+            "adaptdl_slot_strikes",
+            "gauge",
+            "Consecutive failed-allocation strikes per slot.",
+        )
+        b.family(
+            "adaptdl_slot_quarantined",
+            "gauge",
+            "1 for slots quarantined away from the allocator.",
+        )
+        b.family(
+            "adaptdl_supervisor_recoveries_total",
+            "counter",
+            "Durable-state recoveries this cluster has performed.",
+        )
+        b.family(
+            "adaptdl_supervisor_recovery_seconds",
+            "gauge",
+            "Duration of the last snapshot+journal replay.",
+        )
+        b.family(
+            "adaptdl_journal_torn_records_total",
+            "counter",
+            "Torn journal records dropped during recovery.",
+        )
         lifecycle = self._state.lifecycle_metrics()
-        lines = [
-            "# TYPE adaptdl_jobs gauge",
-            "# TYPE adaptdl_job_replicas gauge",
-            "# TYPE adaptdl_job_degraded gauge",
-            "# TYPE adaptdl_job_batch_size gauge",
-            "# TYPE adaptdl_job_retunes_total counter",
-            "# TYPE adaptdl_job_submissions_total counter",
-            f"adaptdl_job_submissions_total "
-            f"{lifecycle['submitted_total']}",
-            "# TYPE adaptdl_job_completion_seconds summary",
-        ]
+        b.sample(
+            "adaptdl_job_submissions_total",
+            value=lifecycle["submitted_total"],
+        )
         for status, (count, total) in sorted(
             lifecycle["completions"].items()
         ):
-            label = f'status="{status}"'
-            lines.append(
-                f"adaptdl_job_completion_seconds_count{{{label}}} "
-                f"{count}"
+            b.sample(
+                "adaptdl_job_completion_seconds",
+                {"status": status},
+                count,
+                suffix="_count",
             )
-            lines.append(
-                f"adaptdl_job_completion_seconds_sum{{{label}}} "
-                f"{total:.3f}"
+            b.sample(
+                "adaptdl_job_completion_seconds",
+                {"status": status},
+                round(total, 3),
+                suffix="_sum",
             )
         jobs = self._state.jobs()
         by_status: dict[str, int] = {}
         for record in jobs.values():
             by_status[record.status] = by_status.get(record.status, 0) + 1
         for status, count in sorted(by_status.items()):
-            lines.append(
-                f'adaptdl_jobs{{status="{status}"}} {count}'
-            )
+            b.sample("adaptdl_jobs", {"status": status}, count)
         for key, record in sorted(jobs.items()):
-            label = f'job="{key}"'
-            lines.append(
-                f"adaptdl_job_replicas{{{label}}} "
-                f"{len(record.allocation)}"
+            labels = {"job": key}
+            b.sample(
+                "adaptdl_job_replicas", labels, len(record.allocation)
             )
-            lines.append(
-                f"adaptdl_job_retunes_total{{{label}}} {record.retunes}"
+            b.sample(
+                "adaptdl_job_retunes_total", labels, record.retunes
             )
-            lines.append(
-                f"adaptdl_job_degraded{{{label}}} "
-                f"{int(record.degraded)}"
+            b.sample(
+                "adaptdl_job_degraded", labels, int(record.degraded)
             )
             hints = record.hints or {}
             if hints.get("initBatchSize"):
-                lines.append(
-                    f"adaptdl_job_batch_size{{{label}}} "
-                    f"{hints['initBatchSize']}"
+                b.sample(
+                    "adaptdl_job_batch_size",
+                    labels,
+                    hints["initBatchSize"],
                 )
-            lines.append(
-                f"adaptdl_alloc_epoch{{{label}}} {record.alloc_epoch}"
-            )
-            lines.append(
-                f"adaptdl_alloc_pending{{{label}}} "
-                f"{int(record.alloc_state == 'pending')}"
+            b.sample("adaptdl_alloc_epoch", labels, record.alloc_epoch)
+            b.sample(
+                "adaptdl_alloc_pending",
+                labels,
+                int(record.alloc_state == "pending"),
             )
         # Transactional-rescale + durable-state observability: the
         # rollback/quarantine gauges the chaos acceptance checks read.
         health = self._state.slot_health()
-        lines.append("# TYPE adaptdl_alloc_rollbacks_total counter")
         for key, count in sorted(health["rollbacks"].items()):
-            lines.append(
-                f'adaptdl_alloc_rollbacks_total{{job="{key}"}} {count}'
+            b.sample(
+                "adaptdl_alloc_rollbacks_total", {"job": key}, count
             )
-        lines.append("# TYPE adaptdl_slot_strikes gauge")
         for slot, count in sorted(health["strikes"].items()):
-            lines.append(
-                f'adaptdl_slot_strikes{{slot="{slot}"}} {count}'
-            )
-        lines.append("# TYPE adaptdl_slot_quarantined gauge")
+            b.sample("adaptdl_slot_strikes", {"slot": slot}, count)
         for slot in sorted(health["quarantined"]):
-            lines.append(
-                f'adaptdl_slot_quarantined{{slot="{slot}"}} 1'
-            )
+            b.sample("adaptdl_slot_quarantined", {"slot": slot}, 1)
         recovery = self._state.recovery_info()
-        lines.append("# TYPE adaptdl_supervisor_recoveries_total counter")
-        lines.append(
-            f"adaptdl_supervisor_recoveries_total "
-            f"{recovery['recoveries']}"
+        b.sample(
+            "adaptdl_supervisor_recoveries_total",
+            value=recovery["recoveries"],
         )
         if recovery["lastRecoveryS"] is not None:
-            lines.append(
-                "# TYPE adaptdl_supervisor_recovery_seconds gauge"
+            b.sample(
+                "adaptdl_supervisor_recovery_seconds",
+                value=round(recovery["lastRecoveryS"], 4),
             )
-            lines.append(
-                f"adaptdl_supervisor_recovery_seconds "
-                f"{recovery['lastRecoveryS']:.4f}"
-            )
-        lines.append("# TYPE adaptdl_journal_torn_records_total counter")
-        lines.append(
-            f"adaptdl_journal_torn_records_total "
-            f"{recovery['tornRecords']}"
+        b.sample(
+            "adaptdl_journal_torn_records_total",
+            value=recovery["tornRecords"],
         )
+        # graftscope: per-phase latency histograms + event counters
+        # (supervisor-side spans recorded locally, worker-side spans
+        # absorbed on PUT /trace).
+        trace.render_into(b)
         return web.Response(
-            text="\n".join(lines) + "\n",
+            text=b.render(),
             content_type="text/plain",
         )
 
@@ -457,6 +662,8 @@ class Supervisor(ThreadedHttpServer):
                 web.put("/hints/{namespace}/{name}", self._put_hints),
                 web.get("/hints/{namespace}/{name}", self._get_hints),
                 web.get("/config/{namespace}/{name}", self._get_config),
+                web.put("/trace/{namespace}/{name}", self._put_trace),
+                web.get("/trace/{namespace}/{name}", self._get_trace),
                 web.get("/healthz", self._healthz),
                 web.get("/status", self._status),
                 web.get("/metrics", self._metrics),

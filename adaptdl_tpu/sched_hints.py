@@ -12,7 +12,7 @@ from __future__ import annotations
 import logging
 from typing import Any
 
-from adaptdl_tpu import env, rpc
+from adaptdl_tpu import env, rpc, trace
 from adaptdl_tpu.goodput import GradParams, PerfParams
 
 LOG = logging.getLogger(__name__)
@@ -127,7 +127,15 @@ def fetch_job_config(job_id: str | None = None) -> dict | None:
         )
         response.raise_for_status()
         payload = response.json()
-        return payload if isinstance(payload, dict) else None
+        if not isinstance(payload, dict):
+            return None
+        if job_id == env.job_id() and payload.get("traceParent"):
+            # Join the current decision's rescale trace: if this
+            # config is about to restart us, our final save spans
+            # (the worker-side "prepare") must land in the same trace
+            # as the allocator decision and our successor's restore.
+            trace.set_traceparent(payload["traceParent"])
+        return payload
     except Exception as exc:  # noqa: BLE001 - best effort by design
         LOG.debug("failed to fetch job config: %s", exc)
         return None

@@ -1,0 +1,898 @@
+"""graftscope: end-to-end rescale tracing and telemetry.
+
+The rescale fast path (PR 1) and the transactional control plane
+(PR 5) made rescales fast and safe, but left them unobservable: there
+was no way to follow ONE rescale from the allocator's decision through
+prepare→commit, checkpoint snapshot/write, worker exit, restart, AOT
+cache hit, and first step. This module is that measurement layer —
+the instrumentation substrate Pollux's (OSDI'21) evaluation and
+CheckFreq's (FAST'21) snapshot/write/stall breakdowns are built on:
+
+- **Spans** — ``with trace.span("ckpt.snapshot"): ...`` records a
+  monotonic-clock duration plus a wall-clock start (cross-process
+  alignment), nested parent/child ids per thread, and arbitrary
+  attributes. ``trace.event(...)`` records a zero-duration point (and
+  bumps a Prometheus counter). Disabled (``ADAPTDL_TRACE=off``) both
+  cost one global read and an immediate return.
+- **Trace context** — W3C-style ``traceparent``
+  (``00-<32hex>-<16hex>-01``). The allocator mints a fresh context per
+  rescale decision; it propagates through ``rpc.py`` request headers
+  and the ``ADAPTDL_TRACEPARENT`` environment variable across the
+  checkpoint-restart boundary, so one trace id stitches the doomed
+  incarnation's final save, the supervisor's epoch lifecycle, and the
+  successor's restore/first-step into one timeline.
+- **Bounded ring buffer** — finished spans land in a lock-guarded
+  deque of ``ADAPTDL_TRACE_BUFFER`` capacity; a runaway producer can
+  evict history but never grow memory.
+- **Three exporters**:
+
+  1. a per-job JSONL *structured event journal*
+     (``ADAPTDL_TRACE_DIR/trace-<job>.jsonl``, one finished span per
+     line) — durable across kills, which is what lets a chaos test
+     prove trace-context survival through a mid-rescale worker death;
+  2. Chrome/Perfetto ``trace_event`` JSON (:func:`to_perfetto`) for
+     visual timelines (``chrome://tracing`` / ui.perfetto.dev);
+  3. Prometheus histograms with per-phase buckets
+     (:func:`prometheus_lines`), merged into the supervisor's
+     ``/metrics`` exposition.
+
+Workers flush their buffered spans to the supervisor (piggybacked on
+the sched-hints cadence) via ``PUT /trace/{job}``; the supervisor
+serves the stitched per-job view on ``GET /trace/{job}`` and the
+``adaptdl-tpu trace`` CLI renders the phase waterfall.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+import zlib
+from bisect import bisect_left
+from collections import deque
+from contextlib import contextmanager
+
+from adaptdl_tpu import env
+
+LOG = logging.getLogger(__name__)
+
+# ---- trace context (W3C traceparent) ---------------------------------
+
+_TRACEPARENT_VERSION = "00"
+_SAMPLED_FLAGS = "01"
+
+# Span/trace ids are identifiers, not secrets: a per-thread PRNG
+# seeded once from os.urandom generates them at ~0.7us instead of
+# paying the ~15us urandom syscall on every span (the overhead gate
+# holds recording under 1% of step time). The state is keyed by pid
+# so a fork (the elastic test harness launches replicas that way)
+# reseeds in the child — otherwise every forked rank would emit the
+# parent's id sequence and collide.
+_rng_local = threading.local()
+
+
+def _rand_hex(nbytes: int) -> str:
+    state = getattr(_rng_local, "state", None)
+    pid = os.getpid()
+    if state is None or state[0] != pid:
+        state = (
+            pid,
+            random.Random(int.from_bytes(os.urandom(16), "big")),
+        )
+        _rng_local.state = state
+    return "%0*x" % (nbytes * 2, state[1].getrandbits(nbytes * 8))
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    return (
+        f"{_TRACEPARENT_VERSION}-{trace_id}-{span_id}-{_SAMPLED_FLAGS}"
+    )
+
+
+def parse_traceparent(header: str | None) -> tuple[str, str] | None:
+    """(trace_id, span_id) from a W3C traceparent header, or None for
+    anything malformed — a garbled inherited context must degrade to a
+    fresh trace, never crash a restarting worker."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, _flags = parts
+    if len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+def new_traceparent() -> str:
+    """A fresh trace context (NOT installed as this process's current
+    one) — what the allocator mints per rescale decision."""
+    return format_traceparent(_rand_hex(16), _rand_hex(8))
+
+
+# Process-level root context: every span without an explicit
+# traceparent (and without an enclosing span on its thread) parents
+# here. Lazily initialized from ADAPTDL_TRACEPARENT so a restarted
+# incarnation lands in the trace of the decision that restarted it.
+_ctx_lock = threading.Lock()
+_trace_id: str | None = None  # guarded-by: _ctx_lock
+_root_span_id: str | None = None  # guarded-by: _ctx_lock
+
+
+def init_from_env(force: bool = False) -> None:
+    """Adopt ``ADAPTDL_TRACEPARENT`` as this process's root context
+    (or mint a fresh one when unset/malformed). Idempotent unless
+    ``force``."""
+    global _trace_id, _root_span_id
+    with _ctx_lock:
+        if _trace_id is not None and not force:
+            return
+        parsed = parse_traceparent(env.traceparent())
+        if parsed is not None:
+            _trace_id, _root_span_id = parsed
+        else:
+            _trace_id, _root_span_id = _rand_hex(16), _rand_hex(8)
+
+
+def set_traceparent(header: str | None) -> bool:
+    """Adopt an explicit trace context (e.g. from a /config snapshot:
+    the live worker joins the rescale trace that is about to replace
+    it). Returns False (context unchanged) on a malformed header."""
+    global _trace_id, _root_span_id
+    parsed = parse_traceparent(header)
+    if parsed is None:
+        return False
+    with _ctx_lock:
+        _trace_id, _root_span_id = parsed
+    return True
+
+
+def _root_context() -> tuple[str, str]:
+    init_from_env()
+    with _ctx_lock:
+        return _trace_id, _root_span_id  # type: ignore[return-value]
+
+
+def current_traceparent() -> str:
+    """The context to propagate outward right now: the innermost open
+    span on this thread, else the process root."""
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        return format_traceparent(stack[-1][0], stack[-1][1])
+    trace_id, span_id = _root_context()
+    return format_traceparent(trace_id, span_id)
+
+
+# ---- enablement ------------------------------------------------------
+
+_enabled: bool | None = None
+
+
+def enabled() -> bool:
+    global _enabled
+    if _enabled is None:
+        _enabled = env.trace_enabled()
+    return _enabled
+
+
+# This process's restart count, read once (it cannot change within an
+# incarnation); stamped on every record so a cross-restart journal
+# attributes spans to incarnations.
+_incarnation: int | None = None
+
+
+def _inc() -> int:
+    global _incarnation
+    if _incarnation is None:
+        _incarnation = env.num_restarts()
+    return _incarnation
+
+
+# ---- the span record + ring buffer -----------------------------------
+
+# Per-thread stack of (trace_id, span_id) for parent/child nesting.
+_tls = threading.local()
+
+_buffer_lock = threading.Lock()
+_buffer: deque | None = None  # guarded-by: _buffer_lock
+_seq = 0  # guarded-by: _buffer_lock
+_flushed_seq = 0  # guarded-by: _buffer_lock
+
+
+def _buffer_locked() -> deque:  # holds-lock: _buffer_lock
+    global _buffer
+    if _buffer is None:
+        _buffer = deque(maxlen=env.trace_buffer_size())
+    return _buffer
+
+
+def buffer_seq() -> int:
+    """Monotonic sequence of the newest recorded span (0 when none) —
+    lets a caller bracket a window of interest (bench does)."""
+    with _buffer_lock:
+        return _seq
+
+
+def snapshot_spans() -> list[dict]:
+    """A consistent copy of the ring buffer's current contents."""
+    with _buffer_lock:
+        return list(_buffer_locked())
+
+
+def _record(rec: dict) -> None:
+    """Export one finished span/event: ring buffer + histogram (+ the
+    JSONL journal when configured)."""
+    global _seq
+    with _buffer_lock:
+        _seq += 1
+        rec["seq"] = _seq
+        _buffer_locked().append(rec)
+    _observe(rec)
+    _journal_write(rec)
+
+
+def _observe(rec: dict) -> None:
+    """Feed one span record into the Prometheus registry (shared by
+    locally recorded spans and worker spans absorbed by the
+    supervisor)."""
+    if rec.get("kind") == "event":
+        with _metrics_lock:
+            _counters[rec["name"]] = _counters.get(rec["name"], 0) + 1
+    else:
+        observe_phase(rec["name"], float(rec.get("dur", 0.0)))
+
+
+def absorb(records: list[dict]) -> None:
+    """Observe worker-posted span records into THIS process's
+    Prometheus registry (the supervisor calls this on PUT /trace so
+    its /metrics covers both sides of the rescale) without
+    re-buffering or re-journaling them."""
+    for rec in records:
+        if isinstance(rec, dict) and "name" in rec:
+            _observe(rec)
+
+
+@contextmanager
+def span(name: str, traceparent: str | None = None, **attrs):
+    """Record a monotonic-clock span around the ``with`` body.
+
+    ``traceparent`` pins the span to an explicit foreign context (the
+    supervisor recording epoch spans under a job's rescale trace);
+    otherwise the span nests under this thread's innermost open span,
+    else the process root. Yields a mutable attrs dict so the body can
+    annotate outcomes (hit/miss, status, attempts). Exceptions
+    propagate; the span still records, flagged ``error``."""
+    if not enabled():
+        yield attrs
+        return
+    parsed = parse_traceparent(traceparent) if traceparent else None
+    if parsed is not None:
+        trace_id, parent_id = parsed
+    else:
+        stack = getattr(_tls, "stack", None)
+        if stack:
+            trace_id, parent_id = stack[-1]
+        else:
+            trace_id, parent_id = _root_context()
+    span_id = _rand_hex(8)
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    _tls.stack.append((trace_id, span_id))
+    wall = time.time()
+    start = time.monotonic()
+    try:
+        yield attrs
+    except BaseException:
+        attrs["error"] = True
+        raise
+    finally:
+        dur = time.monotonic() - start
+        _tls.stack.pop()
+        _record(
+            {
+                "name": name,
+                "trace": trace_id,
+                "span": span_id,
+                "parent": parent_id,
+                "ts": wall,
+                "dur": dur,
+                "attrs": dict(attrs),
+                "pid": os.getpid(),
+                "tid": threading.current_thread().name,
+                "inc": _inc(),
+            }
+        )
+
+
+def record_span(
+    name: str,
+    duration_s: float,
+    traceparent: str | None = None,
+    ts: float | None = None,
+    **attrs,
+) -> None:
+    """Record an already-measured span (the supervisor's epoch
+    prepare→commit window is timed by the state layer, not a ``with``
+    block)."""
+    if not enabled():
+        return
+    parsed = parse_traceparent(traceparent) if traceparent else None
+    if parsed is not None:
+        trace_id, parent_id = parsed
+    else:
+        trace_id, parent_id = _root_context()
+    _record(
+        {
+            "name": name,
+            "trace": trace_id,
+            "span": _rand_hex(8),
+            "parent": parent_id,
+            "ts": time.time() - duration_s if ts is None else ts,
+            "dur": max(float(duration_s), 0.0),
+            "attrs": dict(attrs),
+            "pid": os.getpid(),
+            "tid": threading.current_thread().name,
+            "inc": _inc(),
+        }
+    )
+
+
+def event(name: str, traceparent: str | None = None, **attrs) -> None:
+    """Record a zero-duration point event and bump its Prometheus
+    counter (``adaptdl_trace_events_total{event=...}``) — retries,
+    circuit opens, cache hits/misses, epoch prepares."""
+    if not enabled():
+        return
+    parsed = parse_traceparent(traceparent) if traceparent else None
+    if parsed is not None:
+        trace_id, parent_id = parsed
+    else:
+        stack = getattr(_tls, "stack", None)
+        if stack:
+            trace_id, parent_id = stack[-1]
+        else:
+            trace_id, parent_id = _root_context()
+    _record(
+        {
+            "name": name,
+            "kind": "event",
+            "trace": trace_id,
+            "span": _rand_hex(8),
+            "parent": parent_id,
+            "ts": time.time(),
+            "dur": 0.0,
+            "attrs": dict(attrs),
+            "pid": os.getpid(),
+            "tid": threading.current_thread().name,
+            "inc": _inc(),
+        }
+    )
+
+
+# ---- pending spans (cross-callsite: restart -> first step) -----------
+
+_pending_lock = threading.Lock()
+# name -> (wall_start, monotonic_start, attrs)
+_pending: dict[str, tuple[float, float, dict]] = {}  # guarded-by: _pending_lock
+
+
+def begin_pending(name: str, **attrs) -> None:
+    """Open a span whose end lives at a different callsite (bootstrap
+    opens ``restart.first_step``; the first ``metrics.profile_step``
+    closes it)."""
+    if not enabled():
+        return
+    with _pending_lock:
+        _pending[name] = (time.time(), time.monotonic(), dict(attrs))
+
+
+def end_pending(name: str, **attrs) -> bool:
+    """Close a :func:`begin_pending` span; False when none is open
+    (every later step hits this cheap path)."""
+    if not enabled():
+        return False
+    # Lock-free emptiness probe: this runs once per TRAINING STEP
+    # (metrics.profile_step), and after the first step there is never
+    # a pending span — don't pay a lock acquisition per step for it.
+    # The race is benign: a begin_pending concurrent with this read
+    # only delays the close to the next step.
+    # graftcheck: disable=GC101 (lock-free emptiness probe by design;
+    # the mutation path below re-checks under the lock)
+    if not _pending:
+        return False
+    with _pending_lock:
+        opened = _pending.pop(name, None)
+    if opened is None:
+        return False
+    wall, start, open_attrs = opened
+    open_attrs.update(attrs)
+    record_span(
+        name, time.monotonic() - start, ts=wall, **open_attrs
+    )
+    return True
+
+
+# ---- exporter 1: per-job JSONL structured event journal --------------
+
+_journal_lock = threading.Lock()
+_journal_fh = None  # guarded-by: _journal_lock
+_journal_target: str | None = None  # guarded-by: _journal_lock
+# Lock-free latch: once the journal is known to be unconfigured, every
+# later record skips the env lookups entirely (set once, cleared only
+# by _reset_state — a benign single-assignment race).
+_journal_disabled = False
+
+
+def _sanitize(job: str) -> str:
+    return "".join(
+        c if c.isalnum() or c in "-_." else "-" for c in job
+    )
+
+
+def journal_path() -> str | None:
+    """The trace journal file this process appends to, or None when
+    ``ADAPTDL_TRACE_DIR`` is unset."""
+    directory = env.trace_dir()
+    if not directory:
+        return None
+    job = env.job_id() or f"proc-{os.getpid()}"
+    return os.path.join(directory, f"trace-{_sanitize(job)}.jsonl")
+
+
+def _journal_write(rec: dict) -> None:
+    """Append one finished span to the JSONL journal (flush per line,
+    no fsync — the journal is observability, not a durability
+    contract; a span lost to a power cut is not a torn checkpoint).
+    Best-effort: a full disk must never fail training."""
+    global _journal_fh, _journal_target, _journal_disabled
+    if _journal_disabled:
+        return
+    path = journal_path()
+    if path is None:
+        _journal_disabled = True
+        return
+    try:
+        with _journal_lock:
+            if _journal_fh is None or _journal_target != path:
+                if _journal_fh is not None:
+                    _journal_fh.close()
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                # A killed predecessor may have left a torn final
+                # line; start ours on a fresh line so its partial
+                # record can't swallow our first one.
+                needs_newline = False
+                try:
+                    with open(path, "rb") as existing:
+                        existing.seek(0, os.SEEK_END)
+                        if existing.tell() > 0:
+                            existing.seek(-1, os.SEEK_END)
+                            needs_newline = existing.read(1) != b"\n"
+                except OSError:
+                    needs_newline = False
+                _journal_fh = open(path, "a", encoding="utf-8")
+                _journal_target = path
+                if needs_newline:
+                    _journal_fh.write("\n")
+            _journal_fh.write(json.dumps(rec, sort_keys=True) + "\n")
+            _journal_fh.flush()
+    except OSError:  # noqa: BLE001 - observability is best-effort
+        LOG.debug("trace journal append failed", exc_info=True)
+
+
+def read_journal(path: str) -> list[dict]:
+    """Parse a trace journal. A torn final line (the process died
+    mid-append) is dropped; a torn line mid-file (a killed
+    incarnation's partial record, with later incarnations' records
+    after it) is skipped so the successors' spans still read back —
+    the file is append-only and shared across incarnations."""
+    records: list[dict] = []
+    try:
+        with open(path, "rb") as f:
+            for raw in f:
+                if not raw.endswith(b"\n"):
+                    break  # torn tail: nothing follows
+                line = raw.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    continue  # torn mid-file record: skip, keep going
+                if isinstance(rec, dict):
+                    records.append(rec)
+    except OSError:
+        return []
+    return records
+
+
+# ---- exporter 2: Chrome/Perfetto trace_event JSON --------------------
+
+
+def _tid_int(name: str) -> int:
+    """Stable small integer for a thread name (trace_event wants
+    numeric tids)."""
+    return zlib.crc32(name.encode()) & 0x7FFFFFFF
+
+
+def to_perfetto(records: list[dict]) -> dict:
+    """Chrome ``trace_event`` JSON (the object form) from span
+    records: complete ("X") events for spans, instant ("i") for
+    events, plus process/thread-name metadata — loadable in
+    chrome://tracing and ui.perfetto.dev."""
+    events: list[dict] = []
+    named: set[tuple[int, int]] = set()
+    for rec in records:
+        pid = int(rec.get("pid", 0))
+        tid = _tid_int(str(rec.get("tid", "main")))
+        if (pid, tid) not in named:
+            named.add((pid, tid))
+            events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": str(rec.get("tid", "main"))},
+                }
+            )
+            events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {
+                        "name": f"pid {pid} (inc {rec.get('inc', 0)})"
+                    },
+                }
+            )
+        args = dict(rec.get("attrs") or {})
+        args["trace_id"] = rec.get("trace", "")
+        args["span_id"] = rec.get("span", "")
+        base = {
+            "name": rec["name"],
+            "cat": "adaptdl",
+            "pid": pid,
+            "tid": tid,
+            "ts": float(rec.get("ts", 0.0)) * 1e6,
+            "args": args,
+        }
+        if rec.get("kind") == "event":
+            base["ph"] = "i"
+            base["s"] = "p"
+        else:
+            base["ph"] = "X"
+            base["dur"] = max(float(rec.get("dur", 0.0)), 0.0) * 1e6
+        events.append(base)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ---- exporter 3: Prometheus histograms + counters --------------------
+
+# Per-phase latency buckets. RPC attempts live in the millisecond
+# band; checkpoint/restore/compile phases in the 10ms-60s band.
+_DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+_RPC_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+    0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _buckets_for(phase: str) -> tuple[float, ...]:
+    return _RPC_BUCKETS if phase.startswith("rpc.") else _DEFAULT_BUCKETS
+
+
+class Histogram:
+    """One Prometheus histogram series: cumulative bucket counts, sum,
+    count. Mutated under the registry lock."""
+
+    __slots__ = ("buckets", "counts", "total", "count")
+
+    def __init__(self, buckets: tuple[float, ...]):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # +Inf tail
+        self.total = 0.0
+        self.count = 0
+
+    def observe_locked(self, value: float) -> None:  # holds-lock: _metrics_lock
+        self.counts[bisect_left(self.buckets, value)] += 1
+        self.total += value
+        self.count += 1
+
+
+_metrics_lock = threading.Lock()
+_histograms: dict[str, Histogram] = {}  # guarded-by: _metrics_lock
+_counters: dict[str, int] = {}  # guarded-by: _metrics_lock
+
+
+def observe_phase(phase: str, seconds: float) -> None:
+    with _metrics_lock:
+        hist = _histograms.get(phase)
+        if hist is None:
+            hist = Histogram(_buckets_for(phase))
+            _histograms[phase] = hist
+        hist.observe_locked(max(float(seconds), 0.0))
+
+
+def escape_label_value(value: str) -> str:
+    """Prometheus exposition-format label escaping: backslash, double
+    quote, and newline."""
+    return (
+        str(value)
+        .replace("\\", r"\\")
+        .replace('"', r"\"")
+        .replace("\n", r"\n")
+    )
+
+
+def _fmt_value(value) -> str:
+    if isinstance(value, bool):
+        return str(int(value))
+    if isinstance(value, int):
+        return str(value)
+    as_float = float(value)
+    if as_float == int(as_float) and abs(as_float) < 1e15:
+        return str(int(as_float))
+    return repr(as_float)
+
+
+def _fmt_le(bound: float) -> str:
+    return "+Inf" if bound == float("inf") else _fmt_value(bound)
+
+
+class PromBuilder:
+    """Prometheus text-exposition builder that conformance comes free
+    from: every family gets exactly one ``# HELP`` and ``# TYPE``
+    line, samples sit under their family, and label values are
+    escaped. The supervisor's /metrics is assembled with this, so a
+    malformed series cannot be emitted by construction."""
+
+    def __init__(self):
+        self._order: list[str] = []
+        # family -> (type, help, [sample lines])
+        self._families: dict[str, tuple[str, str, list[str]]] = {}
+
+    def family(self, name: str, mtype: str, help_text: str) -> None:
+        if name not in self._families:
+            self._order.append(name)
+            self._families[name] = (mtype, help_text, [])
+
+    def sample(
+        self,
+        family: str,
+        labels: dict | None = None,
+        value=0,
+        suffix: str = "",
+    ) -> None:
+        if family not in self._families:
+            raise ValueError(
+                f"sample for undeclared family {family!r} — declare "
+                "it with family() first (HELP/TYPE are mandatory)"
+            )
+        label_text = ""
+        if labels:
+            inner = ",".join(
+                f'{key}="{escape_label_value(val)}"'
+                for key, val in labels.items()
+            )
+            label_text = "{" + inner + "}"
+        self._families[family][2].append(
+            f"{family}{suffix}{label_text} {_fmt_value(value)}"
+        )
+
+    def histogram(
+        self, family: str, labels: dict, hist: Histogram
+    ) -> None:
+        cumulative = 0
+        for bound, count in zip(
+            tuple(hist.buckets) + (float("inf"),), hist.counts
+        ):
+            cumulative += count
+            self.sample(
+                family,
+                dict(labels, le=_fmt_le(bound)),
+                cumulative,
+                suffix="_bucket",
+            )
+        self.sample(family, labels, hist.total, suffix="_sum")
+        self.sample(family, labels, hist.count, suffix="_count")
+
+    def render(self) -> str:
+        lines: list[str] = []
+        for name in self._order:
+            mtype, help_text, samples = self._families[name]
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {mtype}")
+            lines.extend(samples)
+        return "\n".join(lines) + "\n"
+
+
+def render_into(builder: PromBuilder) -> None:
+    """Add the trace registry's histogram + counter families to a
+    metrics exposition (the supervisor's /metrics calls this)."""
+    builder.family(
+        "adaptdl_trace_phase_seconds",
+        "histogram",
+        "Duration of traced rescale-lifecycle phases, by span name.",
+    )
+    builder.family(
+        "adaptdl_trace_events_total",
+        "counter",
+        "Traced point events (retries, circuit opens, cache "
+        "hits/misses, epoch transitions), by event name.",
+    )
+    with _metrics_lock:
+        hists = {
+            phase: (
+                hist.buckets, list(hist.counts), hist.total, hist.count
+            )
+            for phase, hist in _histograms.items()
+        }
+        counters = dict(_counters)
+    for phase in sorted(hists):
+        buckets, counts, total, count = hists[phase]
+        snap = Histogram(buckets)
+        snap.counts, snap.total, snap.count = counts, total, count
+        builder.histogram(
+            "adaptdl_trace_phase_seconds", {"phase": phase}, snap
+        )
+    for name in sorted(counters):
+        builder.sample(
+            "adaptdl_trace_events_total",
+            {"event": name},
+            counters[name],
+        )
+
+
+def prometheus_lines() -> str:
+    """The trace families as a standalone exposition (tests; embedded
+    use goes through :func:`render_into`)."""
+    builder = PromBuilder()
+    render_into(builder)
+    return builder.render()
+
+
+# ---- worker -> supervisor flush --------------------------------------
+
+
+def flush_to_supervisor(job_id: str | None = None) -> bool:
+    """Best-effort PUT of this process's not-yet-flushed spans to the
+    supervisor's per-job trace store (piggybacked on the sched-hints
+    cadence). The flush request itself is untraced — tracing the
+    flush would generate a span per flush, forever."""
+    global _flushed_seq
+    if not enabled():
+        return False
+    url = env.supervisor_url()
+    job_id = job_id if job_id is not None else env.job_id()
+    if not url or not job_id:
+        return False
+    with _buffer_lock:
+        pending = [
+            rec
+            for rec in _buffer_locked()
+            if rec["seq"] > _flushed_seq
+        ]
+    if not pending:
+        return True
+    from adaptdl_tpu import rpc
+
+    try:
+        response = rpc.default_client().put(
+            f"{url}/trace/{job_id}",
+            endpoint=f"trace/{job_id}",
+            json={"spans": pending},
+            timeout=(0.5, 5),
+            attempts=1,
+            circuit_threshold=3,
+            circuit_cooldown=60.0,
+            traced=False,
+        )
+        response.raise_for_status()
+    except Exception as exc:  # noqa: BLE001 - best effort by design
+        LOG.debug("trace flush failed: %s", exc)
+        return False
+    with _buffer_lock:
+        _flushed_seq = max(
+            _flushed_seq, max(rec["seq"] for rec in pending)
+        )
+    return True
+
+
+# ---- waterfall / summaries -------------------------------------------
+
+
+def phase_summary(records: list[dict]) -> dict[str, float]:
+    """name -> median duration (seconds) over span records — the
+    per-phase breakdown bench.py emits next to its stopwatch
+    numbers."""
+    by_name: dict[str, list[float]] = {}
+    for rec in records:
+        if rec.get("kind") == "event":
+            continue
+        by_name.setdefault(rec["name"], []).append(
+            float(rec.get("dur", 0.0))
+        )
+    summary = {}
+    for name, durs in by_name.items():
+        durs.sort()
+        mid = len(durs) // 2
+        if len(durs) % 2:
+            summary[name] = durs[mid]
+        else:
+            summary[name] = (durs[mid - 1] + durs[mid]) / 2.0
+    return summary
+
+
+def render_waterfall(records: list[dict], width: int = 32) -> str:
+    """ASCII phase waterfall of one trace's spans, ordered by wall
+    start (``adaptdl-tpu trace`` prints this)."""
+    spans = [r for r in records if r.get("kind") != "event"]
+    if not spans:
+        return "(no spans)"
+    spans.sort(key=lambda r: float(r.get("ts", 0.0)))
+    t0 = float(spans[0]["ts"])
+    horizon = max(
+        float(r["ts"]) + float(r.get("dur", 0.0)) for r in spans
+    ) - t0 or 1e-9
+    lines = [
+        f"{'PHASE':<28} {'SIDE':<12} {'START(ms)':>10} "
+        f"{'DUR(ms)':>10}  TIMELINE"
+    ]
+    for rec in spans:
+        offset = float(rec["ts"]) - t0
+        dur = float(rec.get("dur", 0.0))
+        lead = int(width * offset / horizon)
+        bar = max(int(width * dur / horizon), 1)
+        side = f"pid{rec.get('pid', '?')}/i{rec.get('inc', 0)}"
+        lines.append(
+            f"{rec['name']:<28} {side:<12} {offset * 1e3:>10.2f} "
+            f"{dur * 1e3:>10.2f}  "
+            f"{' ' * lead}{'#' * min(bar, width - lead or 1)}"
+        )
+    return "\n".join(lines)
+
+
+# ---- test isolation --------------------------------------------------
+
+
+def _reset_state() -> None:
+    """Drop all trace state (tests): buffer, registry, context,
+    journal handle, enablement cache."""
+    global _buffer, _seq, _flushed_seq, _enabled, _incarnation
+    global _trace_id, _root_span_id, _journal_fh, _journal_target
+    global _journal_disabled
+    with _buffer_lock:
+        _buffer = None
+        _seq = 0
+        _flushed_seq = 0
+    with _metrics_lock:
+        _histograms.clear()
+        _counters.clear()
+    with _ctx_lock:
+        _trace_id = None
+        _root_span_id = None
+    with _pending_lock:
+        _pending.clear()
+    with _journal_lock:
+        if _journal_fh is not None:
+            _journal_fh.close()
+        _journal_fh = None
+        _journal_target = None
+    _journal_disabled = False
+    _enabled = None
+    _incarnation = None
+    if hasattr(_tls, "stack"):
+        _tls.stack = []

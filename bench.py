@@ -611,7 +611,17 @@ def _bench_rescale_latency(trainer_factory, dataset, init_bsz, trials=3):
     through the persistent AOT-executable cache the way a real
     restarted incarnation with shared storage would. The persistent
     XLA compilation cache is also enabled for the phase (as
-    initialize_job does in production)."""
+    initialize_job does in production).
+
+    All phase timing is ``time.monotonic()`` (wall-clock deltas are
+    skew-prone under NTP slew); returns ``(p50, breakdown,
+    trace_summary)`` where ``trace_summary`` is the graftscope
+    per-phase view of the same trials — median span durations keyed by
+    span name (ckpt.snapshot / ckpt.write / ckpt.restore / aot.lookup
+    / aot.compile) plus the span count — emitted on the BENCH JSON
+    line as ``rescale_trace`` alongside the existing stopwatch
+    ``rescale_breakdown``, so the two instruments cross-check each
+    other and BENCH_*.json stays comparable round-over-round."""
     import tempfile
 
     from adaptdl_tpu import checkpoint as ckpt_mod
@@ -663,7 +673,11 @@ def _rescale_trials(trainer_factory, dataset, init_bsz, trials=3):
 
     from adaptdl_tpu import aot_cache
     from adaptdl_tpu import checkpoint as ckpt_mod
+    from adaptdl_tpu import trace
 
+    # Bracket the trials in the trace buffer so the summary covers
+    # exactly these spans (earlier phases recorded their own).
+    trace_start_seq = trace.buffer_seq()
     times = []
     parts: dict[str, list] = {
         "snapshot_s": [], "write_s": [],
@@ -739,11 +753,25 @@ def _rescale_trials(trainer_factory, dataset, init_bsz, trials=3):
         key: round(float(np.median(vals)), 4)
         for key, vals in parts.items()
     }
+    trial_spans = [
+        rec
+        for rec in trace.snapshot_spans()
+        if rec.get("seq", 0) > trace_start_seq
+    ]
+    trace_summary = {
+        "phases": {
+            name: round(seconds, 4)
+            for name, seconds in sorted(
+                trace.phase_summary(trial_spans).items()
+            )
+        },
+        "span_count": len(trial_spans),
+    }
     _log(
         f"rescale: trials={['%.2f' % t for t in times]} p50={p50:.2f}s "
-        f"breakdown={breakdown}"
+        f"breakdown={breakdown} trace={trace_summary['phases']}"
     )
-    return p50, breakdown
+    return p50, breakdown, trace_summary
 
 
 def main(quick: bool = False):
@@ -955,11 +983,12 @@ def main(quick: bool = False):
     except Exception as exc:  # noqa: BLE001 - optional metric
         _log(f"flash bench failed: {exc}")
     rescale_breakdown = None
+    rescale_trace = None
     try:
         if _remaining() > 60:
             metrics._reset_state()
-            rescale_p50, rescale_breakdown = _bench_rescale_latency(
-                make_trainer, dataset, init_bsz
+            rescale_p50, rescale_breakdown, rescale_trace = (
+                _bench_rescale_latency(make_trainer, dataset, init_bsz)
             )
     except Exception as exc:  # noqa: BLE001 - optional metric
         _log(f"rescale bench failed: {exc}")
@@ -979,6 +1008,8 @@ def main(quick: bool = False):
         result["rescale_p50_s"] = round(rescale_p50, 3)
     if rescale_breakdown is not None:
         result["rescale_breakdown"] = rescale_breakdown
+    if rescale_trace is not None:
+        result["rescale_trace"] = rescale_trace
     print(json.dumps(result))
 
 

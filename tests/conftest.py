@@ -24,7 +24,7 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
-from adaptdl_tpu import checkpoint  # noqa: E402
+from adaptdl_tpu import checkpoint, trace  # noqa: E402
 
 # Re-exported fixture: forked multi-replica elastic test harness.
 from tests.elastic_harness import elastic_multiprocessing  # noqa: E402, F401
@@ -32,7 +32,10 @@ from tests.elastic_harness import elastic_multiprocessing  # noqa: E402, F401
 
 @pytest.fixture(autouse=True)
 def _clean_state_registry():
-    """Isolate the global State registry between tests."""
+    """Isolate the global State registry (and the graftscope trace
+    buffer/registry/context) between tests."""
     checkpoint._reset_registry()
+    trace._reset_state()
     yield
     checkpoint._reset_registry()
+    trace._reset_state()

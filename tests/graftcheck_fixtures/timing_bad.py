@@ -1,0 +1,25 @@
+"""Fixture: GC701/GC702 violations (timing discipline)."""
+
+import time
+
+from adaptdl_tpu import trace  # noqa: F401 - opts into the discipline
+
+
+def wall_clock_duration():
+    start = time.time()
+    work()
+    return time.time() - start  # GC701
+
+
+def perf_counter_stopwatch():
+    start = time.perf_counter()  # GC702
+    work()
+    return start
+
+
+def inline_delta(deadline):
+    return deadline - time.time()  # GC701
+
+
+def work():
+    pass

@@ -1,0 +1,34 @@
+"""Fixture: clean timing in a trace-instrumented module."""
+
+import time
+
+from adaptdl_tpu import trace
+
+
+def traced_duration():
+    with trace.span("fixture.phase"):
+        work()
+
+
+def monotonic_duration():
+    start = time.monotonic()
+    work()
+    return time.monotonic() - start
+
+
+def wall_clock_timestamp():
+    # A timestamp (not duration math) is fine.
+    return {"ts": time.time()}
+
+
+def suppressed_wall_delta(path_mtime):
+    # graftcheck: disable=GC701 (file mtimes are wall-clock values)
+    return time.time() - path_mtime
+
+
+def untimed_module_without_instrumentation():
+    work()
+
+
+def work():
+    pass

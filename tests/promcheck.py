@@ -1,0 +1,319 @@
+"""Strict Prometheus text-exposition-format parser for conformance
+tests.
+
+The supervisor's /metrics is scraped by real Prometheus in
+production; a malformed series (missing TYPE, unescaped label value,
+non-cumulative histogram buckets) silently drops data at scrape time.
+This module parses the format by the book — prometheus.io/docs/
+instrumenting/exposition_formats/ — and raises ``ConformanceError``
+with the offending line on any violation, so the conformance test in
+tests/test_trace.py fails loudly instead of a dashboard going blank.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r"\s+(?P<value>\S+)(?:\s+(?P<ts>-?\d+))?$"
+)
+
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+class ConformanceError(AssertionError):
+    pass
+
+
+def _parse_labels(text: str, line: str) -> dict[str, str]:
+    """Parse the inside of ``{...}`` honoring the escape rules
+    (``\\\\``, ``\\"``, ``\\n`` inside quoted values)."""
+    labels: dict[str, str] = {}
+    i = 0
+    n = len(text)
+    while i < n:
+        j = i
+        while j < n and text[j] not in "=,":
+            j += 1
+        name = text[i:j].strip()
+        if j >= n or text[j] != "=":
+            raise ConformanceError(f"label without '=' in: {line}")
+        if not LABEL_NAME_RE.match(name):
+            raise ConformanceError(
+                f"invalid label name {name!r} in: {line}"
+            )
+        j += 1
+        if j >= n or text[j] != '"':
+            raise ConformanceError(
+                f"unquoted label value for {name!r} in: {line}"
+            )
+        j += 1
+        value_chars: list[str] = []
+        while True:
+            if j >= n:
+                raise ConformanceError(
+                    f"unterminated label value in: {line}"
+                )
+            c = text[j]
+            if c == "\\":
+                if j + 1 >= n:
+                    raise ConformanceError(
+                        f"dangling escape in: {line}"
+                    )
+                esc = text[j + 1]
+                if esc == "n":
+                    value_chars.append("\n")
+                elif esc in ('"', "\\"):
+                    value_chars.append(esc)
+                else:
+                    raise ConformanceError(
+                        f"invalid escape \\{esc} in: {line}"
+                    )
+                j += 2
+                continue
+            if c == '"':
+                j += 1
+                break
+            if c == "\n":
+                raise ConformanceError(
+                    f"raw newline in label value in: {line}"
+                )
+            value_chars.append(c)
+            j += 1
+        if name in labels:
+            raise ConformanceError(
+                f"duplicate label {name!r} in: {line}"
+            )
+        labels[name] = "".join(value_chars)
+        if j < n:
+            if text[j] != ",":
+                raise ConformanceError(
+                    f"junk after label value in: {line}"
+                )
+            j += 1
+        i = j
+    return labels
+
+
+def _parse_value(raw: str, line: str) -> float:
+    if raw == "+Inf":
+        return math.inf
+    if raw == "-Inf":
+        return -math.inf
+    if raw == "NaN":
+        return math.nan
+    try:
+        return float(raw)
+    except ValueError:
+        raise ConformanceError(f"unparseable value {raw!r} in: {line}")
+
+
+def _family_of(sample_name: str, declared: dict[str, str]) -> str | None:
+    """The declared family a sample belongs to: exact match, or the
+    histogram/summary child series (_bucket/_sum/_count)."""
+    if sample_name in declared:
+        return sample_name
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix):
+            base = sample_name[: -len(suffix)]
+            if base in declared:
+                return base
+    return None
+
+
+def parse_exposition(text: str) -> dict:
+    """Parse (and structurally validate) one exposition payload.
+
+    Returns ``{"families": {name: {"type", "help", "samples":
+    [(sample_name, labels, value)]}}}``. Raises
+    :class:`ConformanceError` on any violation:
+
+    - text must end with a newline (``\\n``);
+    - every ``# TYPE``/``# HELP`` well-formed, at most one each per
+      family, TYPE before any of the family's samples;
+    - every sample belongs to a declared family (histogram/summary
+      children included) and carries both HELP and TYPE;
+    - label names/values lex per the format's escape rules;
+    - values parse as float (``+Inf``/``-Inf``/``NaN`` allowed).
+    """
+    if not text.endswith("\n"):
+        raise ConformanceError("exposition must end with a newline")
+    declared_type: dict[str, str] = {}
+    declared_help: dict[str, str] = {}
+    sampled: dict[str, list] = {}
+    for line in text.split("\n"):
+        if line == "":
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 2 or parts[1] not in ("HELP", "TYPE"):
+                # A plain comment is legal.
+                continue
+            if len(parts) < 3:
+                raise ConformanceError(f"malformed comment: {line}")
+            kind, name = parts[1], parts[2]
+            if not NAME_RE.match(name):
+                raise ConformanceError(
+                    f"invalid metric name in comment: {line}"
+                )
+            if kind == "TYPE":
+                mtype = parts[3].strip() if len(parts) > 3 else ""
+                if mtype not in _TYPES:
+                    raise ConformanceError(
+                        f"invalid TYPE {mtype!r}: {line}"
+                    )
+                if name in declared_type:
+                    raise ConformanceError(
+                        f"duplicate TYPE for {name}: {line}"
+                    )
+                if name in sampled:
+                    raise ConformanceError(
+                        f"TYPE for {name} after its samples: {line}"
+                    )
+                declared_type[name] = mtype
+            else:
+                if name in declared_help:
+                    raise ConformanceError(
+                        f"duplicate HELP for {name}: {line}"
+                    )
+                declared_help[name] = (
+                    parts[3] if len(parts) > 3 else ""
+                )
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ConformanceError(f"unparseable sample line: {line}")
+        sample_name = m.group("name")
+        labels = (
+            _parse_labels(m.group("labels"), line)
+            if m.group("labels") is not None
+            else {}
+        )
+        value = _parse_value(m.group("value"), line)
+        family = _family_of(sample_name, declared_type)
+        if family is None:
+            raise ConformanceError(
+                f"sample without a preceding # TYPE: {line}"
+            )
+        sampled.setdefault(family, []).append(
+            (sample_name, labels, value)
+        )
+    for family in sampled:
+        if family not in declared_help:
+            raise ConformanceError(f"family {family} has no # HELP")
+    return {
+        "families": {
+            name: {
+                "type": declared_type[name],
+                "help": declared_help.get(name, ""),
+                "samples": sampled.get(name, []),
+            }
+            for name in declared_type
+        }
+    }
+
+
+def validate_exposition(text: str) -> dict:
+    """Full conformance check: parse, then verify per-type semantic
+    invariants (histogram bucket monotonicity, +Inf == _count,
+    _sum/_count presence; counter non-negativity)."""
+    parsed = parse_exposition(text)
+    for name, family in parsed["families"].items():
+        mtype = family["type"]
+        samples = family["samples"]
+        if mtype == "histogram":
+            _validate_histogram(name, samples)
+        elif mtype == "summary":
+            _validate_summary(name, samples)
+        elif mtype == "counter":
+            for sample_name, labels, value in samples:
+                if sample_name != name:
+                    raise ConformanceError(
+                        f"counter {name} has child series "
+                        f"{sample_name}"
+                    )
+                if not (value >= 0):
+                    raise ConformanceError(
+                        f"counter {name}{labels} is negative: {value}"
+                    )
+    return parsed
+
+
+def _series_key(labels: dict, drop: tuple[str, ...] = ()) -> tuple:
+    return tuple(
+        sorted(
+            (k, v) for k, v in labels.items() if k not in drop
+        )
+    )
+
+
+def _validate_histogram(name: str, samples: list) -> None:
+    buckets: dict[tuple, list[tuple[float, float]]] = {}
+    sums: dict[tuple, float] = {}
+    counts: dict[tuple, float] = {}
+    for sample_name, labels, value in samples:
+        if sample_name == f"{name}_bucket":
+            if "le" not in labels:
+                raise ConformanceError(
+                    f"{name}_bucket without an le label"
+                )
+            le = labels["le"]
+            bound = (
+                math.inf if le == "+Inf" else _parse_value(le, le)
+            )
+            buckets.setdefault(_series_key(labels, ("le",)), []).append(
+                (bound, value)
+            )
+        elif sample_name == f"{name}_sum":
+            sums[_series_key(labels)] = value
+        elif sample_name == f"{name}_count":
+            counts[_series_key(labels)] = value
+        else:
+            raise ConformanceError(
+                f"histogram {name} has stray series {sample_name}"
+            )
+    for key, series in buckets.items():
+        series.sort(key=lambda bv: bv[0])
+        if not series or series[-1][0] != math.inf:
+            raise ConformanceError(
+                f"histogram {name}{dict(key)} lacks a +Inf bucket"
+            )
+        last = -math.inf
+        for bound, value in series:
+            if value < last:
+                raise ConformanceError(
+                    f"histogram {name}{dict(key)} buckets are not "
+                    f"cumulative at le={bound}"
+                )
+            last = value
+        if key not in counts:
+            raise ConformanceError(
+                f"histogram {name}{dict(key)} lacks _count"
+            )
+        if key not in sums:
+            raise ConformanceError(
+                f"histogram {name}{dict(key)} lacks _sum"
+            )
+        if series[-1][1] != counts[key]:
+            raise ConformanceError(
+                f"histogram {name}{dict(key)}: +Inf bucket "
+                f"{series[-1][1]} != _count {counts[key]}"
+            )
+
+
+def _validate_summary(name: str, samples: list) -> None:
+    for sample_name, labels, _value in samples:
+        if sample_name == name:
+            if "quantile" not in labels:
+                raise ConformanceError(
+                    f"summary {name} bare sample without quantile"
+                )
+        elif sample_name not in (f"{name}_sum", f"{name}_count"):
+            raise ConformanceError(
+                f"summary {name} has stray series {sample_name}"
+            )

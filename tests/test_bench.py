@@ -88,7 +88,7 @@ def test_rescale_breakdown_sums_consistently(tmp_path, monkeypatch):
             mesh=create_mesh(devices=jax.devices()[:1]),
         )
 
-    p50, breakdown = bench_mod._bench_rescale_latency(
+    p50, breakdown, trace_summary = bench_mod._bench_rescale_latency(
         make_trainer, dataset, 8, trials=1
     )
     assert p50 > 0
@@ -104,3 +104,14 @@ def test_rescale_breakdown_sums_consistently(tmp_path, monkeypatch):
         + breakdown["first_step_s"]
     )
     assert serial <= p50 + 1e-6, (serial, p50, breakdown)
+    # The graftscope view of the same trials rides alongside: the
+    # instrumented checkpoint pipeline recorded snapshot/write/restore
+    # spans, and the two instruments agree on the snapshot phase to
+    # within the span's own overhead.
+    phases = trace_summary["phases"]
+    assert trace_summary["span_count"] > 0
+    for name in ("ckpt.snapshot", "ckpt.write", "ckpt.restore"):
+        assert name in phases, phases
+    assert phases["ckpt.snapshot"] == pytest.approx(
+        breakdown["snapshot_s"], abs=0.05
+    )

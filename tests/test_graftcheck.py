@@ -121,6 +121,57 @@ def test_journal_discipline_good():
     assert run_on("journaled_good.py") == []
 
 
+def test_timing_discipline_bad():
+    findings = run_on("timing_bad.py")
+    assert rule_lines(findings, "GC701") == [11, 21]
+    assert rule_lines(findings, "GC702") == [15]
+    assert {f.rule for f in findings} == {"GC701", "GC702"}
+
+
+def test_timing_discipline_good():
+    assert run_on("timing_good.py") == []
+
+
+def test_timing_discipline_only_binds_instrumented_modules(tmp_path):
+    """A module with wall-clock duration math but NO adaptdl_tpu.trace
+    import is outside the discipline — the pass must not fire on
+    arbitrary code."""
+    plain = tmp_path / "plain.py"
+    plain.write_text(
+        "import time\n\n\n"
+        "def f():\n"
+        "    start = time.time()\n"
+        "    return time.time() - start\n"
+    )
+    ctx = Context(root=str(tmp_path))
+    assert analyze_paths([str(plain)], ALL_PASSES, ctx) == []
+
+
+def test_trace_instrumented_modules_stay_instrumented():
+    """The GC7xx discipline only has teeth while the rescale-lifecycle
+    modules keep importing trace: a refactor that silently drops the
+    instrumentation (and with it the spans AND the timing lint) must
+    fail here."""
+    from tools.graftcheck.core import parse_file
+    from tools.graftcheck.passes.timing_discipline import (
+        _imports_trace,
+    )
+
+    for rel in (
+        "adaptdl_tpu/rpc.py",
+        "adaptdl_tpu/checkpoint.py",
+        "adaptdl_tpu/aot_cache.py",
+        "adaptdl_tpu/bootstrap.py",
+        "adaptdl_tpu/metrics.py",
+        "adaptdl_tpu/sched/journal.py",
+        "adaptdl_tpu/sched/state.py",
+        "adaptdl_tpu/sched/allocator.py",
+        "adaptdl_tpu/sched/supervisor.py",
+    ):
+        sf = parse_file(os.path.join(REPO, rel), REPO)
+        assert _imports_trace(sf), f"{rel} no longer imports trace"
+
+
 def test_fault_rpc_catalog_tracks_faults_module(tmp_path):
     """GC602 judges against the REAL faults.py catalog: a root with no
     faults module yields no (unjudgeable) findings, and a root whose

@@ -1,0 +1,128 @@
+"""Trace-context propagation across a REAL restart (graftscope).
+
+The stitching claim only matters if it survives the failure it was
+built for: a worker hard-killed mid-rescale. The doomed incarnation's
+spans live in the JSONL trace journal (flushed per line), so they
+outlive the process; the successor inherits the SAME trace context
+through ``ADAPTDL_TRACEPARENT`` and appends its restore/first-step
+spans to the same journal. The test kills incarnation 0 with a fault
+injected inside the checkpoint write pipeline (``os._exit`` at
+``ckpt.write.pre_rename`` on its second save) and asserts one trace
+id spans both incarnations' records."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from adaptdl_tpu import trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = textwrap.dedent(
+    """
+    import os
+    import sys
+
+    from adaptdl_tpu import checkpoint, trace
+
+
+    class Blob(checkpoint.State):
+        def __init__(self):
+            super().__init__("model")
+            self.payload = b"x" * 1024
+
+        def save(self, fileobj):
+            fileobj.write(self.payload)
+
+        def load(self, fileobj):
+            self.payload = fileobj.read()
+
+
+    blob = Blob()
+    trace.init_from_env()
+    if os.environ["WORKER_PHASE"] == "doomed":
+        # Steady state: one completed save...
+        checkpoint.save_all_states(wait=True)
+        # ...then the rescale-epoch save. The fault schedule hard-kills
+        # (os._exit) at ckpt.write.pre_rename on this one: snapshot
+        # spans are already journaled, the write span never finishes.
+        checkpoint.save_all_states(wait=True)
+        raise SystemExit("unreachable: fault should have killed us")
+    # Successor incarnation: restore + first step under the SAME
+    # inherited trace context.
+    assert checkpoint.load_state(blob), "no checkpoint to restore"
+    with trace.span("restart.first_step"):
+        pass
+    """
+)
+
+
+@pytest.mark.chaos
+def test_trace_id_survives_worker_kill_mid_rescale(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    traceparent = trace.new_traceparent()
+    trace_id, _ = trace.parse_traceparent(traceparent)
+    base_env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+        ADAPTDL_CHECKPOINT_PATH=str(tmp_path / "ckpt"),
+        ADAPTDL_TRACE_DIR=str(tmp_path / "traces"),
+        ADAPTDL_TRACEPARENT=traceparent,
+        ADAPTDL_JOB_ID="test/killed",
+    )
+
+    doomed = subprocess.run(
+        [sys.executable, str(script)],
+        env=dict(
+            base_env,
+            WORKER_PHASE="doomed",
+            ADAPTDL_NUM_RESTARTS="0",
+            ADAPTDL_FAULT_SPEC="ckpt.write.pre_rename=exit@2",
+        ),
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert doomed.returncode == 1, doomed.stderr[-2000:]
+
+    successor = subprocess.run(
+        [sys.executable, str(script)],
+        env=dict(
+            base_env,
+            WORKER_PHASE="successor",
+            ADAPTDL_NUM_RESTARTS="1",
+        ),
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert successor.returncode == 0, successor.stderr[-2000:]
+
+    journal = os.path.join(
+        str(tmp_path / "traces"), "trace-test-killed.jsonl"
+    )
+    records = trace.read_journal(journal)
+    assert records, "trace journal is empty"
+    by_incarnation: dict[int, set[str]] = {}
+    for rec in records:
+        by_incarnation.setdefault(int(rec["inc"]), set()).add(
+            rec["name"]
+        )
+    # The doomed incarnation's save ("prepare") spans survived the
+    # kill; the write span of the fatal save is absent (never
+    # finished) but the first save's full pipeline is there.
+    assert "ckpt.snapshot" in by_incarnation[0]
+    assert "ckpt.write" in by_incarnation[0]
+    # The successor's restore/first-step spans are present...
+    assert "ckpt.restore" in by_incarnation[1]
+    assert "restart.first_step" in by_incarnation[1]
+    # ...and EVERY span of both incarnations carries the same trace
+    # id — the one the rescale decision minted.
+    assert {rec["trace"] for rec in records} == {trace_id}

@@ -11,6 +11,9 @@ from tools.graftcheck.passes.journal_discipline import (
     JournalDisciplinePass,
 )
 from tools.graftcheck.passes.lock_discipline import LockDisciplinePass
+from tools.graftcheck.passes.timing_discipline import (
+    TimingDisciplinePass,
+)
 
 ALL_PASSES = [
     LockDisciplinePass(),
@@ -20,6 +23,7 @@ ALL_PASSES = [
     CheckpointProtocolPass(),
     FaultRpcPass(),
     JournalDisciplinePass(),
+    TimingDisciplinePass(),
 ]
 
 RULE_CATALOG = {

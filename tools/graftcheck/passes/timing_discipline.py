@@ -1,0 +1,174 @@
+"""GC7xx — timing discipline in trace-instrumented modules.
+
+graftscope (adaptdl_tpu/trace.py) is the one sanctioned way to measure
+durations in the rescale lifecycle: spans are monotonic-clock, carry
+trace context, and land in the journal/histograms. A raw wall-clock
+duration (``time.time()`` deltas) is skew-prone — NTP slew or a
+suspend/resume silently corrupts the measurement — and a raw
+``time.perf_counter()`` stopwatch is invisible to the trace timeline.
+Two rules, applied to *instrumented modules* (any module that imports
+``adaptdl_tpu.trace`` — using the trace subsystem opts the module into
+its discipline; the trace module itself is exempt, it IS the timing
+layer):
+
+- **GC701** — ``time.time()`` used in duration math: a subtraction
+  with a direct ``time.time()`` operand, or with a variable assigned
+  directly from ``time.time()`` in the same scope. Wall-clock reads
+  used as *timestamps* (record fields, mtime comparisons) are fine —
+  and when one legitimately participates in arithmetic (file mtimes,
+  cross-restart completion times), suppress with a reasoned
+  ``# graftcheck: disable=GC701 (...)``.
+- **GC702** — any ``time.perf_counter()`` call: use
+  ``time.monotonic()`` (the codebase-wide clock every span and
+  deadline already uses) or a ``trace.span`` so the measurement joins
+  the timeline.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftcheck.core import (
+    Context,
+    Finding,
+    Pass,
+    SourceFile,
+    dotted_name,
+)
+
+_WALL_NAMES = ("time.time",)
+_PERF_NAMES = ("time.perf_counter", "perf_counter")
+
+
+def _is_call_to(node: ast.AST, names: tuple[str, ...]) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    return name in names
+
+
+def _imports_trace(sf: SourceFile) -> bool:
+    """Whether the module imports ``adaptdl_tpu.trace`` anywhere
+    (module level or lazily inside a function — both opt in)."""
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "adaptdl_tpu.trace":
+                    return True
+        elif isinstance(node, ast.ImportFrom):
+            module = node.module or ""
+            if module == "adaptdl_tpu.trace":
+                return True
+            if module == "adaptdl_tpu" and any(
+                alias.name == "trace" for alias in node.names
+            ):
+                return True
+    return False
+
+
+class TimingDisciplinePass(Pass):
+    name = "timing-discipline"
+    rules = {
+        "GC701": (
+            "wall-clock time.time() duration math in a "
+            "trace-instrumented module"
+        ),
+        "GC702": (
+            "time.perf_counter() in a trace-instrumented module"
+        ),
+    }
+
+    def _is_exempt(self, sf: SourceFile, ctx: Context) -> bool:
+        rel = sf.rel.replace("\\", "/")
+        exempt = tuple(
+            ctx.options.get(
+                "trace_modules", ("adaptdl_tpu/trace.py", "trace.py")
+            )
+        )
+        return any(
+            rel == mod or rel.endswith("/" + mod) for mod in exempt
+        )
+
+    def check_file(
+        self, sf: SourceFile, ctx: Context
+    ) -> list[Finding]:
+        if self._is_exempt(sf, ctx) or not _imports_trace(sf):
+            return []
+        findings: list[Finding] = []
+
+        # Scope -> names directly assigned from time.time(); a later
+        # subtraction on one of them is the split-stopwatch form of
+        # the same wall-clock duration bug.
+        wall_names: set[tuple[ast.AST | None, str]] = set()
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and _is_call_to(node.value, _WALL_NAMES)
+            ):
+                wall_names.add(
+                    (
+                        sf.enclosing_function(node),
+                        node.targets[0].id,
+                    )
+                )
+
+        def is_wall_operand(operand: ast.AST) -> bool:
+            if _is_call_to(operand, _WALL_NAMES):
+                return True
+            return isinstance(operand, ast.Name) and (
+                (sf.enclosing_function(operand), operand.id)
+                in wall_names
+            )
+
+        for node in ast.walk(sf.tree):
+            if _is_call_to(node, _PERF_NAMES):
+                findings.append(
+                    Finding(
+                        file=sf.rel,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        rule="GC702",
+                        message=(
+                            "time.perf_counter() in a trace-"
+                            "instrumented module"
+                        ),
+                        hint=(
+                            "use time.monotonic() (the clock spans "
+                            "and deadlines already use) or wrap the "
+                            "measurement in trace.span so it joins "
+                            "the timeline"
+                        ),
+                    )
+                )
+            elif isinstance(node, ast.BinOp) and isinstance(
+                node.op, ast.Sub
+            ):
+                if is_wall_operand(node.left) or is_wall_operand(
+                    node.right
+                ):
+                    findings.append(
+                        Finding(
+                            file=sf.rel,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            rule="GC701",
+                            message=(
+                                "wall-clock time.time() duration "
+                                "math — NTP slew / suspend-resume "
+                                "corrupts the measurement"
+                            ),
+                            hint=(
+                                "measure with trace.span / "
+                                "trace.event (or time.monotonic() "
+                                "for plain deadlines); wall-clock "
+                                "arithmetic that is genuinely "
+                                "correct (file mtimes, cross-"
+                                "restart timestamps) takes a "
+                                "reasoned # graftcheck: "
+                                "disable=GC701"
+                            ),
+                        )
+                    )
+        return findings
