@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched trace-gate probe
+.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched chaos-preempt trace-gate probe
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -51,6 +51,16 @@ chaos:
 chaos-sched:
 	$(CPU_ENV) ADAPTDL_FAULT_SEED=1234 $(PY) -m pytest \
 	    tests/test_chaos_sched.py -q --durations=10
+
+# Preemption-survival chaos (docs/robustness.md "Preemption
+# survival"): fault-injected reclaim notice through the real
+# listener with loss equality vs the undisturbed run + one trace id
+# across notice/drain/first-step, supervisor 500s on the report, VM
+# killed mid-drain-save, supervisor hard-killed mid-drain. Same
+# fixed seed as `chaos`.
+chaos-preempt:
+	$(CPU_ENV) ADAPTDL_FAULT_SEED=1234 $(PY) -m pytest \
+	    tests/test_chaos_preempt.py -q --durations=10
 
 # graftscope gates (docs/observability.md): tracing on vs off on the
 # CPU harness step loop must cost < 1% step time, the span ring

@@ -157,6 +157,14 @@ def initialize_job(distributed: bool | None = None) -> None:
         except Exception:  # noqa: BLE001 - rendezvous best-effort local
             LOG.exception("supervisor discovery failed; continuing solo")
         start_heartbeat()
+        # Spot deployments (ADAPTDL_PREEMPT_POLL_S > 0) get the
+        # reclaim-notice listener: on notice it arms the urgent-drain
+        # path and reports to the supervisor so re-placement overlaps
+        # the drain. The default (0) starts nothing — dev boxes and CI
+        # must not poll a metadata server that isn't there.
+        from adaptdl_tpu.sched import preemption
+
+        preemption.ensure_listener()
         if not collective.initialized():
             master = peers.get(0) if peers else None
             collective.initialize(

@@ -236,6 +236,15 @@ def _ensure_atexit_join() -> None:
     atexit.register(wait_for_inflight_save)
 
 
+def inflight_save() -> AsyncSaveHandle | None:
+    """The background write currently in flight, if any. The urgent
+    preemption drain reads this to report whether its blocking save
+    had to JOIN an async write (``save_all_states`` always waits for
+    the in-flight handle first, so two saves can never race into the
+    same version dir — this accessor only observes that fact)."""
+    return _inflight_save
+
+
 def wait_for_inflight_save() -> None:
     """Join the in-flight background write, if any. A failed
     background write is logged, NOT re-raised: every caller is a

@@ -242,10 +242,12 @@ def _print_table(rows: list[tuple]) -> None:
 
 def _cmd_status(args) -> int:
     """Operator view of a live supervisor: per-job phase with the
-    degraded flag, allocation epoch/state (pending = a transactional
-    rescale awaiting its commit quorum), and lease ages — plus slot
-    strikes/quarantine and recovery info, so the reason an allocation
-    was withdrawn or rolled back is visible instead of implied."""
+    degraded/draining flags, allocation epoch/state (pending = a
+    transactional rescale awaiting its commit quorum), and lease ages
+    — plus slot strikes/quarantine, reclaim-notice drain state with
+    per-kind hazard rates, and recovery info, so the reason an
+    allocation was withdrawn, rolled back, or moved off spot is
+    visible instead of implied."""
     from adaptdl_tpu import rpc
 
     payload = rpc.default_client().get(
@@ -257,7 +259,7 @@ def _cmd_status(args) -> int:
     ).json()
     rows = [
         (
-            "JOB", "PHASE", "REPLICAS", "DEGRADED", "ALLOC",
+            "JOB", "PHASE", "REPLICAS", "DEGRADED", "DRAIN", "ALLOC",
             "RESTARTS", "LEASES",
         )
     ]
@@ -269,12 +271,16 @@ def _cmd_status(args) -> int:
                 ages.items(), key=lambda kv: int(kv[0])
             )
         )
+        drain = job.get("drainRemainingS")
         rows.append(
             (
                 key,
                 str(job.get("status", "?")),
                 str(job.get("replicas", 0)),
                 "yes" if job.get("degraded") else "no",
+                f"{int(drain)}s left"
+                if job.get("draining") and drain is not None
+                else "-",
                 f"{job.get('allocEpoch', 0)}/"
                 f"{job.get('allocState', '?')}",
                 str(job.get("restarts", 0)),
@@ -282,6 +288,24 @@ def _cmd_status(args) -> int:
             )
         )
     _print_table(rows)
+    draining_slots = payload.get("drainingSlots") or {}
+    if draining_slots:
+        print(
+            "\ndraining slots (reclaim notice): "
+            + ", ".join(
+                f"{slot} ({int(remaining)}s left)"
+                for slot, remaining in sorted(draining_slots.items())
+            )
+        )
+    hazards = payload.get("hazardRates") or {}
+    if any(rate > 0 for rate in hazards.values()):
+        print(
+            "reclaim hazard: "
+            + ", ".join(
+                f"{kind}={rate * 3600:.3f}/slot-hour"
+                for kind, rate in sorted(hazards.items())
+            )
+        )
     quarantined = payload.get("quarantinedSlots", {})
     strikes = payload.get("slotStrikes", {})
     if quarantined or strikes:

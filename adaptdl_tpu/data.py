@@ -384,12 +384,26 @@ class AdaptiveDataLoader:
 
     def _check_exit(self) -> None:
         """Overlapped exit-flag agreement; checkpoint+exit(143) once
-        every replica has seen the signal."""
+        every replica has seen the signal. A preemption notice routes
+        the final save through the urgent drain — deadline-budgeted,
+        joins any in-flight async write, reports to the supervisor —
+        instead of the plain blocking save."""
         if self._exit_future is not None:
             should_exit = self._exit_future.result()
             if should_exit:
-                LOG.info("graceful exit: saving states and exiting 143")
-                checkpoint.save_all_states()
+                from adaptdl_tpu.sched import preemption
+
+                if preemption.notice_active():
+                    LOG.info(
+                        "graceful exit (preemption notice): urgent "
+                        "drain then exit 143"
+                    )
+                    preemption.urgent_drain()
+                else:
+                    LOG.info(
+                        "graceful exit: saving states and exiting 143"
+                    )
+                    checkpoint.save_all_states()
                 sys.exit(_signal.GRACEFUL_EXIT_CODE)
         self._exit_future = collective.allreduce_async(
             bool(_signal.get_exit_flag()), lambda vs: any(vs)

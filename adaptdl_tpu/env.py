@@ -350,6 +350,57 @@ def sched_reconcile_window() -> float:
     return _get_float("ADAPTDL_SCHED_RECONCILE_WINDOW", 30.0)
 
 
+def preempt_notice_s() -> float:
+    """Seconds of warning a preemption notice gives before the VM is
+    reclaimed (GCE spot gives 30). The urgent drain budgets its final
+    blocking checkpoint inside this window."""
+    return _get_float("ADAPTDL_PREEMPT_NOTICE_S", 30.0)
+
+
+def preempt_margin_s() -> float:
+    """Safety margin subtracted from the notice window when budgeting
+    the urgent drain's blocking save — covers exit/teardown time after
+    the checkpoint lands."""
+    return _get_float("ADAPTDL_PREEMPT_MARGIN_S", 5.0)
+
+
+def preempt_poll_s() -> float:
+    """Base cadence of the preemption-notice listener's metadata poll.
+    0 — the default — disables the auto-started listener entirely
+    (spot deployments opt in with e.g. 5); explicit
+    ``start_listener`` callers pass their own interval."""
+    return _get_float("ADAPTDL_PREEMPT_POLL_S", 0.0)
+
+
+def preempt_slow_poll_s() -> float:
+    """Backed-off poll cadence after the metadata endpoint has been
+    unreachable ``preempt_backoff_after()`` times in a row — off GCE
+    the listener idles at this rate instead of hammering a dead
+    endpoint every few seconds."""
+    return _get_float("ADAPTDL_PREEMPT_SLOW_POLL_S", 60.0)
+
+
+def preempt_backoff_after() -> int:
+    """Consecutive unreachable metadata polls before the listener
+    backs off to the slow cadence (one reachable poll restores the
+    base cadence)."""
+    return max(_get_int("ADAPTDL_PREEMPT_BACKOFF_AFTER", 12), 1)
+
+
+def hazard_tau_s() -> float:
+    """Time constant (seconds) of the per-slot-kind reclaim-hazard
+    EWMA the scheduler maintains from observed preemption notices: the
+    estimated rate converges to events-per-second over roughly this
+    horizon and decays back toward zero at the same pace."""
+    return max(_get_float("ADAPTDL_HAZARD_TAU_S", 3600.0), 1.0)
+
+
+def spot_price_ratio() -> float | None:
+    """Configured spot-vs-on-demand price ratio for the expander's
+    capacity-mix policy (raw; the expander applies its default)."""
+    return _get_opt_float("ADAPTDL_SPOT_PRICE_RATIO")
+
+
 def checkpoint_verify() -> bool:
     """Whether ``load_state`` verifies per-state sha256/size against
     the checkpoint's integrity manifest before restoring (``off``/

@@ -68,6 +68,11 @@ INJECTION_POINTS = {
     "sup.config.pre": "job-config snapshot handler",
     "sup.heartbeat.pre": "heartbeat lease-renewal handler",
     "sup.trace.pre": "worker trace-span intake handler (graftscope)",
+    "sup.preempt.pre": "preemption-notice intake handler",
+    # preemption survival (sched.preemption; an injected fault at
+    # preempt.notice SIMULATES a reclaim notice in the listener)
+    "preempt.notice": "each listener poll for a reclaim notice",
+    "preempt.drain_save": "before the urgent drain's blocking save",
     # worker lifecycle backends (sched.local_runner / sched.multi_runner)
     "runner.launch.pre": "before a worker subprocess launch",
     "runner.supervise.poll": "each supervision poll cycle",

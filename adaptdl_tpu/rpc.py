@@ -313,6 +313,9 @@ class RpcClient:
     def put(self, url: str, **kwargs):
         return self.request("PUT", url, **kwargs)
 
+    def post(self, url: str, **kwargs):
+        return self.request("POST", url, **kwargs)
+
 
 # Process-wide shared client, created on first use. A lock (not a
 # fast-path read) is fine here: callers cache the result or are

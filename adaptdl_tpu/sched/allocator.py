@@ -11,6 +11,7 @@ worker-lifecycle backend (local runner, k8s operator) is attached.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 
@@ -40,13 +41,12 @@ LOG = logging.getLogger(__name__)
 RESTART_AMORTIZATION_S = 300.0
 
 
-def restart_penalty_from_stats(stats: dict | None) -> float | None:
-    """Fractional goodput penalty from a job's measured rescale-cost
-    components (metrics.restart_stats schema). Only the phases on the
-    rescale critical path count: the final pre-exit save blocks
-    (snapshot + write) and the restore blocks the new incarnation;
-    steady-state saves overlap training and are free. None when
-    nothing was measured — the policy keeps its assumed default."""
+def restart_cost_s_from_stats(stats: dict | None) -> float | None:
+    """Raw measured rescale cost in seconds from a job's posted
+    restartStats. Only the phases on the rescale critical path count:
+    the final pre-exit save blocks (snapshot + write) and the restore
+    blocks the new incarnation; steady-state saves overlap training
+    and are free. None when nothing was measured."""
     if not stats:
         return None
     cost = 0.0
@@ -56,9 +56,34 @@ def restart_penalty_from_stats(stats: dict | None) -> float | None:
         if value is not None:
             cost += max(float(value), 0.0)
             measured = True
-    if not measured:
+    return cost if measured else None
+
+
+def _penalty_from_cost(cost: float | None) -> float | None:
+    """Measured restart seconds -> fractional goodput penalty
+    (amortized over the reallocation horizon, clamped)."""
+    if cost is None:
         return None
     return float(np.clip(cost / RESTART_AMORTIZATION_S, 0.005, 0.5))
+
+
+def restart_penalty_from_stats(stats: dict | None) -> float | None:
+    """Fractional goodput penalty from a job's measured rescale cost
+    (the seconds from :func:`restart_cost_s_from_stats` amortized
+    over the reallocation horizon). None when nothing was measured —
+    the policy keeps its assumed default."""
+    return _penalty_from_cost(restart_cost_s_from_stats(stats))
+
+
+def slot_kind(node: NodeInfo) -> str:
+    """The hazard-accounting kind of a slice: an explicit
+    ``extra["kind"]`` wins, else preemptible slices are "spot" and the
+    rest "ondemand" — the keys the cluster state's per-kind hazard
+    EWMA and the expander's mix policy share."""
+    kind = (node.extra or {}).get("kind")
+    if kind:
+        return str(kind)
+    return "spot" if node.preemptible else "ondemand"
 
 
 def job_info_from_hints(
@@ -104,6 +129,9 @@ def job_info_from_hints(
         # one replica scheduled so profiling can begin.
         speedup_fn = lambda n, r: r  # noqa: E731
         max_replicas = max(min_replicas, 1)
+    restart_cost_s = restart_cost_s_from_stats(
+        (hints or {}).get("restartStats")
+    )
     return JobInfo(
         resources=resources,
         speedup_fn=speedup_fn,
@@ -111,9 +139,8 @@ def job_info_from_hints(
         min_replicas=min_replicas,
         max_replicas=max(max_replicas, max(min_replicas, 1)),
         preemptible=preemptible,
-        restart_penalty=restart_penalty_from_stats(
-            (hints or {}).get("restartStats")
-        ),
+        restart_penalty=_penalty_from_cost(restart_cost_s),
+        restart_cost_s=restart_cost_s,
     )
 
 
@@ -183,13 +210,23 @@ class Allocator:
         # Slots struck out by failed allocation epochs are off the
         # table until their un-quarantine probe: re-placing a job on
         # a slot that just crash-looped it would burn the retry
-        # budget re-proving the same failure.
+        # budget re-proving the same failure. Slots DRAINING under an
+        # active reclaim notice are excluded the same way — placing
+        # on a slot the cloud promised to take back within seconds
+        # guarantees an immediate second rescale.
         quarantined = set(self._state.quarantined_slots())
+        draining = set(self._state.draining_slots())
         nodes = self._current_nodes()
         if quarantined:
             LOG.info(
                 "excluding quarantined slots from placement: %s",
                 sorted(quarantined),
+            )
+        if draining:
+            LOG.info(
+                "excluding draining (reclaim-notice) slots from "
+                "placement: %s",
+                sorted(draining),
             )
         if not nodes:
             # Scaled to zero with pending work: the policy cannot run
@@ -199,14 +236,52 @@ class Allocator:
             if self._expander is not None:
                 self._expander.request(1)
             return {}
+        # Hazard pricing: register the inventory's slot->kind map (so
+        # a preemption notice is attributed to the right hazard kind)
+        # and stamp each slice with its kind's decayed EWMA hazard —
+        # the policy's expected-loss term reads it off the NodeInfo.
+        kinds = {key: slot_kind(node) for key, node in nodes.items()}
+        self._state.set_slot_kinds(
+            kinds,
+            preemptible={
+                key
+                for key, node in nodes.items()
+                if node.preemptible
+            },
+        )
+        hazards = self._state.hazard_rates()
+        nodes = {
+            key: dataclasses.replace(
+                node, hazard=hazards.get(kinds[key], 0.0)
+            )
+            for key, node in nodes.items()
+        }
+        template = dataclasses.replace(
+            self._template,
+            hazard=hazards.get(slot_kind(self._template), 0.0),
+        )
         allocations, desired = self._policy.optimize(
-            jobs, nodes, base, self._template, quarantined=quarantined
+            jobs,
+            nodes,
+            base,
+            template,
+            quarantined=quarantined | draining,
         )
         decide_attrs["jobs"] = len(jobs)
         decide_attrs["slots"] = sum(
             info.resources.get("tpu", 0) for info in nodes.values()
         )
         if self._expander is not None:
+            note = getattr(self._expander, "note_restart_costs", None)
+            if note is not None:
+                # The mix-policy expander weighs the spot discount
+                # against the jobs' measured restart costs.
+                note(
+                    {
+                        key: info.restart_cost_s
+                        for key, info in jobs.items()
+                    }
+                )
             self._expander.request(desired)
         for key, alloc in allocations.items():
             record = self._state.get_job(key)
@@ -256,8 +331,15 @@ class Allocator:
                 # incarnation and /config serves it to the doomed one,
                 # so every span of this rescale — decide, epoch
                 # prepare/commit, final save, restore, first step —
-                # shares one trace id.
-                traceparent = trace.new_traceparent()
+                # shares one trace id. EXCEPT a preemption-driven
+                # re-placement: the worker minted the survival trace
+                # at notice time (preempt.notice → drain.save), and
+                # the successor's restore/first-step must land on THAT
+                # id, so the draining job's trace parent is reused.
+                if record.draining and record.trace_parent:
+                    traceparent = record.trace_parent
+                else:
+                    traceparent = trace.new_traceparent()
                 trace.event(
                     "alloc.publish",
                     traceparent=traceparent,
@@ -283,6 +365,13 @@ class Allocator:
         return allocations
 
     def start(self) -> None:
+        # The kick baseline is snapshotted BEFORE each cycle —
+        # including this initial synchronous one: a preemption notice
+        # that lands WHILE optimize_once runs must wake the next wait
+        # immediately, not be silently consumed and wait out the full
+        # interval (the notice window is 30s; the interval can be
+        # minutes).
+        initial_seen = self._state.alloc_kick_count()
         # First cycle runs synchronously so a newly created job has an
         # allocation the moment start() returns.
         try:
@@ -291,7 +380,16 @@ class Allocator:
             LOG.exception("initial allocator cycle failed")
 
         def loop():
-            while not self._stop.wait(self._interval):
+            seen = initial_seen
+            while not self._stop.is_set():
+                # Interruptible cadence: a preemption notice kicks the
+                # state so the next cycle runs NOW — re-placement must
+                # overlap the notice window, not wait out the
+                # interval.
+                self._state.wait_alloc_kick(self._interval, seen=seen)
+                if self._stop.is_set():
+                    return
+                seen = self._state.alloc_kick_count()
                 try:
                     self.optimize_once()
                 except Exception:  # noqa: BLE001
@@ -304,5 +402,7 @@ class Allocator:
 
     def stop(self) -> None:
         self._stop.set()
+        # Unblock a loop parked in wait_alloc_kick.
+        self._state.kick_allocator()
         if self._thread is not None:
             self._thread.join(timeout=10)

@@ -17,7 +17,9 @@ import threading
 import time
 from typing import Callable, Protocol
 
+from adaptdl_tpu import env
 from adaptdl_tpu.sched.policy import NodeInfo
+from adaptdl_tpu.sched.policy.pollux import DEFAULT_RESTART_COST_S
 
 LOG = logging.getLogger(__name__)
 
@@ -198,6 +200,184 @@ class ClusterExpander:
 
         self._thread = threading.Thread(
             target=loop, name="adaptdl-expander", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
+# ---- spot-capacity autoscaling ---------------------------------------
+
+# DEFAULT_RESTART_COST_S comes from the policy (pollux.py) so the mix
+# policy's break-even and the placement policy's hazard pricing can
+# never price the same unmeasured restart differently.
+DEFAULT_SPOT_PRICE_RATIO = 0.3
+
+
+class SpotMixPolicy:
+    """Decides how much desired capacity to provision from the spot
+    pool vs on-demand by weighing the configured spot discount against
+    the measured expected restart loss.
+
+    A spot slice costs ``spot_price_ratio`` of an on-demand slice but
+    loses an expected ``hazard x restart_cost_s`` fraction of its
+    useful output to preemption restarts, so its *effective* cost per
+    unit of goodput is ``ratio / (1 - loss)``. While that stays below
+    1.0 the discount wins and growth goes to spot; once observed
+    reclaims push the loss past break-even, new capacity (and, after
+    the scale-down hysteresis, existing spot capacity) shifts to
+    on-demand. ``min_ondemand`` keeps a floor of reliable slices for
+    non-preemptible jobs regardless."""
+
+    def __init__(
+        self,
+        spot_price_ratio: float | None = None,
+        min_ondemand: int = 0,
+        max_loss: float = 0.95,
+    ):
+        if spot_price_ratio is None:
+            spot_price_ratio = (
+                env.spot_price_ratio() or DEFAULT_SPOT_PRICE_RATIO
+            )
+        self._ratio = max(float(spot_price_ratio), 0.0)
+        self._min_ondemand = max(int(min_ondemand), 0)
+        self._max_loss = float(max_loss)
+
+    def expected_loss(
+        self, hazard_rate: float, restart_cost_s: float
+    ) -> float:
+        """Expected fraction of a spot slice's output lost to reclaim
+        restarts: reclaims/sec x seconds-lost-per-reclaim, capped."""
+        return min(
+            max(hazard_rate, 0.0) * max(restart_cost_s, 0.0),
+            self._max_loss,
+        )
+
+    def spot_worthwhile(
+        self, hazard_rate: float, restart_cost_s: float
+    ) -> bool:
+        loss = self.expected_loss(hazard_rate, restart_cost_s)
+        effective = self._ratio / max(1.0 - loss, 1e-6)
+        return effective < 1.0
+
+    def split(
+        self,
+        desired: int,
+        hazard_rate: float,
+        restart_cost_s: float,
+    ) -> tuple[int, int]:
+        """(spot, ondemand) slice counts for ``desired`` total."""
+        desired = max(int(desired), 0)
+        ondemand = min(self._min_ondemand, desired)
+        if self.spot_worthwhile(hazard_rate, restart_cost_s):
+            return desired - ondemand, ondemand
+        return 0, desired
+
+
+class MixedClusterExpander:
+    """Two-pool expander: reconciles the allocator's desired slice
+    count across a spot pool and an on-demand pool through a
+    :class:`SpotMixPolicy`. The hazard input is the cluster state's
+    per-kind EWMA (fed by preemption notices); the restart-cost input
+    is the mean of the jobs' measured restart costs, pushed by the
+    allocator via :meth:`note_restart_costs` each cycle — so the mix
+    responds to BOTH how often spot is reclaimed and how much a
+    reclaim actually costs the current workload. Each pool keeps the
+    single-pool expander's grow-now / shrink-after-hysteresis
+    behavior."""
+
+    def __init__(
+        self,
+        spot_provisioner: SliceProvisioner,
+        ondemand_provisioner: SliceProvisioner,
+        policy: SpotMixPolicy | None = None,
+        hazard_fn: Callable[[], float] | None = None,
+        state=None,
+        min_slices: int = 0,
+        max_slices: int = 64,
+        scale_down_delay: float = 300.0,
+        interval: float = 30.0,
+    ):
+        if hazard_fn is None:
+            if state is not None:
+                hazard_fn = lambda: state.hazard_rates().get(  # noqa: E731
+                    "spot", 0.0
+                )
+            else:
+                hazard_fn = lambda: 0.0  # noqa: E731
+        self._policy = policy or SpotMixPolicy()
+        self._hazard_fn = hazard_fn
+        self._spot = ClusterExpander(
+            spot_provisioner,
+            min_slices=0,
+            max_slices=max_slices,
+            scale_down_delay=scale_down_delay,
+            interval=interval,
+        )
+        self._ondemand = ClusterExpander(
+            ondemand_provisioner,
+            min_slices=min_slices,
+            max_slices=max_slices,
+            scale_down_delay=scale_down_delay,
+            interval=interval,
+        )
+        self._interval = interval
+        self._lock = threading.Lock()
+        self._restart_costs: dict[str, float] = {}  # guarded-by: _lock
+        self.last_split: tuple[int, int] = (0, 0)  # guarded-by: _lock
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def note_restart_costs(
+        self, costs: dict[str, float | None]
+    ) -> None:
+        """Per-job measured restart costs from the allocator's cycle
+        (None entries — unmeasured jobs — are dropped)."""
+        with self._lock:
+            self._restart_costs = {
+                key: float(value)
+                for key, value in costs.items()
+                if value is not None
+            }
+
+    def _avg_restart_cost(self) -> float:
+        with self._lock:
+            costs = list(self._restart_costs.values())
+        if not costs:
+            return DEFAULT_RESTART_COST_S
+        return sum(costs) / len(costs)
+
+    def request(self, desired_slices: int) -> None:
+        """Latest desired TOTAL slice count from the allocator, split
+        across the pools by the mix policy."""
+        spot, ondemand = self._policy.split(
+            desired_slices,
+            self._hazard_fn(),
+            self._avg_restart_cost(),
+        )
+        with self._lock:
+            self.last_split = (spot, ondemand)
+        self._spot.request(spot)
+        self._ondemand.request(ondemand)
+
+    def reconcile_once(self, now: float | None = None) -> int:
+        return self._spot.reconcile_once(now) + (
+            self._ondemand.reconcile_once(now)
+        )
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self._interval):
+                try:
+                    self.reconcile_once()
+                except Exception:  # noqa: BLE001
+                    LOG.exception("mixed expander reconcile failed")
+
+        self._thread = threading.Thread(
+            target=loop, name="adaptdl-expander-mixed", daemon=True
         )
         self._thread.start()
 

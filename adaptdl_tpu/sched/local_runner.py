@@ -62,6 +62,7 @@ class LocalElasticRunner:
         generations: int = 20,
         term_grace_period: float = 120.0,
         state_dir: str | None = None,
+        preemptible: bool = True,
     ):
         self.term_grace_period = term_grace_period
         self.script = script
@@ -81,7 +82,11 @@ class LocalElasticRunner:
             "resources": {"tpu": 1},
             "min_replicas": min_replicas,
             "max_replicas": self.max_replicas,
-            "preemptible": True,
+            # Honors the caller's choice (it used to be hardcoded
+            # True, which made Pollux's non-preemptible repair path —
+            # pin the incumbent's allocation verbatim — unreachable
+            # from the local runners).
+            "preemptible": bool(preemptible),
         }
         from adaptdl_tpu.sched.validator import validate_job_spec
 
@@ -309,6 +314,12 @@ def main() -> int:
     parser.add_argument("--checkpoint-dir", required=True)
     parser.add_argument("--min-replicas", type=int, default=0)
     parser.add_argument("--max-replicas", type=int, default=None)
+    parser.add_argument(
+        "--non-preemptible",
+        action="store_true",
+        help="pin the job's allocation once granted (the scheduler "
+        "never shrinks or moves it to make room for other jobs)",
+    )
     args = parser.parse_args()
     chips = args.chips
     if chips is None:
@@ -321,6 +332,7 @@ def main() -> int:
         checkpoint_dir=args.checkpoint_dir,
         min_replicas=args.min_replicas,
         max_replicas=args.max_replicas,
+        preemptible=not args.non_preemptible,
     )
     return runner.run()
 

@@ -50,6 +50,10 @@ class JobSpec:
     checkpoint_dir: str
     min_replicas: int = 0
     max_replicas: int | None = None
+    # False pins the job's allocation once granted: Pollux's repair
+    # step keeps non-preemptible incumbents on their base allocation
+    # verbatim instead of shrinking/moving them for other jobs.
+    preemptible: bool = True
     extra_env: dict = field(default_factory=dict)
 
 
@@ -79,7 +83,9 @@ class MultiJobRunner:
                 "resources": {"tpu": 1},
                 "min_replicas": job.min_replicas,
                 "max_replicas": job.max_replicas or num_chips,
-                "preemptible": True,
+                # From the JobSpec (was hardcoded True — the policy's
+                # non-preemptible pinning was unreachable here).
+                "preemptible": bool(job.preemptible),
             }
             validate_job_spec(spec)
             record = self.state.get_job(job.name)

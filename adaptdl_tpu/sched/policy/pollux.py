@@ -12,7 +12,11 @@ from the reference, re-expressed for slices:
   Speedups are normalized by each job's dominant resource share so one
   "fair share" of the cluster ~ speedup 1; solutions that move a job
   off its current allocation pay a 10% restart penalty (checkpoint-
-  restart is cheap but not free).
+  restart is cheap but not free). Placements on hazardous (spot)
+  slices additionally pay an **expected-loss** term — the sum of the
+  occupied slices' reclaim-hazard rates times the job's measured
+  restart cost — so expensive-restart jobs migrate to on-demand
+  capacity while cheap-restart jobs soak up the spot discount.
 - feasibility (the repair step): pinned (non-preemptible, already
   running) jobs keep their allocation; at most one *distributed* job
   per slice — a job spanning chips owns the slice's ICI; per-job
@@ -36,6 +40,15 @@ from adaptdl_tpu.sched.policy.utils import JobInfo, NodeInfo
 LOG = logging.getLogger(__name__)
 
 RESTART_PENALTY = 0.1
+# Assumed checkpoint-restart cost (seconds) for jobs that have not
+# posted measured restartStats yet — RESTART_PENALTY amortized over
+# the allocator's 5-minute horizon (allocator.RESTART_AMORTIZATION_S),
+# so the hazard term and the move penalty price restarts consistently.
+DEFAULT_RESTART_COST_S = 30.0
+# Ceiling on the hazard expected-loss fraction: even a hazard-saturated
+# placement keeps a sliver of scored goodput, so the search can still
+# rank terrible options instead of flattening them all to zero.
+MAX_HAZARD_LOSS = 0.9
 
 
 class PolluxPolicy:
@@ -325,9 +338,17 @@ class PolluxPolicy:
 
 
 def _sorted_nodes(nodes: dict) -> OrderedDict:
-    """Stable preference order: reliable slices first."""
+    """Stable preference order: reliable slices first, then by
+    measured hazard within each reliability class."""
     return OrderedDict(
-        sorted(nodes.items(), key=lambda kv: (kv[1].preemptible, kv[0]))
+        sorted(
+            nodes.items(),
+            key=lambda kv: (
+                kv[1].preemptible,
+                getattr(kv[1], "hazard", 0.0),
+                kv[0],
+            ),
+        )
     )
 
 
@@ -400,6 +421,20 @@ class _Problem:
                 for job in jobs
             ]
         )
+        # Hazard-pricing inputs: per-node reclaim rate (EWMA of
+        # observed notices, stamped by the allocator) and per-job
+        # measured restart cost in seconds.
+        self._node_hazard = np.array(
+            [max(getattr(n, "hazard", 0.0), 0.0) for n in nodes]
+        )
+        self._restart_cost_s = np.array(
+            [
+                DEFAULT_RESTART_COST_S
+                if job.restart_cost_s is None
+                else max(float(job.restart_cost_s), 0.0)
+                for job in jobs
+            ]
+        )
 
     # -- objectives ----------------------------------------------------
 
@@ -426,6 +461,20 @@ class _Problem:
         scaled = np.where(
             moved, scaled * (1 - self._restart_penalty[None, :]), scaled
         )
+        # Hazard expected-loss term: a job restarts when ANY of its
+        # slices is reclaimed, so its reclaim rate is the sum of its
+        # occupied slices' hazards; each reclaim costs ~restart_cost_s
+        # of goodput. The product (rate x cost) is the expected
+        # fraction of time lost to preemption restarts — expensive-
+        # restart jobs are priced off spot, cheap ones soak it up.
+        if self._node_hazard.any():
+            lam = (states > 0).astype(float) @ self._node_hazard
+            loss = np.clip(
+                lam * self._restart_cost_s[None, :],
+                0.0,
+                MAX_HAZARD_LOSS,
+            )
+            scaled = scaled * (1.0 - loss)
         return np.column_stack(
             [-scaled.sum(axis=1), self._cluster_sizes(states)]
         )

@@ -23,6 +23,13 @@ class JobInfo:
     # hints), so cheap-to-rescale jobs move freely and expensive ones
     # stay put.
     restart_penalty: float | None = None
+    # Measured wall seconds one checkpoint-restart costs this job
+    # (final save + restore, the rescale critical path). Prices the
+    # hazard expected-loss term: on a slice with reclaim hazard h the
+    # policy charges ~h * restart_cost_s of goodput, so expensive-
+    # restart jobs migrate to on-demand slices while cheap-restart
+    # jobs soak up spot. None -> the policy's assumed default.
+    restart_cost_s: float | None = None
 
     def __post_init__(self):
         assert self.max_replicas > 0
@@ -33,4 +40,9 @@ class JobInfo:
 class NodeInfo:
     resources: dict[str, int]  # total allocatable (e.g. {"tpu": 8})
     preemptible: bool = False  # spot/preemptible slice
+    # Estimated reclaim hazard of this slice (expected preemption
+    # notices per second; the cluster state maintains a per-slot-kind
+    # EWMA from observed notices and the allocator stamps it here
+    # each cycle). 0 = reliable capacity.
+    hazard: float = 0.0
     extra: dict = field(default_factory=dict)

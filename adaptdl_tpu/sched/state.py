@@ -35,6 +35,7 @@ explicitly:
 from __future__ import annotations
 
 import logging
+import math
 import threading
 import time
 from dataclasses import dataclass, field
@@ -160,6 +161,15 @@ class JobRecord:
     # commit/rollback span's start, so the epoch's prepare->verdict
     # window is measured, not inferred.
     alloc_prepared_at: float | None = None
+    # True while the incumbent incarnation drains after a preemption
+    # notice (POST /preempt): the affected slots are already withdrawn
+    # from inventory and the successor's allocation epoch may open
+    # DURING the notice window. Cleared when the successor group shows
+    # up (register/heartbeat bump) or the incumbent's leases expire.
+    draining: bool = False
+    # Monotonic end of the notice window (transient — re-armed with a
+    # fresh clock on recovery).
+    drain_deadline: float | None = None
 
 
 def _job_to_dict(record: JobRecord) -> dict:
@@ -193,6 +203,7 @@ def _job_to_dict(record: JobRecord) -> dict:
         "alloc_prepare_group": record.alloc_prepare_group,
         "alloc_require_bump": record.alloc_require_bump,
         "trace_parent": record.trace_parent,
+        "draining": record.draining,
     }
 
 
@@ -241,6 +252,7 @@ def _job_from_dict(payload: dict) -> JobRecord:
         payload.get("alloc_require_bump", False)
     )
     record.trace_parent = payload.get("trace_parent")
+    record.draining = bool(payload.get("draining", False))
     return record
 
 
@@ -256,6 +268,7 @@ class ClusterState:
         slot_quarantine_s: float | None = None,
         reconcile_window: float | None = None,
         snapshot_every: int = 256,
+        hazard_tau_s: float | None = None,
     ):
         self._cond = threading.Condition()
         # The job table is THE cross-component contract: allocator,
@@ -298,6 +311,27 @@ class ClusterState:
         self._slot_strikes: dict[str, int] = {}  # guarded-by: _cond
         self._quarantined: dict[str, float] = {}  # guarded-by: _cond
         self._rollbacks: dict[str, int] = {}  # guarded-by: _cond
+        # Preemption survival: slots draining under an active reclaim
+        # notice (slot -> monotonic end of the notice window; the
+        # allocator must not place jobs on them), the per-slot-kind
+        # reclaim-hazard EWMA (kind -> (rate, last wall ts) — wall
+        # clock so the estimate survives restarts via the journal),
+        # notice counters, and the allocator-registered slot->kind map
+        # (in-memory: derivable from the inventory every cycle).
+        self._hazard_tau = (
+            env.hazard_tau_s()
+            if hazard_tau_s is None
+            else max(float(hazard_tau_s), 1.0)
+        )
+        self._draining_slots: dict[str, float] = {}  # guarded-by: _cond
+        self._hazard: dict[str, tuple[float, float]] = {}  # guarded-by: _cond
+        self._preempt_notices: dict[str, int] = {}  # guarded-by: _cond
+        self._slot_kinds: dict[str, str] = {}  # guarded-by: _cond
+        self._preemptible_slots: set[str] = set()  # guarded-by: _cond
+        # Allocator kick counter: bumped by a preemption notice so the
+        # allocator re-places the job DURING the notice window instead
+        # of waiting out its cycle interval.
+        self._alloc_kick = 0  # guarded-by: _cond
         # Durability / recovery bookkeeping.
         # True only inside recovery's replay loop: replayed ops are
         # history and must not re-record trace events/spans.
@@ -350,6 +384,12 @@ class ClusterState:
             "quarantined": sorted(self._quarantined),
             "rollbacks": dict(self._rollbacks),
             "recoveries": self._recoveries,
+            "draining_slots": sorted(self._draining_slots),
+            "hazard": {
+                kind: [rate, last_ts]
+                for kind, (rate, last_ts) in self._hazard.items()
+            },
+            "preempt_notices": dict(self._preempt_notices),
         }
 
     def _recover(self) -> None:  # journaled
@@ -389,6 +429,27 @@ class ClusterState:
                     for slot in snapshot.get("quarantined") or []
                 }
                 self._recoveries = int(snapshot.get("recoveries", 0))
+                # Placeholder deadlines; re-armed below like the
+                # quarantine clocks.
+                self._draining_slots = {
+                    slot: 0.0
+                    for slot in snapshot.get("draining_slots") or []
+                }
+                # The hazard EWMA is wall-clock anchored, so it
+                # survives the restart as-is (the reader decays it
+                # from last_ts to now).
+                self._hazard = {
+                    kind: (float(rate), float(last_ts))
+                    for kind, (rate, last_ts) in (
+                        snapshot.get("hazard") or {}
+                    ).items()
+                }
+                self._preempt_notices = {
+                    kind: int(n)
+                    for kind, n in (
+                        snapshot.get("preempt_notices") or {}
+                    ).items()
+                }
                 for key, payload in (
                     snapshot.get("jobs") or {}
                 ).items():
@@ -427,6 +488,19 @@ class ClusterState:
                 slot: now + self._quarantine_s
                 for slot in self._quarantined
             }
+            # Same for drain windows: re-arm a full notice window (a
+            # slot mid-drain when the supervisor crashed is still
+            # about to vanish; holding it out one spare window is the
+            # conservative call).
+            self._draining_slots = {
+                slot: now + env.preempt_notice_s()
+                for slot in self._draining_slots
+            }
+            for record in self._jobs.values():
+                if record.draining:
+                    record.drain_deadline = (
+                        now + env.preempt_notice_s()
+                    )
             if snapshot is not None or records:
                 op = {"op": "recovered"}
                 self._journal_append(op)
@@ -456,6 +530,8 @@ class ClusterState:
             return self._apply_commit_locked(op)
         if kind == "alloc_rollback":
             return self._apply_rollback_locked(op)
+        if kind == "preempt":
+            return self._apply_preempt_locked(op)
         if kind == "recovered":
             self._recoveries += 1
             return None
@@ -608,6 +684,9 @@ class ClusterState:
             # never registers, so a stale multi-process quorum would
             # make its epochs forever uncommittable.
             record.expected_processes = 1
+            # The successor arrived: the preemption drain is served.
+            record.draining = False
+            record.drain_deadline = None
         accepted = group == record.group
         if accepted:
             record.workers[rank] = op["address"]
@@ -638,6 +717,8 @@ class ClusterState:
             # Same quorum reset as a register-driven bump: heartbeats
             # are how single-process incarnations announce themselves.
             record.expected_processes = 1
+            record.draining = False
+            record.drain_deadline = None
         record.alive_ranks.add(rank)
         if float(op["ttl"]) > 0:
             # ttl 0 = lease enforcement disabled: the beat proves
@@ -659,6 +740,10 @@ class ClusterState:
             record.alloc_state = "committed"
             record.alloc_deadline = None
             record.alloc_fresh = set()
+            # The incumbent died without a successor: the drain (if
+            # one was open) resolved into a plain lease expiry.
+            record.draining = False
+            record.drain_deadline = None
 
     def _promote_committed_locked(  # holds-lock: _cond
         self, record: JobRecord
@@ -731,6 +816,60 @@ class ClusterState:
             self._slot_strikes[slot] = strikes
             if strikes >= self._strike_limit:
                 self._quarantined[slot] = now + self._quarantine_s
+
+    def _update_hazard_locked(  # holds-lock: _cond
+        self, kind: str, ts: float
+    ) -> None:
+        """Fold one observed reclaim into the kind's hazard EWMA:
+        exponential decay since the last event plus a 1/tau impulse —
+        at a steady reclaim rate R the estimate converges to R
+        events/second, and with no events it decays back toward zero
+        over ~tau. Anchored to the journaled wall timestamp so replay
+        reproduces the estimate exactly."""
+        rate, last = self._hazard.get(kind, (0.0, float(ts)))
+        dt = max(float(ts) - last, 0.0)
+        decayed = rate * math.exp(-dt / self._hazard_tau)
+        self._hazard[kind] = (
+            decayed + 1.0 / self._hazard_tau,
+            float(ts),
+        )
+
+    def _apply_preempt_locked(self, op: dict) -> None:  # holds-lock: _cond
+        """A reclaim notice: the job starts draining, its slots leave
+        the placement inventory for the notice window, and each slot's
+        kind pays a hazard observation. The notice's trace parent (the
+        worker minted it at notice time) becomes the job's — the
+        allocator's re-placement REUSES it, so the notice, the drain
+        save, and the successor's first step share one trace id."""
+        record = self._jobs[op["key"]]
+        now = time.monotonic()
+        notice_s = float(op.get("notice_s") or 30.0)
+        record.draining = True
+        record.drain_deadline = now + notice_s
+        if op.get("trace_parent"):
+            record.trace_parent = op["trace_parent"]
+        ts = op.get("ts") or time.time()
+        kinds = op.get("kinds") or {}
+        for slot in op.get("slots", []):
+            self._draining_slots[slot] = now + notice_s
+        # ONE notice = one observed reclaim: one hazard impulse (and
+        # one notice count) per affected KIND, however many of the
+        # job's slots share it — per-slot impulses would teach the
+        # EWMA that a 4-slice job's single notice was 4 reclaims.
+        for kind in sorted(
+            {kinds.get(slot, "spot") for slot in op.get("slots", [])}
+        ):
+            self._update_hazard_locked(kind, ts)
+            self._preempt_notices[kind] = (
+                self._preempt_notices.get(kind, 0) + 1
+            )
+        if not self._replaying:
+            trace.event(
+                "preempt.slot_withdrawn",
+                traceparent=record.trace_parent,
+                job=record.key,
+                slots=len(op.get("slots", [])),
+            )
 
     def _maybe_commit_locked(  # holds-lock: _cond
         self, record: JobRecord  # journaled
@@ -983,6 +1122,212 @@ class ClusterState:
                 self._cond.notify_all()
         return rolled
 
+    # -- preemption survival -------------------------------------------
+
+    def report_preemption(  # journaled
+        self,
+        key: str,
+        group: int | None = None,
+        rank: int | None = None,
+        slot: str | None = None,
+        notice_s: float | None = None,
+        trace_parent: str | None = None,
+    ) -> bool:
+        """Intake of a worker's reclaim notice (``POST /preempt``):
+        marks the job draining, withdraws the affected slots from the
+        placement inventory for the notice window, updates the
+        per-slot-kind hazard EWMA, and kicks the allocator so the
+        successor's allocation epoch opens DURING the notice window.
+        Idempotent per drain: repeat reports from other ranks of the
+        same doomed incarnation (or rpc retries) return False without
+        a second hazard observation. A stale incarnation's late notice
+        (``group`` below the current one) is ignored too."""
+        with self._cond:
+            record = self._jobs[key]
+            if record.status in FINISHED:
+                return False
+            if group is not None and group < record.group:
+                return False
+            now = time.monotonic()
+            if record.draining and (
+                record.drain_deadline is None
+                or now < record.drain_deadline
+            ):
+                return False
+            notice = float(
+                notice_s if notice_s else env.preempt_notice_s()
+            )
+            if slot:
+                slots = [slot]
+            else:
+                # The worker does not know which VM the notice was
+                # for, only that one of its hosts is going away:
+                # withdraw the job's PREEMPTIBLE slots (a reclaim
+                # cannot hit on-demand capacity, and draining a
+                # healthy on-demand slot would block re-placing the
+                # successor on it). Fall back to the whole allocation
+                # when the allocator has not registered preemptibility
+                # yet (e.g. right after a supervisor recovery).
+                slots = sorted(set(record.allocation))
+                known = [
+                    s for s in slots if s in self._preemptible_slots
+                ]
+                if known:
+                    slots = known
+            op = {
+                "op": "preempt",
+                "key": key,
+                "slots": slots,
+                # Kinds resolved at intake time (the allocator
+                # registers the slot->kind map each cycle) and
+                # journaled, so replay reproduces the hazard estimate
+                # without the map.
+                "kinds": {
+                    s: self._slot_kinds.get(s, "spot") for s in slots
+                },
+                "notice_s": notice,
+                "ts": time.time(),
+            }
+            if rank is not None:
+                op["rank"] = int(rank)
+            if trace_parent:
+                op["trace_parent"] = trace_parent
+            self._journal_append(op)
+            self._apply_preempt_locked(op)
+            # Wake the allocator NOW: re-placement must overlap the
+            # drain, not wait out the optimization interval.
+            self._alloc_kick += 1
+            self._cond.notify_all()
+            return True
+
+    def set_slot_kinds(
+        self,
+        kinds: dict[str, str],
+        preemptible: set[str] | frozenset[str] | None = None,
+    ) -> None:
+        """Allocator-registered inventory view: the slot->kind map
+        ("spot"/"ondemand"/...) that attributes preemption notices to
+        a hazard kind, and which slots are preemptible (a notice only
+        drains those). REPLACES the previous registration — the
+        allocator re-registers the full inventory every cycle, and
+        accumulating slots that left the inventory would grow without
+        bound under slice churn. In-memory only: derivable from the
+        inventory, and journaled preempt ops carry resolved kinds."""
+        with self._cond:
+            self._slot_kinds = {
+                str(k): str(v) for k, v in kinds.items()
+            }
+            if preemptible is not None:
+                self._preemptible_slots = {
+                    str(s) for s in preemptible
+                }
+
+    def _hazard_rates_locked(  # holds-lock: _cond
+        self, now: float
+    ) -> dict[str, float]:
+        # The EWMA tracks the kind's AGGREGATE notice rate (every
+        # reclaim of any slot of the kind lands in one estimator);
+        # per-SLOT hazard — what the policy charges per occupied
+        # slice and the mix policy prices per provisioned slice —
+        # divides by the kind's current fleet size. Unknown fleet
+        # (nothing registered yet) conservatively reads as size 1.
+        sizes: dict[str, int] = {}
+        for kind in self._slot_kinds.values():
+            sizes[kind] = sizes.get(kind, 0) + 1
+        return {
+            kind: (
+                rate
+                * math.exp(-max(now - last, 0.0) / self._hazard_tau)
+                / max(sizes.get(kind, 1), 1)
+            )
+            for kind, (rate, last) in self._hazard.items()
+        }
+
+    def hazard_rates(self, now: float | None = None) -> dict[str, float]:
+        """Per-slot reclaim hazard by slot kind (expected notices per
+        slot-second: the kind's aggregate EWMA over
+        ``ADAPTDL_HAZARD_TAU_S``, normalized by the kind's registered
+        fleet size), decayed to ``now`` (wall clock — the estimate is
+        journal-anchored so it survives supervisor restarts)."""
+        if now is None:
+            now = time.time()
+        with self._cond:
+            return self._hazard_rates_locked(float(now))
+
+    def _prune_draining_locked(  # holds-lock: _cond
+        self, now: float
+    ) -> None:
+        """A drain window that lapsed means the slot was reclaimed
+        (the provisioner stops listing it) or the notice was canceled
+        (the slot is healthy again) — either way it stops being
+        special to the allocator."""
+        for slot in [
+            slot
+            for slot, until in self._draining_slots.items()
+            if until <= now
+        ]:
+            del self._draining_slots[slot]
+
+    def draining_slots(self, now: float | None = None) -> list[str]:
+        """Slots under an active reclaim notice: withdrawn from the
+        placement inventory for the notice window."""
+        now = time.monotonic() if now is None else now
+        with self._cond:
+            self._prune_draining_locked(now)
+            return sorted(self._draining_slots)
+
+    def preemption_info(self, now: float | None = None) -> dict:
+        """Preemption observability in one locked snapshot: notice
+        counts and decayed hazard rate per slot kind, plus the slots
+        currently draining with their remaining notice window."""
+        wall = time.time()
+        now = time.monotonic() if now is None else now
+        with self._cond:
+            self._prune_draining_locked(now)
+            return {
+                "noticesByKind": dict(self._preempt_notices),
+                "hazardRates": self._hazard_rates_locked(wall),
+                "drainingSlots": {
+                    slot: max(until - now, 0.0)
+                    for slot, until in self._draining_slots.items()
+                },
+            }
+
+    def kick_allocator(self) -> None:
+        """Wake any allocator blocked in :meth:`wait_alloc_kick`."""
+        with self._cond:
+            self._alloc_kick += 1
+            self._cond.notify_all()
+
+    def alloc_kick_count(self) -> int:
+        """The kick counter, snapshotted BEFORE an optimization cycle
+        and passed back as :meth:`wait_alloc_kick`'s baseline — a kick
+        landing while the cycle runs then wakes the next wait
+        immediately instead of being silently consumed."""
+        with self._cond:
+            return self._alloc_kick
+
+    def wait_alloc_kick(
+        self, timeout: float, seen: int | None = None
+    ) -> bool:
+        """Block until something demands an immediate re-optimization
+        (a preemption notice, an explicit kick) or ``timeout`` lapses;
+        True when kicked. ``seen`` is the caller's counter baseline
+        (:meth:`alloc_kick_count`, taken before its last cycle);
+        None means "from now". The allocator's cycle loop waits here
+        instead of a plain sleep, so notice-driven re-placement
+        overlaps the drain window."""
+        deadline = time.monotonic() + max(timeout, 0.0)
+        with self._cond:
+            if seen is None:
+                seen = self._alloc_kick
+            while self._alloc_kick == seen:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
+
     # -- readers -------------------------------------------------------
 
     def lifecycle_metrics(self) -> dict:
@@ -1136,6 +1481,13 @@ class ClusterState:
                     "workers": len(record.workers),
                     "allocEpoch": record.alloc_epoch,
                     "allocState": record.alloc_state,
+                    "draining": record.draining,
+                    "drainRemainingS": (
+                        max(record.drain_deadline - now, 0.0)
+                        if record.draining
+                        and record.drain_deadline is not None
+                        else None
+                    ),
                     "leaseRemainingS": {
                         str(rank): max(deadline - now, 0.0)
                         for rank, deadline in record.leases.items()

@@ -16,6 +16,12 @@ backend-agnostic; reference: sched/adaptdl_sched/supervisor.py:45-80):
   incarnation's dying beats and lets single-process jobs — which
   never register — prove a pending allocation epoch alive.
 - ``GET /hints/{namespace}/{name}``, ``GET /healthz``.
+- ``POST /preempt/{namespace}/{name}`` — reclaim-notice intake: the
+  worker reports a preemption notice the moment it lands; the
+  supervisor withdraws the doomed slots from inventory, updates the
+  per-slot-kind hazard EWMA, and kicks the allocator so the
+  successor's allocation epoch opens *during* the notice window.
+  Idempotent per drain (retries and sibling ranks fold into one).
 - ``GET /status`` — operator-facing JSON: per-job phase, degraded
   flag, allocation epoch/state, lease ages, plus slot strikes,
   quarantine, and recovery info (the ``adaptdl-tpu status`` CLI).
@@ -274,6 +280,50 @@ class Supervisor(ThreadedHttpServer):
             return web.json_response({"error": "no such job"}, status=404)
         return web.json_response(snapshot)
 
+    @_faultable("sup.preempt.pre")
+    async def _preempt(self, request: web.Request) -> web.Response:
+        """Reclaim-notice intake (``POST /preempt/{job}``): the worker
+        reports the notice the moment it lands, so the supervisor
+        withdraws the doomed slots and the allocator opens the
+        successor's epoch DURING the notice window — re-placement
+        overlaps the drain instead of waiting for lease expiry.
+        Idempotent: rpc retries and sibling ranks of the same doomed
+        incarnation fold into one drain."""
+        key = "{namespace}/{name}".format(**request.match_info)
+        try:
+            body = await request.json()
+        except ValueError:
+            body = {}
+        if not isinstance(body, dict):
+            body = {}
+        if self._state.get_job(key) is None:
+            return web.json_response(
+                {"error": "no such job"}, status=404
+            )
+
+        def mutate() -> bool:
+            accepted = self._state.report_preemption(
+                key,
+                group=body.get("group"),
+                rank=body.get("rank"),
+                slot=body.get("slot"),
+                notice_s=body.get("noticeS"),
+                trace_parent=body.get("traceParent"),
+            )
+            if accepted and body.get("rank") is not None:
+                # The notice is also proof of life (for a few more
+                # seconds): piggyback the lease renewal like any
+                # other worker traffic.
+                self._renew(
+                    key, int(body["rank"]), group=body.get("group")
+                )
+            return accepted
+
+        accepted = await self._offload(mutate)
+        return web.json_response(
+            {"ok": True, "draining": bool(accepted)}
+        )
+
     async def _healthz(self, request: web.Request) -> web.Response:
         return web.json_response({"ok": True})
 
@@ -301,6 +351,20 @@ class Supervisor(ThreadedHttpServer):
         }
         payload["rollbacks"] = health["rollbacks"]
         payload["recovery"] = self._state.recovery_info()
+        # Preemption survival: which slots are draining under an
+        # active notice, the per-kind hazard estimate, and notice
+        # counts — the operator's answer to "why did that job move
+        # off spot".
+        preempt = self._state.preemption_info()
+        payload["drainingSlots"] = {
+            slot: round(remaining, 3)
+            for slot, remaining in preempt["drainingSlots"].items()
+        }
+        payload["hazardRates"] = {
+            kind: round(rate, 9)
+            for kind, rate in preempt["hazardRates"].items()
+        }
+        payload["preemptionNotices"] = preempt["noticesByKind"]
         return web.json_response(payload)
 
     # -- graftscope: worker span intake + stitched per-job timeline --
@@ -491,6 +555,27 @@ class Supervisor(ThreadedHttpServer):
             "1 for slots quarantined away from the allocator.",
         )
         b.family(
+            "adaptdl_preemption_notices_total",
+            "counter",
+            "Reclaim notices observed, by slot kind.",
+        )
+        b.family(
+            "adaptdl_slot_draining",
+            "gauge",
+            "1 for slots draining under an active reclaim notice.",
+        )
+        b.family(
+            "adaptdl_job_draining",
+            "gauge",
+            "1 while a job drains after a preemption notice.",
+        )
+        b.family(
+            "adaptdl_hazard_rate",
+            "gauge",
+            "EWMA reclaim hazard per slot kind (notices per "
+            "slot-second).",
+        )
+        b.family(
             "adaptdl_supervisor_recoveries_total",
             "counter",
             "Durable-state recoveries this cluster has performed.",
@@ -555,6 +640,9 @@ class Supervisor(ThreadedHttpServer):
                 labels,
                 int(record.alloc_state == "pending"),
             )
+            b.sample(
+                "adaptdl_job_draining", labels, int(record.draining)
+            )
         # Transactional-rescale + durable-state observability: the
         # rollback/quarantine gauges the chaos acceptance checks read.
         health = self._state.slot_health()
@@ -566,6 +654,21 @@ class Supervisor(ThreadedHttpServer):
             b.sample("adaptdl_slot_strikes", {"slot": slot}, count)
         for slot in sorted(health["quarantined"]):
             b.sample("adaptdl_slot_quarantined", {"slot": slot}, 1)
+        preempt = self._state.preemption_info()
+        for kind, count in sorted(
+            preempt["noticesByKind"].items()
+        ):
+            b.sample(
+                "adaptdl_preemption_notices_total",
+                {"kind": kind},
+                count,
+            )
+        for slot in sorted(preempt["drainingSlots"]):
+            b.sample("adaptdl_slot_draining", {"slot": slot}, 1)
+        for kind, rate in sorted(preempt["hazardRates"].items()):
+            b.sample(
+                "adaptdl_hazard_rate", {"kind": kind}, round(rate, 9)
+            )
         recovery = self._state.recovery_info()
         b.sample(
             "adaptdl_supervisor_recoveries_total",
@@ -664,6 +767,9 @@ class Supervisor(ThreadedHttpServer):
                 web.get("/config/{namespace}/{name}", self._get_config),
                 web.put("/trace/{namespace}/{name}", self._put_trace),
                 web.get("/trace/{namespace}/{name}", self._get_trace),
+                web.post(
+                    "/preempt/{namespace}/{name}", self._preempt
+                ),
                 web.get("/healthz", self._healthz),
                 web.get("/status", self._status),
                 web.get("/metrics", self._metrics),

@@ -1,0 +1,487 @@
+"""Chaos suite for preemption survival (notice-driven drain).
+
+The headline acceptance: a training run whose worker receives a
+fault-injected preemption notice — through the REAL listener, drain
+window honored, supervisor notified over REAL HTTP — loses ZERO steps
+against the undisturbed run (exact trained-state equality), the
+successor's first step lands well inside the old lease TTL (the
+re-placement overlapped the drain instead of waiting for expiry), and
+the notice, the drain save, and the successor's first step share ONE
+trace id end to end.
+
+Plus the ugly windows: the supervisor 500s the notice report (the
+resilient client retries through it), the VM dies mid-drain-save (the
+previous complete checkpoint survives untouched), and the supervisor
+is hard-killed mid-drain (recovery preserves the hazard EWMA and the
+draining verdicts, and the allocator still re-places off the doomed
+slot)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from adaptdl_tpu import checkpoint, faults, rpc, sched_hints, trace
+from adaptdl_tpu._compat import pick_unused_port
+from adaptdl_tpu.sched import preemption
+from adaptdl_tpu.sched.allocator import Allocator
+from adaptdl_tpu.sched.policy import NodeInfo, PolluxPolicy
+from adaptdl_tpu.sched.state import ClusterState
+from adaptdl_tpu.sched.supervisor import Supervisor
+
+pytestmark = pytest.mark.chaos
+
+SEED = 1234
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LEASE_TTL = 10.0
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.reset()
+    rpc.reset_default_client()
+    preemption.reset_notice()
+    yield
+    faults.reset()
+    rpc.reset_default_client()
+    preemption.reset_notice()
+    from adaptdl_tpu import _signal
+
+    _signal.set_exit_flag(False)
+
+
+class _TrainerSim:
+    """Deterministic stand-in trainer: the update depends only on
+    (weights, step), so any correct recovery reproduces the
+    undisturbed trajectory bit-for-bit."""
+
+    def __init__(self):
+        self.w = np.zeros(8, dtype=np.float64)
+        self.step = 0
+
+    def train_step(self):
+        rng = np.random.default_rng(self.step)
+        grad = rng.normal(size=self.w.shape)
+        self.w = self.w - 0.01 * grad + 0.001 * np.sin(self.w)
+        self.step += 1
+
+
+class _SimState(checkpoint.State):
+    def __init__(self, sim):
+        super().__init__("preempt_chaos_sim")
+        self.sim = sim
+
+    def save(self, fileobj):
+        np.save(fileobj, self.sim.w, allow_pickle=False)
+        fileobj.write(self.sim.step.to_bytes(8, "big"))
+
+    def load(self, fileobj):
+        import io
+
+        blob = fileobj.read()
+        self.sim.w = np.load(io.BytesIO(blob[:-8]), allow_pickle=False)
+        self.sim.step = int.from_bytes(blob[-8:], "big")
+
+
+def _run_spot_sim(
+    tmp_path, monkeypatch, tag, preempt_at=None, total_steps=24
+):
+    """A worker-like loop against a REAL supervisor + allocator over
+    HTTP, on a spot slice. ``preempt_at`` injects a reclaim notice
+    through the real listener at that step; the incumbent drains
+    (urgent_drain), "dies", and its successor resumes from the drain
+    save on whatever slot the kicked allocator chose. Returns the
+    final weights, restart count, timing facts, and the job's
+    stitched trace."""
+    job = "c/spot"
+    ckpt_dir = tmp_path / f"ckpt-{tag}"
+    ckpt_dir.mkdir()
+    port = pick_unused_port()
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(ckpt_dir))
+    monkeypatch.setenv(
+        "ADAPTDL_SUPERVISOR_URL", f"http://127.0.0.1:{port}"
+    )
+    monkeypatch.setenv("ADAPTDL_JOB_ID", job)
+    monkeypatch.setenv("ADAPTDL_NUM_RESTARTS", "0")
+    monkeypatch.delenv("ADAPTDL_TRACEPARENT", raising=False)
+
+    state = ClusterState(alloc_commit_timeout=30.0)
+    state.create_job(
+        job, spec={"min_replicas": 1, "max_replicas": 1}
+    )
+    state.update(job, allocation=["spot-0"], status="Running")
+    nodes = {
+        "spot-0": NodeInfo(resources={"tpu": 1}, preemptible=True),
+        "od-0": NodeInfo(resources={"tpu": 1}),
+    }
+    supervisor = Supervisor(
+        state, port=port, lease_ttl=LEASE_TTL, sweep_interval=0.2
+    )
+    supervisor.start()
+    allocator = Allocator(
+        state,
+        nodes,
+        policy=PolluxPolicy(pop_size=16, generations=10),
+        interval=60.0,  # the NOTICE must drive the re-placement
+    )
+    allocator.start()
+    assert state.get_job(job).allocation == ["spot-0"]
+
+    checkpoint._reset_registry()
+    sim = _TrainerSim()
+    sim_state = _SimState(sim)
+    checkpoint.load_state(sim_state)
+    group = 0
+    restarts = 0
+    seen_alloc = None
+    listener_stop = None
+    notice_at_mono = None
+    first_step_after_restart = False
+    successor_first_step_mono = None
+    try:
+        while sim.step < total_steps:
+            step = sim.step
+            assert sched_hints.send_heartbeat(rank=0, group=group)
+            config = sched_hints.fetch_job_config()
+            if config is not None and config["allocation"]:
+                alloc = config["allocation"]
+                if seen_alloc is None:
+                    seen_alloc = alloc
+                elif alloc != seen_alloc:
+                    # The incumbent reacts exactly like the product
+                    # loop (data._check_exit): a notice routes the
+                    # final save through the urgent drain.
+                    if preemption.notice_active():
+                        summary = preemption.urgent_drain()
+                        assert summary["deadlineMet"], summary
+                    else:
+                        checkpoint.save_all_states()
+                    # Simulated death + successor launch: fresh
+                    # registry, bumped restart group, and the
+                    # launcher's ADAPTDL_TRACEPARENT export so the
+                    # successor joins the decision's trace.
+                    checkpoint._reset_registry()
+                    preemption.reset_notice()
+                    restarts += 1
+                    group += 1
+                    monkeypatch.setenv(
+                        "ADAPTDL_NUM_RESTARTS", str(group)
+                    )
+                    record = state.get_job(job)
+                    if record.trace_parent:
+                        monkeypatch.setenv(
+                            "ADAPTDL_TRACEPARENT",
+                            record.trace_parent,
+                        )
+                    trace.init_from_env(force=True)
+                    trace.begin_pending(
+                        "restart.first_step", restarts=group
+                    )
+                    first_step_after_restart = True
+                    sim = _TrainerSim()
+                    sim_state = _SimState(sim)
+                    checkpoint.load_state(sim_state)
+                    seen_alloc = alloc
+                    # The successor's liveness commits the epoch.
+                    assert sched_hints.send_heartbeat(
+                        rank=0, group=group
+                    )
+            sim.train_step()
+            if first_step_after_restart:
+                first_step_after_restart = False
+                successor_first_step_mono = time.monotonic()
+                trace.end_pending("restart.first_step")
+                trace.flush_to_supervisor()
+            if (
+                preempt_at is not None
+                and step == preempt_at
+                and notice_at_mono is None
+            ):
+                # The REAL listener path: the preempt.notice fault
+                # point simulates the metadata server flipping TRUE.
+                faults.configure("preempt.notice=fail@1", seed=SEED)
+                listener_stop = preemption.start_listener(
+                    "http://127.0.0.1:9/unused", interval=0.02
+                )
+                deadline = time.monotonic() + 5.0
+                while (
+                    not preemption.notice_active()
+                    and time.monotonic() < deadline
+                ):
+                    time.sleep(0.01)
+                assert preemption.notice_active()
+                notice_at_mono = time.monotonic()
+                faults.configure(None)
+        record = state.get_job(job)
+        # The stitched view over real HTTP — what the acceptance
+        # check and the `adaptdl-tpu trace` CLI actually read.
+        spans = (
+            rpc.default_client()
+            .get(f"http://127.0.0.1:{port}/trace/{job}")
+            .json()["spans"]
+        )
+        return {
+            "weights": sim.w.copy(),
+            "restarts": restarts,
+            "final_alloc": list(record.allocation),
+            "alloc_state": record.alloc_state,
+            "draining": record.draining,
+            "notice_at": notice_at_mono,
+            "successor_first_step_at": successor_first_step_mono,
+            "spans": spans,
+            "trace_parent": record.trace_parent,
+        }
+    finally:
+        if listener_stop is not None:
+            listener_stop.set()
+        allocator.stop()
+        supervisor.stop()
+        checkpoint._reset_registry()
+
+
+def test_preemption_notice_loss_equality_and_one_trace(
+    tmp_path, monkeypatch
+):
+    """Acceptance: the run that takes a reclaim notice (drain honored,
+    successor re-placed DURING the notice window) ends bit-for-bit
+    equal to the undisturbed run; the successor's first step lands
+    well before the old lease would have expired; and the notice, the
+    drain save, and the successor's first step all carry one trace
+    id — proven over real HTTP via the supervisor's stitched view."""
+    base = _run_spot_sim(tmp_path, monkeypatch, "base")
+    rpc.reset_default_client()
+    preemption.reset_notice()
+    from adaptdl_tpu import _signal
+
+    _signal.set_exit_flag(False)
+    chaos = _run_spot_sim(
+        tmp_path, monkeypatch, "chaos", preempt_at=8
+    )
+    assert base["restarts"] == 0
+    assert chaos["restarts"] == 1, (
+        "exactly the one notice-driven restart"
+    )
+    # Chaos loss-equality: zero steps lost beyond the drain save.
+    np.testing.assert_array_equal(chaos["weights"], base["weights"])
+    # The successor came up by notice-driven re-placement, off the
+    # doomed slot, and its epoch committed.
+    assert chaos["final_alloc"] == ["od-0"]
+    assert chaos["alloc_state"] == "committed"
+    assert not chaos["draining"], "drain served by the successor"
+    # Replacement overlapped the drain: first successor step landed
+    # well inside the old lease TTL (the pre-PR floor was a full
+    # lease expiry plus an allocator cycle).
+    latency = (
+        chaos["successor_first_step_at"] - chaos["notice_at"]
+    )
+    assert latency < LEASE_TTL, latency
+    # One trace id across the whole survival arc.
+    by_name = {}
+    for rec in chaos["spans"]:
+        by_name.setdefault(rec["name"], []).append(rec)
+    for name in (
+        "preempt.notice",
+        "drain.save",
+        "restart.first_step",
+    ):
+        assert by_name.get(name), f"missing span {name}"
+    survival_trace = {
+        rec["trace"]
+        for name in (
+            "preempt.notice", "drain.save", "restart.first_step"
+        )
+        for rec in by_name[name]
+    }
+    assert len(survival_trace) == 1, survival_trace
+    parsed = trace.parse_traceparent(chaos["trace_parent"])
+    assert parsed is not None and parsed[0] in survival_trace, (
+        "the job's published trace parent IS the survival trace"
+    )
+
+
+def test_notice_report_retries_through_supervisor_500(
+    tmp_path, monkeypatch
+):
+    """sup.preempt.pre=fail@1: the first POST /preempt becomes a 500;
+    the resilient client retries inside the notice window and the
+    drain verdict still lands."""
+    job = "c/retry"
+    port = pick_unused_port()
+    monkeypatch.setenv(
+        "ADAPTDL_SUPERVISOR_URL", f"http://127.0.0.1:{port}"
+    )
+    monkeypatch.setenv("ADAPTDL_JOB_ID", job)
+    state = ClusterState(alloc_commit_timeout=30.0)
+    state.create_job(job, spec={})
+    state.update(job, allocation=["spot-0"], status="Running")
+    supervisor = Supervisor(state, port=port, lease_ttl=LEASE_TTL)
+    supervisor.start()
+    try:
+        assert preemption.deliver_notice(
+            source="test", notify=False
+        )
+        faults.configure("sup.preempt.pre=fail@1", seed=SEED)
+        assert preemption.notify_supervisor()
+        assert faults.hit_count("sup.preempt.pre") >= 2
+        assert state.get_job(job).draining
+        assert preemption.notice_state()["reported"] is True
+    finally:
+        supervisor.stop()
+
+
+_DRAIN_KILL_SCRIPT = textwrap.dedent(
+    """
+    import sys
+
+    from adaptdl_tpu import checkpoint, faults
+    from adaptdl_tpu.sched import preemption
+
+
+    class Blob(checkpoint.State):
+        def __init__(self):
+            super().__init__("w")
+            self.payload = b"before"
+
+        def save(self, fileobj):
+            fileobj.write(self.payload)
+
+        def load(self, fileobj):
+            self.payload = fileobj.read()
+
+
+    state = Blob()
+    checkpoint.save_all_states()  # the durable baseline (seq 0)
+    state.payload = b"after"
+    # The reclaim lands mid-drain-write: the VM dies inside the
+    # drain save's per-state serialization (hit counters are
+    # per-schedule, so the drain's write is hit 1 of THIS schedule).
+    faults.configure("ckpt.write.state=exit@1", seed=int(sys.argv[1]))
+    preemption.deliver_notice(source="test", notify=False)
+    preemption.urgent_drain()
+    print("UNREACHABLE")
+    """
+)
+
+
+def test_window_expires_mid_drain_save_keeps_previous_checkpoint(
+    tmp_path,
+):
+    """The notice window expiring mid-save (VM hard-killed inside the
+    drain's write) must never cost the PREVIOUS complete checkpoint:
+    the successor restores the baseline, not garbage."""
+    env = dict(
+        os.environ,
+        ADAPTDL_CHECKPOINT_PATH=str(tmp_path),
+        ADAPTDL_REPLICA_RANK="0",
+        ADAPTDL_NUM_RESTARTS="0",
+        JAX_PLATFORMS="cpu",
+    )
+    env.pop("ADAPTDL_FAULT_SPEC", None)
+    env.pop("ADAPTDL_SUPERVISOR_URL", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _DRAIN_KILL_SCRIPT, str(SEED)],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1, proc.stderr
+    assert "UNREACHABLE" not in proc.stdout
+    dirs = checkpoint.scan_versioned_dirs(
+        str(tmp_path), checkpoint._CKPT_DIR_PATTERN
+    )
+    assert [(r, s) for r, s, _ in dirs] == [(0, 0)], (
+        "only the pre-drain complete checkpoint survives"
+    )
+    manifest = checkpoint.read_manifest(dirs[0][2])
+    assert manifest is not None and "w" in manifest["states"]
+    with open(os.path.join(dirs[0][2], "w"), "rb") as f:
+        assert f.read() == b"before"
+
+
+def test_supervisor_hard_kill_mid_drain_recovers_and_replaces(
+    tmp_path,
+):
+    """Supervisor hard-killed after the notice intake (in-memory
+    state discarded, WAL only): recovery preserves the hazard EWMA,
+    the notice counters, and the draining verdicts — and a recovered
+    allocator still re-places the job off the doomed slot."""
+    job = "c/crash"
+    state_dir = str(tmp_path / "sched")
+    port = pick_unused_port()
+
+    def boot():
+        st = ClusterState(
+            state_dir=state_dir,
+            alloc_commit_timeout=30.0,
+            reconcile_window=0.5,
+        )
+        if st.get_job(job) is None:
+            st.create_job(
+                job, spec={"min_replicas": 1, "max_replicas": 1}
+            )
+            st.update(
+                job, allocation=["spot-0"], status="Running"
+            )
+        st.set_slot_kinds(
+            {"spot-0": "spot", "od-0": "ondemand"}
+        )
+        sup = Supervisor(
+            st, port=port, lease_ttl=LEASE_TTL, sweep_interval=0.2
+        )
+        sup.start()
+        return st, sup
+
+    state, supervisor = boot()
+    client = rpc.default_client()
+    url = f"http://127.0.0.1:{port}"
+    client.post(
+        f"{url}/preempt/{job}",
+        json={"group": 0, "rank": 0, "noticeS": 30.0},
+    ).raise_for_status()
+    hazard_before = state.hazard_rates()["spot"]
+    assert hazard_before > 0
+    # Hard kill: HTTP face dies, memory dropped, WAL only.
+    supervisor.stop()
+    del state
+    state, supervisor = boot()
+    try:
+        assert state.get_job(job).draining
+        assert state.draining_slots() == ["spot-0"]
+        now = time.time()
+        assert state.hazard_rates(now=now)[
+            "spot"
+        ] == pytest.approx(hazard_before, rel=0.01)
+        assert state.preemption_info()["noticesByKind"] == {
+            "spot": 1
+        }
+        allocator = Allocator(
+            state,
+            {
+                "spot-0": NodeInfo(
+                    resources={"tpu": 1}, preemptible=True
+                ),
+                "od-0": NodeInfo(resources={"tpu": 1}),
+            },
+            policy=PolluxPolicy(pop_size=16, generations=10),
+        )
+        allocator.optimize_once()
+        record = state.get_job(job)
+        assert record.allocation == ["od-0"], (
+            "recovered allocator must still re-place off the "
+            "draining slot"
+        )
+        text = client.get(f"{url}/metrics").text
+        assert (
+            'adaptdl_preemption_notices_total{kind="spot"} 1'
+            in text
+        )
+    finally:
+        supervisor.stop()

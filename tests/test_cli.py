@@ -229,8 +229,8 @@ def test_status_surfaces_degraded_leases_and_quarantine(capsys):
         out = capsys.readouterr().out
         lines = out.splitlines()
         assert lines[0].split() == [
-            "JOB", "PHASE", "REPLICAS", "DEGRADED", "ALLOC",
-            "RESTARTS", "LEASES",
+            "JOB", "PHASE", "REPLICAS", "DEGRADED", "DRAIN",
+            "ALLOC", "RESTARTS", "LEASES",
         ]
         ok_row = next(l for l in lines if l.startswith("ns/ok"))
         assert "no" in ok_row.split()
@@ -243,6 +243,20 @@ def test_status_surfaces_degraded_leases_and_quarantine(capsys):
             l.split()[:2] == ["bad", "1"] for l in lines if l.strip()
         ), out
         assert "QUARANTINED" in out
+        # PR-8 drain state: a reclaim notice shows up as the job's
+        # DRAIN countdown plus the draining-slot and hazard lines.
+        state.set_slot_kinds({"s0": "spot"})
+        assert state.report_preemption(
+            "ns/ok", group=0, rank=0, notice_s=30.0
+        )
+        assert main(["status", "--supervisor", url]) == 0
+        out = capsys.readouterr().out
+        ok_row = next(
+            l for l in out.splitlines() if l.startswith("ns/ok")
+        )
+        assert "s left" in ok_row, "drain countdown rendered"
+        assert "draining slots (reclaim notice): s0" in out
+        assert "reclaim hazard: spot=" in out
     finally:
         supervisor.stop()
 

@@ -186,6 +186,109 @@ def test_policy_allocates_dp_sp_mesh_for_long_context():
     assert topo > pure_dp
 
 
+def test_hazard_pricing_places_expensive_restart_on_ondemand():
+    """Acceptance: with one spot slice (nonzero reclaim hazard) and
+    one on-demand slice, the job with the measured EXPENSIVE restart
+    cost lands on on-demand while the cheap-restart job soaks up
+    spot — deterministically (fixed GA seed, identical inputs)."""
+    nodes = {
+        "ondemand-0": NodeInfo(resources={"tpu": 4}),
+        "spot-0": NodeInfo(
+            resources={"tpu": 4}, preemptible=True, hazard=1 / 600.0
+        ),
+    }
+
+    def jobs():
+        return {
+            # Ordered so creation-timestamp priority alone would give
+            # the CHEAP job the preferred (on-demand) slice — only
+            # hazard pricing flips the assignment.
+            "cheap": JobInfo(
+                resources={"tpu": 1},
+                speedup_fn=_speedup_fn(),
+                creation_timestamp=0.0,
+                min_replicas=2,
+                max_replicas=4,
+                restart_cost_s=2.0,
+            ),
+            "expensive": JobInfo(
+                resources={"tpu": 1},
+                speedup_fn=_speedup_fn(),
+                creation_timestamp=1.0,
+                min_replicas=2,
+                max_replicas=4,
+                restart_cost_s=240.0,
+            ),
+        }
+
+    template = NodeInfo(resources={"tpu": 4})
+    results = []
+    for _ in range(2):
+        policy = PolluxPolicy(pop_size=24, generations=20)
+        allocations, _ = policy.optimize(
+            jobs(), dict(nodes), {}, template
+        )
+        results.append(
+            {k: sorted(v) for k, v in allocations.items()}
+        )
+    assert results[0] == results[1], "must be deterministic"
+    assert set(results[0]["expensive"]) == {"ondemand-0"}, results[0]
+    assert set(results[0]["cheap"]) == {"spot-0"}, results[0]
+
+
+def test_hazard_expected_loss_exact_objective_math():
+    """The hazard term's exact effect on the objective: with hazard h
+    on the occupied slice and measured restart cost c, the scored
+    goodput is the hazard-free score times (1 - min(h*c, 0.9)); with
+    h = 0 the objective is BIT-IDENTICAL to the pre-hazard scoring
+    (the regression guard for every existing deployment)."""
+    from adaptdl_tpu.sched.policy.pollux import (
+        MAX_HAZARD_LOSS,
+        _Problem,
+    )
+
+    def problem(hazard, cost):
+        job = JobInfo(
+            resources={"tpu": 1},
+            speedup_fn=_speedup_fn(),
+            min_replicas=1,
+            max_replicas=4,
+            restart_cost_s=cost,
+        )
+        nodes = [
+            NodeInfo(resources={"tpu": 4}, hazard=hazard),
+            NodeInfo(resources={"tpu": 4}),
+        ]
+        return _Problem(
+            [job], nodes, np.zeros((1, 2), dtype=int)
+        )
+
+    # One replica on the hazardous slice; two on the safe one.
+    states = np.array([[[1, 0]], [[0, 2]]], dtype=int)
+    flat = states.reshape(2, -1)
+    for hazard, cost in [
+        (1 / 600.0, 240.0),   # loss 0.4
+        (1 / 60.0, 600.0),    # saturates at MAX_HAZARD_LOSS
+    ]:
+        f_free = problem(0.0, cost).evaluate(flat)
+        f_hz = problem(hazard, cost).evaluate(flat)
+        loss = min(hazard * cost, MAX_HAZARD_LOSS)
+        # Row 0 occupies the hazardous slice: scaled by (1 - loss).
+        assert f_hz[0, 0] == pytest.approx(
+            f_free[0, 0] * (1 - loss)
+        )
+        # Row 1 never touches it: identical score.
+        assert f_hz[1, 0] == f_free[1, 0]
+    # Zero hazard everywhere: the restart cost is unreachable (it
+    # only enters through the hazard product), so the objective is
+    # bit-identical whatever cost the job measured — i.e. exactly
+    # the pre-hazard scoring.
+    np.testing.assert_array_equal(
+        problem(0.0, 240.0).evaluate(flat),
+        problem(0.0, None).evaluate(flat),
+    )
+
+
 def test_speedup_best_config_pure_dp_defaults():
     fn = _speedup_fn()
     bsz, accum, sp, tp, ss, ep, micro = fn.best_config(1, 4)

@@ -1,0 +1,501 @@
+"""Scheduler-side preemption survival: the draining slot state
+machine, the per-slot-kind hazard EWMA, the POST /preempt intake, and
+the allocator's notice-driven re-placement (slot exclusion + survival
+trace reuse + kicked cycle)."""
+
+import math
+import threading
+import time
+
+import pytest
+import requests
+
+from adaptdl_tpu import trace
+from adaptdl_tpu.sched.allocator import (
+    Allocator,
+    job_info_from_hints,
+    restart_cost_s_from_stats,
+    slot_kind,
+)
+from adaptdl_tpu.sched.policy import NodeInfo, PolluxPolicy
+from adaptdl_tpu.sched.state import ClusterState
+from adaptdl_tpu.sched.supervisor import Supervisor
+
+HINTS = {
+    "initBatchSize": 128,
+    "localBszBounds": [64, 256],
+    "maxBatchSize": 1280,
+    "maxProfiledReplicas": 2,
+    "gradientAccumulation": True,
+    "gradParams": {"sqr": 0.00136, "var": 0.000502},
+    "perfParams": {
+        "alpha_c": 0.121,
+        "beta_c": 0.00568,
+        "alpha_n": 0.0236,
+        "beta_n": 0.00634,
+        "alpha_r": 0.0118,
+        "beta_r": 0.00317,
+        "gamma": 1.14,
+    },
+}
+
+
+# ---- restart-cost extraction -----------------------------------------
+
+
+def test_restart_cost_s_from_stats():
+    assert restart_cost_s_from_stats(None) is None
+    assert restart_cost_s_from_stats({"numRetunes": 3}) is None
+    stats = {"snapshotS": 1.5, "writeS": 2.0, "restoreS": 0.5}
+    assert restart_cost_s_from_stats(stats) == pytest.approx(4.0)
+    info = job_info_from_hints(
+        dict(HINTS, restartStats=stats), {"max_replicas": 8}, 0.0
+    )
+    assert info.restart_cost_s == pytest.approx(4.0)
+    assert info.restart_penalty is not None
+
+
+def test_slot_kind_resolution():
+    assert slot_kind(NodeInfo(resources={"tpu": 4})) == "ondemand"
+    assert (
+        slot_kind(NodeInfo(resources={"tpu": 4}, preemptible=True))
+        == "spot"
+    )
+    assert (
+        slot_kind(
+            NodeInfo(resources={"tpu": 4}, extra={"kind": "v5e-spot"})
+        )
+        == "v5e-spot"
+    )
+
+
+# ---- state machine ---------------------------------------------------
+
+
+def _draining_state(**kwargs):
+    kwargs.setdefault("alloc_commit_timeout", 30.0)
+    state = ClusterState(**kwargs)
+    state.create_job("ns/j", spec={"max_replicas": 4})
+    state.update(
+        "ns/j", allocation=["spot-0", "spot-0"], status="Running"
+    )
+    state.set_slot_kinds({"spot-0": "spot", "od-0": "ondemand"})
+    return state
+
+
+def test_report_preemption_marks_draining_and_withdraws_slots():
+    state = _draining_state()
+    tp = trace.new_traceparent()
+    assert state.report_preemption(
+        "ns/j", group=0, rank=0, notice_s=30.0, trace_parent=tp
+    )
+    record = state.get_job("ns/j")
+    assert record.draining
+    assert record.trace_parent == tp
+    # The job's slots leave the inventory for the notice window, and
+    # the spot kind pays one hazard observation.
+    assert state.draining_slots() == ["spot-0"]
+    assert state.hazard_rates()["spot"] > 0
+    info = state.preemption_info()
+    assert info["noticesByKind"] == {"spot": 1}
+    assert 0 < info["drainingSlots"]["spot-0"] <= 30.0
+
+
+def test_report_preemption_idempotent_per_drain():
+    state = _draining_state()
+    assert state.report_preemption("ns/j", group=0, rank=0)
+    # Sibling ranks / rpc retries of the same doomed incarnation fold
+    # into the one drain: no second hazard observation.
+    assert not state.report_preemption("ns/j", group=0, rank=1)
+    assert state.preemption_info()["noticesByKind"] == {"spot": 1}
+    # A stale incarnation's late notice is ignored outright.
+    state.register_worker("ns/j", 2, 0, "10.0.0.1")
+    assert not state.report_preemption("ns/j", group=1, rank=0)
+
+
+def test_group_bump_clears_draining():
+    state = _draining_state()
+    state.report_preemption("ns/j", group=0, rank=0)
+    assert state.get_job("ns/j").draining
+    # The successor incarnation announces itself: drain served.
+    state.renew_lease("ns/j", 0, ttl=30.0, group=1)
+    record = state.get_job("ns/j")
+    assert not record.draining
+    assert record.drain_deadline is None
+
+
+def test_lease_expiry_clears_draining():
+    state = _draining_state(reconcile_window=0.0)
+    state.renew_lease("ns/j", 0, ttl=0.01, group=0)
+    state.report_preemption("ns/j", group=0, rank=0)
+    time.sleep(0.05)
+    expired = state.expire_stale_leases()
+    assert ("ns/j", 0) in expired
+    assert not state.get_job("ns/j").draining
+
+
+def test_drain_window_lapses():
+    state = _draining_state()
+    state.report_preemption("ns/j", group=0, rank=0, notice_s=0.05)
+    assert state.draining_slots() == ["spot-0"]
+    time.sleep(0.08)
+    assert state.draining_slots() == []
+    # The lapsed drain also stops blocking a NEW notice (a later
+    # incarnation on the same, still-listed slot can drain again).
+    assert state.report_preemption("ns/j", group=0, rank=0)
+
+
+def test_hazard_ewma_converges_and_decays():
+    tau = 1000.0
+    state = ClusterState(hazard_tau_s=tau)
+    state.create_job("ns/h", spec={})
+    state.update("ns/h", allocation=["s-0"], status="Running")
+    state.set_slot_kinds({"s-0": "spot"})
+    now = time.time()
+    # Feed reclaims at exactly 1 per 50s through the journal-op path
+    # for ~5 tau (long enough to converge).
+    for i in range(100):
+        op = {
+            "op": "preempt",
+            "key": "ns/h",
+            "slots": ["s-0"],
+            "kinds": {"s-0": "spot"},
+            "notice_s": 30.0,
+            "ts": now + 50.0 * i,
+        }
+        with state._cond:
+            state._apply_preempt_locked(op)
+    last = now + 50.0 * 99
+    rate = state.hazard_rates(now=last)["spot"]
+    assert rate == pytest.approx(1 / 50.0, rel=0.05)
+    # Quiet for 3 tau: the estimate decays toward zero.
+    later = state.hazard_rates(now=last + 3 * tau)["spot"]
+    assert later == pytest.approx(rate * math.exp(-3.0), rel=0.01)
+
+
+def test_hazard_survives_restart_via_journal(tmp_path):
+    state_dir = str(tmp_path / "sched")
+    state = ClusterState(state_dir=state_dir, hazard_tau_s=3600.0)
+    state.create_job("ns/j", spec={})
+    state.update("ns/j", allocation=["spot-0"], status="Running")
+    state.set_slot_kinds({"spot-0": "spot"})
+    state.report_preemption("ns/j", group=0, rank=0, notice_s=0.01)
+    time.sleep(0.02)
+    state.report_preemption("ns/j", group=0, rank=0)
+    now = time.time()
+    before = state.hazard_rates(now=now)["spot"]
+    notices = state.preemption_info()["noticesByKind"]
+    del state
+    recovered = ClusterState(
+        state_dir=state_dir, hazard_tau_s=3600.0
+    )
+    assert recovered.hazard_rates(now=now)["spot"] == pytest.approx(
+        before
+    )
+    assert (
+        recovered.preemption_info()["noticesByKind"] == notices
+    )
+    assert recovered.get_job("ns/j").draining
+
+
+def test_notice_drains_only_preemptible_slots():
+    """A notice on a job spanning spot + on-demand withdraws (and
+    hazard-charges) only the preemptible slots: a reclaim cannot hit
+    on-demand capacity, and draining the healthy on-demand slot would
+    block re-placing the successor on it."""
+    state = ClusterState(alloc_commit_timeout=30.0)
+    state.create_job("ns/mix", spec={"max_replicas": 4})
+    state.update(
+        "ns/mix",
+        allocation=["spot-0", "od-0"],
+        status="Running",
+    )
+    state.set_slot_kinds(
+        {"spot-0": "spot", "od-0": "ondemand"},
+        preemptible={"spot-0"},
+    )
+    assert state.report_preemption("ns/mix", group=0, rank=0)
+    assert state.draining_slots() == ["spot-0"]
+    rates = state.hazard_rates()
+    assert rates.get("spot", 0) > 0
+    assert "ondemand" not in rates, (
+        "on-demand capacity must never earn reclaim hazard from a "
+        "spot notice"
+    )
+    assert state.preemption_info()["noticesByKind"] == {"spot": 1}
+
+
+def test_notice_charges_one_impulse_per_kind():
+    """One notice on a job holding several slots of one kind is ONE
+    observed reclaim, not one per slot — per-slot impulses would
+    teach the EWMA that a 4-slice job's notice was 4 reclaims."""
+    state = ClusterState(alloc_commit_timeout=30.0)
+    state.create_job("ns/wide", spec={"max_replicas": 4})
+    state.update(
+        "ns/wide",
+        allocation=["spot-0", "spot-1"],
+        status="Running",
+    )
+    state.set_slot_kinds(
+        {"spot-0": "spot", "spot-1": "spot"},
+        preemptible={"spot-0", "spot-1"},
+    )
+    state.report_preemption("ns/wide", group=0, rank=0)
+    assert state.draining_slots() == ["spot-0", "spot-1"]
+    assert state.preemption_info()["noticesByKind"] == {"spot": 1}
+
+
+def test_hazard_normalized_by_kind_fleet_size():
+    """The EWMA aggregates every notice of a kind; the served hazard
+    is per SLOT — divided by the kind's registered fleet size — so a
+    bigger spot fleet with the same per-slot reclaim rate does not
+    read as proportionally more hazardous."""
+    now = time.time()
+
+    def one_notice(state):
+        with state._cond:
+            state._apply_preempt_locked(
+                {
+                    "op": "preempt",
+                    "key": "ns/j",
+                    "slots": ["spot-0"],
+                    "kinds": {"spot-0": "spot"},
+                    "notice_s": 30.0,
+                    "ts": now,
+                }
+            )
+
+    small = ClusterState(hazard_tau_s=3600.0)
+    small.create_job("ns/j", spec={})
+    small.set_slot_kinds({"spot-0": "spot"})
+    one_notice(small)
+    big = ClusterState(hazard_tau_s=3600.0)
+    big.create_job("ns/j", spec={})
+    big.set_slot_kinds(
+        {f"spot-{i}": "spot" for i in range(4)}
+    )
+    one_notice(big)
+    assert big.hazard_rates(now=now)["spot"] == pytest.approx(
+        small.hazard_rates(now=now)["spot"] / 4.0
+    )
+
+
+def test_set_slot_kinds_replaces_registration():
+    """Each cycle's registration REPLACES the last: slots that left
+    the inventory do not accumulate forever under slice churn."""
+    state = ClusterState()
+    state.set_slot_kinds({"a": "spot"}, preemptible={"a"})
+    state.set_slot_kinds({"b": "ondemand"}, preemptible=set())
+    with state._cond:
+        assert state._slot_kinds == {"b": "ondemand"}
+        assert state._preemptible_slots == set()
+
+
+def test_kick_during_cycle_not_lost():
+    """A kick landing between two waits (i.e. while optimize_once
+    runs) must wake the NEXT wait immediately when the caller passes
+    its pre-cycle baseline — otherwise a notice whose report lands
+    mid-cycle waits out the full allocator interval."""
+    state = _draining_state()
+    seen = state.alloc_kick_count()
+    # The "cycle" runs; a notice lands during it.
+    state.report_preemption("ns/j", group=0, rank=0)
+    # Old baseline: returns immediately. Fresh baseline: times out.
+    start = time.monotonic()
+    assert state.wait_alloc_kick(5.0, seen=seen) is True
+    assert time.monotonic() - start < 1.0
+    assert state.wait_alloc_kick(0.05) is False
+
+
+def test_wait_alloc_kick_woken_by_notice():
+    state = _draining_state()
+    kicked = threading.Event()
+
+    def waiter():
+        if state.wait_alloc_kick(5.0):
+            kicked.set()
+
+    thread = threading.Thread(target=waiter, daemon=True)
+    thread.start()
+    time.sleep(0.05)
+    state.report_preemption("ns/j", group=0, rank=0)
+    assert kicked.wait(2.0), (
+        "a preemption notice must wake the allocator immediately"
+    )
+    # And a plain timeout returns False without a kick.
+    assert state.wait_alloc_kick(0.05) is False
+
+
+# ---- allocator integration -------------------------------------------
+
+
+def test_allocator_replaces_draining_job_reusing_survival_trace():
+    """The whole supervisor-side arc: a notice withdraws the slot,
+    the allocator's next cycle re-places the job on the surviving
+    slice, and the published decision REUSES the notice's trace
+    parent so the successor joins the survival trace."""
+    state = ClusterState(alloc_commit_timeout=30.0)
+    state.create_job(
+        "ns/j", spec={"min_replicas": 1, "max_replicas": 2}
+    )
+    nodes = {
+        "od-0": NodeInfo(resources={"tpu": 2}),
+        "spot-0": NodeInfo(resources={"tpu": 2}, preemptible=True),
+    }
+    allocator = Allocator(
+        state,
+        nodes,
+        policy=PolluxPolicy(pop_size=16, generations=10),
+    )
+    state.update(
+        "ns/j", allocation=["spot-0"], status="Running"
+    )
+    state.renew_lease("ns/j", 0, ttl=60.0, group=0)
+    tp = trace.new_traceparent()
+    assert state.report_preemption(
+        "ns/j", group=0, rank=0, trace_parent=tp
+    )
+    allocator.optimize_once()
+    record = state.get_job("ns/j")
+    assert record.allocation, "job must be re-placed"
+    assert "spot-0" not in record.allocation, (
+        "draining slot must not host the successor"
+    )
+    assert record.trace_parent == tp, (
+        "re-placement must continue the survival trace, not mint a "
+        "fresh one"
+    )
+    assert record.alloc_state == "pending", (
+        "successor epoch opens during the notice window"
+    )
+    # The slot->kind map was registered for hazard attribution.
+    info = state.preemption_info()
+    assert info["noticesByKind"] == {"spot": 1}
+
+
+def test_allocator_stamps_hazard_onto_nodes():
+    """The policy sees each slice's decayed kind hazard on the
+    NodeInfo (the expected-loss term's input)."""
+    state = ClusterState()
+    state.create_job("ns/j", spec={"max_replicas": 2})
+    state.update("ns/j", allocation=["spot-0"], status="Running")
+    state.set_slot_kinds({"spot-0": "spot", "od-0": "ondemand"})
+    state.report_preemption("ns/j", group=0, rank=0, notice_s=0.01)
+    time.sleep(0.02)
+
+    seen = {}
+
+    class SpyPolicy(PolluxPolicy):
+        def optimize(self, jobs, nodes, base, template, **kwargs):
+            seen.update(
+                {key: node.hazard for key, node in nodes.items()}
+            )
+            return super().optimize(
+                jobs, nodes, base, template, **kwargs
+            )
+
+    nodes = {
+        "od-0": NodeInfo(resources={"tpu": 2}),
+        "spot-0": NodeInfo(resources={"tpu": 2}, preemptible=True),
+    }
+    allocator = Allocator(
+        state,
+        nodes,
+        policy=SpyPolicy(pop_size=16, generations=5),
+    )
+    allocator.optimize_once()
+    assert seen["spot-0"] > 0, "spot slice carries the EWMA hazard"
+    assert seen["od-0"] == 0.0
+
+
+# ---- supervisor REST surface -----------------------------------------
+
+
+@pytest.fixture
+def cluster():
+    state = ClusterState(alloc_commit_timeout=30.0)
+    state.create_job("test/job", spec={"max_replicas": 8})
+    state.update(
+        "test/job", allocation=["spot-0"], status="Running"
+    )
+    state.set_slot_kinds({"spot-0": "spot"})
+    supervisor = Supervisor(state)
+    url = supervisor.start()
+    yield state, url
+    supervisor.stop()
+
+
+def test_preempt_endpoint_intake_and_idempotency(cluster):
+    state, url = cluster
+    body = {
+        "group": 0,
+        "rank": 0,
+        "noticeS": 30.0,
+        "traceParent": trace.new_traceparent(),
+    }
+    r = requests.post(
+        f"{url}/preempt/test/job", json=body, timeout=5
+    )
+    assert r.status_code == 200
+    assert r.json()["draining"] is True
+    # Retry / sibling rank: accepted but folded into the same drain.
+    r2 = requests.post(
+        f"{url}/preempt/test/job", json=dict(body, rank=1), timeout=5
+    )
+    assert r2.json()["draining"] is False
+    assert (
+        requests.post(
+            f"{url}/preempt/test/nope", json=body, timeout=5
+        ).status_code
+        == 404
+    )
+    record = state.get_job("test/job")
+    assert record.draining
+    assert record.trace_parent == body["traceParent"]
+    # The notice piggybacked a lease for the reporting rank.
+    assert 0 in record.leases
+
+
+def test_status_and_metrics_expose_notice_state(cluster):
+    state, url = cluster
+    requests.post(
+        f"{url}/preempt/test/job",
+        json={"group": 0, "rank": 0, "noticeS": 30.0},
+        timeout=5,
+    )
+    status = requests.get(f"{url}/status", timeout=5).json()
+    job = status["jobs"]["test/job"]
+    assert job["draining"] is True
+    assert 0 < job["drainRemainingS"] <= 30.0
+    assert "spot-0" in status["drainingSlots"]
+    assert status["hazardRates"]["spot"] > 0
+    assert status["preemptionNotices"] == {"spot": 1}
+    text = requests.get(f"{url}/metrics", timeout=5).text
+    assert (
+        'adaptdl_preemption_notices_total{kind="spot"} 1' in text
+    )
+    assert 'adaptdl_slot_draining{slot="spot-0"} 1' in text
+    assert 'adaptdl_job_draining{job="test/job"} 1' in text
+    assert 'adaptdl_hazard_rate{kind="spot"}' in text
+
+
+def test_metrics_stay_prometheus_conformant_with_preempt_series(
+    cluster,
+):
+    from tests.promcheck import parse_exposition
+
+    state, url = cluster
+    requests.post(
+        f"{url}/preempt/test/job",
+        json={"group": 0, "rank": 0},
+        timeout=5,
+    )
+    text = requests.get(f"{url}/metrics", timeout=5).text
+    families = parse_exposition(text)["families"]
+    for name in (
+        "adaptdl_preemption_notices_total",
+        "adaptdl_slot_draining",
+        "adaptdl_job_draining",
+        "adaptdl_hazard_rate",
+    ):
+        assert name in families, name

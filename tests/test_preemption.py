@@ -1,5 +1,6 @@
-"""Preemption listener test with a fake metadata endpoint (reference
-strategy: aws/test_worker.py runs with a mocked metadata server)."""
+"""Preemption listener + urgent-drain tests with a fake metadata
+endpoint (reference strategy: aws/test_worker.py runs with a mocked
+metadata server)."""
 
 import contextlib
 import threading
@@ -10,7 +11,7 @@ import pytest
 
 from adaptdl_tpu._compat import pick_unused_port
 
-from adaptdl_tpu import _signal, faults
+from adaptdl_tpu import _signal, checkpoint, faults, trace
 from adaptdl_tpu.sched import preemption
 
 
@@ -30,8 +31,12 @@ class FakeMetadata(BaseHTTPRequestHandler):
 @pytest.fixture(autouse=True)
 def _clean_faults():
     faults.reset()
+    preemption.reset_notice()
+    _signal.set_exit_flag(False)
     yield
     faults.reset()
+    preemption.reset_notice()
+    _signal.set_exit_flag(False)
 
 
 @contextlib.contextmanager
@@ -116,3 +121,210 @@ def test_listener_keeps_polling_through_dropped_rpcs():
             stop.set()
         finally:
             _signal.set_exit_flag(False)
+
+
+# ---- tri-state poll + listener hardening -----------------------------
+
+
+def test_poll_status_tristate():
+    with fake_metadata_server(preempted=False) as url:
+        assert preemption.poll_status(url) == preemption.POLL_OK
+        FakeMetadata.preempted = True
+        assert preemption.poll_status(url) == preemption.POLL_PREEMPTED
+    port = pick_unused_port()
+    assert (
+        preemption.poll_status(f"http://127.0.0.1:{port}/x")
+        == preemption.POLL_UNREACHABLE
+    )
+
+
+def test_next_interval_jitter_and_backoff():
+    """The poll cadence is jittered ±20%, and after the unreachable
+    streak reaches the threshold it jumps to the slow cadence — the
+    off-GCE listener idles instead of hammering a dead endpoint."""
+    lo = preemption._next_interval(0, 5.0, 60.0, 12, 0.0)
+    hi = preemption._next_interval(0, 5.0, 60.0, 12, 0.999)
+    assert lo == pytest.approx(4.0)
+    assert hi == pytest.approx(6.0, abs=0.01)
+    # Below the threshold: base cadence. At/after: slow cadence.
+    assert preemption._next_interval(11, 5.0, 60.0, 12, 0.5) < 7
+    assert preemption._next_interval(12, 5.0, 60.0, 12, 0.5) > 48
+    assert preemption._next_interval(30, 5.0, 60.0, 12, 0.5) > 48
+
+
+def test_listener_backs_off_unreachable_then_recovers(monkeypatch):
+    """Consecutive unreachable polls push the listener to the slow
+    cadence (poll count stops growing); one reachable poll resets the
+    streak and restores the base cadence."""
+    calls = []
+    status = {"value": preemption.POLL_UNREACHABLE}
+
+    def fake_poll(url, timeout=2.0):
+        calls.append(time.monotonic())
+        return status["value"]
+
+    monkeypatch.setattr(preemption, "poll_status", fake_poll)
+    stop = preemption.start_listener(
+        "http://unused", interval=0.02, slow_interval=2.0,
+        backoff_after=3,
+    )
+    try:
+        time.sleep(0.8)
+        slow_count = len(calls)
+        # 3 fast polls then the 2s slow cadence: far fewer than the
+        # ~40 the base cadence would have produced in 0.8s.
+        assert 3 <= slow_count <= 6, slow_count
+        # Recovery: the metadata path comes back; the next (slow)
+        # poll succeeds, the streak resets, and the FAST cadence
+        # resumes — many polls land quickly again.
+        status["value"] = preemption.POLL_OK
+        deadline = time.monotonic() + 6.0
+        while (
+            len(calls) < slow_count + 8
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        assert len(calls) >= slow_count + 8, (
+            "one reachable poll must restore the base cadence"
+        )
+        # The recovered polls are fast-cadence spaced, not 2s apart.
+        tail_gaps = [
+            b - a for a, b in zip(calls[-5:], calls[-4:])
+        ]
+        assert all(gap < 1.0 for gap in tail_gaps), tail_gaps
+    finally:
+        stop.set()
+
+
+def test_injected_fault_simulates_notice():
+    """The preempt.notice injection point turns a poll into a notice
+    — the chaos path to a drain without any metadata server."""
+    faults.configure("preempt.notice=fail@1")
+    assert preemption._poll_for_notice("http://unused") == (
+        preemption.POLL_PREEMPTED
+    )
+
+
+# ---- notice state + urgent drain -------------------------------------
+
+
+def test_deliver_notice_idempotent_and_armed(monkeypatch):
+    monkeypatch.setenv("ADAPTDL_PREEMPT_NOTICE_S", "30")
+    monkeypatch.setenv("ADAPTDL_PREEMPT_MARGIN_S", "5")
+    assert not preemption.notice_active()
+    assert preemption.deliver_notice(source="test", notify=False)
+    assert not preemption.deliver_notice(source="test", notify=False)
+    assert preemption.notice_active()
+    assert _signal.get_exit_flag()
+    state = preemption.notice_state()
+    assert state["source"] == "test"
+    assert state["noticeS"] == 30.0
+    assert state["budgetS"] == pytest.approx(25.0)
+    assert trace.parse_traceparent(state["traceParent"]) is not None
+    remaining = preemption.drain_remaining_s()
+    assert 0 < remaining <= 25.0
+
+
+class _BlobState(checkpoint.State):
+    def __init__(self, name, payload=b"x" * 64):
+        super().__init__(name)
+        self.payload = payload
+
+    def save(self, fileobj):
+        fileobj.write(self.payload)
+
+    def load(self, fileobj):
+        self.payload = fileobj.read()
+
+
+@pytest.fixture
+def _ckpt_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    monkeypatch.setenv("ADAPTDL_REPLICA_RANK", "0")
+    monkeypatch.setenv("ADAPTDL_NUM_RESTARTS", "0")
+    checkpoint._reset_registry()
+    yield tmp_path
+    checkpoint._reset_registry()
+
+
+def test_urgent_drain_saves_within_budget(_ckpt_env):
+    state = _BlobState("drain_basic")
+    preemption.deliver_notice(source="test", notify=False)
+    summary = preemption.urgent_drain()
+    assert summary["deadlineMet"] is True
+    assert summary["joinedInflight"] is False
+    # The drain produced a complete, loadable checkpoint.
+    state.unregister()
+    reread = _BlobState("drain_basic", payload=b"")
+    assert checkpoint.load_state(reread)
+    assert reread.payload == b"x" * 64
+
+
+def test_urgent_drain_joins_inflight_async_save(_ckpt_env):
+    """Satellite: a notice arriving mid-async-checkpoint — the drain
+    must JOIN the in-flight AsyncSaveHandle write rather than racing
+    a second save into the same version dir (slowed via the
+    ckpt.write.state chaos point)."""
+    import os
+
+    state = _BlobState("drain_join")
+    faults.configure("ckpt.write.state=sleep:0.4@1")
+    handle = checkpoint.save_all_states(wait=False)
+    assert not handle.done()
+    preemption.deliver_notice(source="test", notify=False)
+    summary = preemption.urgent_drain()
+    assert summary["joinedInflight"] is True
+    assert handle.done(), "drain joined the in-flight write"
+    # The drain wrote its own NEW version (seq 1 — the joined async
+    # save took seq 0 and was pruned as superseded): two saves never
+    # raced into one dir, and no temp dirs survive.
+    dirs = checkpoint._list_checkpoints(str(_ckpt_env))
+    assert [(r, s) for r, s, _ in dirs] == [(0, 1)]
+    leftovers = [
+        e
+        for e in os.listdir(_ckpt_env)
+        if e.startswith(checkpoint._TMP_PREFIX)
+    ]
+    assert leftovers == []
+    state.unregister()
+    reread = _BlobState("drain_join", payload=b"")
+    assert checkpoint.load_state(reread)
+    assert reread.payload == b"x" * 64
+
+
+def test_urgent_drain_records_deadline_miss(_ckpt_env, monkeypatch):
+    """A save that overruns the notice window completes anyway (it is
+    the only recovery chance) but records the overrun — the
+    drain.deadline_exceeded signal operators alert on."""
+    monkeypatch.setenv("ADAPTDL_PREEMPT_NOTICE_S", "1.05")
+    monkeypatch.setenv("ADAPTDL_PREEMPT_MARGIN_S", "0")
+    _BlobState("drain_slow")
+    faults.configure("ckpt.write.state=sleep:1.3@1")
+    preemption.deliver_notice(source="test", notify=False)
+    summary = preemption.urgent_drain()
+    assert summary["deadlineMet"] is False
+    events = [
+        rec
+        for rec in trace.snapshot_spans()
+        if rec["name"] == "drain.deadline_exceeded"
+    ]
+    assert events, "overrun must be recorded"
+
+
+def test_urgent_drain_fault_leaves_previous_checkpoint(_ckpt_env):
+    """preempt.drain_save=fail: the drain save never starts; the
+    previous complete checkpoint stays the newest (nothing is ever
+    half-written by the drain path)."""
+    state = _BlobState("drain_fault")
+    checkpoint.save_all_states()  # seq 0 — the durable baseline
+    state.payload = b"y" * 64
+    faults.configure("preempt.drain_save=fail@1")
+    preemption.deliver_notice(source="test", notify=False)
+    with pytest.raises(faults.InjectedFault):
+        preemption.urgent_drain()
+    dirs = checkpoint._list_checkpoints(str(_ckpt_env))
+    assert [(r, s) for r, s, _ in dirs] == [(0, 0)]
+    state.unregister()
+    reread = _BlobState("drain_fault", payload=b"")
+    assert checkpoint.load_state(reread)
+    assert reread.payload == b"x" * 64

@@ -3,7 +3,11 @@ checks; cluster_expander reconcile behavior)."""
 
 import pytest
 
-from adaptdl_tpu.sched.expander import ClusterExpander
+from adaptdl_tpu.sched.expander import (
+    ClusterExpander,
+    MixedClusterExpander,
+    SpotMixPolicy,
+)
 from adaptdl_tpu.sched.validator import (
     ValidationError,
     validate_job_spec,
@@ -41,6 +45,74 @@ class FakeProvisioner:
 
     def set_slices(self, count):
         self.slices = count
+
+
+def test_spot_mix_policy_weighs_price_against_expected_loss():
+    """The mix policy's break-even: spot wins while the discount
+    beats the hazard x restart-cost expected loss, flips to on-demand
+    past it."""
+    policy = SpotMixPolicy(spot_price_ratio=0.3, min_ondemand=1)
+    # Quiet cluster (no observed reclaims): the discount wins.
+    assert policy.split(5, 0.0, 300.0) == (4, 1)
+    # Hazard 1/600 s^-1 x 240s restart cost = 40% expected loss:
+    # effective spot cost 0.3/0.6 = 0.5 < 1 — still worth it.
+    assert policy.split(5, 1 / 600.0, 240.0) == (4, 1)
+    # Same hazard, a 500s restart cost: loss 83%, effective cost
+    # 1.79 > 1 — everything shifts on-demand.
+    assert policy.split(5, 1 / 600.0, 500.0) == (0, 5)
+    # The on-demand floor holds even when spot is free-lunch cheap.
+    assert policy.split(1, 0.0, 1.0) == (0, 1)
+    assert policy.split(0, 0.0, 1.0) == (0, 0)
+
+
+def test_mixed_expander_shifts_pools_with_hazard():
+    """End-to-end mix: the expander splits the allocator's desired
+    count across spot/on-demand pools, and a hazard spike (observed
+    reclaims) re-routes capacity to on-demand — weighing the
+    configured spot price against the jobs' measured restart costs."""
+    spot = FakeProvisioner(slices=0)
+    ondemand = FakeProvisioner(slices=0)
+    hazard = {"rate": 0.0}
+    exp = MixedClusterExpander(
+        spot,
+        ondemand,
+        policy=SpotMixPolicy(spot_price_ratio=0.3, min_ondemand=1),
+        hazard_fn=lambda: hazard["rate"],
+        scale_down_delay=100.0,
+    )
+    exp.note_restart_costs({"a": 240.0, "b": None})  # None dropped
+    exp.request(5)
+    assert exp.reconcile_once(now=0.0) == 5
+    assert (spot.slices, ondemand.slices) == (4, 1)
+    assert exp.last_split == (4, 1)
+    # Reclaim storm: hazard makes spot a net loss for these jobs.
+    hazard["rate"] = 1 / 600.0
+    exp.note_restart_costs({"a": 500.0})
+    exp.request(5)
+    # On-demand grows immediately; spot shrinks only after the
+    # hysteresis delay (slices take minutes to come up, so flapping
+    # the pool on one notice would thrash).
+    assert exp.reconcile_once(now=10.0) == 9
+    assert (spot.slices, ondemand.slices) == (4, 5)
+    assert exp.reconcile_once(now=120.0) == 5
+    assert (spot.slices, ondemand.slices) == (0, 5)
+
+
+def test_mixed_expander_default_restart_cost():
+    """With no measured restart costs yet the policy prices the
+    default (cheap) cost — spot-friendly, like the single-pool
+    expander's optimism."""
+    spot = FakeProvisioner(slices=0)
+    ondemand = FakeProvisioner(slices=0)
+    exp = MixedClusterExpander(
+        spot,
+        ondemand,
+        policy=SpotMixPolicy(spot_price_ratio=0.3),
+        hazard_fn=lambda: 1 / 600.0,
+    )
+    exp.request(4)
+    exp.reconcile_once(now=0.0)
+    assert (spot.slices, ondemand.slices) == (4, 0)
 
 
 def test_expander_grows_immediately_shrinks_with_delay():
