@@ -39,7 +39,15 @@ def pcast(x, axes, to):
     """Lazy resolver: jax is only imported on first use, so consumers
     that need nothing but ``pick_unused_port`` (runners, the forked
     test harness — which must keep the forking parent jax-free) never
-    pay the jax import."""
+    pay the jax import.
+
+    On pre-vma jax the replicated->varying cast is numerically the
+    identity, but its TRANSPOSE is not: the cotangent of a varying
+    output w.r.t. a replicated input is the psum over the manual
+    axes. The fallback is therefore a custom-vjp identity whose
+    backward psums — without it, differentiating through a pipeline /
+    zero3 carry scales gradients by the axis size (the old-jax "vma
+    gap" tier-1 failures)."""
     global _pcast_impl
     if _pcast_impl is None:
         import jax
@@ -47,12 +55,44 @@ def pcast(x, axes, to):
         try:
             _pcast_impl = jax.lax.pcast
         except AttributeError:  # pragma: no cover - older jax
+            from functools import partial
 
-            def _identity(x, axes, to):  # noqa: ARG001 - parity
-                return x
+            @partial(jax.custom_vjp, nondiff_argnums=(1,))
+            def _cast_leaf(leaf, axes):
+                return leaf
 
-            _pcast_impl = _identity
+            def _cast_fwd(leaf, axes):
+                return leaf, None
+
+            def _cast_bwd(axes, _res, ct):
+                return (jax.lax.psum(ct, axes),)
+
+            _cast_leaf.defvjp(_cast_fwd, _cast_bwd)
+
+            def _r2v(x, axes, to):
+                if to != "varying":
+                    return x
+                return jax.tree.map(
+                    lambda leaf: _cast_leaf(leaf, axes), x
+                )
+
+            _pcast_impl = _r2v
     return _pcast_impl(x, axes, to)
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` where it exists; on older jax (0.4.x)
+    fall back to ``lax.psum(1, axis_name)``, which the tracer
+    constant-folds to the same static Python int inside
+    pmap/shard_map. Keeping the result static matters: callers use it
+    for schedule lengths (``jnp.arange(ticks)``) and permutation
+    tables, which must be concrete at trace time."""
+    import jax
+
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:  # pragma: no cover - older jax
+        return jax.lax.psum(1, axis_name)
 
 
 def shard_map_kwargs() -> dict:

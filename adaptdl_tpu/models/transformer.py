@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from adaptdl_tpu._compat import axis_size as _axis_size
+
 
 @dataclass(frozen=True)
 class TransformerConfig:
@@ -211,7 +213,7 @@ class MoEFFN(nn.Module):
         # declared vs received shapes at apply time).
         local_experts = num_experts
         if cfg.moe_axis is not None:
-            ep = jax.lax.axis_size(cfg.moe_axis)
+            ep = _axis_size(cfg.moe_axis)
             assert num_experts % ep == 0, (
                 f"{num_experts} experts cannot shard over {ep} devices"
                 " (each shard owns a whole number of experts)"

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from adaptdl_tpu._compat import axis_size as _axis_size
 from adaptdl_tpu._compat import pcast as _pcast
 from adaptdl_tpu.parallel.mesh import STAGE_AXIS
 
@@ -63,7 +64,7 @@ def gpipe(
       ``where``/psum keyed on ``lax.axis_index``).
     """
     stage = lax.axis_index(axis_name)
-    num_stages = lax.axis_size(axis_name)
+    num_stages = _axis_size(axis_name)
     num_micro = micro_inputs.shape[0]
     ticks = num_micro + num_stages - 1
     perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
@@ -76,7 +77,7 @@ def gpipe(
         micro_inputs[0] * 0.0, axis_name, to="varying"
     )
 
-    def tick(carry, t):
+    def tick(carry, t):  # graftcheck: stage-seq=pipeline-tick
         incoming = carry  # activation handed over by the previous stage
         # Stage 0 feeds microbatch t (clamped; out-of-range ticks
         # compute garbage that the output masking discards).
@@ -169,7 +170,7 @@ def interleaved_pipeline(
     buffering window; the scheduler's topology search respects this).
     """
     stage = lax.axis_index(axis_name)
-    num_stages = lax.axis_size(axis_name)
+    num_stages = _axis_size(axis_name)
     num_micro = micro_inputs.shape[0]
     if num_micro < num_stages:
         # With M < S the wrap-hop activation lands AFTER its read
@@ -195,7 +196,7 @@ def interleaved_pipeline(
         zero_act, (num_micro,) + zero_act.shape
     )
 
-    def tick(carry, t):
+    def tick(carry, t):  # graftcheck: stage-seq=pipeline-tick
         buf, incoming = carry
         # Index of the chunk the ring PREDECESSOR computed last tick —
         # the microbatch slot the incoming activation belongs to
@@ -250,7 +251,11 @@ def interleaved_loss(
     """ElasticTrainer-compatible loss over the interleaved schedule
     (the ``gpipe_loss`` counterpart; same masking contract)."""
 
-    def loss_fn(chunks_local, batch, rng):
+    # Both pipeline flavors must execute the identical (ppermute ×
+    # ticks, psum) collective program — a divergence deadlocks the
+    # stage group at the first mismatched rendezvous. GC802 compares
+    # the transitively flattened sequences of this group.
+    def loss_fn(chunks_local, batch, rng):  # graftcheck: stage-seq=pipeline-loss
         del rng
         # Trainer-sharded leaves arrive [1, v, ...] (leading stage
         # axis size 1 locally, the stack_stage_params convention);
@@ -267,7 +272,7 @@ def interleaved_loss(
         )
         final = outs.reshape(x.shape)
         stage = lax.axis_index(axis_name)
-        num_stages = lax.axis_size(axis_name)
+        num_stages = _axis_size(axis_name)
         is_last = stage == num_stages - 1
         final = jnp.where(is_last, final, jnp.ones_like(final))
         loss = loss_head(final, batch)
@@ -297,7 +302,7 @@ def gpipe_loss(
       is ``[per_replica_batch, ...]`` and divisible by ``num_micro``.
     """
 
-    def loss_fn(stage_params_local, batch, rng):
+    def loss_fn(stage_params_local, batch, rng):  # graftcheck: stage-seq=pipeline-loss
         del rng
         x = batch["x"]
         assert x.shape[0] % num_micro == 0, (
@@ -308,7 +313,7 @@ def gpipe_loss(
         outs = gpipe(stage_fn, stage_params_local, micro, axis_name)
         final = outs.reshape(x.shape)
         stage = lax.axis_index(axis_name)
-        num_stages = lax.axis_size(axis_name)
+        num_stages = _axis_size(axis_name)
         is_last = stage == num_stages - 1
         # Non-final stages hold garbage intermediates here. Replace
         # them with ones BEFORE loss_head: a head with a

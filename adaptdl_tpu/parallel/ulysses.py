@@ -40,6 +40,7 @@ from functools import partial
 import jax.numpy as jnp
 from jax import lax
 
+from adaptdl_tpu._compat import axis_size as _axis_size
 from adaptdl_tpu.parallel.mesh import SEQ_AXIS
 
 
@@ -66,7 +67,7 @@ def ulysses_attention(
     Returns:
       ``[batch, heads, seq_local, head_dim]`` local attention output.
     """
-    shards = lax.axis_size(axis_name)
+    shards = _axis_size(axis_name)
     heads = q.shape[1]
     if heads % shards != 0:
         raise ValueError(

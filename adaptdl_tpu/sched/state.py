@@ -207,7 +207,7 @@ def _job_to_dict(record: JobRecord) -> dict:
     }
 
 
-def _job_from_dict(payload: dict) -> JobRecord:
+def _job_from_dict(payload: dict) -> JobRecord:  # replay-pure
     record = JobRecord(key=payload["key"])
     record.spec = dict(payload.get("spec") or {})
     record.hints = payload.get("hints")
@@ -229,8 +229,10 @@ def _job_from_dict(payload: dict) -> JobRecord:
     record.counted_failures = list(
         payload.get("counted_failures") or []
     )
+    # Snapshots always carry the stamp; 0.0 (not "now") keeps the
+    # load deterministic for older snapshot versions.
     record.creation_timestamp = float(
-        payload.get("creation_timestamp", time.time())
+        payload.get("creation_timestamp", 0.0)
     )
     record.restarts = int(payload.get("restarts", 0))
     record.expected_processes = int(
@@ -458,7 +460,7 @@ class ClusterState:
             try:
                 for op in records:
                     try:
-                        self._apply_locked(op)
+                        self._apply_locked(op, start)
                     except Exception:  # noqa: BLE001 - prefix recovery
                         LOG.exception(
                             "skipping unreplayable journal record %r",
@@ -504,59 +506,70 @@ class ClusterState:
             if snapshot is not None or records:
                 op = {"op": "recovered"}
                 self._journal_append(op)
-                self._apply_locked(op)
+                self._apply_locked(op, now)
             self._last_recovery_s = time.monotonic() - start
             self._cond.notify_all()
 
     # -- replay/apply layer (shared by live mutators and recovery) -----
 
-    def _apply_locked(self, op: dict) -> Any:  # holds-lock: _cond
+    def _apply_locked(self, op: dict, now: float) -> Any:  # holds-lock: _cond # replay-pure
+        """Dispatch one journal op to its apply function. ``now`` is
+        the caller's monotonic stamp: live mutators read the clock
+        BEFORE applying, recovery passes one replay-wide stamp — the
+        apply layer itself never reads a clock (graftcheck GC901), so
+        replaying a journal reproduces durable state bit-for-bit."""
         kind = op["op"]
         if kind == "create_job":
-            return self._apply_create_locked(op)
+            return self._apply_create_locked(op, now)
         if kind == "remove_job":
-            return self._apply_remove_locked(op)
+            return self._apply_remove_locked(op, now)
         if kind == "update":
-            return self._apply_update_locked(op)
+            return self._apply_update_locked(op, now)
         if kind == "retune":
-            return self._apply_retune_locked(op)
+            return self._apply_retune_locked(op, now)
         if kind == "register":
-            return self._apply_register_locked(op)
+            return self._apply_register_locked(op, now)
         if kind == "lease":
-            return self._apply_lease_locked(op)
+            return self._apply_lease_locked(op, now)
         if kind == "lease_expired":
-            return self._apply_lease_expiry_locked(op)
+            return self._apply_lease_expiry_locked(op, now)
         if kind == "alloc_commit":
-            return self._apply_commit_locked(op)
+            return self._apply_commit_locked(op, now)
         if kind == "alloc_rollback":
-            return self._apply_rollback_locked(op)
+            return self._apply_rollback_locked(op, now)
         if kind == "preempt":
-            return self._apply_preempt_locked(op)
+            return self._apply_preempt_locked(op, now)
         if kind == "recovered":
             self._recoveries += 1
             return None
         raise ValueError(f"unknown journal op {kind!r}")
 
-    def _apply_create_locked(self, op: dict) -> JobRecord:  # holds-lock: _cond
+    def _apply_create_locked(  # holds-lock: _cond # replay-pure
+        self, op: dict, now: float
+    ) -> JobRecord:
         key = op["key"]
         if key in self._jobs:
             return self._jobs[key]
         record = JobRecord(
             key=key,
             spec=dict(op.get("spec") or {}),
-            creation_timestamp=op.get("ts") or time.time(),
+            # Live mutators always stamp ts; a record from an older
+            # journal version replays as 0.0 — deterministic, never
+            # "whenever the replay happened to run".
+            creation_timestamp=float(op.get("ts") or 0.0),
         )
         self._jobs[key] = record
         self._submitted_total += 1
         return record
 
-    def _apply_remove_locked(self, op: dict) -> None:  # holds-lock: _cond
+    def _apply_remove_locked(self, op: dict, now: float) -> None:  # holds-lock: _cond # replay-pure
         self._jobs.pop(op["key"], None)
 
-    def _apply_update_locked(self, op: dict) -> None:  # holds-lock: _cond
+    def _apply_update_locked(  # holds-lock: _cond # replay-pure
+        self, op: dict, now: float
+    ) -> None:
         record = self._jobs[op["key"]]
-        ts = op.get("ts") or time.time()
-        now = time.monotonic()
+        ts = float(op.get("ts") or 0.0)
         fields = op["fields"]
         # A launch-config change is an allocation change OR a
         # topology change on the same slot list — the runners restart
@@ -647,7 +660,7 @@ class ClusterState:
             # is immediately the rollback target.
             self._promote_committed_locked(record)
 
-    def _apply_retune_locked(self, op: dict) -> None:  # holds-lock: _cond
+    def _apply_retune_locked(self, op: dict, now: float) -> None:  # holds-lock: _cond # replay-pure
         record = self._jobs[op["key"]]
         record.batch_config = dict(op["batch_config"])
         record.retunes += 1
@@ -666,7 +679,9 @@ class ClusterState:
             return
         record.alloc_fresh.add(rank)
 
-    def _apply_register_locked(self, op: dict) -> bool:  # holds-lock: _cond
+    def _apply_register_locked(  # holds-lock: _cond # replay-pure
+        self, op: dict, now: float
+    ) -> bool:
         record = self._jobs[op["key"]]
         group, rank = int(op["group"]), int(op["rank"])
         if group > record.group:
@@ -698,7 +713,7 @@ class ClusterState:
             self._note_liveness_locked(record, rank)
         return accepted
 
-    def _apply_lease_locked(self, op: dict) -> None:  # holds-lock: _cond
+    def _apply_lease_locked(self, op: dict, now: float) -> None:  # holds-lock: _cond # replay-pure
         record = self._jobs[op["key"]]
         group = op.get("group")
         rank = int(op["rank"])
@@ -724,10 +739,12 @@ class ClusterState:
             # ttl 0 = lease enforcement disabled: the beat proves
             # liveness below but must not plant an instantly-stale
             # lease for the sweeper to expire.
-            record.leases[rank] = time.monotonic() + float(op["ttl"])
+            record.leases[rank] = now + float(op["ttl"])
         self._note_liveness_locked(record, rank)
 
-    def _apply_lease_expiry_locked(self, op: dict) -> None:  # holds-lock: _cond
+    def _apply_lease_expiry_locked(  # holds-lock: _cond # replay-pure
+        self, op: dict, now: float
+    ) -> None:
         record = self._jobs[op["key"]]
         for rank in op["ranks"]:
             rank = int(rank)
@@ -759,7 +776,7 @@ class ClusterState:
             dict(record.batch_config) if record.batch_config else None
         )
 
-    def _apply_commit_locked(self, op: dict) -> None:  # holds-lock: _cond
+    def _apply_commit_locked(self, op: dict, now: float) -> None:  # holds-lock: _cond # replay-pure
         record = self._jobs[op["key"]]
         self._promote_committed_locked(record)
         record.alloc_state = "committed"
@@ -782,7 +799,9 @@ class ClusterState:
         for slot in set(record.allocation):
             self._slot_strikes.pop(slot, None)
 
-    def _apply_rollback_locked(self, op: dict) -> None:  # holds-lock: _cond
+    def _apply_rollback_locked(  # holds-lock: _cond # replay-pure
+        self, op: dict, now: float
+    ) -> None:
         record = self._jobs[op["key"]]
         record.allocation = list(record.committed_allocation)
         record.topology = (
@@ -810,7 +829,6 @@ class ClusterState:
         self._rollbacks[op["key"]] = (
             self._rollbacks.get(op["key"], 0) + 1
         )
-        now = time.monotonic()
         for slot in op.get("strikes", []):
             strikes = self._slot_strikes.get(slot, 0) + 1
             self._slot_strikes[slot] = strikes
@@ -834,7 +852,9 @@ class ClusterState:
             float(ts),
         )
 
-    def _apply_preempt_locked(self, op: dict) -> None:  # holds-lock: _cond
+    def _apply_preempt_locked(  # holds-lock: _cond # replay-pure
+        self, op: dict, now: float
+    ) -> None:
         """A reclaim notice: the job starts draining, its slots leave
         the placement inventory for the notice window, and each slot's
         kind pays a hazard observation. The notice's trace parent (the
@@ -842,13 +862,12 @@ class ClusterState:
         allocator's re-placement REUSES it, so the notice, the drain
         save, and the successor's first step share one trace id."""
         record = self._jobs[op["key"]]
-        now = time.monotonic()
         notice_s = float(op.get("notice_s") or 30.0)
         record.draining = True
         record.drain_deadline = now + notice_s
         if op.get("trace_parent"):
             record.trace_parent = op["trace_parent"]
-        ts = op.get("ts") or time.time()
+        ts = float(op.get("ts") or 0.0)
         kinds = op.get("kinds") or {}
         for slot in op.get("slots", []):
             self._draining_slots[slot] = now + notice_s
@@ -897,7 +916,7 @@ class ClusterState:
             return
         op = {"op": "alloc_commit", "key": record.key}
         self._journal_append(op)
-        self._apply_commit_locked(op)
+        self._apply_commit_locked(op, time.monotonic())
 
     # -- mutators (journaled) ------------------------------------------
 
@@ -914,7 +933,7 @@ class ClusterState:
                 "ts": time.time(),
             }
             self._journal_append(op)
-            record = self._apply_create_locked(op)
+            record = self._apply_create_locked(op, time.monotonic())
             self._cond.notify_all()
             return record
 
@@ -924,7 +943,7 @@ class ClusterState:
                 return
             op = {"op": "remove_job", "key": key}
             self._journal_append(op)
-            self._apply_remove_locked(op)
+            self._apply_remove_locked(op, time.monotonic())
             self._cond.notify_all()
 
     def update(self, key: str, **fields: Any) -> None:  # journaled
@@ -937,7 +956,7 @@ class ClusterState:
                 "ts": time.time(),
             }
             self._journal_append(op)
-            self._apply_update_locked(op)
+            self._apply_update_locked(op, time.monotonic())
             self._cond.notify_all()
 
     def publish_retune(  # journaled
@@ -959,7 +978,7 @@ class ClusterState:
                 "batch_config": dict(batch_config),
             }
             self._journal_append(op)
-            self._apply_retune_locked(op)
+            self._apply_retune_locked(op, time.monotonic())
             self._cond.notify_all()
             return True
 
@@ -989,7 +1008,9 @@ class ClusterState:
             if processes:
                 op["processes"] = int(processes)
             self._journal_append(op)
-            accepted = self._apply_register_locked(op)
+            accepted = self._apply_register_locked(
+                op, time.monotonic()
+            )
             if accepted:
                 self._maybe_commit_locked(record)
             self._cond.notify_all()
@@ -1036,7 +1057,7 @@ class ClusterState:
                 op["group"] = group
             if durable:
                 self._journal_append(op)
-            self._apply_lease_locked(op)
+            self._apply_lease_locked(op, time.monotonic())
             self._maybe_commit_locked(record)
             return True
 
@@ -1075,7 +1096,7 @@ class ClusterState:
                     "withdraw": not record.degraded,
                 }
                 self._journal_append(op)
-                self._apply_lease_expiry_locked(op)
+                self._apply_lease_expiry_locked(op, now)
                 expired.extend((key, rank) for rank in stale)
             if expired:
                 self._cond.notify_all()
@@ -1116,7 +1137,7 @@ class ClusterState:
                     "strikes": strikes,
                 }
                 self._journal_append(op)
-                self._apply_rollback_locked(op)
+                self._apply_rollback_locked(op, now)
                 rolled.append(key)
             if rolled:
                 self._cond.notify_all()
@@ -1193,7 +1214,7 @@ class ClusterState:
             if trace_parent:
                 op["trace_parent"] = trace_parent
             self._journal_append(op)
-            self._apply_preempt_locked(op)
+            self._apply_preempt_locked(op, now)
             # Wake the allocator NOW: re-placement must overlap the
             # drain, not wait out the optimization interval.
             self._alloc_kick += 1

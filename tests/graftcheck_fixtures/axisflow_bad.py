@@ -1,0 +1,24 @@
+"""GC803 known-bad: literal axis args flowing into collectives."""
+# graftcheck: declare-axes=data
+
+from jax import lax
+
+
+def reduce_over(x, axis_name):
+    return lax.psum(x, axis_name)
+
+
+def two_hops(x, axis):
+    return reduce_over(x, axis)
+
+
+def caller_typo(x):
+    return reduce_over(x, "dtaa")  # line 16: GC803
+
+
+def caller_kwarg_typo(x):
+    return two_hops(x, axis="dat")  # line 20: GC803 (two hops)
+
+
+def bad_default(x, axis_name="dta"):  # line 23: GC803 default
+    return lax.psum(x, axis_name)

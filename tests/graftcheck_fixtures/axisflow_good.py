@@ -1,0 +1,26 @@
+"""GC803 known-good: resolvable literals and parameter threading."""
+# graftcheck: declare-axes=data,seq
+
+from jax import lax
+
+DATA_AXIS = "data"
+
+
+def reduce_over(x, axis_name):
+    return lax.psum(x, axis_name)
+
+
+def literal_resolves(x):
+    return reduce_over(x, "data")
+
+
+def constant_resolves(x):
+    return reduce_over(x, DATA_AXIS)
+
+
+def param_threads(x, axis_name=DATA_AXIS):
+    return reduce_over(x, axis_name)
+
+
+def kwarg_resolves(x):
+    return reduce_over(x, axis_name="seq")

@@ -1,0 +1,32 @@
+"""GC103 + flow-aware GC101 known-bad."""
+
+import threading
+
+_lock = threading.Lock()
+_table = {}  # guarded-by: _lock
+
+
+def locked_helper():  # holds-lock: _lock
+    return len(_table)
+
+
+def bad_caller():
+    return locked_helper()  # line 14: GC103 (lock not held)
+
+
+def good_caller():
+    with _lock:
+        return locked_helper()
+
+
+def _sweep():
+    _table.clear()  # line 23: GC101 (an unlocked caller exists)
+
+
+def sweep_locked():
+    with _lock:
+        _sweep()
+
+
+def sweep_unlocked():
+    _sweep()

@@ -1,0 +1,36 @@
+"""Flow-aware GC101 known-good: helpers proven locked by call sites.
+
+v1 required `# holds-lock:` on every such helper; v2's lock-set
+dataflow infers it when EVERY resolved call site holds the lock and
+no reference to the helper escapes.
+"""
+
+import threading
+
+_lock = threading.Lock()
+_items = {}  # guarded-by: _lock
+
+
+def _drain():
+    # No annotation: inferred held — both call sites acquire _lock.
+    _items.clear()
+
+
+def flush():
+    with _lock:
+        _drain()
+
+
+def flush_twice():
+    with _lock:
+        _drain()
+        _drain()
+
+
+def _nested_helper():  # holds-lock: _lock
+    return len(_items)
+
+
+def annotated_caller():  # holds-lock: _lock
+    # Annotated callers satisfy GC103 for annotated callees.
+    return _nested_helper()

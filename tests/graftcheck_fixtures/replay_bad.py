@@ -1,0 +1,36 @@
+"""GC9xx known-bad: the pre-v2 state.py impurity patterns."""
+
+import os
+import time
+
+from adaptdl_tpu import trace
+
+
+class State:
+    def __init__(self):
+        self._jobs = {}
+        self._replaying = False
+
+    def _journal_append(self, op):
+        pass
+
+    def _apply_create_locked(self, op):  # replay-pure
+        ts = op.get("ts") or time.time()  # line 18: GC901 wall clock
+        self._jobs[op["key"]] = ts
+
+    def _apply_lease_locked(self, op):  # replay-pure
+        deadline = time.monotonic() + op["ttl"]  # line 22: GC901
+        self._jobs[op["key"]] = deadline
+        mode = os.environ.get("MODE")  # line 24: GC901 env read
+        return mode
+
+    def _apply_commit_locked(self, op):  # replay-pure
+        trace.event("epoch.commit", job=op["key"])  # line 28: GC902
+        self._journal_append(op)  # line 29: GC901 journal write
+        self._helper(op)
+
+    def _helper(self, op):
+        self._jobs[op["key"]] = time.time()  # line 33: GC901 via call
+
+    def _apply_sneaky_locked(self, op):  # line 35: GC903 unannotated
+        self._jobs.pop(op["key"], None)

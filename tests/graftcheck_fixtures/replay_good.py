@@ -1,0 +1,37 @@
+"""GC9xx known-good: pure apply layer, guarded live-side emission."""
+
+import time
+
+from adaptdl_tpu import trace
+
+
+class State:
+    def __init__(self):
+        self._jobs = {}
+        self._replaying = False
+
+    def _journal_append(self, op):
+        pass
+
+    def _apply_create_locked(self, op, now):  # replay-pure
+        # Clock values arrive via the journaled op / caller stamp.
+        self._jobs[op["key"]] = float(op.get("ts") or 0.0)
+
+    def _apply_lease_locked(self, op, now):  # replay-pure
+        self._jobs[op["key"]] = now + float(op["ttl"])
+        self._promote(op)
+
+    def _apply_commit_locked(self, op, now):  # replay-pure
+        if not self._replaying:
+            # Live side only: replayed ops are history.
+            trace.record_span("epoch.commit", time.monotonic())
+        self._jobs[op["key"]] = "committed"
+
+    def _promote(self, op):
+        self._jobs[op["key"]] = dict(op)
+
+    def create(self, key):  # journaled
+        # Live mutator (not replay-pure): clocks are fine here.
+        op = {"op": "create", "key": key, "ts": time.time()}
+        self._journal_append(op)
+        self._apply_create_locked(op, time.monotonic())

@@ -1,0 +1,39 @@
+"""GC801 known-bad: rank-divergent collectives (slice deadlocks)."""
+# graftcheck: declare-axes=data
+
+from jax import lax
+
+from adaptdl_tpu import collective, env
+
+
+def branch_divergent(x):
+    rank = lax.axis_index("data")
+    if rank == 0:
+        x = lax.psum(x, "data")  # line 12: GC801
+    return x
+
+
+def early_return_divergent(x):
+    if env.process_rank() != 0:
+        return x
+    return collective.allreduce(x)  # line 19: GC801
+
+
+def env_divergent(x):
+    import os
+
+    if os.environ.get("ROLE") == "leader":
+        return lax.all_gather(x, "data")  # line 26: GC801
+    return x
+
+
+def order_divergent(x, y):
+    # Same collectives, different ORDER: rank 0 waits at psum while
+    # everyone else waits at pmean — multiset equality is not enough.
+    if env.process_rank() == 0:
+        a = lax.psum(x, "data")  # line 34: GC801
+        b = lax.pmean(y, "data")
+    else:
+        b = lax.pmean(y, "data")
+        a = lax.psum(x, "data")
+    return a, b

@@ -1,0 +1,42 @@
+"""GC801 known-good: divergent compute, unconditional rendezvous."""
+# graftcheck: declare-axes=data
+
+from jax import lax
+
+from adaptdl_tpu import collective, env
+
+
+def balanced_broadcast(x):
+    # The sanctioned shape: compute divergently, rendezvous on every
+    # rank (data.py's _optimize_batch_size pattern).
+    if env.replica_rank() == 0:
+        decision = x * 2
+    else:
+        decision = None
+    return collective.broadcast(decision)
+
+
+def rank_conditional_without_collectives(x):
+    # Divergent control flow is fine while no rendezvous is inside
+    # (metrics.py's rank-0 fit gate).
+    if env.replica_rank() != 0:
+        return None
+    return x + 1
+
+
+def both_branches_collect(x):
+    rank = lax.axis_index("data")
+    if rank == 0:
+        y = lax.psum(x * 2, "data")
+    else:
+        y = lax.psum(x, "data")
+    return y
+
+
+def static_conditional(x, causal):
+    # Static (same on every rank) config flags stay out of scope.
+    if causal:
+        x = lax.psum(x, "data")
+    else:
+        x = lax.psum(x * 0, "data")
+    return x

@@ -1,0 +1,15 @@
+"""GC802 known-bad: stage bodies with divergent collective programs."""
+# graftcheck: declare-axes=stage
+
+from jax import lax
+
+
+def tick_a(carry, x):  # graftcheck: stage-seq=demo-tick
+    y = lax.ppermute(x, "stage", [(0, 1)])
+    loss = lax.psum(y, "stage")
+    return carry, loss
+
+
+def tick_b(carry, x):  # graftcheck: stage-seq=demo-tick
+    y = lax.ppermute(x, "stage", [(0, 1)])  # line 14 (seq diverges after)
+    return carry, y  # missing the psum tick_a runs -> GC802 on tick_b

@@ -1,0 +1,20 @@
+"""GC802 known-good: identical sequences, one through a helper."""
+# graftcheck: declare-axes=stage
+
+from jax import lax
+
+
+def _reduce(y):
+    return lax.psum(y, "stage")
+
+
+def tick_a(carry, x):  # graftcheck: stage-seq=demo-tick
+    y = lax.ppermute(x, "stage", [(0, 1)])
+    return carry, lax.psum(y, "stage")
+
+
+def tick_b(carry, x):  # graftcheck: stage-seq=demo-tick
+    # Same (ppermute, psum) sequence, psum via a helper: the
+    # transitive flatten must see through the call.
+    y = lax.ppermute(x, "stage", [(0, 1)])
+    return carry, _reduce(y)

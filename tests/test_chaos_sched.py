@@ -532,23 +532,29 @@ def test_multi_runner_drops_recovered_jobs_not_in_job_list(tmp_path):
 
 
 def test_unquarantine_probe_readmits_then_rebenches(tmp_path):
+    # One simulated clock throughout: the sweep's `now` is also the
+    # instant the rollback quarantines the slot (the apply layer is
+    # replay-pure and never reads a clock of its own), so the probe
+    # window is measured from the sweep time — no real sleeping.
     state = _state(tmp_path, slot_quarantine_s=0.2)
     state.create_job("ns/a")
     state.update("ns/a", allocation=["good"], status="Running")
     state.renew_lease("ns/a", 0, 30.0, group=0)
+    sweep = time.monotonic() + 1.0
     for _ in range(2):  # strike limit 2 -> quarantined
         state.update("ns/a", allocation=["bad"])
-        state.expire_overdue_allocations(now=time.monotonic() + 1.0)
-    assert state.quarantined_slots() == ["bad"]
-    time.sleep(0.25)
-    assert state.quarantined_slots() == [], "probe window open"
-    assert state.slot_health()["strikes"]["bad"] == 1, (
+        state.expire_overdue_allocations(now=sweep)
+    assert state.quarantined_slots(now=sweep) == ["bad"]
+    assert state.quarantined_slots(now=sweep + 0.25) == [], (
+        "probe window open"
+    )
+    assert state.slot_health(now=sweep + 0.25)["strikes"]["bad"] == 1, (
         "strikes primed one below the limit"
     )
     # One more failed epoch re-benches immediately.
     state.update("ns/a", allocation=["bad"])
-    state.expire_overdue_allocations(now=time.monotonic() + 1.0)
-    assert state.quarantined_slots() == ["bad"]
+    state.expire_overdue_allocations(now=sweep + 0.3)
+    assert state.quarantined_slots(now=sweep + 0.3) == ["bad"]
 
 
 def test_allocator_excludes_quarantined_slots(tmp_path):

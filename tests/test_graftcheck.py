@@ -121,6 +121,76 @@ def test_journal_discipline_good():
     assert run_on("journaled_good.py") == []
 
 
+def test_replay_purity_bad():
+    findings = run_on("replay_bad.py")
+    assert rule_lines(findings, "GC901") == [18, 22, 24, 29, 33]
+    assert rule_lines(findings, "GC902") == [28]
+    assert rule_lines(findings, "GC903") == [35]
+    # The unannotated journal append is also a GC604 (both catalogs
+    # are honest about the same sneaky method).
+    assert {f.rule for f in findings} == {
+        "GC901", "GC902", "GC903", "GC604",
+    }
+
+
+def test_replay_purity_good():
+    assert run_on("replay_good.py") == []
+
+
+def test_replay_purity_transitive_finding_names_path():
+    findings = run_on("replay_bad.py")
+    via = [f for f in findings if f.line == 33]
+    assert len(via) == 1
+    assert "_helper" in via[0].message
+    assert "_apply_commit_locked" in via[0].message
+
+
+def test_spmd_divergence_bad():
+    """The acceptance gate: a deliberately rank-divergent collective
+    is caught at the exact line — including the equal-multiset,
+    different-ORDER form (rank 0 at psum, the rest at pmean)."""
+    findings = run_on("spmd_bad.py")
+    assert rule_lines(findings, "GC801") == [12, 19, 26, 34]
+    assert {f.rule for f in findings} == {"GC801"}
+
+
+def test_spmd_divergence_good():
+    assert run_on("spmd_good.py") == []
+
+
+def test_stage_seq_bad():
+    findings = run_on("stageseq_bad.py")
+    assert rule_lines(findings, "GC802") == [13]
+    assert {f.rule for f in findings} == {"GC802"}
+
+
+def test_stage_seq_good_sees_through_helpers():
+    assert run_on("stageseq_good.py") == []
+
+
+def test_axis_flow_bad():
+    findings = run_on("axisflow_bad.py")
+    assert rule_lines(findings, "GC803") == [16, 20, 23]
+    assert {f.rule for f in findings} == {"GC803"}
+
+
+def test_axis_flow_good():
+    assert run_on("axisflow_good.py") == []
+
+
+def test_lock_flow_bad():
+    findings = run_on("lockflow_bad.py")
+    assert rule_lines(findings, "GC103") == [14]
+    assert rule_lines(findings, "GC101") == [23]
+    assert {f.rule for f in findings} == {"GC101", "GC103"}
+
+
+def test_lock_flow_good_infers_helper_locks():
+    """v1 flagged _drain's unannotated access; the interprocedural
+    lock-set must prove it held from its (all-locked) call sites."""
+    assert run_on("lockflow_good.py") == []
+
+
 def test_timing_discipline_bad():
     findings = run_on("timing_bad.py")
     assert rule_lines(findings, "GC701") == [11, 21]
@@ -233,17 +303,22 @@ def test_findings_have_location_rule_and_hint():
 
 def test_package_is_clean_or_baselined():
     """THE gate: ``adaptdl_tpu/`` must produce no findings beyond the
-    committed baseline. A regression in any invariant fails tier-1
-    right here."""
+    committed baseline — and the cold run that proves it must fit the
+    <10s budget that keeps graftcheck in `make lint` and CI on every
+    push (one timed analysis serves both assertions; the suite pays
+    for a full-package run exactly once)."""
     ctx = Context(root=REPO, docs_dir=os.path.join(REPO, "docs"))
+    start = time.monotonic()
     findings = analyze_paths(
         [os.path.join(REPO, "adaptdl_tpu")], ALL_PASSES, ctx
     )
+    elapsed = time.monotonic() - start
     baseline = load_baseline(
         os.path.join(REPO, "graftcheck_baseline.json")
     )
     fresh = new_findings(findings, baseline)
     assert fresh == [], "\n".join(f.render() for f in fresh)
+    assert elapsed < 10.0
 
 
 def test_package_annotations_are_present():
@@ -295,15 +370,9 @@ def test_cluster_state_mutators_stay_journaled():
     assert expected <= annotated, annotated
 
 
-def test_analyzer_speed_budget():
-    """The smoke-mode requirement: a full cold run over the package
-    stays well under 10s so `make lint` + CI keep it on every push."""
-    ctx = Context(root=REPO, docs_dir=os.path.join(REPO, "docs"))
-    start = time.monotonic()
-    analyze_paths(
-        [os.path.join(REPO, "adaptdl_tpu")], ALL_PASSES, ctx
-    )
-    assert time.monotonic() - start < 10.0
+# The <10s cold speed budget is asserted inside
+# test_package_is_clean_or_baselined (same timed run); the <1s warm
+# budget lives in test_graftcheck_program.py.
 
 
 # ---- baseline workflow ----------------------------------------------
@@ -359,8 +428,13 @@ def _run_cli(*args: str):
     )
 
 
-def test_cli_clean_package_exits_zero():
-    proc = _run_cli("adaptdl_tpu")
+def test_cli_clean_input_exits_zero():
+    """Exit-0 semantics on clean input (the real-package gate runs
+    in-process in test_package_is_clean_or_baselined — no need to pay
+    a second full cold CLI analysis here)."""
+    proc = _run_cli(
+        os.path.join("tests", "graftcheck_fixtures", "lock_good.py")
+    )
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 

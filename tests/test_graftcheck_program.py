@@ -1,0 +1,493 @@
+"""graftcheck v2: whole-program infrastructure contract tests.
+
+Covers the pieces the fixture pairs in test_graftcheck.py build on:
+the symbol table / call graph / lock-set dataflow (program.py), the
+--fast cache fingerprint (tool content + rule set + cross-file
+inputs), SARIF output, GC304 stale-docs detection, and the speed
+budgets (<10s cold, <1s warm) on the grown codebase.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tools.graftcheck import ALL_PASSES, Context, analyze_paths
+from tools.graftcheck.core import (
+    CACHE_FILE,
+    Pass,
+    parse_file,
+    tool_fingerprint,
+)
+from tools.graftcheck.program import Program
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "graftcheck_fixtures")
+
+
+def _program(tmp_path, files: dict[str, str]) -> Program:
+    parsed = []
+    for rel, text in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(text)
+        parsed.append(parse_file(str(path), str(tmp_path)))
+    return Program(parsed)
+
+
+# ---- call graph -----------------------------------------------------
+
+
+def test_resolves_module_level_and_self_method_calls(tmp_path):
+    prog = _program(
+        tmp_path,
+        {
+            "pkg/a.py": (
+                "def helper():\n"
+                "    return 1\n"
+                "\n"
+                "\n"
+                "class C:\n"
+                "    def m(self):\n"
+                "        return self.n() + helper()\n"
+                "\n"
+                "    def n(self):\n"
+                "        return 2\n"
+            ),
+        },
+    )
+    m = prog.functions["pkg/a.py::C.m"]
+    callees = {s.callee.qualname for s in m.call_sites if s.callee}
+    assert callees == {"pkg/a.py::C.n", "pkg/a.py::helper"}
+
+
+def test_resolves_cross_module_calls_through_imports(tmp_path):
+    prog = _program(
+        tmp_path,
+        {
+            "pkg/util.py": "def work():\n    return 1\n",
+            "pkg/main.py": (
+                "from pkg.util import work\n"
+                "from pkg import util\n"
+                "\n"
+                "\n"
+                "def direct():\n"
+                "    return work()\n"
+                "\n"
+                "\n"
+                "def dotted():\n"
+                "    return util.work()\n"
+            ),
+        },
+    )
+    work = prog.functions["pkg/util.py::work"]
+    caller_names = {
+        s.caller.name for s in work.callers if s.caller is not None
+    }
+    assert caller_names == {"direct", "dotted"}
+
+
+def test_reference_edges_for_scan_and_jit(tmp_path):
+    prog = _program(
+        tmp_path,
+        {
+            "m.py": (
+                "from jax import lax\n"
+                "\n"
+                "\n"
+                "def outer(xs):\n"
+                "    def body(c, x):\n"
+                "        return c, x\n"
+                "    return lax.scan(body, 0, xs)\n"
+            ),
+        },
+    )
+    body = next(
+        info
+        for info in prog.functions.values()
+        if info.name == "body"
+    )
+    assert any(s.is_reference for s in body.callers)
+
+
+def test_inheritance_resolves_base_methods(tmp_path):
+    prog = _program(
+        tmp_path,
+        {
+            "m.py": (
+                "class Base:\n"
+                "    def shared(self):\n"
+                "        return 1\n"
+                "\n"
+                "\n"
+                "class Child(Base):\n"
+                "    def go(self):\n"
+                "        return self.shared()\n"
+            ),
+        },
+    )
+    shared = prog.functions["m.py::Base.shared"]
+    assert {s.caller.name for s in shared.callers} == {"go"}
+
+
+# ---- lock-set dataflow ----------------------------------------------
+
+
+def test_entry_locks_inferred_from_all_locked_call_sites(tmp_path):
+    prog = _program(
+        tmp_path,
+        {
+            "m.py": (
+                "import threading\n"
+                "_lock = threading.Lock()\n"
+                "\n"
+                "\n"
+                "def helper():\n"
+                "    return 1\n"
+                "\n"
+                "\n"
+                "def a():\n"
+                "    with _lock:\n"
+                "        return helper()\n"
+                "\n"
+                "\n"
+                "def b():\n"
+                "    with _lock:\n"
+                "        return helper()\n"
+            ),
+        },
+    )
+    assert prog.functions["m.py::helper"].entry_locks == {"_lock"}
+
+
+def test_entry_locks_meet_is_intersection(tmp_path):
+    prog = _program(
+        tmp_path,
+        {
+            "m.py": (
+                "import threading\n"
+                "_lock = threading.Lock()\n"
+                "\n"
+                "\n"
+                "def helper():\n"
+                "    return 1\n"
+                "\n"
+                "\n"
+                "def locked():\n"
+                "    with _lock:\n"
+                "        return helper()\n"
+                "\n"
+                "\n"
+                "def unlocked():\n"
+                "    return helper()\n"
+            ),
+        },
+    )
+    assert prog.functions["m.py::helper"].entry_locks == frozenset()
+
+
+def test_entry_locks_propagate_through_annotated_callers(tmp_path):
+    prog = _program(
+        tmp_path,
+        {
+            "m.py": (
+                "def inner():\n"
+                "    return 1\n"
+                "\n"
+                "\n"
+                "def mid():  # holds-lock: _cond\n"
+                "    return inner()\n"
+            ),
+        },
+    )
+    assert prog.functions["m.py::inner"].entry_locks == {"_cond"}
+
+
+def test_method_reference_escape_poisons_inference(tmp_path):
+    """`Thread(target=self._drain)` is an ATTRIBUTE reference — it
+    must mark the method escaping exactly like a bare-name target, or
+    lock inference would silence GC101 on the unlocked-thread race."""
+    prog = _program(
+        tmp_path,
+        {
+            "m.py": (
+                "import threading\n"
+                "_lock = threading.Lock()\n"
+                "\n"
+                "\n"
+                "class W:\n"
+                "    def _drain(self):\n"
+                "        return 1\n"
+                "\n"
+                "    def go(self):\n"
+                "        with _lock:\n"
+                "            self._drain()\n"
+                "        threading.Thread(target=self._drain)\n"
+            ),
+        },
+    )
+    drain = prog.functions["m.py::W._drain"]
+    assert drain.escapes
+    assert drain.entry_locks == frozenset()
+
+
+def test_escaped_functions_get_no_inferred_locks(tmp_path):
+    prog = _program(
+        tmp_path,
+        {
+            "m.py": (
+                "import threading\n"
+                "_lock = threading.Lock()\n"
+                "\n"
+                "\n"
+                "def worker():\n"
+                "    return 1\n"
+                "\n"
+                "\n"
+                "def spawn():\n"
+                "    with _lock:\n"
+                "        worker()\n"
+                "        t = threading.Thread(target=worker)\n"
+                "        t.start()\n"
+            ),
+        },
+    )
+    worker = prog.functions["m.py::worker"]
+    assert worker.escapes
+    assert worker.entry_locks == frozenset()
+
+
+# ---- --fast cache fingerprint ---------------------------------------
+
+
+def test_fingerprint_changes_with_rule_set():
+    class RuleA(Pass):
+        rules = {"GCA": "a"}
+
+    class RuleB(Pass):
+        rules = {"GCB": "b"}
+
+    ctx = Context(root=REPO)
+    assert tool_fingerprint([RuleA()], ctx) != tool_fingerprint(
+        [RuleB()], ctx
+    )
+
+
+def test_fingerprint_tracks_cache_input_content(tmp_path):
+    dep = tmp_path / "dep.cfg"
+    dep.write_text("one")
+
+    class DepPass(Pass):
+        rules = {"GCX": "x"}
+
+        def cache_inputs(self, ctx):
+            return [str(dep)]
+
+    ctx = Context(root=str(tmp_path))
+    first = tool_fingerprint([DepPass()], ctx)
+    # Same size, same mtime — only the CONTENT differs. mtime/size
+    # keys (the v1 scheme) cannot see this.
+    stat = os.stat(dep)
+    dep.write_text("two")
+    os.utime(dep, (stat.st_atime, stat.st_mtime))
+    assert tool_fingerprint([DepPass()], ctx) != first
+
+
+def test_fast_cache_refreshes_on_faults_catalog_change(tmp_path):
+    """The v1 staleness bug: GC602 findings judged against faults.py
+    stayed cached when the catalog changed. Registering the point
+    must clear the finding on the SECOND --fast run."""
+    pkg = tmp_path / "adaptdl_tpu"
+    pkg.mkdir()
+    (pkg / "faults.py").write_text(
+        'INJECTION_POINTS = {\n    "a.point": "x",\n}\n'
+    )
+    (pkg / "mod.py").write_text(
+        "from adaptdl_tpu import faults\n"
+        "\n"
+        "\n"
+        "def f():\n"
+        '    faults.maybe_fail("b.point")\n'
+    )
+    env = dict(os.environ, PYTHONPATH=REPO)
+
+    def run():
+        return subprocess.run(
+            [
+                sys.executable, "-m", "tools.graftcheck",
+                "adaptdl_tpu", "--fast",
+            ],
+            cwd=str(tmp_path),
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+
+    first = run()
+    assert "GC602" in first.stdout, first.stdout + first.stderr
+    (pkg / "faults.py").write_text(
+        'INJECTION_POINTS = {\n'
+        '    "a.point": "x",\n'
+        '    "b.point": "y",\n'
+        "}\n"
+    )
+    second = run()
+    assert second.returncode == 0, second.stdout + second.stderr
+    assert "GC602" not in second.stdout
+
+
+def test_fast_cache_reuses_program_findings_when_unchanged(tmp_path):
+    """Warm path: an unchanged tree serves program-level findings
+    (GC103 here) from the cache without re-analysis — and still
+    reports them identically."""
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "\n"
+        "\n"
+        "def helper():  # holds-lock: _lock\n"
+        "    return 1\n"
+        "\n"
+        "\n"
+        "def bad():\n"
+        "    return helper()\n"
+    )
+    env = dict(os.environ, PYTHONPATH=REPO)
+
+    def run():
+        return subprocess.run(
+            [
+                sys.executable, "-m", "tools.graftcheck",
+                "mod.py", "--fast",
+            ],
+            cwd=str(tmp_path),
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+
+    first, second = run(), run()
+    assert first.returncode == second.returncode == 1
+    assert first.stdout == second.stdout
+    assert "GC103" in second.stdout
+    cache = json.loads((tmp_path / CACHE_FILE).read_text())
+    assert "__project__" in cache["files"]
+
+
+# ---- SARIF ----------------------------------------------------------
+
+
+def test_sarif_output_is_valid_and_locates_findings():
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tools.graftcheck",
+            os.path.join(
+                "tests", "graftcheck_fixtures", "spmd_bad.py"
+            ),
+            "--format", "sarif", "-q",
+            "--baseline", "does-not-exist.json",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftcheck"
+    results = run["results"]
+    assert {r["ruleId"] for r in results} == {"GC801"}
+    lines = sorted(
+        r["locations"][0]["physicalLocation"]["region"]["startLine"]
+        for r in results
+    )
+    assert lines == [12, 19, 26, 34]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "GC801" in rule_ids
+    # Every result's ruleIndex must point at its own rule.
+    for r in results:
+        assert (
+            run["tool"]["driver"]["rules"][r["ruleIndex"]]["id"]
+            == r["ruleId"]
+        )
+
+
+# ---- GC304: stale env docs ------------------------------------------
+
+
+def test_stale_documented_key_is_flagged(tmp_path):
+    pkg = tmp_path / "adaptdl_tpu"
+    pkg.mkdir()
+    (pkg / "env.py").write_text(
+        "import os\n"
+        "\n"
+        "\n"
+        "def alive():\n"
+        '    return os.environ.get("ADAPTDL_ALIVE")\n'
+    )
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "environment.md").write_text(
+        "# Env\n"
+        "\n"
+        "| `ADAPTDL_ALIVE` | set it |\n"
+        "| `ADAPTDL_REMOVED_KNOB` | gone from env.py |\n"
+    )
+    ctx = Context(root=str(tmp_path), docs_dir=str(docs))
+    findings = analyze_paths([str(pkg)], ALL_PASSES, ctx)
+    stale = [f for f in findings if f.rule == "GC304"]
+    assert len(stale) == 1
+    assert stale[0].file == "docs/environment.md"
+    assert stale[0].line == 4
+    assert "ADAPTDL_REMOVED_KNOB" in stale[0].message
+    # The live key is documented AND read: no GC303/GC304 for it.
+    assert not any(
+        "ADAPTDL_ALIVE" in f.message for f in findings
+    )
+
+
+# A GC304 finding in THIS repo would surface through
+# test_package_is_clean_or_baselined (the package gate runs with
+# docs_dir set), so no separate full-package analysis is spent on it.
+
+
+# ---- speed budgets --------------------------------------------------
+
+
+def test_warm_fast_run_stays_under_one_second(tmp_path):
+    """The `make lint` contract: with a warm cache and no edits, the
+    whole-program analyzer must not re-parse or re-analyze — the warm
+    run serves per-file AND program findings from the cache in well
+    under a second."""
+    cache = str(tmp_path / "cache.json")
+    ctx = Context(root=REPO, docs_dir=os.path.join(REPO, "docs"))
+    analyze_paths(
+        [os.path.join(REPO, "adaptdl_tpu")],
+        ALL_PASSES,
+        ctx,
+        use_cache=True,
+        cache_path=cache,
+    )
+    start = time.monotonic()
+    analyze_paths(
+        [os.path.join(REPO, "adaptdl_tpu")],
+        ALL_PASSES,
+        ctx,
+        use_cache=True,
+        cache_path=cache,
+    )
+    assert time.monotonic() - start < 1.0
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -164,7 +164,7 @@ def test_hazard_ewma_converges_and_decays():
             "ts": now + 50.0 * i,
         }
         with state._cond:
-            state._apply_preempt_locked(op)
+            state._apply_preempt_locked(op, time.monotonic())
     last = now + 50.0 * 99
     rate = state.hazard_rates(now=last)["spot"]
     assert rate == pytest.approx(1 / 50.0, rel=0.05)
@@ -262,7 +262,8 @@ def test_hazard_normalized_by_kind_fleet_size():
                     "kinds": {"spot-0": "spot"},
                     "notice_s": 30.0,
                     "ts": now,
-                }
+                },
+                time.monotonic(),
             )
 
     small = ClusterState(hazard_tau_s=3600.0)

@@ -1,0 +1,126 @@
+"""Journal replay must be pure: recovery reproduces history
+bit-for-bit regardless of when it runs.
+
+The ``_apply_*_locked`` layer is annotated ``# replay-pure`` and
+enforced by graftcheck GC901/902/903 (tools/graftcheck/passes/
+replay_purity.py); these tests pin the RUNTIME consequence — two
+recoveries of the same journal, run under different clocks, produce
+identical durable state. Before the purity refactor the apply layer
+fell back to ``time.time()`` for records missing a ``ts`` stamp
+(records written by an older supervisor version), so the recovered
+state depended on when the recovery happened to run.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from adaptdl_tpu.sched.journal import StateJournal
+from adaptdl_tpu.sched.state import ClusterState
+
+
+def _exercise(state: ClusterState) -> None:
+    state.create_job("ns/job", spec={"min": 1})
+    state.update("ns/job", status="Running", allocation=["slot-0"])
+    state.register_worker(
+        "ns/job", group=0, rank=0, address="10.0.0.1:1", processes=1
+    )
+    state.renew_lease("ns/job", rank=0, ttl=60.0)
+    state.set_slot_kinds({"slot-0": "spot"}, preemptible={"slot-0"})
+    state.report_preemption("ns/job", group=0, slot="slot-0")
+    state.update("ns/job", status="Succeeded")
+
+
+def _durable_view(state: ClusterState, hazard_now: float) -> dict:
+    metrics = state.lifecycle_metrics()
+    job = state.get_job("ns/job")
+    return {
+        "completions": metrics["completions"],
+        "submitted": metrics["submitted_total"],
+        "creation_ts": job.creation_timestamp,
+        "status": job.status,
+        "group": job.group,
+        # Hazard EWMA is wall-clock-anchored via journaled ts; read
+        # it at one fixed instant so the views are comparable.
+        "hazard": state.hazard_rates(now=hazard_now),
+    }
+
+
+def test_recovery_is_invariant_to_recovery_wall_clock(
+    tmp_path, monkeypatch
+):
+    state_dir = str(tmp_path / "sched")
+    live = ClusterState(state_dir=state_dir)
+    _exercise(live)
+    hazard_now = time.time() + 5.0
+
+    first = ClusterState(state_dir=state_dir)
+    view_first = _durable_view(first, hazard_now)
+
+    # Recover the same journal "a week later": wall clock shifted by
+    # an arbitrary amount. Durable state must not notice.
+    real_time = time.time
+    monkeypatch.setattr(
+        "adaptdl_tpu.sched.state.time.time",
+        lambda: real_time() + 7 * 24 * 3600.0,
+    )
+    second = ClusterState(state_dir=state_dir)
+    view_second = _durable_view(second, hazard_now)
+    assert view_first == view_second
+
+
+def test_legacy_record_without_ts_replays_deterministically(
+    tmp_path, monkeypatch
+):
+    """A create op from an old journal version carries no ts. It must
+    replay to the SAME creation_timestamp (0.0) every time — never
+    "whenever recovery ran", which corrupted the completion-time
+    summary on the first status change after a crash."""
+    state_dir = str(tmp_path / "sched")
+    journal = StateJournal(state_dir)
+    journal.append({"op": "create_job", "key": "ns/old", "spec": {}})
+    journal.append(
+        {
+            "op": "update",
+            "key": "ns/old",
+            "fields": {"status": "Succeeded"},
+            "ts": 123.0,
+        }
+    )
+    journal.close()
+
+    first = ClusterState(state_dir=state_dir)
+    assert first.get_job("ns/old").creation_timestamp == 0.0
+    count, total = first.lifecycle_metrics()["completions"][
+        "Succeeded"
+    ]
+    assert count == 1
+    assert total == pytest.approx(123.0)
+
+    real_time = time.time
+    monkeypatch.setattr(
+        "adaptdl_tpu.sched.state.time.time",
+        lambda: real_time() + 1e6,
+    )
+    second = ClusterState(state_dir=state_dir)
+    assert second.get_job("ns/old").creation_timestamp == 0.0
+    assert (
+        second.lifecycle_metrics()["completions"]
+        == first.lifecycle_metrics()["completions"]
+    )
+
+
+def test_lease_deadlines_use_caller_stamp(tmp_path):
+    """The apply layer never reads a clock: a lease planted via
+    renew_lease expires relative to the mutator's stamp, and replayed
+    leases are re-armed by recovery's reconciliation grace — both
+    observable without any clock read inside _apply_lease_locked."""
+    state = ClusterState(state_dir=None)
+    state.create_job("ns/j", spec={})
+    before = time.monotonic()
+    state.renew_lease("ns/j", rank=0, ttl=30.0)
+    after = time.monotonic()
+    deadline = state.get_job("ns/j").leases[0]
+    assert before + 30.0 <= deadline <= after + 30.0

@@ -66,9 +66,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--format",
-        choices=("text", "json"),
+        choices=("text", "json", "sarif"),
         default="text",
-        help="finding output format",
+        help=(
+            "finding output format (sarif = SARIF 2.1.0 for GitHub "
+            "code-scanning PR annotations)"
+        ),
     )
     parser.add_argument(
         "--list-rules",
@@ -150,6 +153,18 @@ def main(argv: list[str] | None = None) -> int:
         print(
             json.dumps(
                 [f.to_json() for f in fresh], indent=2, sort_keys=True
+            )
+        )
+    elif args.format == "sarif":
+        import json
+
+        from tools.graftcheck.sarif import to_sarif
+
+        print(
+            json.dumps(
+                to_sarif(fresh, RULE_CATALOG),
+                indent=2,
+                sort_keys=True,
             )
         )
     else:

@@ -17,16 +17,36 @@ import tokenize
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable
 
-TOOL_VERSION = "1"
+TOOL_VERSION = "2"
 
 
-def tool_fingerprint() -> str:
-    """Cache-busting version for --fast: TOOL_VERSION plus the
-    (name, mtime, size) of every graftcheck source file, so editing a
-    pass invalidates cached per-file results without anyone having to
-    remember a manual version bump."""
+def tool_fingerprint(
+    passes: "list[Pass] | None" = None,
+    ctx: "Context | None" = None,
+) -> str:
+    """Cache-busting version for --fast.
+
+    Folds in everything cached per-file findings can depend on besides
+    the analyzed file itself:
+
+    - TOOL_VERSION and the active rule-id set (a pass enabled or
+      renamed between runs invalidates even if no file changed),
+    - the CONTENT hash of every graftcheck source file — mtime/size
+      alone misses a same-size edit whose mtime was restored (git
+      stash round-trips, build systems normalizing timestamps),
+    - each pass's declared cross-file ``cache_inputs`` (e.g. the
+      faults.py catalog GC602 judges against: registering a point
+      must refresh other files' cached findings, not serve stale
+      ones).
+    """
+    import hashlib
+
+    h = hashlib.sha256(TOOL_VERSION.encode())
+    if passes is not None:
+        for pazz in passes:
+            for rule in sorted(pazz.rules):
+                h.update(rule.encode())
     tool_dir = os.path.dirname(os.path.abspath(__file__))
-    parts = [TOOL_VERSION]
     for dirpath, dirnames, filenames in os.walk(tool_dir):
         dirnames[:] = sorted(
             d for d in dirnames if d != "__pycache__"
@@ -35,17 +55,22 @@ def tool_fingerprint() -> str:
             if not name.endswith(".py"):
                 continue
             path = os.path.join(dirpath, name)
+            h.update(os.path.relpath(path, tool_dir).encode())
             try:
-                stat = os.stat(path)
+                with open(path, "rb") as f:
+                    h.update(f.read())
             except OSError:  # pragma: no cover
                 continue
-            parts.append(
-                f"{os.path.relpath(path, tool_dir)}:"
-                f"{stat.st_mtime}:{stat.st_size}"
-            )
-    import hashlib
-
-    return hashlib.sha256("|".join(parts).encode()).hexdigest()
+    if passes is not None and ctx is not None:
+        for pazz in passes:
+            for path in sorted(pazz.cache_inputs(ctx)):
+                h.update(path.encode())
+                try:
+                    with open(path, "rb") as f:
+                        h.update(f.read())
+                except OSError:
+                    h.update(b"<missing>")
+    return h.hexdigest()
 
 CACHE_FILE = ".graftcheck_cache.json"
 DEFAULT_BASELINE = "graftcheck_baseline.json"
@@ -60,10 +85,17 @@ DEFAULT_BASELINE = "graftcheck_baseline.json"
 #   risky()             # graftcheck: disable=GC101 (why it is safe)
 #   # graftcheck: disable-file=GC301             anywhere in the file
 #   # graftcheck: declare-axes=data,seq          extra mesh axes
+#   def _apply_x():     # replay-pure            on the journal-replay
+#                                                path: no clock/RNG/env/IO
+#   def tick():         # graftcheck: stage-seq=pipeline-tick
+#                       all defs sharing a group must run the same
+#                       collective sequence (GC802)
 
 GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
 HOLDS_LOCK_RE = re.compile(r"#\s*holds-lock:\s*([A-Za-z_][\w.]*)")
 HOT_PATH_RE = re.compile(r"#\s*graftcheck:\s*hot-path\b")
+REPLAY_PURE_RE = re.compile(r"#\s*replay-pure\b")
+STAGE_SEQ_RE = re.compile(r"#\s*graftcheck:\s*stage-seq=([\w-]+)")
 DISABLE_RE = re.compile(r"#\s*graftcheck:\s*disable=([A-Z0-9,\s]+)")
 DISABLE_FILE_RE = re.compile(
     r"#\s*graftcheck:\s*disable-file=([A-Z0-9,\s]+)"
@@ -137,11 +169,26 @@ class SourceFile:
                 self.file_disables |= {
                     r.strip() for r in m.group(1).split(",") if r.strip()
                 }
-        # child -> parent links for enclosing-scope queries
+        # child -> parent links for enclosing-scope queries, plus the
+        # flat node list in ast.walk (BFS) order — passes iterate
+        # this instead of re-walking the tree (a dozen passes times a
+        # full ast.walk each dominated v1's cold cost).
         self.parents: dict[ast.AST, ast.AST] = {}
+        self.all_nodes: list[ast.AST] = [self.tree]
         for node in ast.walk(self.tree):
             for child in ast.iter_child_nodes(node):
                 self.parents[child] = node
+                self.all_nodes.append(child)
+
+    def walk(self, *types: type) -> Iterable[ast.AST]:
+        """All nodes (ast.walk order), optionally type-filtered."""
+        if not types:
+            return iter(self.all_nodes)
+        return (
+            node
+            for node in self.all_nodes
+            if isinstance(node, types)
+        )
 
     # -- tree helpers --------------------------------------------------
 
@@ -214,6 +261,24 @@ class SourceFile:
         return False
 
 
+def walk_own(root: ast.AST) -> Iterable[ast.AST]:
+    """Walk ``root``'s subtree without descending into nested defs or
+    lambdas: a closure's body is not part of the enclosing function's
+    straight-line behavior (it runs wherever it is invoked — the call
+    graph's reference edges cover scan/jit bodies). Shared by the
+    interprocedural passes."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
 def dotted_name(node: ast.AST) -> str | None:
     """'a.b.c' for Name/Attribute chains, else None."""
     parts: list[str] = []
@@ -243,11 +308,24 @@ class Pass:
     the --fast per-file cache. A project-level pass that must see
     specific modules even on a warm cache (where unchanged files skip
     parsing) lists their path suffixes in ``project_files``.
+
+    ``check_program`` runs once with the whole-program model (symbol
+    table + call graph, :mod:`tools.graftcheck.program`); a pass that
+    implements it sets ``whole_program = True`` so the engine parses
+    EVERY file even on a warm --fast cache — interprocedural facts
+    cannot come from a per-file cache. Like project findings, program
+    findings are recomputed on every run, never cached.
+
+    ``cache_inputs`` names files OUTSIDE the analyzed set whose
+    content per-file findings depend on (e.g. the faults.py catalog);
+    their content is folded into the --fast cache fingerprint so an
+    edit there invalidates cached findings everywhere.
     """
 
     name: str = "pass"
     rules: dict[str, str] = {}
     project_files: tuple[str, ...] = ()
+    whole_program: bool = False
 
     def check_file(
         self, sf: SourceFile, ctx: Context
@@ -257,6 +335,12 @@ class Pass:
     def check_project(
         self, files: list[SourceFile], ctx: Context
     ) -> list[Finding]:
+        return []
+
+    def check_program(self, program, ctx: Context) -> list[Finding]:
+        return []
+
+    def cache_inputs(self, ctx: Context) -> list[str]:
         return []
 
 
@@ -300,12 +384,20 @@ def analyze_paths(
     """Run every pass over every .py file under ``paths``.
 
     With ``use_cache``, per-file findings for files whose (mtime, size)
-    are unchanged since the last run are reused; project-level rules
-    always recompute (they depend on files outside the cache key).
+    are unchanged since the last run are reused. Project- and
+    program-level findings are cached as one unit keyed on the FULL
+    file set: they are reused only when every analyzed file is a
+    cache hit and the set itself is unchanged (their cross-file
+    inputs — docs, the faults catalog — are folded into the cache
+    fingerprint via ``Pass.cache_inputs``). Any miss recomputes them
+    from a full parse, so a warm clean run does no parsing at all and
+    a single edited file re-runs the whole-program passes.
     """
     cache: dict[str, Any] = {}
     cache_dirty = False
-    version = tool_fingerprint() if use_cache else TOOL_VERSION
+    version = (
+        tool_fingerprint(passes, ctx) if use_cache else TOOL_VERSION
+    )
     if use_cache and cache_path:
         try:
             with open(cache_path, encoding="utf-8") as f:
@@ -320,15 +412,50 @@ def analyze_paths(
     always_parse = tuple(
         suffix for pazz in passes for suffix in pazz.project_files
     )
+    # Whole-program passes need EVERY file parsed: the call graph and
+    # symbol table cannot be assembled from cached findings.
+    parse_all = any(pazz.whole_program for pazz in passes)
 
-    findings: list[Finding] = []
-    parsed: list[SourceFile] = []
+    # First pass over stats: when EVERY file is a cache hit and the
+    # file set is unchanged, the cached project/program findings are
+    # valid too and nothing needs parsing at all (the sub-second warm
+    # path `make lint` runs on).
+    listed = []
     for path in iter_python_files(paths):
         rel = os.path.relpath(path, ctx.root)
         try:
             stat = os.stat(path)
         except OSError:
             continue
+        listed.append((path, rel, stat))
+    rel_set = sorted(r for _p, r, _s in listed)
+    project_entry = cache.get("__project__") if use_cache else None
+    all_hit = (
+        use_cache
+        and project_entry is not None
+        and project_entry.get("files") == rel_set
+        and all(
+            cache.get(rel) is not None
+            and cache[rel].get("mtime") == stat.st_mtime
+            and cache[rel].get("size") == stat.st_size
+            for _p, rel, stat in listed
+        )
+    )
+    if all_hit:
+        findings = [
+            Finding(**item)
+            for _p, rel, _s in listed
+            for item in cache[rel].get("findings", [])
+        ]
+        findings.extend(
+            Finding(**item)
+            for item in project_entry.get("findings", [])
+        )
+        return sorted(findings)
+
+    findings: list[Finding] = []
+    parsed: list[SourceFile] = []
+    for path, rel, stat in listed:
         entry = cache.get(rel)
         cache_hit = (
             use_cache
@@ -336,8 +463,12 @@ def analyze_paths(
             and entry.get("mtime") == stat.st_mtime
             and entry.get("size") == stat.st_size
         )
-        if cache_hit and not rel.replace(os.sep, "/").endswith(
-            always_parse or ("\0",)
+        if (
+            cache_hit
+            and not parse_all
+            and not rel.replace(os.sep, "/").endswith(
+                always_parse or ("\0",)
+            )
         ):
             # Warm path: cached findings, no parse at all — parsing
             # dominates a clean run's cost.
@@ -382,11 +513,27 @@ def analyze_paths(
             cache_dirty = True
 
     by_rel = {sf.rel: sf for sf in parsed}
+    program = None
+    if parse_all and parsed:
+        from tools.graftcheck.program import Program
+
+        program = Program(parsed)
+    kept_project: list[Finding] = []
     for pazz in passes:
-        for finding in pazz.check_project(parsed, ctx):
+        project_findings = list(pazz.check_project(parsed, ctx))
+        if program is not None and pazz.whole_program:
+            project_findings.extend(pazz.check_program(program, ctx))
+        for finding in project_findings:
             sf = by_rel.get(finding.file)
             if sf is None or not sf.is_suppressed(finding):
-                findings.append(finding)
+                kept_project.append(finding)
+    findings.extend(kept_project)
+    if use_cache:
+        cache["__project__"] = {
+            "files": rel_set,
+            "findings": [f.to_json() for f in kept_project],
+        }
+        cache_dirty = True
 
     if use_cache and cache_path and cache_dirty:
         try:

@@ -11,6 +11,8 @@ from tools.graftcheck.passes.journal_discipline import (
     JournalDisciplinePass,
 )
 from tools.graftcheck.passes.lock_discipline import LockDisciplinePass
+from tools.graftcheck.passes.replay_purity import ReplayPurityPass
+from tools.graftcheck.passes.spmd import SpmdDisciplinePass
 from tools.graftcheck.passes.timing_discipline import (
     TimingDisciplinePass,
 )
@@ -20,10 +22,12 @@ ALL_PASSES = [
     HostSyncPass(),
     EnvRegistryPass(),
     CollectiveAxisPass(),
+    SpmdDisciplinePass(),
     CheckpointProtocolPass(),
     FaultRpcPass(),
     JournalDisciplinePass(),
     TimingDisciplinePass(),
+    ReplayPurityPass(),
 ]
 
 RULE_CATALOG = {

@@ -72,7 +72,7 @@ def _state_classes(sf: SourceFile) -> list[ast.ClassDef]:
     a base whose last dotted component is ``State``."""
     classes = [
         node
-        for node in ast.walk(sf.tree)
+        for node in sf.walk()
         if isinstance(node, ast.ClassDef)
     ]
     by_name = {cls.name: cls for cls in classes}

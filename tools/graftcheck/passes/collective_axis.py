@@ -6,15 +6,22 @@ exact topology that exercises it — which for elastic jobs can be a
 rescale in production. Rule:
 
 - **GC401** — a ``lax.psum``/``pmean``/``pmax``/``all_gather``-family
-  call whose axis argument is a string literal that no
-  ``shard_map``/``pmap``/``Mesh`` construction *in the same module*
-  binds, no module-level ``*_AXIS``/``*_AXES`` constant defines, and
-  no file-level ``# graftcheck: declare-axes=...`` declares.
+  call whose axis argument is a string literal that resolves to no
+  axis the PROGRAM binds: no ``shard_map``/``pmap``/``Mesh``
+  construction in any analyzed module, no ``*_AXIS``/``*_AXES``
+  constant (``parallel/mesh.py``'s canonical names included), and no
+  file-level ``# graftcheck: declare-axes=...``.
 
-Axis arguments that are function parameters, imported ``*_AXIS``
-constants, or locally computed values are trusted — the rule only
-fires on unresolvable hard-coded literals, so it stays quiet on the
-parameterized style the parallel/ modules use.
+v1 matched only against meshes bound *in the same module*, so every
+cross-module mesh usage needed a suppression; v2 resolves through the
+whole program (the trade: an axis bound by any module in the analyzed
+set counts, so a literal that is a *valid* axis used under the wrong
+mesh is runtime territory — shard_map's binding check — while typos
+and stale names after a mesh change stay static findings).
+
+Axis arguments that are function parameters or locally computed
+values are trusted here; the call-graph *flow* of literal arguments
+into those parameters is GC803 (passes/spmd.py).
 """
 
 from __future__ import annotations
@@ -80,7 +87,11 @@ def _strings_in(node: ast.AST) -> set[str]:
 
 def _declared_axes(sf: SourceFile) -> tuple[set[str], set[str]]:
     """(axis name strings declared in this module, names of constants
-    or imports that stand for axis names)."""
+    or imports that stand for axis names). Memoized on the SourceFile
+    — three passes ask for it per analyze run."""
+    cached = sf.__dict__.get("_gc_declared_axes")
+    if cached is not None:
+        return cached
     axes: set[str] = set()
     axis_consts: set[str] = set()
     for comment in sf.comments.values():
@@ -89,7 +100,7 @@ def _declared_axes(sf: SourceFile) -> tuple[set[str], set[str]]:
             axes |= {
                 a.strip() for a in m.group(1).split(",") if a.strip()
             }
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if isinstance(node, ast.Call):
             if _last(dotted_name(node.func)) in _AXIS_BINDERS:
                 for arg in node.args:
@@ -108,18 +119,24 @@ def _declared_axes(sf: SourceFile) -> tuple[set[str], set[str]]:
                 name = alias.asname or alias.name
                 if name.endswith(("_AXIS", "_AXES", "_axis")):
                     axis_consts.add(name)
+    sf.__dict__["_gc_declared_axes"] = (axes, axis_consts)
     return axes, axis_consts
 
 
 def _lax_imports(sf: SourceFile) -> set[str]:
-    """Bare names imported from jax.lax or the _compat shims."""
+    """Bare names imported from jax.lax or the _compat shims.
+    Memoized on the SourceFile (two passes ask per run)."""
+    cached = sf.__dict__.get("_gc_lax_imports")
+    if cached is not None:
+        return cached
     names: set[str] = set()
-    for imp in ast.walk(sf.tree):
+    for imp in sf.walk():
         if isinstance(imp, ast.ImportFrom) and imp.module and (
             imp.module.endswith("lax") or "_compat" in imp.module
         ):
             for alias in imp.names:
                 names.add(alias.asname or alias.name)
+    sf.__dict__["_gc_lax_imports"] = names
     return names
 
 
@@ -144,35 +161,59 @@ def _is_lax_call(
     return None
 
 
+def axis_argument(node: ast.Call, short: str) -> ast.expr | None:
+    """The axis-name argument expression of a collective call."""
+    pos = _COLLECTIVES[short]
+    for kw in node.keywords:
+        if kw.arg in _AXIS_KWARGS:
+            return kw.value
+    if len(node.args) > pos:
+        return node.args[pos]
+    return None
+
+
+def program_axes(files: list[SourceFile]) -> set[str]:
+    """The whole-program axis environment: every axis name any
+    analyzed module binds or declares (mesh constructions, ``*_AXIS``
+    constants — ``parallel/mesh.py``'s canonical names land here —
+    and ``declare-axes`` annotations)."""
+    axes: set[str] = set()
+    for sf in files:
+        axes |= _declared_axes(sf)[0]
+    return axes
+
+
 class CollectiveAxisPass(Pass):
     name = "collective-axis"
     rules = {
         "GC401": (
-            "collective axis name bound by no mesh/shard_map in this "
-            "module"
+            "collective axis name bound by no mesh/shard_map in the "
+            "program"
         ),
     }
+    whole_program = True
 
-    def check_file(
-        self, sf: SourceFile, ctx: Context
+    def check_program(self, program, ctx: Context) -> list[Finding]:
+        global_axes = program_axes(program.files)
+        findings: list[Finding] = []
+        for sf in program.files:
+            findings.extend(self._check_module(sf, global_axes))
+        return findings
+
+    def _check_module(
+        self, sf: SourceFile, global_axes: set[str]
     ) -> list[Finding]:
         axes, _axis_consts = _declared_axes(sf)
+        axes = axes | global_axes
         lax_names = _lax_imports(sf)
         findings: list[Finding] = []
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if not isinstance(node, ast.Call):
                 continue
             short = _is_lax_call(lax_names, node)
             if short is None:
                 continue
-            pos = _COLLECTIVES[short]
-            axis_arg: ast.expr | None = None
-            for kw in node.keywords:
-                if kw.arg in _AXIS_KWARGS:
-                    axis_arg = kw.value
-                    break
-            if axis_arg is None and len(node.args) > pos:
-                axis_arg = node.args[pos]
+            axis_arg = axis_argument(node, short)
             if axis_arg is None:
                 continue
             # Only unresolvable string literals are findings: Name
@@ -193,8 +234,8 @@ class CollectiveAxisPass(Pass):
                         rule="GC401",
                         message=(
                             f"axis {atom.value!r} in lax.{short} is "
-                            "bound by no shard_map/pmap/Mesh in this "
-                            "module"
+                            "bound by no shard_map/pmap/Mesh in the "
+                            "analyzed program"
                         ),
                         hint=(
                             "pass the axis in as a parameter, use a "

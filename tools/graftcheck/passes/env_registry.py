@@ -13,6 +13,10 @@ module is an undocumented, untyped protocol extension. Three rules:
 - **GC303** — a key read inside the registry that no file under
   ``docs/`` mentions: the env surface stays documented. (Project-level
   rule; needs ``Context.docs_dir``.)
+- **GC304** — the inverse: an ``ADAPTDL_*`` key documented in
+  ``docs/environment.md`` that the registry no longer reads — stale
+  docs describing a knob that silently does nothing. (Project-level;
+  the finding points at the documentation line.)
 
 Keys referenced through module-level string constants
 (``_CONFIG_ENV = "ADAPTDL_..."``) are resolved. Writes into plain
@@ -92,9 +96,26 @@ class EnvRegistryPass(Pass):
         "GC301": "raw ADAPTDL_* environment read outside env.py",
         "GC302": "raw ADAPTDL_* environment write outside env.py",
         "GC303": "env key read in env.py but documented nowhere in docs/",
+        "GC304": (
+            "env key documented in environment.md but read nowhere "
+            "in env.py"
+        ),
     }
     # GC303 must see the registry module even on a warm --fast cache.
     project_files = ("env.py",)
+
+    def cache_inputs(self, ctx: Context) -> list[str]:
+        """GC303/GC304 project findings depend on the docs tree:
+        fold its files into the cache fingerprint so documenting (or
+        un-documenting) a key invalidates cached results."""
+        if ctx.docs_dir is None or not os.path.isdir(ctx.docs_dir):
+            return []
+        out: list[str] = []
+        for dirpath, _dirs, names in os.walk(ctx.docs_dir):
+            for name in sorted(names):
+                if name.endswith((".md", ".rst", ".txt")):
+                    out.append(os.path.join(dirpath, name))
+        return out
 
     def _env_modules(self, ctx: Context) -> tuple[str, ...]:
         return tuple(
@@ -138,7 +159,7 @@ class EnvRegistryPass(Pass):
                 )
             )
 
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if isinstance(node, ast.Call):
                 name = dotted_name(node.func)
                 if name in ("os.getenv", "getenv"):
@@ -204,17 +225,22 @@ class EnvRegistryPass(Pass):
                     except OSError:  # pragma: no cover
                         continue
         findings: list[Finding] = []
+        registry_keys: set[str] = set()
+        saw_registry = False
         for sf in files:
             if not self._is_registry(sf, ctx):
                 continue
+            saw_registry = True
             seen: set[str] = set()
-            for node in ast.walk(sf.tree):
+            for node in sf.walk():
                 if (
                     isinstance(node, ast.Constant)
                     and isinstance(node.value, str)
                     and _KEY_RE.match(node.value)
-                    and node.value not in seen
                 ):
+                    registry_keys.add(node.value)
+                    if node.value in seen:
+                        continue
                     seen.add(node.value)
                     if node.value not in docs_text:
                         findings.append(
@@ -233,4 +259,53 @@ class EnvRegistryPass(Pass):
                                 ),
                             )
                         )
+        if saw_registry:
+            findings.extend(
+                self._check_stale_docs(ctx, registry_keys)
+            )
+        return findings
+
+    def _check_stale_docs(
+        self, ctx: Context, registry_keys: set[str]
+    ) -> list[Finding]:
+        """GC304: every key environment.md documents must still be
+        read (or exported as a key constant) by the registry —
+        otherwise the docs describe a knob that silently does
+        nothing. Only fires when the registry module itself was
+        analyzed, so fixture runs stay quiet."""
+        doc_name = ctx.options.get("env_doc", "environment.md")
+        path = os.path.join(ctx.docs_dir or "", doc_name)
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return []
+        rel = os.path.relpath(path, ctx.root)
+        findings: list[Finding] = []
+        flagged: set[str] = set()
+        key_re = re.compile(r"ADAPTDL_[A-Z0-9_]+")
+        for lineno, line in enumerate(lines, start=1):
+            for m in key_re.finditer(line):
+                key = m.group(0)
+                if key in registry_keys or key in flagged:
+                    continue
+                flagged.add(key)
+                findings.append(
+                    Finding(
+                        file=rel.replace(os.sep, "/"),
+                        line=lineno,
+                        col=m.start(),
+                        rule="GC304",
+                        message=(
+                            f"env key {key!r} is documented in "
+                            f"{doc_name} but read nowhere in the "
+                            "env registry — the documented knob "
+                            "does nothing"
+                        ),
+                        hint=(
+                            "delete the stale doc row, or restore "
+                            "the accessor in adaptdl_tpu/env.py"
+                        ),
+                    )
+                )
         return findings

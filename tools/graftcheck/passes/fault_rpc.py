@@ -76,6 +76,19 @@ class FaultRpcPass(Pass):
         # cache on the file's identity, not just its path.
         self._catalog_cache: dict[tuple, set[str] | None] = {}
 
+    def cache_inputs(self, ctx: Context) -> list[str]:
+        """GC602 findings in EVERY file depend on the faults.py
+        catalog: its content joins the --fast cache fingerprint so
+        registering a point refreshes cached findings elsewhere."""
+        return [
+            os.path.join(
+                ctx.root,
+                ctx.options.get(
+                    "faults_module", "adaptdl_tpu/faults.py"
+                ),
+            )
+        ]
+
     def _rpc_modules(self, ctx: Context) -> tuple[str, ...]:
         return tuple(
             ctx.options.get(
@@ -137,7 +150,7 @@ class FaultRpcPass(Pass):
                 )
             )
 
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if isinstance(node, ast.Import):
                 for alias in node.names:
                     if alias.name.split(".")[0] == "requests":
@@ -164,7 +177,7 @@ class FaultRpcPass(Pass):
         if catalog is None:
             return []
         findings: list[Finding] = []
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if not isinstance(node, ast.Call):
                 continue
             name = dotted_name(node.func)

@@ -69,12 +69,12 @@ def _collect_traced(sf: SourceFile) -> set[ast.AST]:
     """Function defs that end up inside a trace, detected from
     decorators and from by-name first arguments to jit/shard_map."""
     defs_by_name: dict[str, list[ast.AST]] = {}
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             defs_by_name.setdefault(node.name, []).append(node)
 
     traced: set[ast.AST] = set()
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for dec in node.decorator_list:
                 target = dec.func if isinstance(dec, ast.Call) else dec
@@ -151,7 +151,7 @@ class HostSyncPass(Pass):
         traced = _collect_traced(sf)
         hot = {
             node
-            for node in ast.walk(sf.tree)
+            for node in sf.walk()
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
             and HOT_PATH_RE.search(sf.def_header_comment(node))
         }

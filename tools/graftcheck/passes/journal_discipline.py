@@ -60,7 +60,7 @@ class JournalDisciplinePass(Pass):
         """Names of ``# journaled``-annotated defs (used by tests to
         assert the expected mutator catalog stays annotated)."""
         names = set()
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if isinstance(
                 node, (ast.FunctionDef, ast.AsyncFunctionDef)
             ) and JOURNALED_RE.search(sf.def_header_comment(node)):
@@ -72,7 +72,7 @@ class JournalDisciplinePass(Pass):
     ) -> list[Finding]:
         findings: list[Finding] = []
         annotated: dict[ast.AST, bool] = {}
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if isinstance(
                 node, (ast.FunctionDef, ast.AsyncFunctionDef)
             ):
@@ -83,7 +83,7 @@ class JournalDisciplinePass(Pass):
         # def; an annotation on ANY enclosing def covers it (closures
         # spawned inside an annotated mutator are its implementation).
         covered: set[ast.AST] = set()
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if not _is_append_call(node):
                 continue
             enclosing = sf.enclosing_functions(node)

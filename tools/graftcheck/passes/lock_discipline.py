@@ -11,10 +11,25 @@ annotation on its defining statement:
 Every subsequent read or write of a guarded name in the same module
 must sit lexically inside ``with <lock>:`` (matching the lock's last
 dotted component — ``with self._cond:`` and ``with _profile_lock:``
-both count) or inside a function annotated ``# holds-lock: <lock>``
-(for helpers documented as called with the lock held).
+both count), inside a function annotated ``# holds-lock: <lock>``
+(for helpers documented as called with the lock held), or — new in
+v2 — inside a function the **interprocedural lock-set dataflow**
+proves is only ever called with the lock held: every resolved call
+site sits under the lock and no reference to the function escapes
+(Thread targets, stored callbacks). The flow-aware upgrade removes
+the need to annotate every private helper while keeping the
+annotation as the documented contract for anything externally
+callable.
 
-This is a lexical lint, not an escape analysis: it cannot see
+The annotation is also *enforced* now, not just trusted:
+
+- **GC103** — a call to a ``# holds-lock:``-annotated function from a
+  site that provably does NOT hold the lock (neither lexically, nor
+  via the caller's own annotation, nor via the caller's inferred
+  entry set). v1 believed every annotation unconditionally, which is
+  exactly how a refactor turns documentation into a latent race.
+
+This is still not an escape analysis for *data*: it cannot see
 happens-before edges like "written before Thread.start()", so
 deliberate lock-free accesses carry an inline
 ``# graftcheck: disable=GC101 (why)`` — which is exactly the audit
@@ -57,7 +72,7 @@ def _target_names(stmt: ast.stmt) -> list[ast.expr]:
 def _collect_guards(sf: SourceFile) -> tuple[list[_Guard], list[Finding]]:
     guards: list[_Guard] = []
     problems: list[Finding] = []
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if not isinstance(node, (ast.Assign, ast.AnnAssign)):
             continue
         m = GUARDED_BY_RE.search(sf.statement_comment(node))
@@ -186,10 +201,61 @@ class LockDisciplinePass(Pass):
             "access to a guarded field outside its declared lock"
         ),
         "GC102": "malformed or ineffective guarded-by annotation",
+        "GC103": (
+            "holds-lock-annotated function called without the lock"
+        ),
     }
+    whole_program = True
 
-    def check_file(
-        self, sf: SourceFile, ctx: Context
+    def check_program(self, program, ctx: Context) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in program.files:
+            findings.extend(self._check_guards(sf, program))
+        findings.extend(self._check_annotations(program))
+        return findings
+
+    def _check_annotations(self, program) -> list[Finding]:
+        """GC103: every resolved call into a holds-lock-annotated
+        function must provably hold the lock."""
+        findings: list[Finding] = []
+        for info in program.functions.values():
+            if not info.annotated_locks:
+                continue
+            for site in info.callers:
+                held = set(site.held_locks)
+                if site.caller is not None:
+                    held |= site.caller.annotated_locks
+                    held |= site.caller.entry_locks
+                missing = info.annotated_locks - held
+                for lock in sorted(missing):
+                    findings.append(
+                        Finding(
+                            file=(
+                                site.caller.sf.rel
+                                if site.caller is not None
+                                else info.sf.rel
+                            ),
+                            line=site.node.lineno,
+                            col=site.node.col_offset,
+                            rule="GC103",
+                            message=(
+                                f"call to {info.name!r} (annotated "
+                                f"# holds-lock: {lock}, "
+                                f"{info.sf.rel}:{info.node.lineno}) "
+                                f"from a site that does not hold "
+                                f"{lock!r}"
+                            ),
+                            hint=(
+                                f"wrap the call in `with {lock}:`, "
+                                "or fix the callee's annotation if "
+                                "the contract changed"
+                            ),
+                        )
+                    )
+        return findings
+
+    def _check_guards(
+        self, sf: SourceFile, program
     ) -> list[Finding]:
         guards, findings = _collect_guards(sf)
         if not guards:
@@ -199,7 +265,7 @@ class LockDisciplinePass(Pass):
         }
         attr_guards = {g.field: g for g in guards if g.kind == "attr"}
         module_names = set()
-        for n in ast.walk(sf.tree):
+        for n in sf.walk():
             if isinstance(n, ast.Name):
                 module_names.add(n.id)
             elif isinstance(n, ast.Attribute):
@@ -231,7 +297,7 @@ class LockDisciplinePass(Pass):
                     return True
             return False
 
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             guard: _Guard | None = None
             if isinstance(node, ast.Name) and node.id in global_guards:
                 guard = global_guards[node.id]
@@ -250,6 +316,17 @@ class LockDisciplinePass(Pass):
             # ast.Global, never ast.Name) — nothing to skip here.
             if guard.lock in _with_locks(sf, node):
                 continue
+            # Flow-aware: the enclosing function may hold the lock by
+            # construction — every resolved call site acquires it and
+            # no reference escapes (program.py's lock-set fixpoint).
+            encl = sf.enclosing_function(node)
+            if encl is not None:
+                info = program.function_for_node(encl)
+                if (
+                    info is not None
+                    and guard.lock in info.entry_locks
+                ):
+                    continue
             access = (
                 "write"
                 if isinstance(node.ctx, (ast.Store, ast.Del))

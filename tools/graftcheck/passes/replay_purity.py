@@ -1,0 +1,352 @@
+"""GC9xx — journal-replay determinism.
+
+``ClusterState`` recovery replays snapshot+journal through the
+``_apply_*_locked`` layer; the Pollux search then optimizes over the
+recovered state. If an apply function reads a wall clock, RNG,
+``os.environ``, the network, or a file, a crash recovery reproduces
+*different* state than the history it claims to replay — silent
+supervisor corruption that only a crash exercises. The contract is
+annotation-driven like ``# journaled``:
+
+- a ``# replay-pure`` annotation on a ``def`` header declares the
+  function runs on the replay path; **GC901** flags any impure
+  operation — clock reads (``time.time``/``monotonic``/
+  ``perf_counter``, ``datetime.now``), randomness (``random.*``,
+  ``uuid``, ``os.urandom``), environment reads (``os.environ``, an
+  ``env.py`` accessor), file/network I/O (``open``, ``os.replace``,
+  ``requests``, an ``rpc.py`` client call, ``faults.maybe_fail``,
+  ``_journal_append``) — in the annotated function or anything it
+  transitively calls through resolved edges;
+- **GC902** flags trace emission (``trace.event``/``span``/
+  ``record_span``/``flush*``) on the replay path: replayed ops are
+  history and must not re-record spans;
+- **GC903** keeps the root catalog honest: a ``_apply_*`` method in a
+  class that annotates ANY method ``# replay-pure`` must itself be
+  annotated (or the layer silently grows unchecked mutators, the
+  GC603/604 failure mode).
+
+The sanctioned escape is the same pattern the live/replay split
+already uses: code inside ``if not self._replaying:`` (or the
+``else`` of ``if self._replaying:``) is the live side and is exempt —
+the guard IS the proof it never runs during replay. Clocks needed by
+an apply function are passed in as arguments (the mutator stamps
+``op["ts"]``/``now`` before journaling), which keeps the function
+pure by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftcheck.core import (
+    REPLAY_PURE_RE,
+    Context,
+    Finding,
+    Pass,
+    dotted_name,
+    walk_own,
+)
+
+# Impure callables by dotted tail (last two components tried too).
+_IMPURE_CALLS = {
+    "time.time": "wall-clock read",
+    "time.time_ns": "wall-clock read",
+    "time.monotonic": "clock read",
+    "time.monotonic_ns": "clock read",
+    "time.perf_counter": "clock read",
+    "time.perf_counter_ns": "clock read",
+    "datetime.now": "wall-clock read",
+    "datetime.utcnow": "wall-clock read",
+    "datetime.today": "wall-clock read",
+    "date.today": "wall-clock read",
+    "random.random": "RNG",
+    "random.randint": "RNG",
+    "random.randrange": "RNG",
+    "random.choice": "RNG",
+    "random.shuffle": "RNG",
+    "random.uniform": "RNG",
+    "random.sample": "RNG",
+    "random.Random": "RNG construction",
+    "uuid.uuid1": "RNG (uuid)",
+    "uuid.uuid4": "RNG (uuid)",
+    "os.urandom": "RNG",
+    "secrets.token_hex": "RNG",
+    "secrets.token_bytes": "RNG",
+    "os.getenv": "environment read",
+    "os.replace": "file I/O",
+    "os.rename": "file I/O",
+    "os.remove": "file I/O",
+    "os.unlink": "file I/O",
+    "os.makedirs": "file I/O",
+    "os.mkdir": "file I/O",
+    "os.fsync": "file I/O",
+    "os.stat": "file I/O",
+    "os.listdir": "file I/O",
+    "socket.socket": "network I/O",
+    "faults.maybe_fail": "fault-schedule read (seeded RNG + env)",
+}
+
+_IMPURE_BARE = {
+    "open": "file I/O",
+    "input": "console I/O",
+}
+
+# Calls flagged when the name PREFIX matches (requests.get, ...).
+_IMPURE_PREFIXES = {
+    "requests.": "network I/O",
+    "shutil.": "file I/O",
+    "tempfile.": "file I/O",
+}
+
+# Journal appends are fsynced file writes; replay must never re-append
+# (the helper itself no-ops on a None journal, but the WRITE side of
+# the journal belongs to live mutators only).
+_JOURNAL_TAILS = {"_journal_append", "journal_append"}
+
+_TRACE_TAILS = {
+    "event",
+    "span",
+    "record_span",
+    "flush_to_supervisor",
+    "new_traceparent",
+    "set_traceparent",
+}
+
+# Modules that form the impure BOUNDARY: a resolved call into one of
+# these is flagged at the call site and not traversed (their internals
+# would otherwise drown the report in their own implementation).
+_BOUNDARY_SUFFIXES = {
+    "/env.py": ("environment read", "GC901"),
+    "/rpc.py": ("network I/O (rpc client)", "GC901"),
+    "/faults.py": ("fault-schedule read", "GC901"),
+    "/trace.py": ("trace emission", "GC902"),
+}
+
+
+def _boundary(info) -> tuple[str, str] | None:
+    rel = "/" + info.sf.rel.replace("\\", "/")
+    for suffix, verdict in _BOUNDARY_SUFFIXES.items():
+        if rel.endswith(suffix):
+            return verdict
+    return None
+
+
+def _branch_of(if_node: ast.If, node: ast.AST) -> str | None:
+    for stmt in if_node.body:
+        for sub in ast.walk(stmt):
+            if sub is node:
+                return "body"
+    for stmt in if_node.orelse:
+        for sub in ast.walk(stmt):
+            if sub is node:
+                return "orelse"
+    return None
+
+
+def _conjuncts(test: ast.expr) -> list[ast.expr]:
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        out: list[ast.expr] = []
+        for value in test.values:
+            out.extend(_conjuncts(value))
+        return out
+    return [test]
+
+
+def _mentions_replaying(expr: ast.expr) -> bool:
+    name = dotted_name(expr)
+    return bool(name) and "replaying" in name.rsplit(".", 1)[-1]
+
+
+def _replay_guarded(sf, node: ast.AST) -> bool:
+    """True when ``node`` sits on the live (non-replay) side of a
+    ``_replaying`` check: inside ``if not self._replaying:`` (body) or
+    ``if self._replaying: ... else:`` (orelse)."""
+    for anc in sf.ancestors(node):
+        if not isinstance(anc, ast.If):
+            continue
+        branch = _branch_of(anc, node)
+        if branch is None:
+            continue
+        for conj in _conjuncts(anc.test):
+            if (
+                branch == "body"
+                and isinstance(conj, ast.UnaryOp)
+                and isinstance(conj.op, ast.Not)
+                and _mentions_replaying(conj.operand)
+            ):
+                return True
+            if branch == "orelse" and _mentions_replaying(conj):
+                return True
+    return False
+
+
+class ReplayPurityPass(Pass):
+    name = "replay-purity"
+    rules = {
+        "GC901": (
+            "impure operation (clock/RNG/env/IO) on the journal-"
+            "replay path"
+        ),
+        "GC902": "trace emission on the journal-replay path",
+        "GC903": (
+            "_apply_* method missing the # replay-pure annotation"
+        ),
+    }
+    whole_program = True
+
+    def check_program(self, program, ctx: Context) -> list[Finding]:
+        findings: list[Finding] = []
+        roots = [
+            info
+            for info in program.functions.values()
+            if REPLAY_PURE_RE.search(
+                info.sf.def_header_comment(info.node)
+            )
+        ]
+        findings.extend(self._check_catalog(program, roots))
+        if not roots:
+            return findings
+        # Boundary modules (env/rpc/faults/trace) are reported at the
+        # call site, never line-by-line through their internals: cut
+        # them out of the reachability walk.
+        boundary_cut = {
+            q
+            for q, info in program.functions.items()
+            if _boundary(info) is not None
+        }
+        paths = program.reachable_from(roots, cut=boundary_cut)
+        for qual, path in sorted(paths.items()):
+            info = program.functions[qual]
+            findings.extend(self._check_function(info, path, program))
+        return findings
+
+    def _check_catalog(self, program, roots) -> list[Finding]:
+        """GC903: every _apply_* sibling of an annotated method must
+        be annotated too."""
+        findings: list[Finding] = []
+        annotated_classes = {
+            (info.sf.rel, info.cls) for info in roots if info.cls
+        }
+        for info in program.functions.values():
+            if not info.name.startswith("_apply_"):
+                continue
+            if info.cls is None:
+                continue
+            if (info.sf.rel, info.cls) not in annotated_classes:
+                continue
+            if REPLAY_PURE_RE.search(
+                info.sf.def_header_comment(info.node)
+            ):
+                continue
+            findings.append(
+                Finding(
+                    file=info.sf.rel,
+                    line=info.node.lineno,
+                    col=info.node.col_offset,
+                    rule="GC903",
+                    message=(
+                        f"{info.cls}.{info.name} looks like a "
+                        "journal-replay apply method but is not "
+                        "annotated # replay-pure — the purity lint "
+                        "does not cover it"
+                    ),
+                    hint=(
+                        "annotate the def header `# replay-pure` "
+                        "(and keep it clock/RNG/env/IO-free), or "
+                        "rename it if it is not on the replay path"
+                    ),
+                )
+            )
+        return findings
+
+    def _check_function(self, info, path, program) -> list[Finding]:
+        sf = info.sf
+        findings: list[Finding] = []
+        via = (
+            ""
+            if len(path) == 1
+            else " (reachable from replay-pure "
+            + path[0].split("::")[-1]
+            + " via "
+            + " -> ".join(p.split("::")[-1] for p in path[1:])
+            + ")"
+        )
+        sites_by_node = {s.node: s for s in info.call_sites}
+
+        def flag(node, rule: str, what: str, why: str) -> None:
+            findings.append(
+                Finding(
+                    file=sf.rel,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=rule,
+                    message=(
+                        f"{what} — {why} on the journal-replay "
+                        f"path{via}: recovery would not reproduce "
+                        "history bit-for-bit"
+                    ),
+                    hint=(
+                        "pass the value in via the journaled op "
+                        "(the mutator stamps ts/now before "
+                        "appending), or guard the live side with "
+                        "`if not self._replaying:`"
+                        if rule == "GC901"
+                        else "replayed ops are history — guard "
+                        "emission with `if not self._replaying:`"
+                    ),
+                )
+            )
+
+        for node in walk_own(info.node):
+            if isinstance(node, ast.Call):
+                if _replay_guarded(sf, node):
+                    continue
+                name = dotted_name(node.func)
+                site = sites_by_node.get(node)
+                if site is not None and site.callee is not None:
+                    verdict = _boundary(site.callee)
+                    if verdict is not None:
+                        why, rule = verdict
+                        flag(node, rule, f"call to {name}()", why)
+                        continue
+                if name is None:
+                    continue
+                tail = name.rsplit(".", 1)[-1]
+                tail2 = ".".join(name.split(".")[-2:])
+                if tail in _JOURNAL_TAILS:
+                    flag(
+                        node,
+                        "GC901",
+                        f"{name}()",
+                        "journal write (fsynced file I/O)",
+                    )
+                elif name in _IMPURE_CALLS or tail2 in _IMPURE_CALLS:
+                    why = _IMPURE_CALLS.get(
+                        name, _IMPURE_CALLS.get(tail2)
+                    )
+                    flag(node, "GC901", f"{name}()", why)
+                elif name in _IMPURE_BARE:
+                    flag(node, "GC901", f"{name}()", _IMPURE_BARE[name])
+                elif tail in _TRACE_TAILS and name.split(".")[0] in (
+                    "trace",
+                ):
+                    flag(node, "GC902", f"{name}()", "trace emission")
+                else:
+                    for prefix, why in _IMPURE_PREFIXES.items():
+                        if name.startswith(prefix) or tail2.startswith(
+                            prefix
+                        ):
+                            flag(node, "GC901", f"{name}()", why)
+                            break
+            elif isinstance(node, ast.Attribute):
+                base = dotted_name(node)
+                if base in ("os.environ",) and not _replay_guarded(
+                    sf, node
+                ):
+                    flag(
+                        node,
+                        "GC901",
+                        "os.environ access",
+                        "environment read",
+                    )
+        return findings

@@ -1,0 +1,668 @@
+"""GC8xx — SPMD/collective discipline (interprocedural).
+
+A collective is a *rendezvous*: every participant must reach it, in
+the same order, or the slice hangs with no stack trace — the failure
+mode a multi-mesh refactor (dp/tp/pp as schedulable dimensions) makes
+routine instead of exotic. Three rules, all built on the
+whole-program call graph (:mod:`tools.graftcheck.program`):
+
+- **GC801** — a collective reachable under rank- or env-conditional
+  control flow whose other path lacks a matching collective: the
+  classic SPMD deadlock (`if rank == 0: psum(...)` — every other
+  rank never arrives). "Rank-conditional" means the test reads
+  ``axis_index``/``process_index``/``process_rank``/``replica_rank``
+  (directly or through a variable assigned from one) and
+  "env-conditional" means it reads ``os.environ`` or a resolved
+  ``env.py`` accessor. Collectives are counted *transitively* through
+  resolved calls, and an early-``return`` branch is compared against
+  the statements that follow the ``if`` (the `if rank != 0: return`
+  idiom diverges against the function's tail). Collectives covered:
+  the ``lax`` axis family, the control-plane object collectives
+  (``collective.allreduce``/``broadcast`` — "every replica must
+  invoke every collective here in the same order"), and
+  ``multihost_utils`` barriers.
+- **GC802** — collective-sequence consistency across pipeline-stage
+  bodies: defs annotated ``# graftcheck: stage-seq=<group>`` must all
+  run the IDENTICAL ordered sequence of (collective, axis) —
+  transitively flattened — because stage bodies executing different
+  collective programs under one ``shard_map`` deadlock at the first
+  divergence. ``parallel/pipeline.py``'s schedule bodies carry the
+  annotation.
+- **GC803** — axis-name flow through the call graph: a string-literal
+  axis argument at a CALL SITE whose callee parameter feeds a
+  collective (directly or transitively) must resolve in the
+  whole-program axis environment. GC401 checks literals *inside*
+  collective calls; GC803 closes the blind spot where the literal is
+  a call-site argument to a parameterized helper
+  (``gpipe_loss(..., axis_name="stge")`` — v1 trusted the callee's
+  parameter, so the typo was invisible).
+
+Resolution limits (see program.py): dynamic dispatch, escaped
+callables, and data-driven calls contribute no edges — an unresolved
+call can hide a finding, never invent one.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftcheck.core import (
+    STAGE_SEQ_RE,
+    Context,
+    Finding,
+    Pass,
+    dotted_name,
+    walk_own,
+)
+from tools.graftcheck.passes.collective_axis import (
+    _COLLECTIVES,
+    _is_lax_call,
+    _lax_imports,
+    axis_argument,
+    program_axes,
+)
+
+# Control-plane object collectives (adaptdl_tpu/collective.py): the
+# module contract is "every replica must invoke every collective here
+# in the same order", so they rendezvous exactly like lax collectives.
+_OBJECT_COLLECTIVES = {"allreduce", "allreduce_async", "broadcast"}
+
+# multihost_utils barriers (matched on the last dotted component).
+_MULTIHOST_COLLECTIVES = {
+    "sync_global_devices",
+    "broadcast_one_to_all",
+    "process_allgather",
+}
+
+# Calls whose result identifies this participant's rank.
+_RANK_SOURCES = {
+    "axis_index",
+    "process_index",
+    "process_rank",
+    "replica_rank",
+    "host_id",
+    "node_rank",
+}
+
+_TERMINAL_CALLS = {"exit", "_exit", "abort"}
+
+_MAX_DEPTH = 12
+
+
+def _is_env_module(info) -> bool:
+    rel = info.sf.rel.replace("\\", "/")
+    return rel.endswith("/env.py") or rel == "env.py"
+
+
+class _Collective:
+    __slots__ = ("kind", "axis", "line", "col")
+
+    def __init__(self, kind: str, axis: str | None, line: int, col: int):
+        self.kind = kind
+        self.axis = axis
+        self.line = line
+        self.col = col
+
+    @property
+    def key(self) -> tuple[str, str | None]:
+        return (self.kind, self.axis)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"{self.kind}({self.axis})@{self.line}"
+
+
+def _axis_repr(expr: ast.expr | None) -> str | None:
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    name = dotted_name(expr)
+    if name is not None:
+        return name
+    return "<expr>"
+
+
+class SpmdDisciplinePass(Pass):
+    name = "spmd-discipline"
+    rules = {
+        "GC801": (
+            "collective under rank/env-conditional control flow with "
+            "no matching collective on the other path"
+        ),
+        "GC802": (
+            "stage-seq group members run different collective "
+            "sequences"
+        ),
+        "GC803": (
+            "literal axis argument flowing into a collective "
+            "resolves to no program-bound axis"
+        ),
+    }
+    whole_program = True
+
+    def check_program(self, program, ctx: Context) -> list[Finding]:
+        self._program = program
+        self._lax_names = {
+            sf.rel: _lax_imports(sf) for sf in program.files
+        }
+        self._seq_cache: dict[str, list[_Collective]] = {}
+        findings: list[Finding] = []
+        findings.extend(self._check_divergence(program))
+        findings.extend(self._check_stage_seq(program))
+        findings.extend(self._check_axis_flow(program, ctx))
+        unique: dict[tuple, Finding] = {}
+        for f in findings:
+            unique.setdefault((f.file, f.line, f.col, f.rule), f)
+        return list(unique.values())
+
+    # -- collective extraction -----------------------------------------
+
+    def _collective_of(self, sf, info, node: ast.Call) -> _Collective | None:
+        """A _Collective if ``node`` is a direct collective call."""
+        short = _is_lax_call(self._lax_names[sf.rel], node)
+        if short is not None:
+            return _Collective(
+                short,
+                _axis_repr(axis_argument(node, short)),
+                node.lineno,
+                node.col_offset,
+            )
+        name = dotted_name(node.func)
+        if name is None:
+            return None
+        tail = name.rsplit(".", 1)[-1]
+        if tail in _MULTIHOST_COLLECTIVES:
+            return _Collective(tail, None, node.lineno, node.col_offset)
+        if tail in _OBJECT_COLLECTIVES:
+            callee = self._program.resolve_call(sf, info, node.func)
+            base_is_collective = (
+                "." in name
+                and name.split(".")[-2] == "collective"
+            )
+            if base_is_collective or (
+                callee is not None
+                and callee.sf.rel.replace("\\", "/").endswith(
+                    "/collective.py"
+                )
+            ):
+                return _Collective(
+                    tail, None, node.lineno, node.col_offset
+                )
+        return None
+
+    def _function_sequence(
+        self, info, _stack: frozenset[str] = frozenset()
+    ) -> list[_Collective]:
+        """Ordered (source order) collective sequence of one function,
+        transitively flattened through resolved call/reference edges.
+        Inlined collectives keep the CALL SITE's location so findings
+        point into the function under analysis."""
+        if info.qualname in self._seq_cache:
+            return self._seq_cache[info.qualname]
+        if info.qualname in _stack or len(_stack) > _MAX_DEPTH:
+            return []
+        seq = self._statements_sequence(
+            info.node.body, info, _stack | {info.qualname}
+        )
+        self._seq_cache[info.qualname] = seq
+        return seq
+
+    def _statements_sequence(
+        self, stmts, info, _stack: frozenset[str]
+    ) -> list[_Collective]:
+        sf = info.sf
+        out: list[_Collective] = []
+        sites_by_node = {
+            site.node: site
+            for site in info.call_sites
+        }
+        for stmt in stmts:
+            if isinstance(
+                stmt,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                continue  # nested defs run where invoked, not here
+            for node in walk_own(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                direct = self._collective_of(sf, info, node)
+                if direct is not None:
+                    out.append(direct)
+                    continue
+                site = sites_by_node.get(node)
+                if site is None or site.callee is None:
+                    continue
+                if site.callee.node is info.node:
+                    continue
+                for inner in self._function_sequence(
+                    site.callee, _stack
+                ):
+                    out.append(
+                        _Collective(
+                            inner.kind,
+                            inner.axis,
+                            node.lineno,
+                            node.col_offset,
+                        )
+                    )
+        return out
+
+    # -- GC801: rank/env-divergent collectives -------------------------
+
+    def _expr_divergent(
+        self, expr: ast.expr, sf, tainted: set[str]
+    ) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func) or ""
+                tail = name.rsplit(".", 1)[-1].lstrip("_")
+                if tail in _RANK_SOURCES:
+                    return True
+                if name in ("os.getenv", "getenv") or (
+                    name.startswith("os.environ")
+                ):
+                    return True
+                callee = self._program.resolve_call(sf, None, node.func)
+                if callee is not None and _is_env_module(callee):
+                    return True
+            elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load
+            ):
+                if node.id in tainted:
+                    return True
+            elif isinstance(node, ast.Attribute):
+                base = dotted_name(node)
+                if base in ("os.environ", "environ"):
+                    return True
+        return False
+
+    def _terminates(self, stmts) -> bool:
+        if not stmts:
+            return False
+        last = stmts[-1]
+        if isinstance(last, (ast.Return, ast.Raise, ast.Continue, ast.Break)):
+            return True
+        if isinstance(last, ast.Expr) and isinstance(
+            last.value, ast.Call
+        ):
+            name = dotted_name(last.value.func) or ""
+            if name.rsplit(".", 1)[-1] in _TERMINAL_CALLS:
+                return True
+        return False
+
+    def _check_divergence(self, program) -> list[Finding]:
+        findings: list[Finding] = []
+        for info in program.functions.values():
+            sf = info.sf
+            # One walk collects both the If nodes and the rank/env
+            # assignments (taint sources) — this runs per function
+            # over the whole program, so walk count matters.
+            ifs: list[ast.If] = []
+            assigns: list[ast.Assign] = []
+            for node in walk_own(info.node):
+                if isinstance(node, ast.If):
+                    ifs.append(node)
+                elif isinstance(node, ast.Assign):
+                    assigns.append(node)
+            if not ifs:
+                continue
+            tainted: set[str] = set()
+            for node in assigns:
+                if self._expr_divergent(node.value, sf, set()):
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            tainted.add(target.id)
+            for node in ifs:
+                if not self._expr_divergent(node.test, sf, tainted):
+                    continue
+                stack = frozenset({info.qualname})
+                body_seq = self._statements_sequence(
+                    node.body, info, stack
+                )
+                else_seq = self._statements_sequence(
+                    node.orelse, info, stack
+                )
+                body_ends = self._terminates(node.body)
+                else_ends = self._terminates(node.orelse)
+                tail_seq: list[_Collective] = []
+                if body_ends != else_ends:
+                    tail = self._statements_after(sf, node)
+                    tail_seq = self._statements_sequence(
+                        tail, info, stack
+                    )
+                path_a = list(body_seq) + (
+                    [] if body_ends else tail_seq
+                )
+                path_b = list(else_seq) + (
+                    [] if else_ends else tail_seq
+                )
+                findings.extend(
+                    self._divergence_findings(
+                        sf, node, path_a, path_b
+                    )
+                )
+        return findings
+
+    def _statements_after(self, sf, if_node: ast.If):
+        parent = sf.parents.get(if_node)
+        if parent is None:
+            return []
+        for field in ("body", "orelse", "finalbody"):
+            block = getattr(parent, field, None)
+            if isinstance(block, list) and if_node in block:
+                idx = block.index(if_node)
+                return block[idx + 1 :]
+        return []
+
+    def _divergence_findings(
+        self, sf, if_node, path_a, path_b
+    ) -> list[Finding]:
+        # ORDER matters: a rendezvous is matched by position, so
+        # `psum; pmean` vs `pmean; psum` deadlocks even though the
+        # multisets agree — rank 0 waits at psum while the rest wait
+        # at pmean. Compare sequences and point at the first
+        # positionally-divergent collective.
+        seq_a = [c.key for c in path_a]
+        seq_b = [c.key for c in path_b]
+        if seq_a == seq_b:
+            return []
+        idx = next(
+            (
+                i
+                for i, (a, b) in enumerate(zip(seq_a, seq_b))
+                if a != b
+            ),
+            min(len(seq_a), len(seq_b)),
+        )
+        witness = None
+        for path in (path_a, path_b):
+            if idx < len(path):
+                cand = path[idx]
+                if witness is None or cand.line < witness.line:
+                    witness = cand
+        if witness is None:  # pragma: no cover - defensive
+            return []
+        axis = f" over {witness.axis!r}" if witness.axis else ""
+        return [
+            Finding(
+                file=sf.rel,
+                line=witness.line,
+                col=witness.col,
+                rule="GC801",
+                message=(
+                    f"collective {witness.kind}{axis} runs on only "
+                    "one side of a rank/env-conditional branch "
+                    f"(line {if_node.lineno}) — the ranks taking the "
+                    "other path never reach it and the collective "
+                    "deadlocks"
+                ),
+                hint=(
+                    "hoist the collective out of the conditional "
+                    "(compute divergent values, rendezvous "
+                    "unconditionally — the `decision = None; "
+                    "broadcast(decision)` pattern), or justify with "
+                    "`# graftcheck: disable=GC801 (why every rank "
+                    "still arrives)`"
+                ),
+            )
+        ]
+
+    # -- GC802: stage-seq groups ---------------------------------------
+
+    def _check_stage_seq(self, program) -> list[Finding]:
+        groups: dict[str, list] = {}
+        for info in program.functions.values():
+            m = STAGE_SEQ_RE.search(
+                info.sf.def_header_comment(info.node)
+            )
+            if m:
+                groups.setdefault(m.group(1), []).append(info)
+        findings: list[Finding] = []
+        for group, members in groups.items():
+            if len(members) < 2:
+                continue
+            members.sort(key=lambda i: (i.sf.rel, i.node.lineno))
+            reference = members[0]
+            ref_seq = [c.key for c in self._function_sequence(reference)]
+            for info in members[1:]:
+                seq = [c.key for c in self._function_sequence(info)]
+                if seq == ref_seq:
+                    continue
+                colls = self._function_sequence(info)
+                idx = next(
+                    (
+                        i
+                        for i, (a, b) in enumerate(zip(seq, ref_seq))
+                        if a != b
+                    ),
+                    min(len(seq), len(ref_seq)),
+                )
+                if idx < len(colls):
+                    line, col = colls[idx].line, colls[idx].col
+                else:
+                    line, col = info.node.lineno, info.node.col_offset
+                findings.append(
+                    Finding(
+                        file=info.sf.rel,
+                        line=line,
+                        col=col,
+                        rule="GC802",
+                        message=(
+                            f"stage-seq group {group!r}: "
+                            f"{info.name!r} runs collective sequence "
+                            f"{seq!r} but {reference.name!r} "
+                            f"({reference.sf.rel}:"
+                            f"{reference.node.lineno}) runs "
+                            f"{ref_seq!r} — stages executing "
+                            "different collective programs deadlock "
+                            "at the first divergence"
+                        ),
+                        hint=(
+                            "make every stage body run the same "
+                            "ordered collectives, or split the "
+                            "groups if they never share a schedule"
+                        ),
+                    )
+                )
+        return findings
+
+    # -- GC803: axis-name flow through the call graph ------------------
+
+    def _axis_params(self, program) -> dict[str, set[str]]:
+        """qualname -> parameter names that feed a collective axis
+        (directly, or transitively via a resolved call). Fixpoint."""
+        result: dict[str, set[str]] = {
+            q: set() for q in program.functions
+        }
+        # Seed: params used directly as an axis argument.
+        for info in program.functions.values():
+            params = self._param_names(info.node)
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                short = _is_lax_call(
+                    self._lax_names[info.sf.rel], node
+                )
+                if short is None:
+                    continue
+                axis = axis_argument(node, short)
+                if axis is None:
+                    continue
+                for atom in ast.walk(axis):
+                    if (
+                        isinstance(atom, ast.Name)
+                        and atom.id in params
+                    ):
+                        result[info.qualname].add(atom.id)
+        # Propagate backward over call edges.
+        changed = True
+        rounds = 0
+        while changed and rounds < 20:
+            changed = False
+            rounds += 1
+            for info in program.functions.values():
+                params = self._param_names(info.node)
+                for site in info.call_sites:
+                    if site.callee is None or site.is_reference:
+                        continue
+                    callee_axes = result.get(
+                        site.callee.qualname, set()
+                    )
+                    if not callee_axes:
+                        continue
+                    for param, arg in self._map_args(
+                        site.callee.node, site.node
+                    ):
+                        if param not in callee_axes:
+                            continue
+                        if (
+                            isinstance(arg, ast.Name)
+                            and arg.id in params
+                            and arg.id
+                            not in result[info.qualname]
+                        ):
+                            result[info.qualname].add(arg.id)
+                            changed = True
+        return result
+
+    @staticmethod
+    def _param_names(fn_node) -> set[str]:
+        args = fn_node.args
+        names = {
+            a.arg
+            for a in (
+                list(args.posonlyargs)
+                + list(args.args)
+                + list(args.kwonlyargs)
+            )
+        }
+        return names
+
+    @staticmethod
+    def _map_args(callee_node, call: ast.Call):
+        """(param_name, argument_expr) pairs for a call, positional
+        and keyword; *args/**kwargs are skipped. ``self``/``cls`` of
+        methods is dropped (call sites never pass it positionally in
+        the resolved forms program.py supports)."""
+        args = callee_node.args
+        positional = list(args.posonlyargs) + list(args.args)
+        names = [a.arg for a in positional]
+        if names and names[0] in ("self", "cls"):
+            names = names[1:]
+        pairs = []
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break
+            if i < len(names):
+                pairs.append((names[i], arg))
+        valid = {a.arg for a in positional + list(args.kwonlyargs)}
+        for kw in call.keywords:
+            if kw.arg is not None and kw.arg in valid:
+                pairs.append((kw.arg, kw.value))
+        return pairs
+
+    def _check_axis_flow(self, program, ctx: Context) -> list[Finding]:
+        axes = program_axes(program.files)
+        axis_params = self._axis_params(program)
+        findings: list[Finding] = []
+        seen: set[tuple[str, int, int]] = set()
+        for info in program.functions.values():
+            for site in info.call_sites:
+                if site.callee is None or site.is_reference:
+                    continue
+                callee_axes = axis_params.get(
+                    site.callee.qualname, set()
+                )
+                if not callee_axes:
+                    continue
+                for param, arg in self._map_args(
+                    site.callee.node, site.node
+                ):
+                    if param not in callee_axes:
+                        continue
+                    if not (
+                        isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)
+                    ):
+                        continue
+                    if arg.value in axes:
+                        continue
+                    key = (info.sf.rel, arg.lineno, arg.col_offset)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(
+                        Finding(
+                            file=info.sf.rel,
+                            line=arg.lineno,
+                            col=arg.col_offset,
+                            rule="GC803",
+                            message=(
+                                f"axis {arg.value!r} passed to "
+                                f"{site.callee.name}(...{param}=) "
+                                "flows into a collective but is "
+                                "bound by no mesh/shard_map in the "
+                                "analyzed program"
+                            ),
+                            hint=(
+                                "use a *_AXIS constant from "
+                                "parallel/mesh.py (or fix the typo); "
+                                "declare genuinely external axes "
+                                "with `# graftcheck: declare-axes`"
+                            ),
+                        )
+                    )
+        # Default values of axis parameters are call-site literals
+        # every caller inherits — check them too.
+        for info in program.functions.values():
+            params = axis_params.get(info.qualname, set())
+            if not params:
+                continue
+            fn_args = info.node.args
+            named = list(fn_args.posonlyargs) + list(fn_args.args)
+            defaults = list(fn_args.defaults)
+            for a, default in zip(named[len(named) - len(defaults):], defaults):
+                self._check_default(
+                    info, a, default, params, axes, findings, seen
+                )
+            for a, default in zip(fn_args.kwonlyargs, fn_args.kw_defaults):
+                if default is not None:
+                    self._check_default(
+                        info, a, default, params, axes, findings, seen
+                    )
+        return findings
+
+    def _check_default(
+        self, info, arg, default, params, axes, findings, seen
+    ) -> None:
+        if arg.arg not in params:
+            return
+        if not (
+            isinstance(default, ast.Constant)
+            and isinstance(default.value, str)
+        ):
+            return
+        if default.value in axes:
+            return
+        key = (info.sf.rel, default.lineno, default.col_offset)
+        if key in seen:
+            return
+        seen.add(key)
+        findings.append(
+            Finding(
+                file=info.sf.rel,
+                line=default.lineno,
+                col=default.col_offset,
+                rule="GC803",
+                message=(
+                    f"default axis {default.value!r} of "
+                    f"{info.name}({arg.arg}=) flows into a "
+                    "collective but is bound by no mesh/shard_map "
+                    "in the analyzed program"
+                ),
+                hint=(
+                    "default to a *_AXIS constant from "
+                    "parallel/mesh.py (or fix the typo)"
+                ),
+            )
+        )

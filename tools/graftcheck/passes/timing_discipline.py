@@ -50,7 +50,7 @@ def _is_call_to(node: ast.AST, names: tuple[str, ...]) -> bool:
 def _imports_trace(sf: SourceFile) -> bool:
     """Whether the module imports ``adaptdl_tpu.trace`` anywhere
     (module level or lazily inside a function — both opt in)."""
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if isinstance(node, ast.Import):
             for alias in node.names:
                 if alias.name == "adaptdl_tpu.trace":
@@ -100,7 +100,7 @@ class TimingDisciplinePass(Pass):
         # subtraction on one of them is the split-stopwatch form of
         # the same wall-clock duration bug.
         wall_names: set[tuple[ast.AST | None, str]] = set()
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if (
                 isinstance(node, ast.Assign)
                 and len(node.targets) == 1
@@ -122,7 +122,7 @@ class TimingDisciplinePass(Pass):
                 in wall_names
             )
 
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if _is_call_to(node, _PERF_NAMES):
                 findings.append(
                     Finding(

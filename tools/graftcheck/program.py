@@ -1,0 +1,595 @@
+"""Whole-program model: symbol table, call graph, lock-set dataflow.
+
+graftcheck v1 was strictly per-file — GC401 matched collective axis
+literals only against meshes bound in the same module, and GC101 lock
+discipline could not see through a helper call. This module gives the
+passes a package-wide view, still ast-only and pure stdlib:
+
+- a **symbol table**: every module's top-level functions, classes
+  (with methods), constants, and import bindings, keyed by the
+  module's analysis-relative path;
+- a **call graph**: each function's resolved call sites (bare names,
+  ``self.method``, ``module.function``, and by-name function
+  references handed to ``jax.lax.scan``/``jit``/``shard_map``-style
+  wrappers);
+- a **lock-set dataflow**: the set of locks *provably held on entry*
+  to each function, computed as a fixpoint over the call graph from
+  lexical ``with <lock>:`` scopes and ``# holds-lock:`` annotations.
+
+What resolution deliberately does NOT do (and the passes must treat
+as "unknown", never "safe"): dynamic dispatch through non-``self``
+receivers, functions stored in data structures, ``getattr``, star
+imports, and relative imports. A call that does not resolve simply
+contributes no edge — interprocedural facts only ever come from
+resolved edges, so an unresolved call can hide a finding but never
+invent one.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.graftcheck.core import (
+    HOLDS_LOCK_RE,
+    SourceFile,
+    dotted_name,
+)
+
+# Wrappers whose by-name function argument is effectively a call edge:
+# the wrapped function runs with the caller's context (trace entry
+# points) or inside the caller's control flow (scan/cond bodies).
+_REFERENCE_WRAPPERS = {
+    "jit",
+    "pjit",
+    "pmap",
+    "shard_map",
+    "xmap",
+    "checkpoint",
+    "remat",
+    "scan",
+    "cond",
+    "while_loop",
+    "fori_loop",
+    "vmap",
+    "grad",
+    "value_and_grad",
+}
+
+
+class CallSite:
+    """One resolved or unresolved call inside a function body."""
+
+    __slots__ = (
+        "node",
+        "caller",
+        "callee",
+        "name",
+        "is_reference",
+        "_sf",
+        "_held",
+    )
+
+    def __init__(
+        self,
+        node: ast.Call,
+        caller: "FunctionInfo | None",  # None = module level
+        callee: "FunctionInfo | None",  # None = unresolved
+        name: str,  # dotted callee text as written ("trace.event")
+        sf: SourceFile,
+        is_reference: bool = False,  # by-name arg to a scan/jit wrapper
+    ):
+        self.node = node
+        self.caller = caller
+        self.callee = callee
+        self.name = name
+        self.is_reference = is_reference
+        self._sf = sf
+        self._held: frozenset[str] | None = None
+
+    @property
+    def held_locks(self) -> frozenset[str]:
+        """Locks lexically held at the site — computed lazily: only
+        resolved edges (the minority of calls) ever need it."""
+        if self._held is None:
+            self._held = _with_locks_at(self._sf, self.node)
+        return self._held
+
+
+@dataclass
+class FunctionInfo:
+    """One function or method definition in the program."""
+
+    qualname: str  # "<rel>::Class.method" or "<rel>::fn"
+    name: str
+    cls: str | None
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    sf: SourceFile
+    annotated_locks: frozenset[str] = frozenset()
+    call_sites: list[CallSite] = field(default_factory=list)
+    # Call sites INTO this function, filled by Program.
+    callers: list[CallSite] = field(default_factory=list)
+    # Locks provably held on entry (lock-set fixpoint result).
+    entry_locks: frozenset[str] = frozenset()
+    # True when a reference to the function escapes outside a direct
+    # call or a known wrapper (Thread targets, callbacks stored in
+    # data): unknown callers exist, so nothing may be inferred held.
+    escapes: bool = False
+
+
+def _module_key(sf: SourceFile) -> str:
+    """Import-style module name for a SourceFile, derived from its
+    analysis-relative path (``adaptdl_tpu/sched/state.py`` ->
+    ``adaptdl_tpu.sched.state``)."""
+    rel = sf.rel.replace("\\", "/")
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    if rel.endswith("/__init__"):
+        rel = rel[: -len("/__init__")]
+    return rel.replace("/", ".")
+
+
+def _with_locks_at(sf: SourceFile, node: ast.AST) -> frozenset[str]:
+    """Last dotted components of every lock lexically held at ``node``
+    (enclosing ``with`` items and ``# holds-lock:`` annotations)."""
+    held: set[str] = set()
+    for anc in sf.ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    expr = expr.func
+                name = dotted_name(expr)
+                if name:
+                    held.add(name.rsplit(".", 1)[-1])
+        elif isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for m in HOLDS_LOCK_RE.finditer(
+                sf.def_header_comment(anc)
+            ):
+                held.add(m.group(1).rsplit(".", 1)[-1])
+    return frozenset(held)
+
+
+class Program:
+    """Symbol table + call graph over one analyze run's parsed files."""
+
+    def __init__(self, files: list[SourceFile]):
+        self.files = list(files)
+        self.modules: dict[str, SourceFile] = {}
+        # module -> top-level name -> value; values are FunctionInfo,
+        # ("class", {method: FunctionInfo}), ("const", ast.expr), or
+        # ("import", target_module, target_name|None).
+        self.symbols: dict[str, dict[str, object]] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self._by_node: dict[ast.AST, FunctionInfo] = {}
+        # Enclosing def node -> {nested def name -> FunctionInfo},
+        # filled at index time so bare-name resolution never walks.
+        self._nested: dict[ast.AST, dict[str, FunctionInfo]] = {}
+        self._resolve_memo: dict[tuple, FunctionInfo | None] = {}
+        for sf in self.files:
+            self.modules[_module_key(sf)] = sf
+        for sf in self.files:
+            self._index_module(sf)
+        for sf in self.files:
+            self._link_calls(sf)
+        self._lockset_fixpoint()
+
+    # -- indexing ------------------------------------------------------
+
+    def _add_function(
+        self,
+        sf: SourceFile,
+        node: ast.FunctionDef | ast.AsyncFunctionDef,
+        cls: str | None,
+    ) -> FunctionInfo:
+        qual = f"{sf.rel}::{cls + '.' if cls else ''}{node.name}"
+        annotated = frozenset(
+            m.group(1).rsplit(".", 1)[-1]
+            for m in HOLDS_LOCK_RE.finditer(sf.def_header_comment(node))
+        )
+        info = FunctionInfo(
+            qualname=qual,
+            name=node.name,
+            cls=cls,
+            node=node,
+            sf=sf,
+            annotated_locks=annotated,
+        )
+        self.functions[qual] = info
+        self._by_node[node] = info
+        return info
+
+    def _index_module(self, sf: SourceFile) -> None:
+        mod = _module_key(sf)
+        table: dict[str, object] = {}
+        self.symbols[mod] = table
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                table[node.name] = self._add_function(sf, node, None)
+            elif isinstance(node, ast.ClassDef):
+                methods: dict[str, FunctionInfo] = {}
+                bases = [
+                    dotted_name(b)
+                    for b in node.bases
+                    if dotted_name(b) is not None
+                ]
+                for item in node.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        methods[item.name] = self._add_function(
+                            sf, item, node.name
+                        )
+                table[node.name] = ("class", methods, bases)
+            elif isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        table[target.id] = ("const", node.value)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    table[bound] = (
+                        "import",
+                        alias.name if alias.asname else alias.name.split(".")[0],
+                        None,
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    table[bound] = (
+                        "import",
+                        node.module or "",
+                        alias.name,
+                    )
+        # nested defs (closures like pipeline tick bodies) get
+        # FunctionInfos too — addressable for annotation-driven rules
+        # and reference edges, just not via the module symbol table.
+        for node in sf.walk():
+            if (
+                isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                )
+                and node not in self._by_node
+            ):
+                encl = sf.enclosing_function(node)
+                cls = None
+                for anc in sf.ancestors(node):
+                    if isinstance(anc, ast.ClassDef):
+                        cls = anc.name
+                        break
+                qual = (
+                    f"{sf.rel}::"
+                    + (f"{cls}." if cls else "")
+                    + (
+                        f"{encl.name}.<{node.name}>"
+                        if encl is not None
+                        else node.name
+                    )
+                )
+                if qual in self.functions:
+                    qual += f"@{node.lineno}"
+                annotated = frozenset(
+                    m.group(1).rsplit(".", 1)[-1]
+                    for m in HOLDS_LOCK_RE.finditer(
+                        sf.def_header_comment(node)
+                    )
+                )
+                info = FunctionInfo(
+                    qualname=qual,
+                    name=node.name,
+                    cls=cls,
+                    node=node,
+                    sf=sf,
+                    annotated_locks=annotated,
+                )
+                self.functions[qual] = info
+                self._by_node[node] = info
+                if encl is not None:
+                    self._nested.setdefault(encl, {})[
+                        node.name
+                    ] = info
+
+    # -- resolution ----------------------------------------------------
+
+    def function_for_node(
+        self, node: ast.AST
+    ) -> FunctionInfo | None:
+        return self._by_node.get(node)
+
+    def _module_symbol(
+        self, mod: str, name: str, _depth: int = 0
+    ) -> object | None:
+        """Resolve ``name`` in ``mod``, following import chains a few
+        hops (A imports f from B which imports it from C)."""
+        if _depth > 4:
+            return None
+        table = self.symbols.get(mod)
+        if table is None:
+            return None
+        value = table.get(name)
+        if isinstance(value, tuple) and value[0] == "import":
+            _tag, target_mod, target_name = value
+            if target_name is None:
+                # `import X` — the binding is the module itself.
+                if target_mod in self.modules:
+                    return ("module", target_mod)
+                return None
+            resolved = self._module_symbol(
+                target_mod, target_name, _depth + 1
+            )
+            if resolved is not None:
+                return resolved
+            if f"{target_mod}.{target_name}" in self.modules:
+                # `from pkg import submodule`
+                return ("module", f"{target_mod}.{target_name}")
+            return None
+        return value
+
+    def _class_method(
+        self,
+        mod: str,
+        cls_name: str,
+        method: str,
+        _seen: frozenset[str] = frozenset(),
+    ) -> FunctionInfo | None:
+        if cls_name in _seen:
+            return None
+        sym = self._module_symbol(mod, cls_name)
+        if not (isinstance(sym, tuple) and sym[0] == "class"):
+            return None
+        _tag, methods, bases = sym
+        if method in methods:
+            return methods[method]
+        for base in bases:
+            info = self._class_method(
+                mod,
+                base.rsplit(".", 1)[-1],
+                method,
+                _seen | {cls_name},
+            )
+            if info is not None:
+                return info
+        return None
+
+    def resolve_call(
+        self, sf: SourceFile, caller: FunctionInfo | None, node: ast.expr
+    ) -> FunctionInfo | None:
+        """Resolve a callee expression to a FunctionInfo, or None."""
+        name = dotted_name(node)
+        if name is None:
+            return None
+        key = (
+            sf.rel,
+            caller.qualname if caller is not None else None,
+            name,
+        )
+        if key not in self._resolve_memo:
+            self._resolve_memo[key] = self._resolve_uncached(
+                sf, caller, name
+            )
+        return self._resolve_memo[key]
+
+    def _resolve_uncached(
+        self, sf: SourceFile, caller: FunctionInfo | None, name: str
+    ) -> FunctionInfo | None:
+        mod = _module_key(sf)
+        parts = name.split(".")
+        if len(parts) == 1:
+            # Nested def in an enclosing function of the call site?
+            if caller is not None:
+                for anc_fn in [caller.node] + list(
+                    sf.enclosing_functions(caller.node)
+                ):
+                    info = self._nested.get(anc_fn, {}).get(parts[0])
+                    if info is not None:
+                        return info
+            sym = self._module_symbol(mod, parts[0])
+            if isinstance(sym, FunctionInfo):
+                return sym
+            if isinstance(sym, tuple) and sym[0] == "class":
+                # Constructor call -> __init__ if defined.
+                return sym[1].get("__init__")
+            return None
+        if parts[0] in ("self", "cls") and len(parts) == 2:
+            if caller is not None and caller.cls is not None:
+                return self._class_method(
+                    _module_key(caller.sf), caller.cls, parts[1]
+                )
+            return None
+        # module.attr(...) or module.Class.method(...)
+        sym = self._module_symbol(mod, parts[0])
+        if isinstance(sym, tuple) and sym[0] == "module":
+            target_mod = sym[1]
+            if len(parts) == 2:
+                resolved = self._module_symbol(target_mod, parts[1])
+                if isinstance(resolved, FunctionInfo):
+                    return resolved
+            elif len(parts) == 3:
+                return self._class_method(
+                    target_mod, parts[1], parts[2]
+                )
+        return None
+
+    def _link_calls(self, sf: SourceFile) -> None:
+        fn_nodes = {
+            info.node: info
+            for info in self.functions.values()
+            if info.sf is sf
+        }
+
+        def enclosing_info(node: ast.AST) -> FunctionInfo | None:
+            fn = sf.enclosing_function(node)
+            return fn_nodes.get(fn) if fn is not None else None
+
+        for node in sf.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            caller = enclosing_info(node)
+            callee = self.resolve_call(sf, caller, node.func)
+            name = dotted_name(node.func) or "<expr>"
+            site = CallSite(
+                node=node,
+                caller=caller,
+                callee=callee,
+                name=name,
+                sf=sf,
+            )
+            if caller is not None:
+                caller.call_sites.append(site)
+            if callee is not None:
+                callee.callers.append(site)
+            # By-name references handed to scan/jit/shard_map-style
+            # wrappers: edge from the call's enclosing function to the
+            # referenced function (its body runs under this context).
+            short = name.rsplit(".", 1)[-1].lstrip("_")
+            if short in _REFERENCE_WRAPPERS:
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    if not isinstance(arg, ast.Name):
+                        continue
+                    target = self.resolve_call(sf, caller, arg)
+                    if target is None:
+                        continue
+                    ref = CallSite(
+                        node=node,
+                        caller=caller,
+                        callee=target,
+                        name=arg.id,
+                        sf=sf,
+                        is_reference=True,
+                    )
+                    if caller is not None:
+                        caller.call_sites.append(ref)
+                    target.callers.append(ref)
+        # Escape detection: a loaded reference that resolves to a
+        # known function but is neither the callee of a call nor a
+        # by-name argument to a reference wrapper has unknown callers
+        # (Thread(target=...), callbacks stored in dicts, returns).
+        # Both bare names (`target=worker`) and attribute references
+        # (`target=self._drain`, `mod.worker`) count — a method
+        # reference escaping into a thread is exactly what the lock
+        # inference must never see through.
+        fn_names = {info.name for info in self.functions.values()}
+        for node in sf.walk():
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load
+            ):
+                if node.id not in fn_names:
+                    continue
+            elif isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load
+            ):
+                if node.attr not in fn_names:
+                    continue
+            else:
+                continue
+            parent = sf.parents.get(node)
+            if isinstance(parent, ast.Call):
+                if parent.func is node:
+                    continue
+                if isinstance(
+                    parent.func, ast.Attribute
+                ) and node is parent.func.value:
+                    # The base of the callee chain (`self` in
+                    # `self.m()`, `mod` in `mod.fn()`), not an
+                    # escaping reference of its own.
+                    continue
+                wrapper = dotted_name(parent.func) or ""
+                if (
+                    wrapper.rsplit(".", 1)[-1].lstrip("_")
+                    in _REFERENCE_WRAPPERS
+                ):
+                    continue
+            if isinstance(parent, ast.keyword):
+                grand = sf.parents.get(parent)
+                if isinstance(grand, ast.Call):
+                    wrapper = dotted_name(grand.func) or ""
+                    if (
+                        wrapper.rsplit(".", 1)[-1].lstrip("_")
+                        in _REFERENCE_WRAPPERS
+                    ):
+                        continue
+            target = self.resolve_call(
+                sf, self.function_for_node(sf.enclosing_function(node)), node
+            )
+            if target is not None:
+                target.escapes = True
+
+    # -- lock-set dataflow ---------------------------------------------
+
+    def _lockset_fixpoint(self) -> None:
+        """entry_locks(fn) = locks held at EVERY resolved call site
+        (site-lexical ∪ caller's entry set). Functions with no
+        resolved callers get the empty set — an escaping reference or
+        an external caller could hold nothing. Reference edges (scan /
+        jit bodies, thread targets are NOT edges) participate like
+        calls: the body runs while the wrapper call site's locks are
+        held."""
+        TOP = None  # lattice top: "every lock" until a site is seen
+        entry: dict[str, frozenset[str] | None] = {
+            q: TOP for q in self.functions
+        }
+        for info in self.functions.values():
+            if not info.callers or info.escapes:
+                entry[info.qualname] = frozenset()
+        changed = True
+        iterations = 0
+        while changed and iterations < 50:
+            changed = False
+            iterations += 1
+            for info in self.functions.values():
+                if not info.callers or info.escapes:
+                    continue
+                acc: frozenset[str] | None = TOP
+                for site in info.callers:
+                    held = set(site.held_locks)
+                    if site.caller is not None:
+                        held |= site.caller.annotated_locks
+                        caller_entry = entry[site.caller.qualname]
+                        if caller_entry is not None:
+                            held |= caller_entry
+                    site_set = frozenset(held)
+                    acc = (
+                        site_set
+                        if acc is None
+                        else acc & site_set
+                    )
+                if acc is None:
+                    acc = frozenset()
+                if acc != entry[info.qualname]:
+                    entry[info.qualname] = acc
+                    changed = True
+        for info in self.functions.values():
+            resolved = entry[info.qualname]
+            info.entry_locks = (
+                frozenset() if resolved is None else resolved
+            )
+
+    # -- reachability helpers ------------------------------------------
+
+    def reachable_from(
+        self,
+        roots: list[FunctionInfo],
+        cut: "frozenset[str] | set[str]" = frozenset(),
+    ) -> dict[str, list[str]]:
+        """Functions reachable from ``roots`` over resolved call
+        edges, mapped to one witness path of qualnames (root first).
+        Qualnames in ``cut`` are not entered (nor traversed through)
+        — passes use this to stop at module boundaries they report at
+        the call site instead."""
+        paths: dict[str, list[str]] = {}
+        stack = [(r, [r.qualname]) for r in roots]
+        while stack:
+            info, path = stack.pop()
+            if info.qualname in paths or info.qualname in cut:
+                continue
+            paths[info.qualname] = path
+            for site in info.call_sites:
+                if site.callee is not None and (
+                    site.callee.qualname not in paths
+                ):
+                    stack.append(
+                        (site.callee, path + [site.callee.qualname])
+                    )
+        return paths

@@ -1,0 +1,102 @@
+"""SARIF 2.1.0 output for graftcheck findings.
+
+SARIF (Static Analysis Results Interchange Format) is the schema
+GitHub code scanning ingests: uploading a run via
+``github/codeql-action/upload-sarif`` renders each finding as an
+inline annotation on the PR diff, so a GC801 deadlock shows up on the
+exact line under review instead of in a CI log nobody opens.
+
+The emitted document is deliberately minimal — one run, one driver,
+rule metadata from the pass catalog, one physical location per
+finding — which is the subset GitHub's ingester documents and every
+SARIF viewer renders.
+"""
+
+from __future__ import annotations
+
+from tools.graftcheck.core import TOOL_VERSION, Finding
+
+_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemas/sarif-schema-2.1.0.json"
+)
+
+
+def to_sarif(
+    findings: list[Finding],
+    rule_catalog: dict[str, tuple[str, str]],
+) -> dict:
+    """Build a SARIF ``log`` dict from findings.
+
+    ``rule_catalog`` maps rule id -> (pass name, description) — the
+    shape of ``passes.RULE_CATALOG``. Rules referenced by findings
+    but missing from the catalog (GC001 syntax errors) get stub
+    metadata so the document always validates.
+    """
+    used = sorted({f.rule for f in findings})
+    rules = []
+    index: dict[str, int] = {}
+    for rule in sorted(set(rule_catalog) | set(used)):
+        pass_name, desc = rule_catalog.get(
+            rule, ("engine", "analyzer-internal finding")
+        )
+        index[rule] = len(rules)
+        rules.append(
+            {
+                "id": rule,
+                "name": pass_name,
+                "shortDescription": {"text": desc},
+                "defaultConfiguration": {"level": "error"},
+            }
+        )
+    results = []
+    for f in findings:
+        message = f.message
+        if f.hint:
+            message += f" [hint: {f.hint}]"
+        results.append(
+            {
+                "ruleId": f.rule,
+                "ruleIndex": index[f.rule],
+                "level": "error",
+                "message": {"text": message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": f.file.replace("\\", "/"),
+                                "uriBaseId": "SRCROOT",
+                            },
+                            "region": {
+                                "startLine": max(f.line, 1),
+                                # SARIF columns are 1-based; Finding
+                                # cols are 0-based ast offsets.
+                                "startColumn": f.col + 1,
+                            },
+                        }
+                    }
+                ],
+            }
+        )
+    return {
+        "$schema": _SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftcheck",
+                        "informationUri": (
+                            "docs/static-analysis.md"
+                        ),
+                        "version": TOOL_VERSION,
+                        "rules": rules,
+                    }
+                },
+                "originalUriBaseIds": {
+                    "SRCROOT": {"uri": "file:///"}
+                },
+                "results": results,
+            }
+        ],
+    }
