@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched chaos-preempt trace-gate probe
+.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched chaos-preempt trace-gate simgate bench-sched probe
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -70,6 +70,20 @@ chaos-preempt:
 trace-gate:
 	$(CPU_ENV) $(PY) -m pytest tests/test_trace.py -q \
 	    -k "overhead or bounded or conformant" --durations=5
+
+# graftsim gate (docs/simulator.md): the committed 1k-job / 10k-slot
+# trace through the REAL scheduler under a virtual clock — the
+# deterministic summary must be bit-identical across two same-seed
+# runs and simulated-goodput retention vs the fixed-allocation
+# baseline must hold >= 1.0, inside the wall budget.
+simgate:
+	$(CPU_ENV) $(PY) -m pytest tests/test_simgate.py -q --durations=5
+
+# Thousand-job control-plane bench standalone (bench.py also merges
+# these keys into the BENCH json): allocator decide p50/p99 at 1k
+# jobs / 10k slots + supervisor per-endpoint p99s under load.
+bench-sched:
+	$(CPU_ENV) $(PY) bench_sched.py
 
 probe:
 	timeout 180 $(PY) tools/tpu_probe.py || echo "probe: tunnel dead/cpu-only"

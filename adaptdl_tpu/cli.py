@@ -402,6 +402,74 @@ def _cmd_trace(args) -> int:
     return 0
 
 
+def _cmd_sim(args) -> int:
+    """graftsim: replay a JSONL job-arrival trace through the REAL
+    scheduler (PolluxPolicy + Allocator + ClusterState) under a
+    virtual clock and render the summary table — or generate a trace
+    (``--generate N``). A fixed ``--seed`` reproduces the summary
+    bit-for-bit; ``--compare-fixed`` also runs the fixed-allocation
+    baseline and prints the goodput-retention ratio."""
+    from adaptdl_tpu.sim import (
+        generate_trace,
+        load_trace,
+        run_trace,
+        write_trace,
+    )
+
+    if args.generate is not None:
+        records = generate_trace(
+            args.generate, args.duration, seed=args.seed
+        )
+        if args.out:
+            write_trace(args.out, records)
+            print(
+                f"wrote {len(records)} arrivals to {args.out}",
+                file=sys.stderr,
+            )
+        else:
+            for record in records:
+                print(json.dumps(record, sort_keys=True))
+        return 0
+    if not args.trace:
+        print(
+            "sim: a TRACE file is required (or --generate N)",
+            file=sys.stderr,
+        )
+        return 2
+    records = load_trace(args.trace)
+    kwargs = dict(
+        slices=args.slices,
+        chips_per_slice=args.chips_per_slice,
+        seed=args.seed,
+        interval=args.interval,
+        spot_fraction=args.spot_fraction,
+        reclaims_per_slot_hour=args.reclaims_per_slot_hour,
+    )
+    report = run_trace(records, fixed=args.fixed, **kwargs)
+    print(report.render())
+    payload = {
+        "summary": report.summary(),
+        "latency": report.latency(),
+    }
+    if args.compare_fixed and not args.fixed:
+        baseline = run_trace(records, fixed=True, **kwargs)
+        retention = report.summary()["avg_goodput_x_ideal"] / max(
+            baseline.summary()["avg_goodput_x_ideal"], 1e-9
+        )
+        payload["fixed_baseline"] = baseline.summary()
+        payload["goodput_retention_vs_fixed"] = round(retention, 4)
+        print(
+            f"\ngoodput retention vs fixed allocation: "
+            f"{retention:.4f} (>= 1.0 means the adaptive policy "
+            "wins)"
+        )
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(payload, f, sort_keys=True, indent=2)
+        print(f"\nwrote report JSON to {args.json}", file=sys.stderr)
+    return 0
+
+
 def _cmd_hints(args) -> int:
     from adaptdl_tpu import rpc
 
@@ -810,6 +878,66 @@ def main(argv=None) -> int:
         help="render every stored span, not just one trace",
     )
     p.set_defaults(fn=_cmd_trace)
+
+    p = sub.add_parser(
+        "sim",
+        help="graftsim: replay a job-arrival trace through the real "
+        "scheduler under a virtual clock (or --generate a trace); "
+        "fixed seed => bit-identical summary",
+    )
+    p.add_argument(
+        "trace", nargs="?", default=None, help="JSONL arrival trace"
+    )
+    p.add_argument("--slices", type=int, default=16)
+    p.add_argument("--chips-per-slice", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--interval",
+        type=float,
+        default=60.0,
+        help="virtual seconds between allocator cycles",
+    )
+    p.add_argument(
+        "--fixed",
+        action="store_true",
+        help="score the fixed-allocation baseline instead of Pollux",
+    )
+    p.add_argument(
+        "--compare-fixed",
+        action="store_true",
+        help="also run the fixed baseline and print the goodput-"
+        "retention ratio",
+    )
+    p.add_argument(
+        "--spot-fraction",
+        type=float,
+        default=0.0,
+        help="fraction of slices that are preemptible",
+    )
+    p.add_argument(
+        "--reclaims-per-slot-hour",
+        type=float,
+        default=0.0,
+        help="Poisson reclaim-notice rate per spot slice (0 = off)",
+    )
+    p.add_argument(
+        "--generate",
+        type=int,
+        default=None,
+        metavar="N",
+        help="generate an N-job trace instead of simulating",
+    )
+    p.add_argument(
+        "--duration",
+        type=float,
+        default=3600.0,
+        help="arrival span (virtual seconds) for --generate",
+    )
+    p.add_argument("-o", "--out", default=None, help="trace output file")
+    p.add_argument(
+        "--json", default=None, help="write summary+latency JSON here"
+    )
+    p.set_defaults(fn=_cmd_sim)
 
     p = sub.add_parser("hints", help="show a job's posted sched hints")
     p.add_argument("job", help="namespace/name")

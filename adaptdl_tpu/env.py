@@ -350,6 +350,34 @@ def sched_reconcile_window() -> float:
     return _get_float("ADAPTDL_SCHED_RECONCILE_WINDOW", 30.0)
 
 
+def journal_group_commit_s() -> float:
+    """Group-commit window (seconds) for the supervisor's write-ahead
+    journal: appends landing within the window share ONE fsync instead
+    of paying one each, bounding fsync latency on the mutation path at
+    the cost of a power-loss window of at most this many seconds of
+    acknowledged mutations (a plain process crash loses nothing —
+    records are flushed to the OS per append). 0 — the default — keeps
+    the strict fsync-per-record behavior."""
+    return max(_get_float("ADAPTDL_JOURNAL_GROUP_COMMIT_S", 0.0), 0.0)
+
+
+def alloc_dirty_threshold() -> float:
+    """Fraction of jobs that must be dirty (changed hints, arrivals,
+    departures, preemptions) before the allocator abandons the
+    incremental re-optimization path and runs a full Pollux cycle —
+    re-searching only dirty jobs is cheap but cannot globally
+    rebalance, so heavy churn falls back to the full search."""
+    return min(max(_get_float("ADAPTDL_ALLOC_DIRTY_THRESHOLD", 0.25), 0.0), 1.0)
+
+
+def alloc_full_every() -> int:
+    """Force a full Pollux optimization every Nth allocator cycle
+    regardless of dirtiness, so background jobs pinned by incremental
+    cycles are periodically re-balanced (freed capacity redistributed,
+    fairness restored). 1 disables incremental allocation entirely."""
+    return max(_get_int("ADAPTDL_ALLOC_FULL_EVERY", 10), 1)
+
+
 def preempt_notice_s() -> float:
     """Seconds of warning a preemption notice gives before the VM is
     reclaimed (GCE spot gives 30). The urgent drain budgets its final

@@ -14,10 +14,11 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
+import time
 
 import numpy as np
 
-from adaptdl_tpu import trace
+from adaptdl_tpu import env, trace
 from adaptdl_tpu.goodput import GoodputFunction, GradParams, PerfParams
 from adaptdl_tpu.sched.policy import (
     JobInfo,
@@ -155,12 +156,22 @@ class Allocator:
         policy: PolluxPolicy | None = None,
         interval: float = 60.0,
         expander=None,
+        dirty_threshold: float | None = None,
+        full_every: int | None = None,
     ):
         """``nodes`` is the slice inventory: either a static dict or a
         zero-arg callable returning one — a callable makes provisioned
         capacity visible on the next cycle (the autoscaling feedback
         loop; the reference re-lists k8s nodes every cycle,
-        allocator.py:149-179)."""
+        allocator.py:149-179).
+
+        Incremental allocation: cycles re-optimize only the jobs the
+        cluster state marked dirty (hints, arrivals, departures,
+        preemptions) against a pinned background, falling back to a
+        FULL Pollux cycle when the dirty fraction crosses
+        ``dirty_threshold`` (ADAPTDL_ALLOC_DIRTY_THRESHOLD), every
+        ``full_every``-th cycle (ADAPTDL_ALLOC_FULL_EVERY), or
+        whenever the slice inventory / exclusion set changed."""
         self._state = state
         self._nodes = nodes
         if node_template is None:
@@ -176,6 +187,19 @@ class Allocator:
         self._policy = policy or PolluxPolicy()
         self._interval = interval
         self._expander = expander
+        self._dirty_threshold = (
+            env.alloc_dirty_threshold()
+            if dirty_threshold is None
+            else min(max(float(dirty_threshold), 0.0), 1.0)
+        )
+        self._full_every = (
+            env.alloc_full_every()
+            if full_every is None
+            else max(int(full_every), 1)
+        )
+        self._cycle = 0
+        self._last_slots: frozenset | None = None
+        self._last_excluded: frozenset = frozenset()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -183,30 +207,52 @@ class Allocator:
         return self._nodes() if callable(self._nodes) else self._nodes
 
     def optimize_once(self) -> dict[str, list[str]]:
-        # The decision latency of one full Pollux cycle — the number
-        # the thousand-job control plane's SLO will be written against.
-        with trace.span("alloc.decide") as decide_attrs:
-            allocations = self._optimize_once_traced(decide_attrs)
+        # The decision latency of one Pollux cycle — the number the
+        # thousand-job control plane's SLO is written against (served
+        # as adaptdl_alloc_decide_seconds{mode} on /metrics).
+        start = time.monotonic()
+        dirty = self._state.consume_dirty_jobs()
+        try:
+            with trace.span("alloc.decide") as decide_attrs:
+                allocations, mode = self._optimize_once_traced(
+                    decide_attrs, dirty
+                )
+        except Exception:
+            # The consumed dirty set must survive a failed cycle, or
+            # the next incremental cycle would silently skip the jobs
+            # whose changes this one dropped on the floor. The
+            # inventory/exclusion baseline is reset too: the failed
+            # cycle may have consumed a slot-set change that should
+            # force the next cycle onto the full path.
+            for key in dirty:
+                self._state.mark_job_dirty(key)
+            self._last_slots = None
+            raise
+        self._state.note_alloc_cycle(
+            time.monotonic() - start, len(dirty), mode
+        )
         return allocations
 
     def _optimize_once_traced(
-        self, decide_attrs: dict
-    ) -> dict[str, list[str]]:
-        jobs = {}
+        self, decide_attrs: dict, dirty: set[str]
+    ) -> tuple[dict[str, list[str]], str]:
+        self._cycle += 1
+        records = {}
         base = {}
         for key, record in self._state.jobs().items():
             if record.status in FINISHED:
                 continue
-            jobs[key] = job_info_from_hints(
-                record.hints, record.spec, record.creation_timestamp
-            )
+            records[key] = record
             base[key] = list(record.allocation)
-        if not jobs:
+        if not records:
             # No incomplete jobs: let the expander retire capacity
             # (clamped to its min; shrink waits out the hysteresis).
+            # The consumed dirty set is deliberately dropped — it can
+            # only name departed jobs, and any future arrival marks
+            # itself dirty.
             if self._expander is not None:
                 self._expander.request(0)
-            return {}
+            return {}, "full"
         # Slots struck out by failed allocation epochs are off the
         # table until their un-quarantine probe: re-placing a job on
         # a slot that just crash-looped it would burn the retry
@@ -232,10 +278,16 @@ class Allocator:
             # Scaled to zero with pending work: the policy cannot run
             # on an empty inventory (it would report desired=0 and
             # deadlock the cluster at zero forever) — bootstrap one
-            # slice and allocate on the next cycle.
+            # slice and allocate on the next cycle. The consumed
+            # dirty set must survive this skipped cycle (same
+            # invariant as the exception path), and the slot baseline
+            # resets so capacity reappearing forces a full cycle.
+            for key in dirty:
+                self._state.mark_job_dirty(key)
+            self._last_slots = None
             if self._expander is not None:
                 self._expander.request(1)
-            return {}
+            return {}, "full"
         # Hazard pricing: register the inventory's slot->kind map (so
         # a preemption notice is attributed to the right hazard kind)
         # and stamp each slice with its kind's decayed EWMA hazard —
@@ -260,30 +312,100 @@ class Allocator:
             self._template,
             hazard=hazards.get(slot_kind(self._template), 0.0),
         )
-        allocations, desired = self._policy.optimize(
-            jobs,
-            nodes,
-            base,
-            template,
-            quarantined=quarantined | draining,
+        excluded = quarantined | draining
+        dirty_active = dirty & set(records)
+        # Incremental vs full: re-searching only dirty jobs is cheap,
+        # but cannot rebalance the background — so heavy churn, an
+        # inventory/exclusion change, the periodic forced cycle, and
+        # the first cycle all take the full path.
+        slots_now = frozenset(nodes)
+        full = (
+            self._cycle == 1
+            or self._full_every <= 1
+            or self._cycle % self._full_every == 0
+            or self._last_slots != slots_now
+            or self._last_excluded != frozenset(excluded)
+            or len(dirty) > self._dirty_threshold * len(records)
         )
-        decide_attrs["jobs"] = len(jobs)
+        self._last_slots = slots_now
+        self._last_excluded = frozenset(excluded)
+        if full:
+            mode = "full"
+            job_infos = {
+                key: job_info_from_hints(
+                    record.hints,
+                    record.spec,
+                    record.creation_timestamp,
+                )
+                for key, record in records.items()
+            }
+            allocations, desired = self._policy.optimize(
+                job_infos,
+                nodes,
+                base,
+                template,
+                quarantined=excluded,
+            )
+            changed_keys = set(allocations)
+        else:
+            mode = "incremental"
+            # Speedup models (the expensive JobInfo half) are built
+            # for the DIRTY jobs only; the pinned background needs
+            # just its per-replica resources.
+            job_infos = {
+                key: job_info_from_hints(
+                    records[key].hints,
+                    records[key].spec,
+                    records[key].creation_timestamp,
+                )
+                for key in sorted(dirty_active)
+            }
+            allocations, desired = self._policy.optimize_incremental(
+                job_infos,
+                nodes,
+                base,
+                template,
+                dirty=dirty_active,
+                quarantined=excluded,
+                resources={
+                    key: dict(
+                        record.spec.get("resources") or {"tpu": 1}
+                    )
+                    for key, record in records.items()
+                    if key not in dirty_active
+                },
+            )
+            changed_keys = set(dirty_active)
+        decide_attrs["jobs"] = len(records)
         decide_attrs["slots"] = sum(
             info.resources.get("tpu", 0) for info in nodes.values()
         )
+        decide_attrs["mode"] = mode
+        decide_attrs["dirty"] = len(dirty)
         if self._expander is not None:
             note = getattr(self._expander, "note_restart_costs", None)
-            if note is not None:
+            if note is not None and mode == "full":
                 # The mix-policy expander weighs the spot discount
-                # against the jobs' measured restart costs.
+                # against the jobs' measured restart costs. Only full
+                # cycles see every job's JobInfo — an incremental
+                # cycle's dirty-only view would REPLACE the whole map
+                # with an unrepresentative sliver (often empty),
+                # so pool-mix pricing rides full cycles like the
+                # desired-node target does.
                 note(
                     {
                         key: info.restart_cost_s
-                        for key, info in jobs.items()
+                        for key, info in job_infos.items()
                     }
                 )
             self._expander.request(desired)
         for key, alloc in allocations.items():
+            if key not in changed_keys:
+                # Incremental cycles never touch the pinned
+                # background: its allocation is unchanged by
+                # construction, and recomputing its batch/topology
+                # would rebuild 1k speedup models per cycle.
+                continue
             record = self._state.get_job(key)
             if record is None:
                 continue
@@ -296,7 +418,9 @@ class Allocator:
             topology = None
             batch_config = None
             best_config = getattr(
-                jobs[key].speedup_fn, "best_config_with_hysteresis", None
+                job_infos[key].speedup_fn,
+                "best_config_with_hysteresis",
+                None,
             )
             if best_config is not None and alloc:
                 bsz, accum, sp, tp, ss, ep, micro = best_config(
@@ -362,7 +486,7 @@ class Allocator:
                     key, record.batch_config, batch_config,
                 )
                 self._state.publish_retune(key, batch_config)
-        return allocations
+        return allocations, mode
 
     def start(self) -> None:
         # The kick baseline is snapshotted BEFORE each cycle —

@@ -11,6 +11,19 @@ and retune config. This module is that substrate, lifted out of etcd:
   **fsynced before the mutation is applied** (write-ahead ordering: a
   crash between journal and apply loses an un-acknowledged mutation,
   never acknowledges a lost one).
+
+  With ``ADAPTDL_JOURNAL_GROUP_COMMIT_S`` > 0 the fsync is *group
+  committed*: every append is still written and flushed to the OS in
+  order before the mutation applies (write-ahead ordering and
+  acknowledged-prefix semantics are unchanged — a killed supervisor
+  loses nothing, and whatever a power loss keeps is always a prefix
+  of what was acknowledged), but the fsync itself is deferred to a
+  background flusher that syncs all appends landing within the window
+  at once. The trade is explicit and bounded: at most one window of
+  acknowledged mutations is exposed to a *power loss* (not a process
+  crash), in exchange for taking the per-mutation fsync off the
+  supervisor's critical path. ``0`` (the default) keeps the strict
+  fsync-per-record behavior.
 - ``snapshot.json`` — a full state dump written atomically
   (tmp + fsync + rename + dir fsync) every ``snapshot_every`` appends,
   after which the journal is truncated, bounding replay time.
@@ -40,8 +53,10 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
+import time
 
-from adaptdl_tpu import faults, trace
+from adaptdl_tpu import env, faults, trace
 
 LOG = logging.getLogger(__name__)
 
@@ -64,12 +79,18 @@ def _fsync_dir(path: str) -> None:
 class StateJournal:
     """Append-only mutation log + periodic snapshot for one cluster.
 
-    Not internally locked: every method is called under the owning
-    ``ClusterState``'s condition lock, which also serializes append
-    ordering with the in-memory mutations it journals.
+    Append/snapshot/load ordering is serialized by the owning
+    ``ClusterState``'s condition lock; the internal ``_io_lock`` only
+    coordinates the file handle with the group-commit flusher thread
+    (which fsyncs pending appends when the batching window lapses).
     """
 
-    def __init__(self, state_dir: str, snapshot_every: int = 256):
+    def __init__(
+        self,
+        state_dir: str,
+        snapshot_every: int = 256,
+        group_commit_s: float | None = None,
+    ):
         os.makedirs(state_dir, exist_ok=True)
         self.state_dir = state_dir
         self.journal_path = os.path.join(state_dir, JOURNAL_NAME)
@@ -79,33 +100,100 @@ class StateJournal:
         # Monotonic record sequence; primed by load() so a recovered
         # journal keeps counting where the previous life stopped.
         self._seq = 0
-        self._fh = None
+        # Group-commit window: 0 = fsync per append (strict); > 0 =
+        # appends flush immediately but share one deferred fsync.
+        self._group_commit_s = (
+            env.journal_group_commit_s()
+            if group_commit_s is None
+            else max(float(group_commit_s), 0.0)
+        )
+        self._io_lock = threading.Lock()
+        self._fsync_cv = threading.Condition(self._io_lock)
+        self._fh = None  # guarded-by: _io_lock
+        self._fsync_pending = False  # guarded-by: _io_lock
+        self._fsync_deadline = 0.0  # guarded-by: _io_lock
+        self._fsync_thread = None  # guarded-by: _io_lock
+        self._closed = False  # guarded-by: _io_lock
 
     # -- write path ----------------------------------------------------
 
     def append(self, record: dict) -> None:
-        """Durably append one mutation record (fsync before return)."""
-        # The span covers write+fsync — the latency every journaled
-        # supervisor mutation pays on its critical path (and the term
-        # group-commit batching would attack; measure before
-        # optimizing). ``job``/``op`` attrs let a per-job trace pick
-        # its own appends out of the shared journal stream.
+        """Durably append one mutation record. With group commit
+        disabled (the default) the fsync happens before return; with a
+        window, the record is written+flushed in order (a process kill
+        loses nothing acknowledged) and the fsync is deferred to the
+        flusher, bounded by the window."""
+        # The span covers write(+fsync) — the latency every journaled
+        # supervisor mutation pays on its critical path (group commit
+        # moves the fsync half off it). ``job``/``op`` attrs let a
+        # per-job trace pick its own appends out of the shared stream.
         with trace.span(
             "journal.append",
             job=record.get("key", ""),
             op=record.get("op", ""),
         ):
             faults.maybe_fail("sched.journal_write")
-            if self._fh is None:
-                self._fh = open(
-                    self.journal_path, "a", encoding="utf-8"
+            with self._io_lock:
+                if self._fh is None:
+                    self._fh = open(
+                        self.journal_path, "a", encoding="utf-8"
+                    )
+                self._seq += 1
+                record = dict(record, seq=self._seq)
+                self._fh.write(
+                    json.dumps(record, sort_keys=True) + "\n"
                 )
-            self._seq += 1
-            record = dict(record, seq=self._seq)
-            self._fh.write(json.dumps(record, sort_keys=True) + "\n")
-            self._fh.flush()
+                self._fh.flush()
+                if self._group_commit_s <= 0:
+                    os.fsync(self._fh.fileno())
+                elif not self._fsync_pending:
+                    # First append of a batch arms the window; later
+                    # appends inside it ride the same deferred fsync
+                    # (the deadline is NOT pushed out — latency stays
+                    # bounded by one window from the first unsynced
+                    # record, however fast appends keep arriving).
+                    self._fsync_pending = True
+                    self._fsync_deadline = (
+                        time.monotonic() + self._group_commit_s
+                    )
+                    self._ensure_flusher_locked()
+                    self._fsync_cv.notify_all()
+                self._appends_since_snapshot += 1
+
+    def _ensure_flusher_locked(self) -> None:  # holds-lock: _io_lock
+        if self._fsync_thread is not None and self._fsync_thread.is_alive():
+            return
+        self._closed = False  # an append after close() re-opens
+        self._fsync_thread = threading.Thread(
+            target=self._flush_loop,
+            name="adaptdl-journal-fsync",
+            daemon=True,
+        )
+        self._fsync_thread.start()
+
+    def _flush_loop(self) -> None:
+        with self._io_lock:
+            while not self._closed:
+                if not self._fsync_pending:
+                    self._fsync_cv.wait()
+                    continue
+                remaining = self._fsync_deadline - time.monotonic()
+                if remaining > 0:
+                    self._fsync_cv.wait(remaining)
+                    continue
+                self._fsync_now_locked()
+
+    def _fsync_now_locked(self) -> None:  # holds-lock: _io_lock
+        """Sync the batched appends (group commit). Cleared even on
+        error — a failing disk must not wedge the flusher in a hot
+        retry loop; the next append re-arms the window."""
+        self._fsync_pending = False
+        if self._fh is None:
+            return
+        try:
             os.fsync(self._fh.fileno())
-            self._appends_since_snapshot += 1
+        except OSError:  # noqa: BLE001 - surfaced by the next append
+            LOG.exception("group-commit fsync failed")
 
     def snapshot_due(self) -> bool:
         return self._appends_since_snapshot >= self._snapshot_every
@@ -120,9 +208,10 @@ class StateJournal:
         """
         faults.maybe_fail("sched.snapshot_write")
         with trace.span("journal.snapshot"):
-            self._write_snapshot(payload)
+            with self._io_lock:
+                self._write_snapshot_locked(payload)
 
-    def _write_snapshot(self, payload: dict) -> None:
+    def _write_snapshot_locked(self, payload: dict) -> None:  # holds-lock: _io_lock
         tmp = self.snapshot_path + ".tmp"
         # The snapshot covers every record appended so far: replay
         # skips journal records at or below last_seq, so a crash
@@ -138,15 +227,23 @@ class StateJournal:
         if self._fh is not None:
             self._fh.close()
             self._fh = None
+        # The truncation supersedes any group-commit batch: every
+        # journaled record is now covered by the snapshot.
+        self._fsync_pending = False
         with open(self.journal_path, "w", encoding="utf-8") as f:
             f.flush()
             os.fsync(f.fileno())
         self._appends_since_snapshot = 0
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._io_lock:
+            if self._fsync_pending:
+                self._fsync_now_locked()
+            self._closed = True
+            self._fsync_cv.notify_all()
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     # -- recovery ------------------------------------------------------
 

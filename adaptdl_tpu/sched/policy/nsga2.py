@@ -105,10 +105,18 @@ def minimize(
     rng = np.random.default_rng(seed)
     pop = repair(np.asarray(initial), rng)
     if pop.shape[0] < pop_size:
-        # Fill by mutating copies of the seeds.
+        # Fill by mutating copies of the seeds — but keep EVERY given
+        # seed intact: the callers' seeds are high-value states (the
+        # incumbent allocation, greedy dense packings), and mutating
+        # all but the first threw the good ones away before the
+        # search even started.
         reps = -(-pop_size // pop.shape[0])
-        pop = np.concatenate([pop] * reps, axis=0)[:pop_size]
-        pop[1:] = repair(mutate(pop[1:], rng), rng)
+        fill = np.concatenate([pop] * reps, axis=0)[
+            pop.shape[0]:pop_size
+        ]
+        if fill.shape[0]:
+            fill = repair(mutate(fill, rng), rng)
+            pop = np.concatenate([pop, fill], axis=0)
     F = evaluate(pop)
 
     for _ in range(generations):

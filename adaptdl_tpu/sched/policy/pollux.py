@@ -29,6 +29,7 @@ from the reference, re-expressed for slices:
 from __future__ import annotations
 
 import copy
+import dataclasses
 import logging
 from collections import OrderedDict
 
@@ -52,14 +53,38 @@ MAX_HAZARD_LOSS = 0.9
 
 
 class PolluxPolicy:
-    def __init__(self, pop_size: int = 100, generations: int = 100):
+    def __init__(
+        self,
+        pop_size: int = 100,
+        generations: int = 100,
+        partition_slices: int = 64,
+        util_band: tuple[float, float] | None = None,
+    ):
         self._pop_size = pop_size
         self._generations = generations
-        self._min_util = 0.35
-        self._max_util = 0.65
+        # Target cluster-utilization band for the autoscaling
+        # objective (reference: pollux.py:121-142). Allocation picks
+        # are clamped to the band-derived node budget so capacity the
+        # autoscaler wants to retire drains; a STATICALLY provisioned
+        # cluster (no expander — e.g. the simulator) should widen the
+        # band to (0, 1) so free capacity is actually used.
+        self._min_util, self._max_util = util_band or (0.35, 0.65)
         self._prev_population = None
         self._prev_jobs: list = []
         self._prev_nodes: list = []
+        # Above this many slices a full cycle runs PARTITIONED (the
+        # Pollux paper's scalability device): jobs and nodes are
+        # split into sub-problems of at most this many slices each,
+        # solved independently, and merged — search cost grows
+        # linearly with cluster size instead of quadratically.
+        self._partition_slices = max(int(partition_slices), 1)
+        # Desired-node target of the last full cycle; incremental
+        # cycles reuse it (autoscaling decisions ride full cycles).
+        self._last_full_desired: int | None = None
+        # Candidate-inventory cap for the incremental path: a dirty
+        # job is re-searched over its own slices plus the best free
+        # slices, not the whole 10k-slot inventory.
+        self._incremental_candidates = 64
 
     # -- single-job arrival (cheap path) ------------------------------
 
@@ -97,7 +122,7 @@ class PolluxPolicy:
         node_template,
         quarantined=(),
     ):
-        """One Pollux cycle.
+        """One FULL Pollux cycle.
 
         Args:
           jobs: {job_key: JobInfo} incomplete jobs.
@@ -116,9 +141,323 @@ class PolluxPolicy:
             (shrinking and restarting a non-preemptible job) — but is
             blocked for every other job until its un-quarantine probe.
 
+        Above ``partition_slices`` slices the cycle runs PARTITIONED:
+        jobs grouped with the slices they occupy into sub-problems of
+        bounded size, each searched independently (the Pollux paper's
+        scalability strategy) — the thousand-job control plane's full
+        fallback stays tractable at 10k slots.
+
         Returns:
           (allocations, desired_nodes)
         """
+        if (
+            len(nodes) > self._partition_slices
+            and len(jobs) > 1
+        ):
+            allocations, desired = self._optimize_partitioned(
+                jobs, nodes, base_allocations, node_template,
+                quarantined=quarantined,
+            )
+        else:
+            allocations, desired = self._optimize_single(
+                jobs, nodes, base_allocations, node_template,
+                quarantined=quarantined,
+            )
+        self._last_full_desired = desired
+        return allocations, desired
+
+    def _optimize_partitioned(
+        self,
+        jobs,
+        nodes,
+        base_allocations,
+        node_template,
+        quarantined=(),
+    ):
+        """Partition the (jobs, slices) problem into independent
+        sub-problems of at most ``partition_slices`` slices: each job
+        with an allocation lands in the partition that holds its
+        slices (slices of one job are kept together); free slices and
+        queued jobs are dealt round-robin. Deterministic for fixed
+        inputs."""
+        cap = self._partition_slices
+        parts: list[dict] = []  # {"nodes": [keys], "jobs": [keys]}
+        node_part: dict[str, int] = {}
+
+        def new_part() -> int:
+            parts.append({"nodes": [], "jobs": []})
+            return len(parts) - 1
+
+        def smallest_open_part(need: int) -> int:
+            best = None
+            for i, part in enumerate(parts):
+                if len(part["nodes"]) + need <= cap and (
+                    best is None
+                    or len(part["nodes"]) < len(parts[best]["nodes"])
+                ):
+                    best = i
+            return new_part() if best is None else best
+
+        # 1. Jobs with allocations, priority order (pinned, then by
+        # creation): grouped with their slices.
+        def pinned(key):
+            job = jobs[key]
+            return not job.preemptible and bool(
+                base_allocations.get(key)
+            )
+
+        allocated = sorted(
+            (key for key in jobs if base_allocations.get(key)),
+            key=lambda k: (
+                not pinned(k),
+                jobs[k].min_replicas,
+                jobs[k].creation_timestamp,
+                k,
+            ),
+        )
+        for key in allocated:
+            held = sorted(set(base_allocations[key]) & set(nodes))
+            homes = {node_part[s] for s in held if s in node_part}
+            if homes:
+                # A slice shared with an earlier job pins this job to
+                # that partition; its remaining slices follow (the
+                # partition may overflow cap slightly — correctness
+                # beats balance).
+                idx = min(homes)
+            else:
+                idx = smallest_open_part(len(held))
+            parts[idx]["jobs"].append(key)
+            for slot in held:
+                if slot not in node_part:
+                    node_part[slot] = idx
+                    parts[idx]["nodes"].append(slot)
+        # 2. Free slices round-robin into partitions with headroom.
+        free = [s for s in sorted(nodes) if s not in node_part]
+        if not parts:
+            new_part()
+        free_count = [0] * len(parts)
+        cursor = 0
+        for slot in free:
+            for _ in range(len(parts) + 1):
+                idx = cursor % len(parts)
+                cursor += 1
+                if len(parts[idx]["nodes"]) < cap:
+                    break
+            else:
+                idx = new_part()
+                free_count.append(0)
+            node_part[slot] = idx
+            parts[idx]["nodes"].append(slot)
+            free_count[idx] += 1
+        # 3. Queued jobs go where the FREE capacity went (greedy by
+        # remaining free-slice quota, arrival order, lowest-index
+        # tie-break): a blind index round-robin could deterministically
+        # deal a queued job into a partition saturated by pinned
+        # incumbents every cycle while free slices sat elsewhere.
+        queued = sorted(
+            (key for key in jobs if not base_allocations.get(key)),
+            key=lambda k: (jobs[k].creation_timestamp, k),
+        )
+        quota = list(free_count)
+        for key in queued:
+            idx = max(
+                range(len(parts)), key=lambda i: (quota[i], -i)
+            )
+            parts[idx]["jobs"].append(key)
+            quota[idx] -= 1
+
+        allocations: dict = {}
+        desired_total = 0
+        for part in parts:
+            part_jobs = OrderedDict(
+                (key, jobs[key]) for key in part["jobs"]
+            )
+            part_nodes = {key: nodes[key] for key in part["nodes"]}
+            part_base = {
+                key: [
+                    s
+                    for s in base_allocations.get(key, [])
+                    if s in part_nodes
+                ]
+                for key in part["jobs"]
+            }
+            if not part_jobs:
+                desired_total += len(part_nodes)
+                continue
+            sub_alloc, sub_desired = self._optimize_single(
+                part_jobs,
+                part_nodes,
+                part_base,
+                node_template,
+                quarantined=set(quarantined) & set(part_nodes),
+                warm=False,
+            )
+            allocations.update(sub_alloc)
+            desired_total += sub_desired
+        # Per-partition GA populations are not comparable across
+        # cycles; drop the warm-start state rather than seed a later
+        # small cycle from one partition's population.
+        self._prev_population = None
+        self._prev_jobs = []
+        self._prev_nodes = []
+        for key in jobs:
+            allocations.setdefault(key, [])
+        return allocations, desired_total
+
+    def optimize_incremental(
+        self,
+        jobs,
+        nodes,
+        base_allocations,
+        node_template,
+        dirty,
+        quarantined=(),
+        resources=None,
+    ):
+        """Re-optimize only the DIRTY jobs against a pinned background.
+
+        Args:
+          jobs: {job_key: JobInfo} for the dirty jobs ONLY (the caller
+            skips building speedup models for the pinned background).
+          nodes: the full slice inventory.
+          base_allocations: current allocations of EVERY active job —
+            non-dirty jobs keep theirs verbatim; their capacity is
+            subtracted from the inventory the dirty jobs search.
+          dirty: job keys to re-optimize (subset of ``jobs``).
+          resources: {job_key: per-replica resources} for background
+            jobs (defaults to {"tpu": 1}).
+
+        Returns (allocations covering every key in base_allocations
+        and ``jobs``, desired_nodes — the last full cycle's target;
+        autoscaling decisions ride full cycles).
+
+        With no dirty jobs this is a pure pass-through: the committed
+        allocations are returned unchanged and NO search runs.
+        """
+        desired = (
+            self._last_full_desired
+            if self._last_full_desired is not None
+            else len(nodes)
+        )
+        allocations = {
+            key: list(alloc)
+            for key, alloc in base_allocations.items()
+        }
+        dirty = [k for k in jobs if k in set(dirty)]
+        if not dirty:
+            return allocations, desired
+        resources = resources or {}
+        background = {
+            key: alloc
+            for key, alloc in base_allocations.items()
+            if key not in set(dirty) and alloc
+        }
+        # Capacity net of the pinned background, and the slices whose
+        # ICI a distributed background job owns (a distributed dirty
+        # job may not co-claim them; repair enforces it via ici_owned).
+        used: dict[str, dict[str, int]] = {}
+        ici_owned: set[str] = set()
+        for key, alloc in background.items():
+            res = resources.get(key) or {"tpu": 1}
+            distributed = len(alloc) > 1
+            for slot in alloc:
+                slot_used = used.setdefault(slot, {})
+                for rtype, amount in res.items():
+                    slot_used[rtype] = (
+                        slot_used.get(rtype, 0) + int(amount)
+                    )
+                if distributed:
+                    ici_owned.add(slot)
+        # Quarantined slots are NOT pre-filtered here: _optimize_single
+        # owns that policy (drop unless a pinned non-preemptible
+        # incumbent still runs there, else block via the repair mask)
+        # and must see them to apply it — pre-dropping would strip a
+        # pinned dirty job of the slot the full path promises it keeps.
+        sub_nodes = {}
+        for key, node in nodes.items():
+            if key in used:
+                remaining = {
+                    rtype: max(
+                        int(total) - used[key].get(rtype, 0), 0
+                    )
+                    for rtype, total in node.resources.items()
+                }
+                node = dataclasses.replace(node, resources=remaining)
+            sub_nodes[key] = node
+        # Candidate inventory: the dirty jobs' own slices plus the
+        # best free slices in preference order, capped — re-searching
+        # a handful of jobs must not scan a 10k-slot inventory.
+        budget = max(
+            self._incremental_candidates, 4 * max(len(dirty), 1)
+        )
+        if len(sub_nodes) > budget:
+            keep = set()
+            for key in dirty:
+                keep.update(
+                    s
+                    for s in base_allocations.get(key, [])
+                    if s in sub_nodes
+                )
+            # Fill with the emptiest slices first (capacity here is
+            # already net of the pinned background): a dirty job must
+            # be able to GROW into free capacity, not just shuffle
+            # around whatever happens to sort first by name.
+            by_free = sorted(
+                sub_nodes.items(),
+                key=lambda kv: (
+                    kv[1].preemptible,
+                    getattr(kv[1], "hazard", 0.0),
+                    -max(kv[1].resources.values(), default=0),
+                    kv[0],
+                ),
+            )
+            for slot, node in by_free:
+                if len(keep) >= budget:
+                    break
+                keep.add(slot)
+            sub_nodes = {
+                slot: node
+                for slot, node in sub_nodes.items()
+                if slot in keep
+            }
+        sub_jobs = OrderedDict((key, jobs[key]) for key in dirty)
+        sub_base = {
+            key: [
+                s
+                for s in base_allocations.get(key, [])
+                if s in sub_nodes
+            ]
+            for key in dirty
+        }
+        sub_alloc, _ = self._optimize_single(
+            sub_jobs,
+            sub_nodes,
+            sub_base,
+            node_template,
+            quarantined=set(quarantined) & set(sub_nodes),
+            ici_owned=ici_owned,
+            warm=False,
+        )
+        for key in dirty:
+            allocations[key] = sub_alloc.get(key, [])
+        return allocations, desired
+
+    def _optimize_single(
+        self,
+        jobs,
+        nodes,
+        base_allocations,
+        node_template,
+        quarantined=(),
+        ici_owned=(),
+        warm=True,
+    ):
+        """The direct NSGA-II cycle over one (jobs, nodes) problem.
+        ``ici_owned`` slices host a distributed job OUTSIDE this
+        problem (incremental background): repair blocks distributed
+        placements there. ``warm=False`` (partition/incremental
+        sub-problems) neither reads nor stores the cross-cycle
+        warm-start population."""
         blocked_slots: set = set()
         if quarantined:
             protected = {
@@ -168,8 +507,34 @@ class PolluxPolicy:
                     if not pinned(key, job):
                         blocked[j, node_index[slot]] = True
 
-        problem = _Problem(job_list, node_list, base_state, blocked=blocked)
-        seeds = self._seed_population(jobs, nodes, base_state, node_list)
+        owned_mask = None
+        if ici_owned:
+            owned_mask = np.zeros(len(node_list), dtype=bool)
+            for slot in ici_owned:
+                if slot in node_index:
+                    owned_mask[node_index[slot]] = True
+
+        problem = _Problem(
+            job_list,
+            node_list,
+            base_state,
+            blocked=blocked,
+            ici_owned=owned_mask,
+        )
+        if warm:
+            seeds = self._seed_population(
+                jobs, nodes, base_state, node_list
+            )
+        else:
+            seeds = np.concatenate(
+                [
+                    base_state.reshape(1, -1),
+                    self._greedy_seeds(
+                        job_list, node_list, num_real=len(nodes)
+                    ),
+                ],
+                axis=0,
+            )
         population, F, front = nsga2.minimize(
             evaluate=problem.evaluate,
             initial=seeds,
@@ -179,9 +544,10 @@ class PolluxPolicy:
             pop_size=self._pop_size,
             generations=self._generations,
         )
-        self._prev_population = copy.deepcopy(population)
-        self._prev_jobs = list(jobs)
-        self._prev_nodes = list(nodes)
+        if warm:
+            self._prev_population = copy.deepcopy(population)
+            self._prev_jobs = list(jobs)
+            self._prev_nodes = list(nodes)
 
         states = population[front].reshape(
             front.size, len(jobs), len(node_list)
@@ -204,22 +570,82 @@ class PolluxPolicy:
             allocations[key] = alloc
         return allocations, desired_nodes
 
+    @classmethod
+    def _greedy_seeds(cls, job_list, node_list, num_real=None):
+        """Three greedy seeds: the full column set (virtual columns =
+        propose growing the cluster), the REAL slices only (the
+        feasible dense packing the GA needs when the node budget
+        forbids expansion), and a hazard-aware real-only packing —
+        jobs pick in descending restart-cost order with no stagger, so
+        expensive-restart jobs land on the safe slices ``_sorted_
+        nodes`` puts first (the expected-loss optimum the mutation
+        operators rarely reach by a coordinated swap)."""
+        full = cls._greedy_seed(job_list, node_list, num_real=num_real)
+        real_only = cls._greedy_seed(
+            job_list,
+            node_list,
+            num_real=num_real,
+            allow_virtual=False,
+        )
+        costs = [
+            DEFAULT_RESTART_COST_S
+            if job.restart_cost_s is None
+            else float(job.restart_cost_s)
+            for job in job_list
+        ]
+        order = sorted(
+            range(len(job_list)), key=lambda i: (-costs[i], i)
+        )
+        permuted = cls._greedy_seed(
+            [job_list[i] for i in order],
+            node_list,
+            num_real=num_real,
+            allow_virtual=False,
+            stagger=False,
+        ).reshape(len(job_list), -1)
+        hazard_aware = np.zeros_like(permuted)
+        for pos, i in enumerate(order):
+            hazard_aware[i] = permuted[pos]
+        return np.concatenate(
+            [full, real_only, hazard_aware.reshape(1, -1)], axis=0
+        )
+
     @staticmethod
-    def _greedy_seed(job_list, node_list):
+    def _greedy_seed(
+        job_list,
+        node_list,
+        num_real=None,
+        allow_virtual=True,
+        stagger=True,
+    ):
         """Fair round-robin seed: every job first gets its
         max(min_replicas, 1), then jobs grow one replica at a time up
         to their max while capacity lasts, honoring the
         one-multi-replica-job-per-slice ICI rule. Gives the GA a
         dense, fair, feasible starting point — from an all-zeros cold
         start, small populations can fail to discover even obvious
-        packings (and a job-ordered greedy seed starves late jobs)."""
+        packings (and a job-ordered greedy seed starves late jobs).
+
+        Placement is STAGGERED: job j starts its scan at slice
+        ``j % num_real`` instead of slice 0, so min-replicas spread
+        across the cluster. Packing them all onto the lowest-index
+        slices froze growth — the first co-tenant to go distributed
+        claimed the shared slice's ICI, and every other job stranded
+        there could never add a second replica. A job whose existing
+        replicas ARE stranded on a foreign-owned slice relocates
+        wholesale to an unowned slice with room."""
         num_columns = len(node_list)
         num_jobs = len(job_list)
+        if num_real is None:
+            num_real = num_columns
+        num_real = max(min(num_real, num_columns), 1)
         state = np.zeros((num_jobs, num_columns), dtype=int)
         free = [dict(n.resources) for n in node_list]
         owner: list[int | None] = [None] * num_columns  # multi-job claim
 
         def capacity(j, s):
+            if not allow_virtual and s >= num_real:
+                return 0
             caps = [
                 free[s].get(r, 0) // amount
                 for r, amount in job_list[j].resources.items()
@@ -227,12 +653,40 @@ class PolluxPolicy:
             ]
             return min(caps) if caps else 0
 
+        def order_for(j):
+            offset = (j % num_real) if stagger else 0
+            def key(s):
+                if s < num_real:
+                    rotated = (s - offset) % num_real
+                else:
+                    # Virtual (requestable) columns always come after
+                    # every real slice, in order.
+                    rotated = num_real + (s - num_real)
+                return (state[j, s] == 0, rotated)
+            return sorted(range(num_columns), key=key)
+
+        def take(j, s):
+            state[j, s] += 1
+            for r, amount in job_list[j].resources.items():
+                free[s][r] = free[s].get(r, 0) - amount
+
+        def relocate(j, s, want):
+            for t in range(num_columns):
+                if state[j, t]:
+                    for r, amount in job_list[j].resources.items():
+                        free[t][r] = (
+                            free[t].get(r, 0) + amount * state[j, t]
+                        )
+                    if owner[t] == j:
+                        owner[t] = None
+                    state[j, t] = 0
+            owner[s] = j
+            for _ in range(want):
+                take(j, s)
+
         def add_one(j):
             becoming_multi = state[j].sum() + 1 > 1
-            # Prefer slices this job already occupies, then fresh ones.
-            order = sorted(
-                range(num_columns), key=lambda s: (state[j, s] == 0, s)
-            )
+            order = order_for(j)
             for s in order:
                 if capacity(j, s) <= 0:
                     continue
@@ -248,15 +702,22 @@ class PolluxPolicy:
                         for t in range(num_columns):
                             if state[j, t] or t == s:
                                 owner[t] = j
-                        state[j, s] += 1
-                        for r, amount in job_list[j].resources.items():
-                            free[s][r] = free[s].get(r, 0) - amount
+                        take(j, s)
                         return True
                     continue
-                state[j, s] += 1
-                for r, amount in job_list[j].resources.items():
-                    free[s][r] = free[s].get(r, 0) - amount
+                take(j, s)
                 return True
+            if becoming_multi:
+                # Stranded: an existing replica sits on a slice some
+                # other job owns. Move the whole job to an unowned
+                # slice with room for one more replica.
+                want = int(state[j].sum()) + 1
+                for s in order:
+                    if owner[s] is not None or state[j, s]:
+                        continue
+                    if capacity(j, s) >= want:
+                        relocate(j, s, want)
+                        return True
             return False
 
         targets = [max(job.min_replicas, 1) for job in job_list]
@@ -274,7 +735,9 @@ class PolluxPolicy:
         """Warm start from the previous population, remapped across job
         and node churn (reference: pollux.py:94-119), plus a greedy
         first-fit seed."""
-        greedy = self._greedy_seed(list(jobs.values()), node_list)
+        greedy = self._greedy_seeds(
+            list(jobs.values()), node_list, num_real=len(nodes)
+        )
         flat_base = np.concatenate(
             [base_state.reshape(1, -1), greedy], axis=0
         )
@@ -366,7 +829,9 @@ def _select_within_budget(values, max_nodes):
 class _Problem:
     """Objectives + variation operators over allocation matrices."""
 
-    def __init__(self, jobs, nodes, base_state, blocked=None):
+    def __init__(
+        self, jobs, nodes, base_state, blocked=None, ici_owned=None
+    ):
         self.jobs = jobs
         self.nodes = nodes
         self.base_state = base_state
@@ -374,6 +839,10 @@ class _Problem:
         # (jobs, nodes) placements repair must zero: quarantined slots
         # kept in the inventory only for a pinned incumbent's sake.
         self._blocked = blocked
+        # Node columns whose ICI a distributed job OUTSIDE this
+        # problem owns (the incremental path's pinned background):
+        # distributed jobs in this problem may not claim them.
+        self._ici_owned = ici_owned
         num_jobs, num_nodes = self.shape
         self._pinned = np.array(
             [
@@ -538,6 +1007,14 @@ class _Problem:
         states[:, self._pinned] = self.base_state[self._pinned]
         if self._blocked is not None and self._blocked.any():
             states[:, self._blocked] = 0
+        if self._ici_owned is not None and self._ici_owned.any():
+            # Slices ICI-owned by a distributed background job: a
+            # distributed job HERE may not co-claim them (the global
+            # one-distributed-job-per-slice rule, enforced across the
+            # incremental problem boundary).
+            distributed = (states.sum(axis=2) > 1)[:, :, None]
+            owned = self._ici_owned[None, None, :]
+            states = np.where(distributed & owned, 0, states)
         # A distributed job owns its slices' ICI: on every slice, keep
         # only the first distributed job (in the sorted priority
         # order), clearing later claimants. "Distributed" = more than

@@ -38,6 +38,7 @@ import logging
 import math
 import threading
 import time
+from bisect import bisect_left
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -50,6 +51,14 @@ LOG = logging.getLogger(__name__)
 # consumer — allocator skip-list, operator cleanup, runner threads —
 # agrees on one definition.
 FINISHED = ("Succeeded", "Failed", "Stopped")
+
+# Allocator decision-latency buckets (adaptdl_alloc_decide_seconds):
+# incremental cycles live in the millisecond band, full NSGA-II cycles
+# in the 0.1-60s band.
+_ALLOC_DECIDE_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
 
 
 def normalize_topology(topology: dict | None) -> dict:
@@ -271,8 +280,17 @@ class ClusterState:
         reconcile_window: float | None = None,
         snapshot_every: int = 256,
         hazard_tau_s: float | None = None,
+        clock=None,
     ):
         self._cond = threading.Condition()
+        # Injectable clock (``monotonic()`` + ``time()``): defaults to
+        # the real ``time`` module; the discrete-event simulator
+        # (adaptdl_tpu/sim) passes a virtual clock so this exact state
+        # machine runs under simulated time — every internal deadline,
+        # lease stamp, and completion time then derives from event
+        # time, which is what makes a fixed-seed sim bit-reproducible.
+        # Assigned once before any other thread holds a reference.
+        self._clock = time if clock is None else clock
         # The job table is THE cross-component contract: allocator,
         # supervisor, runner, and operator threads all touch it, so
         # every access goes through the condition's lock (graftcheck's
@@ -330,6 +348,19 @@ class ClusterState:
         self._preempt_notices: dict[str, int] = {}  # guarded-by: _cond
         self._slot_kinds: dict[str, str] = {}  # guarded-by: _cond
         self._preemptible_slots: set[str] = set()  # guarded-by: _cond
+        # Incremental allocation: jobs whose scheduling inputs changed
+        # since the allocator last consumed the set — arrivals,
+        # departures, hint/spec updates, preemption notices, lease
+        # expiries. The allocator re-optimizes only these against a
+        # pinned background until dirtiness crosses its full-cycle
+        # threshold. In-memory transient (the post-recovery first
+        # cycle is always full).
+        self._dirty: set[str] = set()  # guarded-by: _cond
+        # Allocator decision telemetry, served by the supervisor's
+        # /metrics as adaptdl_alloc_decide_seconds{mode} (histogram)
+        # and adaptdl_alloc_dirty_jobs (gauge).
+        self._alloc_decide: dict[str, dict] = {}  # guarded-by: _cond
+        self._alloc_last_dirty = 0  # guarded-by: _cond
         # Allocator kick counter: bumped by a preemption notice so the
         # allocator re-places the job DURING the notice window instead
         # of waiting out its cycle interval.
@@ -399,7 +430,7 @@ class ClusterState:
         reconciliation window: recovered leases get grace deadlines and
         pending epochs fresh commit deadlines, so live workers can
         reattach before any expiry/rollback verdicts are reached."""
-        start = time.monotonic()
+        start = self._clock.monotonic()
         snapshot, records, torn = self._journal.load()
         with self._cond:
             if snapshot is not None:
@@ -469,7 +500,7 @@ class ClusterState:
             finally:
                 self._replaying = False
             self._torn_records = torn
-            now = time.monotonic()
+            now = self._clock.monotonic()
             if self._jobs:
                 self._reconcile_until = now + self._reconcile_window
             grace = max(self._reconcile_window, 1.0)
@@ -507,7 +538,7 @@ class ClusterState:
                 op = {"op": "recovered"}
                 self._journal_append(op)
                 self._apply_locked(op, now)
-            self._last_recovery_s = time.monotonic() - start
+            self._last_recovery_s = self._clock.monotonic() - start
             self._cond.notify_all()
 
     # -- replay/apply layer (shared by live mutators and recovery) -----
@@ -560,10 +591,16 @@ class ClusterState:
         )
         self._jobs[key] = record
         self._submitted_total += 1
+        # An arrival is scheduling-relevant: the incremental allocator
+        # must consider this job on its next cycle.
+        self._dirty.add(key)
         return record
 
     def _apply_remove_locked(self, op: dict, now: float) -> None:  # holds-lock: _cond # replay-pure
         self._jobs.pop(op["key"], None)
+        # A departure frees capacity — counted toward the allocator's
+        # dirtiness (redistribution to survivors rides full cycles).
+        self._dirty.add(op["key"])
 
     def _apply_update_locked(  # holds-lock: _cond # replay-pure
         self, op: dict, now: float
@@ -571,6 +608,21 @@ class ClusterState:
         record = self._jobs[op["key"]]
         ts = float(op.get("ts") or 0.0)
         fields = op["fields"]
+        # Scheduling-input changes mark the job dirty for the
+        # incremental allocator: new hints/spec, or a transition into
+        # a terminal status (its capacity frees up). Allocator-written
+        # fields (allocation/topology/batch_config) deliberately do
+        # NOT — the allocator's own publishes must not feed back into
+        # its dirtiness signal.
+        if (
+            "hints" in fields
+            or "spec" in fields
+            or (
+                fields.get("status") in FINISHED
+                and record.status not in FINISHED
+            )
+        ):
+            self._dirty.add(op["key"])
         # A launch-config change is an allocation change OR a
         # topology change on the same slot list — the runners restart
         # workers for either, so either must open a commit epoch (a
@@ -761,6 +813,8 @@ class ClusterState:
             # one was open) resolved into a plain lease expiry.
             record.draining = False
             record.drain_deadline = None
+            # The withdrawn job needs re-placement on the next cycle.
+            self._dirty.add(op["key"])
 
     def _promote_committed_locked(  # holds-lock: _cond
         self, record: JobRecord
@@ -788,7 +842,7 @@ class ClusterState:
         if not self._replaying and record.alloc_prepared_at is not None:
             trace.record_span(
                 "epoch.commit",
-                time.monotonic() - record.alloc_prepared_at,
+                self._clock.monotonic() - record.alloc_prepared_at,
                 traceparent=record.trace_parent,
                 job=record.key,
                 epoch=record.alloc_epoch,
@@ -820,7 +874,7 @@ class ClusterState:
         if not self._replaying and record.alloc_prepared_at is not None:
             trace.record_span(
                 "epoch.rollback",
-                time.monotonic() - record.alloc_prepared_at,
+                self._clock.monotonic() - record.alloc_prepared_at,
                 traceparent=record.trace_parent,
                 job=record.key,
                 epoch=record.alloc_epoch,
@@ -863,6 +917,8 @@ class ClusterState:
         save, and the successor's first step share one trace id."""
         record = self._jobs[op["key"]]
         notice_s = float(op.get("notice_s") or 30.0)
+        # The kicked allocator cycle must re-place this job.
+        self._dirty.add(op["key"])
         record.draining = True
         record.drain_deadline = now + notice_s
         if op.get("trace_parent"):
@@ -916,7 +972,7 @@ class ClusterState:
             return
         op = {"op": "alloc_commit", "key": record.key}
         self._journal_append(op)
-        self._apply_commit_locked(op, time.monotonic())
+        self._apply_commit_locked(op, self._clock.monotonic())
 
     # -- mutators (journaled) ------------------------------------------
 
@@ -930,10 +986,10 @@ class ClusterState:
                 "op": "create_job",
                 "key": key,
                 "spec": dict(spec or {}),
-                "ts": time.time(),
+                "ts": self._clock.time(),
             }
             self._journal_append(op)
-            record = self._apply_create_locked(op, time.monotonic())
+            record = self._apply_create_locked(op, self._clock.monotonic())
             self._cond.notify_all()
             return record
 
@@ -943,7 +999,7 @@ class ClusterState:
                 return
             op = {"op": "remove_job", "key": key}
             self._journal_append(op)
-            self._apply_remove_locked(op, time.monotonic())
+            self._apply_remove_locked(op, self._clock.monotonic())
             self._cond.notify_all()
 
     def update(self, key: str, **fields: Any) -> None:  # journaled
@@ -953,10 +1009,10 @@ class ClusterState:
                 "op": "update",
                 "key": key,
                 "fields": fields,
-                "ts": time.time(),
+                "ts": self._clock.time(),
             }
             self._journal_append(op)
-            self._apply_update_locked(op, time.monotonic())
+            self._apply_update_locked(op, self._clock.monotonic())
             self._cond.notify_all()
 
     def publish_retune(  # journaled
@@ -978,7 +1034,7 @@ class ClusterState:
                 "batch_config": dict(batch_config),
             }
             self._journal_append(op)
-            self._apply_retune_locked(op, time.monotonic())
+            self._apply_retune_locked(op, self._clock.monotonic())
             self._cond.notify_all()
             return True
 
@@ -1009,7 +1065,7 @@ class ClusterState:
                 op["processes"] = int(processes)
             self._journal_append(op)
             accepted = self._apply_register_locked(
-                op, time.monotonic()
+                op, self._clock.monotonic()
             )
             if accepted:
                 self._maybe_commit_locked(record)
@@ -1057,7 +1113,7 @@ class ClusterState:
                 op["group"] = group
             if durable:
                 self._journal_append(op)
-            self._apply_lease_locked(op, time.monotonic())
+            self._apply_lease_locked(op, self._clock.monotonic())
             self._maybe_commit_locked(record)
             return True
 
@@ -1074,7 +1130,7 @@ class ClusterState:
         reconciliation window this is a no-op: recovered workers get
         the window to re-prove liveness before anyone is declared
         dead."""
-        now = time.monotonic() if now is None else now
+        now = self._clock.monotonic() if now is None else now
         expired: list[tuple[str, int]] = []
         with self._cond:
             if now < self._reconcile_until:
@@ -1112,7 +1168,7 @@ class ClusterState:
         consecutive strikes quarantine the slot). Returns the keys of
         rolled-back jobs. Held off during the post-recovery
         reconciliation window, like lease expiry."""
-        now = time.monotonic() if now is None else now
+        now = self._clock.monotonic() if now is None else now
         rolled: list[str] = []
         with self._cond:
             if now < self._reconcile_until:
@@ -1169,7 +1225,7 @@ class ClusterState:
                 return False
             if group is not None and group < record.group:
                 return False
-            now = time.monotonic()
+            now = self._clock.monotonic()
             if record.draining and (
                 record.drain_deadline is None
                 or now < record.drain_deadline
@@ -1207,7 +1263,7 @@ class ClusterState:
                     s: self._slot_kinds.get(s, "spot") for s in slots
                 },
                 "notice_s": notice,
-                "ts": time.time(),
+                "ts": self._clock.time(),
             }
             if rank is not None:
                 op["rank"] = int(rank)
@@ -1271,7 +1327,7 @@ class ClusterState:
         fleet size), decayed to ``now`` (wall clock — the estimate is
         journal-anchored so it survives supervisor restarts)."""
         if now is None:
-            now = time.time()
+            now = self._clock.time()
         with self._cond:
             return self._hazard_rates_locked(float(now))
 
@@ -1292,7 +1348,7 @@ class ClusterState:
     def draining_slots(self, now: float | None = None) -> list[str]:
         """Slots under an active reclaim notice: withdrawn from the
         placement inventory for the notice window."""
-        now = time.monotonic() if now is None else now
+        now = self._clock.monotonic() if now is None else now
         with self._cond:
             self._prune_draining_locked(now)
             return sorted(self._draining_slots)
@@ -1301,8 +1357,8 @@ class ClusterState:
         """Preemption observability in one locked snapshot: notice
         counts and decayed hazard rate per slot kind, plus the slots
         currently draining with their remaining notice window."""
-        wall = time.time()
-        now = time.monotonic() if now is None else now
+        wall = self._clock.time()
+        now = self._clock.monotonic() if now is None else now
         with self._cond:
             self._prune_draining_locked(now)
             return {
@@ -1348,6 +1404,68 @@ class ClusterState:
                     return False
                 self._cond.wait(remaining)
             return True
+
+    # -- incremental allocation (dirty tracking + decide telemetry) ----
+
+    def mark_job_dirty(self, key: str) -> None:
+        """Force the incremental allocator to reconsider ``key`` on
+        its next cycle (tests, operators, external policy nudges)."""
+        with self._cond:
+            self._dirty.add(key)
+
+    def dirty_job_count(self) -> int:
+        with self._cond:
+            return len(self._dirty)
+
+    def consume_dirty_jobs(self) -> set[str]:
+        """Snapshot-and-clear the dirty set (the allocator calls this
+        at the top of each cycle; a mutation landing mid-cycle marks
+        dirty again and is picked up by the next one)."""
+        with self._cond:
+            dirty, self._dirty = self._dirty, set()
+            return dirty
+
+    def note_alloc_cycle(
+        self, seconds: float, dirty: int, mode: str
+    ) -> None:
+        """Record one allocator decision: its latency (histogram per
+        mode — "full" vs "incremental") and the dirty-job count it
+        consumed, for /metrics (adaptdl_alloc_decide_seconds,
+        adaptdl_alloc_dirty_jobs)."""
+        with self._cond:
+            hist = self._alloc_decide.get(mode)
+            if hist is None:
+                hist = {
+                    "counts": [0] * (len(_ALLOC_DECIDE_BUCKETS) + 1),
+                    "sum": 0.0,
+                    "count": 0,
+                }
+                self._alloc_decide[mode] = hist
+            value = max(float(seconds), 0.0)
+            hist["counts"][
+                bisect_left(_ALLOC_DECIDE_BUCKETS, value)
+            ] += 1
+            hist["sum"] += value
+            hist["count"] += 1
+            self._alloc_last_dirty = int(dirty)
+
+    def alloc_cycle_metrics(self) -> dict:
+        """One locked snapshot of the allocator decision telemetry:
+        {"buckets": (...), "modes": {mode: {counts, sum, count}},
+        "last_dirty": N}."""
+        with self._cond:
+            return {
+                "buckets": _ALLOC_DECIDE_BUCKETS,
+                "modes": {
+                    mode: {
+                        "counts": list(hist["counts"]),
+                        "sum": hist["sum"],
+                        "count": hist["count"],
+                    }
+                    for mode, hist in self._alloc_decide.items()
+                },
+                "last_dirty": self._alloc_last_dirty,
+            }
 
     # -- readers -------------------------------------------------------
 
@@ -1449,7 +1567,7 @@ class ClusterState:
 
     def quarantined_slots(self, now: float | None = None) -> list[str]:
         """Slots the allocator must not place jobs on right now."""
-        now = time.monotonic() if now is None else now
+        now = self._clock.monotonic() if now is None else now
         with self._cond:
             self._prune_quarantine_locked(now)
             return sorted(self._quarantined)
@@ -1457,7 +1575,7 @@ class ClusterState:
     def slot_health(self, now: float | None = None) -> dict:
         """Strike counts, quarantine remaining-seconds, and per-job
         rollback totals — one locked snapshot for /metrics//status."""
-        now = time.monotonic() if now is None else now
+        now = self._clock.monotonic() if now is None else now
         with self._cond:
             self._prune_quarantine_locked(now)
             return {
@@ -1479,7 +1597,7 @@ class ClusterState:
                 "lastRecoveryS": self._last_recovery_s,
                 "tornRecords": self._torn_records,
                 "reconcileRemainingS": max(
-                    self._reconcile_until - time.monotonic(), 0.0
+                    self._reconcile_until - self._clock.monotonic(), 0.0
                 ),
             }
 
@@ -1488,7 +1606,7 @@ class ClusterState:
         degraded flag, allocation epoch/state, lease remaining-seconds
         per rank — one locked snapshot."""
         with self._cond:
-            now = time.monotonic()
+            now = self._clock.monotonic()
             jobs = {}
             for key, record in self._jobs.items():
                 jobs[key] = {

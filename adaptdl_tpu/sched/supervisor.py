@@ -576,6 +576,18 @@ class Supervisor(ThreadedHttpServer):
             "slot-second).",
         )
         b.family(
+            "adaptdl_alloc_decide_seconds",
+            "histogram",
+            "Allocator decision latency per cycle, by mode "
+            "(full Pollux search vs incremental dirty-job "
+            "re-optimization).",
+        )
+        b.family(
+            "adaptdl_alloc_dirty_jobs",
+            "gauge",
+            "Dirty jobs consumed by the last allocator cycle.",
+        )
+        b.family(
             "adaptdl_supervisor_recoveries_total",
             "counter",
             "Durable-state recoveries this cluster has performed.",
@@ -669,6 +681,19 @@ class Supervisor(ThreadedHttpServer):
             b.sample(
                 "adaptdl_hazard_rate", {"kind": kind}, round(rate, 9)
             )
+        # Incremental-allocator telemetry: per-mode decision-latency
+        # histograms + the last cycle's dirty-job count.
+        alloc = self._state.alloc_cycle_metrics()
+        for mode in sorted(alloc["modes"]):
+            raw = alloc["modes"][mode]
+            snap = trace.Histogram(tuple(alloc["buckets"]))
+            snap.counts = list(raw["counts"])
+            snap.total = raw["sum"]
+            snap.count = raw["count"]
+            b.histogram(
+                "adaptdl_alloc_decide_seconds", {"mode": mode}, snap
+            )
+        b.sample("adaptdl_alloc_dirty_jobs", value=alloc["last_dirty"])
         recovery = self._state.recovery_info()
         b.sample(
             "adaptdl_supervisor_recoveries_total",

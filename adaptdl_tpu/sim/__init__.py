@@ -1,0 +1,20 @@
+"""graftsim: discrete-event simulation of the elastic TPU cluster.
+
+See :mod:`adaptdl_tpu.sim.engine` for the event loop and
+:mod:`adaptdl_tpu.sim.workload` for the trace format; docs/simulator.md
+is the operator guide.
+"""
+
+from adaptdl_tpu.sim.clock import VirtualClock  # noqa: F401
+from adaptdl_tpu.sim.engine import (  # noqa: F401
+    ClusterSim,
+    SimReport,
+    run_trace,
+)
+from adaptdl_tpu.sim.workload import (  # noqa: F401
+    CATEGORIES,
+    generate_trace,
+    load_trace,
+    resolve_job,
+    write_trace,
+)

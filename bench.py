@@ -992,6 +992,22 @@ def main(quick: bool = False):
             )
     except Exception as exc:  # noqa: BLE001 - optional metric
         _log(f"rescale bench failed: {exc}")
+    # Thousand-job control plane (bench_sched.py): allocator decide
+    # p50/p99 at 1k jobs / 10k slots (cold full cycle vs the
+    # incremental path) + supervisor per-endpoint p99s under
+    # simulated-worker load. Pure CPU control-plane work — runs the
+    # same on every platform.
+    sched_stats = None
+    try:
+        if _remaining() > 75:
+            import bench_sched
+
+            sched_stats = bench_sched.collect(
+                quick=_remaining() < 150
+            )
+            _log(f"sched bench: {sched_stats}")
+    except Exception as exc:  # noqa: BLE001 - optional metric
+        _log(f"sched bench failed: {exc}")
 
     result = dict(_PRIMARY_RESULT)
     result["device_kind"] = jax.devices()[0].device_kind
@@ -1010,6 +1026,8 @@ def main(quick: bool = False):
         result["rescale_breakdown"] = rescale_breakdown
     if rescale_trace is not None:
         result["rescale_trace"] = rescale_trace
+    if sched_stats:
+        result.update(sched_stats)
     print(json.dumps(result))
 
 

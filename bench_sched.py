@@ -1,0 +1,225 @@
+"""bench_sched: the thousand-job control-plane benchmark.
+
+Two phases, one JSON line (merged into the BENCH json by bench.py, or
+printed standalone via ``python bench_sched.py``):
+
+1. **Allocator decision latency at 1k-job steady state.** Builds an
+   in-memory ClusterState with 1000 hint-posting jobs over 1250
+   slices (10k chips), runs one COLD full Pollux cycle (the
+   partitioned search), then measures the incremental path on the
+   hints-changed-for-1%-of-jobs scenario: per-cycle p50/p99 plus the
+   cold:incremental speedup ratio (the acceptance bar is >= 5x).
+
+2. **Supervisor load.** Starts a real Supervisor over HTTP and
+   hammers /heartbeat, /hints, and /discover from simulated worker
+   PROCESSES, reporting per-endpoint p50/p99 against SLOs.
+
+Latency numbers are wall-clock medians over enough iterations to be
+stable on a noisy CI box; SLOs are deliberately generous for shared
+hardware (the trend line across BENCH_r*.json files is the signal).
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing
+import sys
+import time
+
+from adaptdl_tpu.sched.allocator import Allocator
+from adaptdl_tpu.sched.policy import NodeInfo, PolluxPolicy
+from adaptdl_tpu.sched.state import ClusterState
+from adaptdl_tpu.sim.workload import (
+    generate_trace,
+    hints_payload,
+    percentile as _pct,
+    resolve_job,
+)
+
+# Per-endpoint p99 SLOs (seconds) for the load phase. Generous for
+# shared CI hardware; the supervisor offloads journaled mutations to
+# an executor, so these hold with margin on an idle box.
+SLOS = {"heartbeat": 0.25, "hints": 0.50, "discover": 0.50}
+
+
+def bench_allocator(
+    jobs: int = 1000,
+    slices: int = 1250,
+    chips_per_slice: int = 8,
+    dirty_fraction: float = 0.01,
+    iterations: int = 12,
+    seed: int = 42,
+) -> dict:
+    """Cold full-cycle latency vs incremental-path p50/p99 at steady
+    state with ``dirty_fraction`` of jobs posting changed hints."""
+    state = ClusterState(state_dir="", alloc_commit_timeout=0.0)
+    nodes = {
+        f"slice-{i:05d}": NodeInfo(
+            resources={"tpu": chips_per_slice}
+        )
+        for i in range(slices)
+    }
+    policy = PolluxPolicy(
+        pop_size=16, generations=10, util_band=(0.0, 1.0)
+    )
+    allocator = Allocator(
+        state,
+        nodes,
+        node_template=NodeInfo(resources={"tpu": chips_per_slice}),
+        policy=policy,
+        # The bench drives full-vs-incremental explicitly: disable
+        # the periodic forced full cycle so the steady-state numbers
+        # measure the incremental path alone.
+        full_every=10**9,
+        dirty_threshold=0.5,
+    )
+    specs = [
+        resolve_job(record)
+        for record in generate_trace(jobs, 3600.0, seed=seed)
+    ]
+    for spec in specs:
+        state.create_job(
+            spec.key,
+            spec={
+                "min_replicas": 0,
+                "max_replicas": spec.max_replicas,
+                "resources": {"tpu": 1},
+            },
+        )
+        state.update(
+            spec.key, status="Running", hints=hints_payload(spec, profiled=4)
+        )
+    # Cold: the full (partitioned) search over all 1k jobs.
+    t0 = time.monotonic()
+    allocator.optimize_once()
+    cold_s = time.monotonic() - t0
+    # Steady state: each cycle, 1% of jobs post changed hints.
+    dirty_n = max(int(jobs * dirty_fraction), 1)
+    latencies = []
+    for it in range(iterations):
+        for k in range(dirty_n):
+            spec = specs[(it * dirty_n + k) % len(specs)]
+            state.update(
+                spec.key,
+                hints=hints_payload(spec, profiled=4 + (it % 3)),
+            )
+        t0 = time.monotonic()
+        allocator.optimize_once()
+        latencies.append(time.monotonic() - t0)
+    metrics = state.alloc_cycle_metrics()
+    incr_cycles = metrics["modes"].get("incremental", {}).get(
+        "count", 0
+    )
+    p50 = _pct(latencies, 0.5)
+    return {
+        "alloc_bench_jobs": jobs,
+        "alloc_bench_slots": slices * chips_per_slice,
+        "alloc_decide_cold_s": round(cold_s, 4),
+        "alloc_decide_p50_s": round(p50, 4),
+        "alloc_decide_p99_s": round(_pct(latencies, 0.99), 4),
+        "alloc_incremental_cycles": incr_cycles,
+        "alloc_incremental_speedup": round(cold_s / max(p50, 1e-9), 1),
+    }
+
+
+def _worker_main(url, job_keys, seconds, out_queue):
+    """One simulated worker process: loops heartbeat + hints + a
+    discover poll against the live supervisor, timing each request."""
+    import requests
+
+    session = requests.Session()
+    lat = {"heartbeat": [], "hints": [], "discover": []}
+    deadline = time.monotonic() + seconds
+    i = 0
+    hints = {
+        "perfParams": None,
+        "gradParams": None,
+        "initBatchSize": 128,
+    }
+    while time.monotonic() < deadline:
+        key = job_keys[i % len(job_keys)]
+        i += 1
+        t0 = time.monotonic()
+        session.put(f"{url}/heartbeat/{key}/0?group=0", timeout=10)
+        lat["heartbeat"].append(time.monotonic() - t0)
+        t0 = time.monotonic()
+        session.put(f"{url}/hints/{key}", json=hints, timeout=10)
+        lat["hints"].append(time.monotonic() - t0)
+        t0 = time.monotonic()
+        session.get(
+            f"{url}/discover/{key}/0?replicas=1", timeout=10
+        )
+        lat["discover"].append(time.monotonic() - t0)
+    out_queue.put(lat)
+
+
+def bench_supervisor(
+    jobs: int = 50, workers: int = 8, seconds: float = 6.0
+) -> dict:
+    """Per-endpoint p50/p99 under concurrent simulated-worker load."""
+    from adaptdl_tpu.sched.supervisor import Supervisor
+
+    state = ClusterState(state_dir="", alloc_commit_timeout=0.0)
+    job_keys = []
+    for i in range(jobs):
+        key = f"bench/j{i:04d}"
+        state.create_job(key, spec={"max_replicas": 4})
+        state.update(key, status="Running", allocation=["local"])
+        # Pre-register rank 0 so /discover resolves instantly instead
+        # of long-polling the whole load window.
+        state.register_worker(key, 0, 0, "127.0.0.1:0")
+        job_keys.append(key)
+    supervisor = Supervisor(state, lease_ttl=60.0)
+    url = supervisor.start()
+    ctx = multiprocessing.get_context("fork")
+    queue = ctx.Queue()
+    procs = [
+        ctx.Process(
+            target=_worker_main,
+            args=(url, job_keys[w::workers] or job_keys, seconds, queue),
+            daemon=True,
+        )
+        for w in range(workers)
+    ]
+    for proc in procs:
+        proc.start()
+    merged = {"heartbeat": [], "hints": [], "discover": []}
+    for _ in procs:
+        lat = queue.get(timeout=seconds * 5 + 60)
+        for endpoint, values in lat.items():
+            merged[endpoint].extend(values)
+    for proc in procs:
+        proc.join(timeout=30)
+    supervisor.stop()
+    out = {"sched_load_workers": workers, "sched_load_seconds": seconds}
+    slo_ok = True
+    for endpoint, values in merged.items():
+        p99 = _pct(values, 0.99)
+        out[f"sched_{endpoint}_p50_s"] = round(_pct(values, 0.5), 5)
+        out[f"sched_{endpoint}_p99_s"] = round(p99, 5)
+        out[f"sched_{endpoint}_rps"] = round(
+            len(values) / max(seconds, 1e-9), 1
+        )
+        slo_ok = slo_ok and p99 <= SLOS[endpoint]
+    out["sched_slo_ok"] = slo_ok
+    return out
+
+
+def collect(quick: bool = False) -> dict:
+    """Everything on one dict (bench.py merges this into BENCH)."""
+    out = {}
+    out.update(
+        bench_allocator(jobs=200, slices=250, iterations=6)
+        if quick
+        else bench_allocator()
+    )
+    out.update(
+        bench_supervisor(jobs=20, workers=4, seconds=3.0)
+        if quick
+        else bench_supervisor()
+    )
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(collect(quick="--quick" in sys.argv)))

@@ -1,0 +1,35 @@
+"""GC9xx known-bad: wall clocks / env / RNG construction hiding on
+the simulated path — each would silently break trace determinism."""
+
+import os
+import random
+import time
+
+
+class LeakyClock:
+    def __init__(self, start=0.0):
+        self._now = float(start)
+
+    def monotonic(self):  # replay-pure
+        return self._now or time.monotonic()  # line 14: GC901 clock
+
+    def time(self):  # replay-pure
+        return time.time()  # line 17: GC901 wall clock
+
+
+class LeakyEngine:
+    def __init__(self, clock):
+        self.clock = clock
+
+    def advance_progress(self, t):  # replay-pure
+        debug = os.environ.get("SIM_DEBUG")  # line 25: GC901 env read
+        self.clock._now = t
+        return debug
+
+    def next_interarrival(self, rate):  # replay-pure
+        rng = random.Random()  # line 30: GC901 RNG construction
+        return rng.expovariate(rate)
+
+    def checkpoint(self, path):  # replay-pure
+        with open(path, "w") as f:  # line 34: GC901 file I/O
+            f.write("state")

@@ -1,0 +1,37 @@
+"""GC9xx known-good: graftsim clock/event plumbing — virtual time
+only, every value derived from event timestamps or seeded state."""
+
+
+class VirtualClock:
+    def __init__(self, start=0.0):
+        self._now = float(start)
+        self._wall_base = 1_600_000_000.0
+
+    def monotonic(self):  # replay-pure
+        return self._now
+
+    def time(self):  # replay-pure
+        return self._wall_base + self._now
+
+    def advance_to(self, t):  # replay-pure
+        if t < self._now:
+            raise ValueError("clock cannot run backward")
+        self._now = float(t)
+
+
+class Engine:
+    def __init__(self, clock, rng):
+        self.clock = clock
+        self._rng = rng  # seeded by the (unannotated) constructor
+        self._work = {}
+
+    def advance_progress(self, t, rates):  # replay-pure
+        dt = t - self.clock.monotonic()
+        for key, rate in rates.items():
+            self._work[key] = self._work.get(key, 0.0) + rate * dt
+        self.clock.advance_to(t)
+
+    def next_interarrival(self, rate):  # replay-pure
+        # Sampling from the stored seeded RNG is fine; CONSTRUCTING
+        # an RNG here would not be.
+        return self._rng.expovariate(rate)

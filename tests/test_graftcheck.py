@@ -145,6 +145,20 @@ def test_replay_purity_transitive_finding_names_path():
     assert "_apply_commit_locked" in via[0].message
 
 
+def test_sim_replay_purity_bad():
+    """graftsim's determinism contract: wall clocks, env reads, RNG
+    construction, and file I/O on `# replay-pure` sim plumbing are
+    caught at the exact line (a hidden time.time() would silently
+    break trace determinism)."""
+    findings = run_on("simpure_bad.py")
+    assert rule_lines(findings, "GC901") == [14, 17, 25, 30, 34]
+    assert {f.rule for f in findings} == {"GC901"}
+
+
+def test_sim_replay_purity_good():
+    assert run_on("simpure_good.py") == []
+
+
 def test_spmd_divergence_bad():
     """The acceptance gate: a deliberately rank-divergent collective
     is caught at the exact line — including the equal-multiset,

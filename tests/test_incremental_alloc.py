@@ -1,0 +1,300 @@
+"""Incremental-allocator equivalence + fallback tests (graftsim PR).
+
+The contract: with no dirty jobs the incremental path returns the
+committed allocations UNCHANGED and runs no search at all; a single
+dirty job converges to the same allocation the cold path finds on
+small deterministic cases; and the forced-full-cycle fallback
+(ADAPTDL_ALLOC_FULL_EVERY / dirty-fraction threshold / inventory
+change) actually fires.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from adaptdl_tpu.goodput import GoodputFunction, GradParams, PerfParams
+from adaptdl_tpu.sched.allocator import Allocator
+from adaptdl_tpu.sched.policy import (
+    JobInfo,
+    NodeInfo,
+    PolluxPolicy,
+    SpeedupFunction,
+)
+from adaptdl_tpu.sched.policy import nsga2
+from adaptdl_tpu.sched.state import ClusterState
+
+PERF = PerfParams(0.121, 0.00568, 0.0236, 0.00634, 0.0118, 0.00317, 1.14)
+GRAD = GradParams(sqr=0.00136, var=0.000502)
+
+HINTS = {
+    "perfParams": dict(PERF._asdict()),
+    "gradParams": dict(GRAD._asdict()),
+    "initBatchSize": 128,
+    "maxBatchSize": 1280,
+    "localBszBounds": [64, 256],
+    "gradientAccumulation": True,
+    "maxProfiledReplicas": 4,
+}
+
+
+def _job(ts=0.0, max_replicas=8):
+    return JobInfo(
+        resources={"tpu": 1},
+        speedup_fn=SpeedupFunction(
+            GoodputFunction(PERF, GRAD, 128),
+            max_batch_size=1280,
+            atomic_bsz_range=(64, 256),
+            accumulation=True,
+        ),
+        creation_timestamp=ts,
+        min_replicas=0,
+        max_replicas=max_replicas,
+    )
+
+
+def _nodes(n=2, chips=4):
+    return {
+        f"slice-{i}": NodeInfo(resources={"tpu": chips})
+        for i in range(n)
+    }
+
+
+def _no_search(monkeypatch):
+    def boom(*args, **kwargs):
+        raise AssertionError("the search ran on a no-dirty cycle")
+
+    monkeypatch.setattr(nsga2, "minimize", boom)
+
+
+def test_no_dirty_jobs_returns_base_without_search(monkeypatch):
+    policy = PolluxPolicy(pop_size=16, generations=8)
+    base = {"a": ["slice-0", "slice-0"], "b": ["slice-1"]}
+    _no_search(monkeypatch)
+    allocations, _ = policy.optimize_incremental(
+        {},
+        _nodes(),
+        base,
+        NodeInfo(resources={"tpu": 4}),
+        dirty=set(),
+    )
+    assert allocations == base
+    # And the returned dict is a copy, not an alias into the caller's
+    # committed state.
+    allocations["a"].append("slice-1")
+    assert base["a"] == ["slice-0", "slice-0"]
+
+
+def test_single_dirty_job_matches_cold_path():
+    """On a small deterministic case (fixed GA seed, identical
+    inputs) the incremental re-optimization of the one dirty job
+    converges to the allocation the cold full search finds."""
+    nodes = _nodes(2, chips=4)
+    template = NodeInfo(resources={"tpu": 4})
+    cold_policy = PolluxPolicy(pop_size=24, generations=20)
+    cold, _ = cold_policy.optimize(
+        {"solo": _job()}, dict(nodes), {}, template
+    )
+    incr_policy = PolluxPolicy(pop_size=24, generations=20)
+    incr, _ = incr_policy.optimize_incremental(
+        {"solo": _job()},
+        dict(nodes),
+        {"solo": []},
+        template,
+        dirty={"solo"},
+    )
+    assert sorted(incr["solo"]) == sorted(cold["solo"])
+    assert len(cold["solo"]) > 0
+
+
+def test_incremental_pins_background_and_its_capacity():
+    """Non-dirty jobs keep their allocation verbatim; the dirty job
+    grows only into capacity the background does not occupy."""
+    nodes = _nodes(2, chips=4)
+    template = NodeInfo(resources={"tpu": 4})
+    base = {
+        "bg": ["slice-0"] * 4,  # slice-0 full
+        "dirty": [],
+    }
+    policy = PolluxPolicy(pop_size=24, generations=20)
+    allocations, _ = policy.optimize_incremental(
+        {"dirty": _job()},
+        nodes,
+        base,
+        template,
+        dirty={"dirty"},
+        resources={"bg": {"tpu": 1}},
+    )
+    assert allocations["bg"] == ["slice-0"] * 4
+    assert allocations["dirty"], "free capacity must be used"
+    assert set(allocations["dirty"]) == {"slice-1"}
+
+
+def test_incremental_respects_background_ici_ownership():
+    """A distributed background job owns its slice's ICI: the dirty
+    job may not land a DISTRIBUTED placement there, even though raw
+    chip capacity remains."""
+    nodes = _nodes(2, chips=8)
+    template = NodeInfo(resources={"tpu": 8})
+    base = {"bg": ["slice-0", "slice-0"], "dirty": []}
+    policy = PolluxPolicy(pop_size=24, generations=20)
+    allocations, _ = policy.optimize_incremental(
+        {"dirty": _job()},
+        nodes,
+        base,
+        template,
+        dirty={"dirty"},
+        resources={"bg": {"tpu": 1}},
+    )
+    dirty_alloc = allocations["dirty"]
+    if len(dirty_alloc) > 1:
+        assert "slice-0" not in set(dirty_alloc), (
+            "distributed placement on a slice a distributed "
+            "background job owns"
+        )
+
+
+class _SpyPolicy(PolluxPolicy):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.calls = []
+
+    def optimize(self, *args, **kwargs):
+        self.calls.append("full")
+        return super().optimize(*args, **kwargs)
+
+    def optimize_incremental(self, *args, **kwargs):
+        self.calls.append("incremental")
+        return super().optimize_incremental(*args, **kwargs)
+
+
+def _cluster(policy, full_every=4, dirty_threshold=0.9):
+    state = ClusterState(alloc_commit_timeout=0.0)
+    for i in range(4):
+        key = f"t/j{i}"
+        state.create_job(key, spec={"max_replicas": 4})
+        state.update(key, status="Running", hints=dict(HINTS))
+    allocator = Allocator(
+        state,
+        _nodes(2, chips=4),
+        node_template=NodeInfo(resources={"tpu": 4}),
+        policy=policy,
+        full_every=full_every,
+        dirty_threshold=dirty_threshold,
+    )
+    return state, allocator
+
+
+def test_allocator_first_cycle_full_then_incremental():
+    policy = _SpyPolicy(pop_size=16, generations=8)
+    state, allocator = _cluster(policy)
+    allocator.optimize_once()
+    assert policy.calls == ["full"]
+    # One job's hints change -> dirty -> the next cycle re-optimizes
+    # incrementally (1 dirty of 4 jobs, under the 0.9 threshold).
+    state.update("t/j0", hints=dict(HINTS, maxProfiledReplicas=2))
+    allocator.optimize_once()
+    assert policy.calls == ["full", "incremental"]
+    metrics = state.alloc_cycle_metrics()
+    assert metrics["modes"]["full"]["count"] == 1
+    assert metrics["modes"]["incremental"]["count"] == 1
+    assert metrics["last_dirty"] == 1
+
+
+def test_allocator_forced_full_cycle_fires():
+    """Every Nth cycle falls back to the full search regardless of
+    dirtiness (ADAPTDL_ALLOC_FULL_EVERY semantics)."""
+    policy = _SpyPolicy(pop_size=16, generations=8)
+    state, allocator = _cluster(policy, full_every=3)
+    for _ in range(6):
+        allocator.optimize_once()
+    # Cycles 1 (first), 3 and 6 (every 3rd) are full.
+    assert policy.calls == [
+        "full", "incremental", "full", "incremental",
+        "incremental", "full",
+    ]
+
+
+def test_allocator_dirty_fraction_forces_full():
+    policy = _SpyPolicy(pop_size=16, generations=8)
+    state, allocator = _cluster(
+        policy, full_every=100, dirty_threshold=0.25
+    )
+    allocator.optimize_once()
+    # 3 of 4 jobs dirty > 25% -> full fallback.
+    for key in ("t/j0", "t/j1", "t/j2"):
+        state.update(key, hints=dict(HINTS, maxProfiledReplicas=2))
+    allocator.optimize_once()
+    assert policy.calls == ["full", "full"]
+
+
+def test_allocator_inventory_change_forces_full():
+    policy = _SpyPolicy(pop_size=16, generations=8)
+    state = ClusterState(alloc_commit_timeout=0.0)
+    state.create_job("t/j0", spec={"max_replicas": 4})
+    state.update("t/j0", status="Running", hints=dict(HINTS))
+    inventory = _nodes(2, chips=4)
+    allocator = Allocator(
+        state,
+        lambda: dict(inventory),
+        node_template=NodeInfo(resources={"tpu": 4}),
+        policy=policy,
+        full_every=100,
+        dirty_threshold=0.9,
+    )
+    allocator.optimize_once()
+    allocator.optimize_once()  # nothing changed: incremental no-op
+    inventory["slice-new"] = NodeInfo(resources={"tpu": 4})
+    allocator.optimize_once()
+    assert policy.calls == ["full", "incremental", "full"]
+
+
+def test_allocator_publish_does_not_mark_dirty():
+    """The allocator's own allocation publishes must not feed back
+    into the dirtiness signal (a self-sustaining full-cycle loop)."""
+    policy = _SpyPolicy(pop_size=16, generations=8)
+    state, allocator = _cluster(policy)
+    allocator.optimize_once()
+    assert state.dirty_job_count() == 0
+    allocator.optimize_once()
+    assert policy.calls[-1] == "incremental"
+
+
+def test_failed_cycle_remarks_dirty(monkeypatch):
+    policy = _SpyPolicy(pop_size=16, generations=8)
+    state, allocator = _cluster(policy)
+    allocator.optimize_once()
+    state.update("t/j0", hints=dict(HINTS, maxProfiledReplicas=2))
+    assert state.dirty_job_count() == 1
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("injected optimizer failure")
+
+    monkeypatch.setattr(policy, "optimize_incremental", boom)
+    with pytest.raises(RuntimeError):
+        allocator.optimize_once()
+    # The consumed dirty set survived the failure for the next cycle.
+    assert state.dirty_job_count() == 1
+
+
+def test_metrics_families_exposed():
+    """adaptdl_alloc_decide_seconds{mode} and adaptdl_alloc_dirty_jobs
+    appear on /metrics after a cycle (the Grafana panels' families)."""
+    from adaptdl_tpu.sched.supervisor import Supervisor
+
+    policy = _SpyPolicy(pop_size=16, generations=8)
+    state, allocator = _cluster(policy)
+    allocator.optimize_once()
+    supervisor = Supervisor(state, lease_ttl=0.0)
+    url = supervisor.start()
+    try:
+        from adaptdl_tpu import rpc
+
+        text = rpc.default_client().get(
+            f"{url}/metrics", endpoint="test/metrics", timeout=10
+        ).text
+    finally:
+        supervisor.stop()
+    assert 'adaptdl_alloc_decide_seconds_bucket{mode="full"' in text
+    assert "adaptdl_alloc_decide_seconds_count" in text
+    assert "adaptdl_alloc_dirty_jobs" in text

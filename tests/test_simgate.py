@@ -1,0 +1,75 @@
+"""The simgate: the committed 1k-job / 10k-slot trace through the
+real scheduler (``make simgate`` / the simgate CI job).
+
+Asserts the graftsim acceptance bar: (a) the deterministic summary is
+BIT-identical across two same-seed runs, (b) simulated-goodput
+retention vs the fixed-allocation baseline is >= 1.0, and (c) the
+run fits the CPU-harness wall budget. ``slow``-marked — tier-1
+carries seconds-scale equivalents in tests/test_sim.py; this tier is
+minutes.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from adaptdl_tpu.sim import load_trace, run_trace
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACE = os.path.join(REPO, "traces", "pollux-1k.jsonl")
+
+GATE = dict(slices=1250, chips_per_slice=8, seed=42, interval=60.0)
+# One adaptive replay of the committed trace must fit this budget on
+# the CPU harness (override for slower boxes).
+WALL_BUDGET_S = float(os.environ.get("SIMGATE_BUDGET_S", "60"))
+
+
+@pytest.fixture(scope="module")
+def gate_runs():
+    records = load_trace(TRACE)
+    assert len(records) == 1000
+    first = run_trace(records, **GATE)
+    second = run_trace(records, **GATE)
+    fixed = run_trace(records, fixed=True, **GATE)
+    return first, second, fixed
+
+
+def test_simgate_deterministic_summary(gate_runs):
+    first, second, _ = gate_runs
+    assert first.summary_json() == second.summary_json()
+    assert first.summary()["completed"] == 1000
+
+
+def test_simgate_goodput_retention(gate_runs):
+    first, _, fixed = gate_runs
+    retention = first.summary()["avg_goodput_x_ideal"] / max(
+        fixed.summary()["avg_goodput_x_ideal"], 1e-9
+    )
+    assert retention >= 1.0, (
+        f"adaptive scheduling lost to the fixed baseline: "
+        f"retention {retention:.4f}"
+    )
+
+
+def test_simgate_wall_budget(gate_runs):
+    first, second, _ = gate_runs
+    wall = min(
+        first.latency()["sim_wall_s"], second.latency()["sim_wall_s"]
+    )
+    assert wall < WALL_BUDGET_S, (
+        f"1k-job / 10k-slot replay took {wall:.1f}s "
+        f"(budget {WALL_BUDGET_S:.0f}s)"
+    )
+
+
+def test_simgate_decision_latency_reported(gate_runs):
+    first, _, _ = gate_runs
+    latency = first.latency()
+    assert latency["alloc_decisions"] > 50
+    assert 0 < latency["alloc_decide_p50_s"] < 10
+    assert latency["alloc_cycles_by_mode"].get("incremental", 0) > 0
+    assert latency["alloc_cycles_by_mode"].get("full", 0) > 0
