@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched chaos-preempt trace-gate simgate bench-sched probe
+.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched chaos-preempt trace-gate rescale-fast simgate bench-sched probe
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -70,6 +70,16 @@ chaos-preempt:
 trace-gate:
 	$(CPU_ENV) $(PY) -m pytest tests/test_trace.py -q \
 	    -k "overhead or bounded or conformant" --durations=5
+
+# Sub-second-rescale gate (docs/checkpointing.md "Peer-to-peer
+# handoff"): the planned-rescale path must restore entirely from the
+# predecessor's shard server — handoff spans recorded, ZERO
+# checkpoint-storage reads (no ckpt.restore span, empty storage dir)
+# — and every delta-chain / fallback correctness property must hold.
+rescale-fast:
+	$(CPU_ENV) $(PY) -m pytest tests/test_delta_handoff.py \
+	    tests/test_bench.py::test_rescale_breakdown_sums_consistently \
+	    -q --durations=5
 
 # graftsim gate (docs/simulator.md): the committed 1k-job / 10k-slot
 # trace through the REAL scheduler under a virtual clock — the

@@ -165,6 +165,21 @@ def initialize_job(distributed: bool | None = None) -> None:
         from adaptdl_tpu.sched import preemption
 
         preemption.ensure_listener()
+        if env.handoff_enabled() and env.num_restarts() > 0:
+            # Successor of a planned rescale: warm the peer-to-peer
+            # handoff discovery (supervisor advertisement / descriptor
+            # file) and its manifest on a side thread, overlapping the
+            # rest of bootstrap — by the time the trainer's
+            # load_state runs, chunk pulls start immediately. A miss
+            # costs nothing: the restore falls back to the durable
+            # checkpoint.
+            from adaptdl_tpu import handoff
+
+            threading.Thread(
+                target=handoff.prefetch,
+                name="adaptdl-handoff-prefetch",
+                daemon=True,
+            ).start()
         if not collective.initialized():
             master = peers.get(0) if peers else None
             collective.initialize(

@@ -403,7 +403,23 @@ class AdaptiveDataLoader:
                     LOG.info(
                         "graceful exit: saving states and exiting 143"
                     )
-                    checkpoint.save_all_states()
+                    serve = env.handoff_enabled()
+                    handle = checkpoint.save_all_states(
+                        retain_snapshots=serve
+                    )
+                    # PLANNED rescale (no reclaim notice — the VM
+                    # survives us): leave a detached shard server
+                    # behind so the successor pulls state peer-to-peer
+                    # instead of round-tripping through storage. The
+                    # durable save above stays the fallback, and the
+                    # server reuses ITS retained snapshots — one
+                    # device->host pass, identical bytes both ways.
+                    if serve:
+                        from adaptdl_tpu import handoff
+
+                        handoff.spawn_server(
+                            snapshots=handle.snapshots
+                        )
                 sys.exit(_signal.GRACEFUL_EXIT_CODE)
         self._exit_future = collective.allreduce_async(
             bool(_signal.get_exit_flag()), lambda vs: any(vs)

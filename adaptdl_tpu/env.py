@@ -200,6 +200,48 @@ def checkpoint_every_steps() -> int:
     return _get_int("ADAPTDL_CKPT_EVERY_STEPS", 0)
 
 
+def ckpt_full_every() -> int:
+    """Force a FULL checkpoint every Nth save; the saves in between
+    write *differential* checkpoints (only the chunks whose content
+    hash changed since the last full snapshot, Check-N-Run NSDI'22
+    style). 1 — the default — disables deltas entirely: every save is
+    a full checkpoint, the pre-delta behavior. A drain/preemption
+    final save is always forced full regardless of this cadence."""
+    return max(_get_int("ADAPTDL_CKPT_FULL_EVERY", 1), 1)
+
+
+def handoff_enabled() -> bool:
+    """Whether planned rescales use the peer-to-peer shard handoff:
+    the doomed incarnation serves its in-memory snapshot chunks over
+    a small HTTP shard server and the successor pulls exactly the
+    chunks it needs, skipping the checkpoint-storage round-trip.
+    Default OFF (unset/empty): the runners opt their jobs in; any
+    handoff failure falls back to the durable checkpoint."""
+    knob = os.environ.get("ADAPTDL_HANDOFF", "")
+    return knob.lower() in ("on", "1", "true", "yes")
+
+
+def handoff_url() -> str | None:
+    """Explicit base URL of a predecessor's handoff shard server (the
+    successor's discovery normally goes descriptor-file → supervisor;
+    this override short-circuits both — tests, bench, single-box)."""
+    return _get_str("ADAPTDL_HANDOFF_URL")
+
+
+def handoff_ttl_s() -> float:
+    """Seconds the spawned handoff shard server lingers waiting for
+    the successor before giving up and exiting (the durable checkpoint
+    then serves the restore, exactly as if no handoff existed)."""
+    return max(_get_float("ADAPTDL_HANDOFF_TTL_S", 60.0), 1.0)
+
+
+def handoff_timeout_s() -> float:
+    """Overall deadline for the successor's handoff fetch (manifest +
+    chunks); past it the restore falls back to the durable checkpoint
+    rather than stall the restart on a dead or slow peer."""
+    return max(_get_float("ADAPTDL_HANDOFF_TIMEOUT_S", 10.0), 0.1)
+
+
 def supervisor_url() -> str | None:
     """Base URL of the cluster supervisor (rendezvous + sched hints)."""
     return _get_str("ADAPTDL_SUPERVISOR_URL")

@@ -56,8 +56,15 @@ INJECTION_POINTS = {
     "ckpt.manifest.write": "integrity manifest write, pre-rename",
     "ckpt.write.pre_rename": "after all writes, before the atomic rename",
     "ckpt.write.post_rename": "after the rename, before pruning",
+    # differential checkpoints (checkpoint._write_snapshots delta path)
+    "ckpt.delta_write": "delta-container serialization into the temp dir",
     # sharded payload store (sharded_checkpoint.sync)
     "ckpt.sharded.payload": "orbax payload save into the versioned dir",
+    # peer-to-peer shard handoff (handoff.py; serve faults become 500s
+    # on the shard server, fetch faults abort the successor's pull —
+    # both must fall back to the durable checkpoint)
+    "handoff.serve": "shard-server chunk handler (doomed incarnation)",
+    "handoff.fetch": "before each chunk fetch on the successor",
     # resilient RPC client (rpc.RpcClient.request)
     "rpc.request.send": "before each HTTP attempt leaves the client",
     "rpc.response.recv": "after a response arrives, before it is returned",

@@ -1,0 +1,700 @@
+"""Peer-to-peer state handoff for planned rescales.
+
+A planned rescale (the runner's SIGTERM → save → exit-143 → relaunch
+cycle) round-trips the full training state through checkpoint storage
+even though the predecessor held every byte in memory moments before
+the successor asks for it. This module closes that loop: during the
+prepare→commit allocation epoch the doomed incarnation serves its
+in-memory snapshot chunks over a small HTTP *shard server*, and the
+successor pulls exactly the chunks its registered states need —
+range-addressed by ``(state, chunk)``, each chunk sha256-verified —
+skipping the storage round-trip entirely. Any failure (peer death,
+timeout, hash mismatch, injected fault) makes ``try_restore`` return
+False and ``checkpoint.load_state`` falls back to the durable
+checkpoint with zero correctness loss: the served chunks are snapshot
+at drain time *after* the final blocking save, so peer and storage
+hold the same version.
+
+Server side (doomed incarnation):
+
+- :func:`collect_chunks` snapshots every registered ``State`` into
+  named chunks — per-leaf for chunk-capable states
+  (``State.snapshot_chunks``), one opaque ``__payload__`` blob for the
+  rest — so the successor can fetch at whatever granularity its new
+  sharding needs (a re-sharding successor re-materializes leaves onto
+  its own mesh exactly as the storage restore path does).
+- :class:`HandoffServer` serves ``GET /manifest`` (chunk orders +
+  sha256 tables), ``GET /chunk/{state}/{chunk}`` (raw bytes), and
+  ``POST /done`` (the successor's "got everything" signal).
+- :func:`spawn_server` forks the server into a *detached child
+  process* holding only host bytes, so it survives the doomed
+  process's exit-143 (the runner relaunches only after that exit).
+  The child writes a discovery descriptor beside the checkpoints,
+  advertises itself to the supervisor (``PUT /handoff/{job}``), and
+  exits after the successor's ``/done`` or a TTL.
+
+Client side (successor): discovery goes explicit URL
+(``ADAPTDL_HANDOFF_URL`` / :func:`set_source`) → supervisor
+(``GET /handoff/{job}``) → descriptor file; all fetches ride the
+resilient rpc client with an overall deadline
+(``ADAPTDL_HANDOFF_TIMEOUT_S``). Measured transfer time and bytes
+feed ``metrics.record_handoff`` and ride ``restartStats`` so Pollux
+prices planned rescales at their new, storage-free cost.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+
+from aiohttp import web
+
+from adaptdl_tpu import checkpoint, env, faults, rpc, trace
+from adaptdl_tpu.sched.http_server import ThreadedHttpServer
+
+LOG = logging.getLogger(__name__)
+
+# Chunk id for states that don't implement snapshot_chunks: the whole
+# write_snapshot byte stream as one opaque blob, applied via
+# State.load on the successor.
+RAW_CHUNK = "__payload__"
+
+# Sentinel recorded in checkpoint._loaded_from for handoff-sourced
+# restores (never equal to any on-disk dir, so dir poisoning can't
+# try to "re-load" a peer-sourced state from storage mid-fallback).
+HANDOFF_SOURCE = "<handoff>"
+
+DESCRIPTOR_NAME = ".handoff.json"
+
+
+def _descriptor_path(root: str | None = None) -> str | None:
+    root = root if root is not None else env.checkpoint_path()
+    if not root:
+        return None
+    return os.path.join(root, DESCRIPTOR_NAME)
+
+
+# ---- server side -----------------------------------------------------
+
+
+def collect_chunks(states=None, snapshots=None) -> dict[str, dict]:
+    """Snapshot every registered state into its handoff chunk set:
+    ``{name: {"order": [ids], "chunks": {id: bytes}, "sha": {id:
+    hex}}}``. Chunk-capable states chunk per-leaf (their
+    ``snapshot_chunks``); the rest contribute one ``__payload__``
+    blob. Runs on the caller's thread — at drain time that is the
+    main thread, after the final blocking save, so the served bytes
+    equal the durable checkpoint's. ``snapshots`` (``{name:
+    snapshot}``, e.g. ``AsyncSaveHandle.snapshots`` from a
+    ``retain_snapshots=True`` save) reuses already-captured host
+    copies instead of paying a second device->host pass."""
+    if states is None:
+        states = list(checkpoint._registry.values())
+    payload: dict[str, dict] = {}
+    for state in states:
+        if snapshots is not None and state.name in snapshots:
+            snap = snapshots[state.name]
+        else:
+            snap = state.snapshot()
+        chunks = state.snapshot_chunks(snap)
+        if chunks is None:
+            buf = io.BytesIO()
+            state.write_snapshot(snap, buf)
+            chunks = [(RAW_CHUNK, buf.getvalue())]
+        payload[state.name] = {
+            "order": [cid for cid, _ in chunks],
+            "chunks": dict(chunks),
+            "sha": {
+                cid: checkpoint._chunk_sha(data)
+                for cid, data in chunks
+            },
+        }
+    return payload
+
+
+class HandoffServer(ThreadedHttpServer):
+    """The doomed incarnation's shard server: an immutable chunk
+    payload behind three tiny endpoints. The payload dict is built
+    before ``start()`` and never mutated, so handlers read it without
+    locks."""
+
+    def __init__(
+        self, payload: dict[str, dict], group: int | None = None,
+        host: str = "127.0.0.1", port: int = 0,
+    ):
+        super().__init__(host=host, port=port)
+        self._payload = payload
+        self._group = (
+            env.num_restarts() if group is None else int(group)
+        )
+        self.done = threading.Event()
+
+    @property
+    def group(self) -> int:
+        return self._group
+
+    async def _manifest(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "group": self._group,
+                "states": {
+                    name: {
+                        "order": entry["order"],
+                        "sha": entry["sha"],
+                        "bytes": {
+                            cid: len(entry["chunks"][cid])
+                            for cid in entry["order"]
+                        },
+                    }
+                    for name, entry in self._payload.items()
+                },
+            }
+        )
+
+    async def _chunk(self, request: web.Request) -> web.Response:
+        try:
+            faults.maybe_fail("handoff.serve")
+        except faults.InjectedFault as exc:
+            return web.json_response(
+                {"error": f"injected fault: {exc}"}, status=500
+            )
+        entry = self._payload.get(request.match_info["state"])
+        if entry is None:
+            return web.json_response(
+                {"error": "no such state"}, status=404
+            )
+        data = entry["chunks"].get(request.match_info["chunk"])
+        if data is None:
+            return web.json_response(
+                {"error": "no such chunk"}, status=404
+            )
+        return web.Response(
+            body=data, content_type="application/octet-stream"
+        )
+
+    async def _state(self, request: web.Request) -> web.Response:
+        """Bulk form: one state's whole chunk container in a single
+        response — the successor's default when it needs every chunk
+        (pure data parallelism), saving a per-chunk round-trip per
+        pytree leaf; the range-addressed ``/chunk`` endpoint remains
+        for partial pulls."""
+        try:
+            faults.maybe_fail("handoff.serve")
+        except faults.InjectedFault as exc:
+            return web.json_response(
+                {"error": f"injected fault: {exc}"}, status=500
+            )
+        entry = self._payload.get(request.match_info["state"])
+        if entry is None:
+            return web.json_response(
+                {"error": "no such state"}, status=404
+            )
+        return web.Response(
+            body=pickle.dumps(
+                {"order": entry["order"], "chunks": entry["chunks"]}
+            ),
+            content_type="application/octet-stream",
+        )
+
+    async def _done(self, request: web.Request) -> web.Response:
+        self.done.set()
+        return web.json_response({"ok": True})
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.add_routes(
+            [
+                web.get("/manifest", self._manifest),
+                web.get("/state/{state}", self._state),
+                web.get("/chunk/{state}/{chunk:.+}", self._chunk),
+                web.post("/done", self._done),
+            ]
+        )
+        return app
+
+
+def serve_states(
+    group: int | None = None, states=None, host: str = "127.0.0.1"
+) -> HandoffServer:
+    """Collect chunks from the registered states and serve them
+    in-process (bench, tests, and the spawned child all build on
+    this). Returns the started server; ``server.url`` is the base."""
+    server = HandoffServer(
+        collect_chunks(states), group=group, host=host
+    )
+    server.start()
+    return server
+
+
+def _advertise(url: str, group: int) -> None:
+    """Best-effort advertisement of the shard server: the discovery
+    descriptor beside the checkpoints, and the supervisor's
+    ``PUT /handoff/{job}`` so a successor on another host finds the
+    peer through the control plane during the allocation epoch."""
+    descriptor = _descriptor_path()
+    if descriptor:
+        try:
+            tmp = descriptor + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(
+                    {"url": url, "group": group, "ts": time.time()}, f
+                )
+            os.replace(tmp, descriptor)
+        except OSError:
+            LOG.warning(
+                "could not write handoff descriptor", exc_info=True
+            )
+    sup = env.supervisor_url()
+    job = env.job_id()
+    if sup and job:
+        try:
+            rpc.default_client().put(
+                f"{sup}/handoff/{job}",
+                endpoint=f"handoff/{job}",
+                json={"url": url, "group": group},
+                timeout=(2, 5),
+                attempts=2,
+                deadline=5.0,
+                use_circuit=False,
+            )
+        except Exception:  # noqa: BLE001 - advertisement best-effort
+            LOG.warning(
+                "could not advertise handoff to the supervisor",
+                exc_info=True,
+            )
+
+
+def withdraw_descriptor(root: str | None = None) -> None:
+    """Remove the discovery descriptor (the spawned server's own
+    wind-down, and the runners' stale-descriptor cleanup after a
+    non-graceful worker death)."""
+    descriptor = _descriptor_path(root)
+    if descriptor:
+        try:
+            os.remove(descriptor)
+        except OSError:
+            pass
+
+
+def spawn_server(
+    states=None, snapshots=None
+) -> "subprocess.Popen | None":
+    """Fork the shard server into a detached child so it outlives
+    this (doomed) process's exit-143: the child inherits only the
+    pickled chunk payload over stdin — no devices, no jax — serves
+    until the successor's ``/done`` or ``ADAPTDL_HANDOFF_TTL_S``,
+    then withdraws its descriptor and exits. Rank 0 only (mirroring
+    the save pipeline's writer — one peer per job, and the served
+    bytes must be the same rank's view the durable checkpoint
+    holds). ``snapshots`` reuses a retained save's host copies (see
+    :func:`collect_chunks`). Returns the Popen (the caller never
+    waits on it) or None when handoff is disabled, this is not rank
+    0, or nothing is registered. Memory note: the chunk payload is
+    one serialized copy of the registered states, held in this
+    process only for the moments between collection and the exit-143
+    that follows; the detached child's copy is the single serving
+    copy."""
+    if not env.handoff_enabled() or env.replica_rank() != 0:
+        return None
+    try:
+        payload = collect_chunks(states, snapshots=snapshots)
+    except Exception:  # noqa: BLE001 - handoff is an optimization
+        LOG.warning(
+            "handoff snapshot failed; planned rescale falls back to "
+            "the durable checkpoint",
+            exc_info=True,
+        )
+        return None
+    if not payload:
+        return None
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "adaptdl_tpu.handoff"],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        pickle.dump(
+            {"group": env.num_restarts(), "states": payload},
+            proc.stdin,
+        )
+        proc.stdin.close()
+    except Exception:  # noqa: BLE001 - handoff is an optimization
+        LOG.warning("could not spawn handoff server", exc_info=True)
+        return None
+    LOG.info(
+        "handoff shard server spawned (pid %d, %d states)",
+        proc.pid, len(payload),
+    )
+    return proc
+
+
+def _serve_main() -> int:
+    """Entry point of the spawned child: read the payload, serve,
+    advertise, linger until fetched or TTL. In cluster mode (a
+    supervisor is configured, so the successor may land on another
+    host) the server binds all interfaces and advertises this host's
+    routable address; standalone it stays on loopback."""
+    import socket
+
+    payload = pickle.load(sys.stdin.buffer)
+    cluster = bool(env.supervisor_url())
+    server = HandoffServer(
+        payload["states"],
+        group=int(payload["group"]),
+        host="0.0.0.0" if cluster else "127.0.0.1",
+    )
+    server.start()
+    advertise_url = server.url
+    if cluster:
+        try:
+            address = socket.gethostbyname(socket.gethostname())
+        except OSError:
+            address = "127.0.0.1"
+        advertise_url = f"http://{address}:{server._port}"
+    _advertise(advertise_url, server.group)
+    try:
+        server.done.wait(env.handoff_ttl_s())
+        if server.done.is_set():
+            # Grace for trailing chunk fetches racing the /done post.
+            time.sleep(0.2)
+    finally:
+        withdraw_descriptor()
+        server.stop()
+    return 0
+
+
+# ---- client side -----------------------------------------------------
+
+# Successor-side fetch state. Discovery + manifest fetch may race
+# between the restore path and bootstrap's prefetch thread, so both
+# go through _ensure_manifest under _manifest_lock; chunk fetch and
+# apply stay on the restore thread.
+_manifest_lock = threading.Lock()
+_source_url: str | None = None  # guarded-by: _manifest_lock
+_manifest: dict | None = None  # guarded-by: _manifest_lock
+_manifest_url: str | None = None  # guarded-by: _manifest_lock
+_unavailable = False  # guarded-by: _manifest_lock (sticky failure)
+_fetch_stats = {"bytes": 0, "seconds": 0.0}
+_states_applied: set[str] = set()
+
+
+def _reset_client_state() -> None:
+    """Forget fetched manifests, caches, and the sticky-unavailable
+    verdict (test isolation; checkpoint._reset_registry calls it)."""
+    global _source_url, _manifest, _manifest_url, _unavailable
+    with _manifest_lock:
+        _source_url = None
+        _manifest = None
+        _manifest_url = None
+        _unavailable = False
+    _fetch_stats["bytes"] = 0
+    _fetch_stats["seconds"] = 0.0
+    _states_applied.clear()
+
+
+def set_source(url: str | None) -> None:
+    """Point the restore path at a known shard server (bench and
+    tests; production discovery is env → supervisor → descriptor)."""
+    global _source_url, _unavailable
+    with _manifest_lock:
+        _source_url = url
+        _unavailable = False
+
+
+def _advertised_group(body) -> int | None:
+    try:
+        return int(body.get("group"))
+    except (TypeError, ValueError, AttributeError):
+        return None
+
+
+def discover_url() -> str | None:
+    """Where the predecessor's shard server lives, if anywhere:
+    explicit override (``set_source`` / ``ADAPTDL_HANDOFF_URL``),
+    then the supervisor's advertisement, then the descriptor file
+    beside the checkpoints. Supervisor/descriptor sources must report
+    EXACTLY this incarnation's immediate predecessor (group ==
+    num_restarts - 1): anything older is some earlier epoch's
+    leftover whose state may predate newer durable checkpoints — a
+    crash between that drain and this launch must never roll
+    training back to it."""
+    with _manifest_lock:
+        if _source_url:
+            return _source_url
+    if not env.handoff_enabled():
+        return None
+    override = env.handoff_url()
+    if override:
+        return override
+    predecessor = env.num_restarts() - 1
+    sup = env.supervisor_url()
+    job = env.job_id()
+    if sup and job:
+        try:
+            response = rpc.default_client().get(
+                f"{sup}/handoff/{job}",
+                endpoint=f"handoff/{job}",
+                timeout=(2, 5),
+                attempts=2,
+                deadline=5.0,
+                use_circuit=False,
+            )
+            if response.status_code == 200:
+                body = response.json()
+                if (
+                    isinstance(body, dict)
+                    and body.get("url")
+                    and _advertised_group(body) == predecessor
+                ):
+                    return body["url"]
+        except Exception:  # noqa: BLE001 - discovery best-effort
+            LOG.debug("supervisor handoff discovery failed", exc_info=True)
+    descriptor = _descriptor_path()
+    if descriptor and os.path.isfile(descriptor):
+        try:
+            with open(descriptor, encoding="utf-8") as f:
+                body = json.load(f)
+            if (
+                isinstance(body, dict)
+                and body.get("url")
+                and _advertised_group(body) == predecessor
+            ):
+                return body["url"]
+        except (OSError, ValueError):
+            LOG.debug("unreadable handoff descriptor", exc_info=True)
+    return None
+
+
+def _fetch_manifest(url: str, deadline_s: float) -> dict | None:
+    response = rpc.default_client().get(
+        f"{url}/manifest",
+        endpoint="handoff/manifest",
+        timeout=(2, deadline_s),
+        attempts=2,
+        deadline=deadline_s,
+        use_circuit=False,
+    )
+    if response.status_code != 200:
+        return None
+    body = response.json()
+    states = body.get("states")
+    return states if isinstance(states, dict) else None
+
+
+def _fetch_state_chunks(
+    url: str, name: str, entry: dict, deadline: float
+) -> list[tuple[str, bytes]]:
+    """Pull one state's chunks, sha256-verifying each against the
+    manifest table. Tries the bulk ``/state`` form first (one
+    round-trip for the whole container — the full-pull common case),
+    then falls back to per-chunk ``/chunk`` fetches. Raises on any
+    mismatch, timeout, or server error — the caller treats every
+    raise as "fall back to storage"."""
+    client = rpc.default_client()
+    sha_table = entry.get("sha") or {}
+    remaining = deadline - time.monotonic()
+    if remaining <= 0:
+        raise TimeoutError("handoff fetch deadline exceeded")
+    faults.maybe_fail("handoff.fetch")
+    try:
+        response = client.get(
+            f"{url}/state/{name}",
+            endpoint=f"handoff/state/{name}",
+            timeout=(2, max(remaining, 0.1)),
+            attempts=2,
+            deadline=remaining,
+            use_circuit=False,
+        )
+    except rpc.RpcError:
+        response = None  # try the per-chunk form below
+    if response is not None and response.status_code == 200:
+        container = pickle.loads(response.content)
+        chunks = container.get("chunks") or {}
+        assembled = []
+        for cid in entry["order"]:
+            data = chunks.get(cid)
+            if data is None:
+                raise RuntimeError(
+                    f"handoff bulk fetch of {name} is missing "
+                    f"chunk {cid!r}"
+                )
+            if checkpoint._chunk_sha(data) != sha_table.get(cid):
+                raise ValueError(
+                    f"handoff chunk {name}/{cid} failed sha256"
+                )
+            assembled.append((cid, data))
+        return assembled
+    assembled = []
+    for cid in entry["order"]:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError("handoff fetch deadline exceeded")
+        faults.maybe_fail("handoff.fetch")
+        response = client.get(
+            f"{url}/chunk/{name}/{cid}",
+            endpoint=f"handoff/chunk/{name}",
+            timeout=(2, max(remaining, 0.1)),
+            attempts=2,
+            deadline=remaining,
+            use_circuit=False,
+        )
+        if response.status_code != 200:
+            raise RuntimeError(
+                f"handoff chunk {name}/{cid} returned "
+                f"{response.status_code}"
+            )
+        data = response.content
+        if checkpoint._chunk_sha(data) != sha_table.get(cid):
+            raise ValueError(
+                f"handoff chunk {name}/{cid} failed sha256"
+            )
+        assembled.append((cid, data))
+    return assembled
+
+
+def _signal_done(url: str) -> None:
+    try:
+        rpc.default_client().post(
+            f"{url}/done",
+            endpoint="handoff/done",
+            timeout=(2, 2),
+            attempts=1,
+            use_circuit=False,
+        )
+    except Exception:  # noqa: BLE001 - courtesy signal only
+        pass
+
+
+def _ensure_manifest() -> tuple[dict, str] | None:
+    """Discover the peer and fetch its manifest once (idempotent,
+    thread-safe — bootstrap's prefetch thread and the restore path
+    both land here). None when no peer is configured/reachable; the
+    failure verdict is sticky."""
+    global _manifest, _manifest_url, _unavailable
+    with _manifest_lock:
+        if _unavailable:
+            return None
+        if _manifest is not None:
+            return _manifest, _manifest_url
+    # Discovery and the manifest RPC run outside the lock (they can
+    # block for seconds); the verdict is committed under it.
+    url = discover_url()
+    if url is None:
+        # Sticky: with no peer discoverable, later states' restores
+        # must not re-pay the supervisor RPC + descriptor probe each
+        # (set_source re-arms for tests/bench).
+        with _manifest_lock:
+            _unavailable = True
+        return None
+    deadline_s = env.handoff_timeout_s()
+    t0 = time.monotonic()
+    try:
+        manifest = _fetch_manifest(url, deadline_s)
+    except Exception:  # noqa: BLE001 - peer gone -> storage
+        LOG.info(
+            "handoff peer at %s unreachable; using the durable "
+            "checkpoint", url,
+        )
+        manifest = None
+    with _manifest_lock:
+        if manifest is None:
+            _unavailable = True
+            return None
+        if _manifest is None:
+            _manifest = manifest
+            _manifest_url = url
+            _fetch_stats["seconds"] += time.monotonic() - t0
+        return _manifest, _manifest_url
+
+
+def prefetch() -> bool:
+    """Warm the handoff discovery + manifest while the rest of
+    bootstrap (jax init, compile-cache setup) runs — the restore
+    path then starts pulling chunks immediately. Best-effort."""
+    return _ensure_manifest() is not None
+
+
+def mark_unavailable() -> None:
+    """Stop serving further restores from the peer. Checkpoint's
+    version-consistency healing calls this when a storage dir proves
+    corrupt: peer-sourced states must re-load through the same
+    storage fallback as everyone else, not re-fetch the version
+    being reconciled away."""
+    global _unavailable
+    with _manifest_lock:
+        _unavailable = True
+
+
+def try_restore(state: "checkpoint.State") -> bool:
+    """Restore one state from the predecessor's shard server; False
+    when no peer is configured/discoverable, the state isn't in the
+    peer's manifest, or anything at all fails — the caller
+    (``checkpoint.load_state``) then proceeds with the durable scan.
+    The manifest is fetched once and reused across states; one
+    failure marks the peer unavailable for the whole process (mixing
+    peer-sourced and storage-sourced states would be version-safe —
+    both hold the final save's version — but re-probing a dead peer
+    for every state would stall the restart it exists to speed up)."""
+    global _unavailable
+    found = _ensure_manifest()
+    if found is None:
+        return False
+    manifest, manifest_url = found
+    entry = manifest.get(state.name)
+    if entry is None:
+        return False
+    deadline = time.monotonic() + env.handoff_timeout_s()
+    t0 = time.monotonic()
+    try:
+        with trace.span(
+            "handoff.fetch", state=state.name
+        ) as attrs:
+            chunks = _fetch_state_chunks(
+                manifest_url, state.name, entry, deadline
+            )
+            nbytes = sum(len(data) for _, data in chunks)
+            attrs["bytes"] = nbytes
+            with trace.span("handoff.restore", state=state.name):
+                if [cid for cid, _ in chunks] == [RAW_CHUNK]:
+                    state.load(io.BytesIO(chunks[0][1]))
+                else:
+                    state.load_chunks(chunks)
+    except Exception:  # noqa: BLE001 - peer failure -> storage
+        LOG.warning(
+            "handoff fetch failed for state %r; falling back to the "
+            "durable checkpoint",
+            state.name,
+            exc_info=True,
+        )
+        with _manifest_lock:
+            _unavailable = True
+        return False
+    elapsed = time.monotonic() - t0
+    _fetch_stats["bytes"] += nbytes
+    _fetch_stats["seconds"] += elapsed
+    _states_applied.add(state.name)
+    try:
+        from adaptdl_tpu import metrics as metrics_mod
+
+        metrics_mod.record_handoff(
+            _fetch_stats["seconds"], _fetch_stats["bytes"]
+        )
+        metrics_mod.record_checkpoint_restore(state.name, elapsed)
+    except Exception:  # noqa: BLE001 - observability best-effort
+        pass
+    if _states_applied >= set(manifest):
+        _signal_done(manifest_url)
+    return True
+
+
+if __name__ == "__main__":
+    sys.exit(_serve_main())

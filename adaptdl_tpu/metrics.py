@@ -112,6 +112,17 @@ class MetricsState:
     ckpt_per_state: dict = field(  # guarded-by: _profile_lock
         default_factory=dict
     )
+    # Differential-checkpoint accounting: the last save's kind and
+    # total serialized bytes, plus the last FULL save's bytes — the
+    # denominator that makes a delta's size meaningful (deltaRatio =
+    # delta bytes / full bytes).
+    ckpt_save_kind: str | None = None  # guarded-by: _profile_lock
+    ckpt_save_bytes: int | None = None  # guarded-by: _profile_lock
+    ckpt_full_bytes: int | None = None  # guarded-by: _profile_lock
+    # Peer-to-peer handoff: measured transfer of the last completed
+    # fetch (successor side) — seconds and bytes over the wire.
+    handoff_s: float | None = None  # guarded-by: _profile_lock
+    handoff_bytes: int | None = None  # guarded-by: _profile_lock
     restore_per_state: dict = field(  # guarded-by: _profile_lock
         default_factory=dict
     )
@@ -292,18 +303,39 @@ def profile_step(
 
 
 def record_checkpoint_save(
-    snapshot_s: float, write_s: float, per_state: dict
+    snapshot_s: float,
+    write_s: float,
+    per_state: dict,
+    kind: str = "full",
+    total_bytes: int | None = None,
 ) -> None:
-    """Measured phase durations of the last completed save. Called
-    from the BACKGROUND WRITER thread under the async pipeline
+    """Measured phase durations AND sizes of the last completed save.
+    Called from the BACKGROUND WRITER thread under the async pipeline
     (checkpoint._record_save_metrics) while the fit thread may be
-    reading ``restart_stats`` — the lock keeps the three fields one
+    reading ``restart_stats`` — the lock keeps the fields one
     consistent observation (a torn read would pair a new snapshot
-    time with the previous save's write time)."""
+    time with the previous save's write time). ``kind`` is "full" or
+    "delta"; a full save's bytes also become the delta-ratio
+    denominator."""
     with _profile_lock:
         _state.ckpt_snapshot_s = float(snapshot_s)
         _state.ckpt_write_s = float(write_s)
         _state.ckpt_per_state = dict(per_state)
+        _state.ckpt_save_kind = kind
+        if total_bytes is not None:
+            _state.ckpt_save_bytes = int(total_bytes)
+            if kind == "full":
+                _state.ckpt_full_bytes = int(total_bytes)
+
+
+def record_handoff(seconds: float, transferred_bytes: int) -> None:
+    """Measured peer-to-peer handoff transfer (successor side): the
+    whole manifest+chunk fetch in seconds and bytes. Feeds
+    ``restartStats`` so Pollux prices a *planned* rescale at the
+    handoff's cost, not the storage round-trip's."""
+    with _profile_lock:
+        _state.handoff_s = float(seconds)
+        _state.handoff_bytes = int(transferred_bytes)
 
 
 def record_checkpoint_restore(name: str, seconds: float) -> None:
@@ -333,6 +365,7 @@ def restart_stats() -> dict | None:
         if (
             _state.ckpt_snapshot_s is None
             and not _state.restore_per_state
+            and _state.handoff_s is None
         ):
             return None
         stats: dict = {"numRetunes": _state.num_retunes}
@@ -345,6 +378,24 @@ def restart_stats() -> dict | None:
                 stats["overlapFrac"] = round(
                     write / (snap + write), 4
                 )
+        # Sizes: delta-vs-full timings are meaningless without the
+        # bytes behind them, and the policy's restart pricing wants
+        # the transfer volume, not just the wall clock.
+        if _state.ckpt_save_bytes is not None:
+            stats["saveBytes"] = _state.ckpt_save_bytes
+            stats["saveKind"] = _state.ckpt_save_kind or "full"
+            if (
+                _state.ckpt_save_kind == "delta"
+                and _state.ckpt_full_bytes
+            ):
+                stats["deltaRatio"] = round(
+                    _state.ckpt_save_bytes
+                    / _state.ckpt_full_bytes,
+                    4,
+                )
+        if _state.handoff_s is not None:
+            stats["handoffS"] = round(_state.handoff_s, 4)
+            stats["handoffBytes"] = _state.handoff_bytes or 0
         if _state.restore_per_state:
             stats["restoreS"] = round(
                 sum(_state.restore_per_state.values()), 4
@@ -574,6 +625,11 @@ class _MetricsCheckpoint(checkpoint.State):
             "ckpt_snapshot_s": _state.ckpt_snapshot_s,
             "ckpt_write_s": _state.ckpt_write_s,
             "ckpt_per_state": dict(_state.ckpt_per_state),
+            "ckpt_save_kind": _state.ckpt_save_kind,
+            "ckpt_save_bytes": _state.ckpt_save_bytes,
+            "ckpt_full_bytes": _state.ckpt_full_bytes,
+            "handoff_s": _state.handoff_s,
+            "handoff_bytes": _state.handoff_bytes,
             "num_retunes": _state.num_retunes,
         }
 
@@ -607,6 +663,11 @@ class _MetricsCheckpoint(checkpoint.State):
             _state.ckpt_per_state = dict(
                 payload.get("ckpt_per_state", {})
             )
+            _state.ckpt_save_kind = payload.get("ckpt_save_kind")
+            _state.ckpt_save_bytes = payload.get("ckpt_save_bytes")
+            _state.ckpt_full_bytes = payload.get("ckpt_full_bytes")
+            _state.handoff_s = payload.get("handoff_s")
+            _state.handoff_bytes = payload.get("handoff_bytes")
             _state.num_retunes = int(payload.get("num_retunes", 0))
         _state.init_batch_size = payload["init_batch_size"]
         _state.max_batch_size = payload["max_batch_size"]

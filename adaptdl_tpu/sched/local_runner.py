@@ -63,8 +63,12 @@ class LocalElasticRunner:
         term_grace_period: float = 120.0,
         state_dir: str | None = None,
         preemptible: bool = True,
+        handoff: bool | None = None,
     ):
         self.term_grace_period = term_grace_period
+        # None inherits the runner environment's ADAPTDL_HANDOFF;
+        # True/False force peer-to-peer handoff on planned rescales.
+        self.handoff = handoff
         self.script = script
         self.num_chips = num_chips
         self.checkpoint_dir = checkpoint_dir
@@ -135,6 +139,8 @@ class LocalElasticRunner:
                 "ADAPTDL_SUPERVISOR_URL": self.supervisor.url,
             }
         )
+        if self.handoff is not None:
+            env["ADAPTDL_HANDOFF"] = "on" if self.handoff else "off"
         record = self.state.get_job(self.job_name)
         if record is not None and record.trace_parent:
             # Cross the checkpoint-restart boundary: the new
@@ -218,6 +224,12 @@ class LocalElasticRunner:
                     self.restarts += 1
                     continue
                 failures += 1
+                # A crash never ran the drain: withdraw any handoff
+                # descriptor an older incarnation left behind so the
+                # next launch goes straight to the durable checkpoint.
+                from adaptdl_tpu import handoff
+
+                handoff.withdraw_descriptor(self.checkpoint_dir)
                 LOG.warning(
                     "%s failed with code %s (%d/%d)",
                     self.job_name,

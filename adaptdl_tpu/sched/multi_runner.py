@@ -54,6 +54,10 @@ class JobSpec:
     # step keeps non-preemptible incumbents on their base allocation
     # verbatim instead of shrinking/moving them for other jobs.
     preemptible: bool = True
+    # None inherits the runner environment's ADAPTDL_HANDOFF; True /
+    # False force peer-to-peer state handoff on planned rescales on
+    # or off for this job's workers.
+    handoff: bool | None = None
     extra_env: dict = field(default_factory=dict)
 
 
@@ -167,6 +171,12 @@ class MultiJobRunner:
                 "ADAPTDL_SUPERVISOR_URL": self.supervisor.url,
             }
         )
+        if job.handoff is not None:
+            # Explicit per-job choice beats the inherited environment:
+            # workers spawn the handoff shard server on planned
+            # rescales (and their successors discover it through the
+            # supervisor advertisement above) only when this is on.
+            env["ADAPTDL_HANDOFF"] = "on" if job.handoff else "off"
         record = self.state.get_job(job.name)
         if record is not None and record.trace_parent:
             # Same graftscope propagation as the single-job runner:
@@ -253,6 +263,14 @@ class MultiJobRunner:
                 self.restart_counts[job.name] += 1
                 continue
             failures += 1
+            # A non-graceful death never ran the drain, so any handoff
+            # descriptor in the checkpoint dir is from an older
+            # incarnation — withdraw it rather than let a successor
+            # spend its probe budget on a dead peer (the successor's
+            # exact-predecessor group check also rejects it).
+            from adaptdl_tpu import handoff
+
+            handoff.withdraw_descriptor(job.checkpoint_dir)
             LOG.warning(
                 "%s failed code=%s (%d/%d)",
                 job.name,

@@ -281,7 +281,10 @@ def urgent_drain() -> dict:
     ) as attrs:
         if remaining is not None:
             attrs["budget_s"] = round(remaining, 4)
-        checkpoint.save_all_states(wait=True)
+        # Forced FULL: the save a successor's life depends on must
+        # restore standalone — never as a delta riding a chain whose
+        # base lives on a VM about to vanish or a disk mid-flush.
+        checkpoint.save_all_states(wait=True, force_full=True)
     duration = time.monotonic() - start
     met = deadline is None or time.monotonic() <= deadline
     if not met:

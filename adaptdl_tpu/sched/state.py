@@ -170,6 +170,16 @@ class JobRecord:
     # commit/rollback span's start, so the epoch's prepare->verdict
     # window is measured, not inferred.
     alloc_prepared_at: float | None = None
+    # Peer-to-peer handoff advertisement (PUT /handoff): where the
+    # doomed incarnation's shard server lives and which restart group
+    # it served — published during the prepare→commit epoch so the
+    # successor discovers its predecessor's in-memory state through
+    # the control plane and skips the checkpoint-storage read. A
+    # successor only trusts an advertisement from EXACTLY its
+    # immediate predecessor group; each new drain overwrites the
+    # previous one.
+    handoff_url: str | None = None
+    handoff_group: int = -1
     # True while the incumbent incarnation drains after a preemption
     # notice (POST /preempt): the affected slots are already withdrawn
     # from inventory and the successor's allocation epoch may open
@@ -212,6 +222,8 @@ def _job_to_dict(record: JobRecord) -> dict:
         "alloc_prepare_group": record.alloc_prepare_group,
         "alloc_require_bump": record.alloc_require_bump,
         "trace_parent": record.trace_parent,
+        "handoff_url": record.handoff_url,
+        "handoff_group": record.handoff_group,
         "draining": record.draining,
     }
 
@@ -263,6 +275,8 @@ def _job_from_dict(payload: dict) -> JobRecord:  # replay-pure
         payload.get("alloc_require_bump", False)
     )
     record.trace_parent = payload.get("trace_parent")
+    record.handoff_url = payload.get("handoff_url")
+    record.handoff_group = int(payload.get("handoff_group", -1))
     record.draining = bool(payload.get("draining", False))
     return record
 
@@ -570,6 +584,8 @@ class ClusterState:
             return self._apply_rollback_locked(op, now)
         if kind == "preempt":
             return self._apply_preempt_locked(op, now)
+        if kind == "handoff":
+            return self._apply_handoff_locked(op, now)
         if kind == "recovered":
             self._recoveries += 1
             return None
@@ -1014,6 +1030,52 @@ class ClusterState:
             self._journal_append(op)
             self._apply_update_locked(op, self._clock.monotonic())
             self._cond.notify_all()
+
+    def advertise_handoff(  # journaled
+        self, key: str, url: str, group: int
+    ) -> bool:
+        """Record where a draining incarnation's handoff shard server
+        lives (``PUT /handoff``). Journaled: a supervisor restart
+        inside the rescale window must not lose the successor's
+        fastest restore path. Rejects stale advertisements — a retry
+        from an incarnation older than one already advertised must
+        not roll the pointer backwards."""
+        with self._cond:
+            record = self._jobs.get(key)
+            if record is None:
+                return False
+            if int(group) < record.handoff_group:
+                return False
+            op = {
+                "op": "handoff",
+                "key": key,
+                "url": str(url),
+                "group": int(group),
+            }
+            self._journal_append(op)
+            self._apply_handoff_locked(op, self._clock.monotonic())
+            self._cond.notify_all()
+            return True
+
+    def _apply_handoff_locked(self, op: dict, now: float) -> None:  # holds-lock: _cond # replay-pure
+        record = self._jobs.get(op["key"])
+        if record is None:
+            return
+        record.handoff_url = op["url"]
+        record.handoff_group = int(op["group"])
+
+    def get_handoff(self, key: str) -> dict | None:
+        """The job's current handoff advertisement (None when absent):
+        ``{"url", "group"}`` — the successor validates the group
+        against its own restart count before trusting the peer."""
+        with self._cond:
+            record = self._jobs.get(key)
+            if record is None or not record.handoff_url:
+                return None
+            return {
+                "url": record.handoff_url,
+                "group": record.handoff_group,
+            }
 
     def publish_retune(  # journaled
         self, key: str, batch_config: dict

@@ -324,6 +324,49 @@ class Supervisor(ThreadedHttpServer):
             {"ok": True, "draining": bool(accepted)}
         )
 
+    async def _put_handoff(self, request: web.Request) -> web.Response:
+        """Shard-server advertisement (``PUT /handoff/{job}``): the
+        draining incarnation's spawned handoff server reports its URL
+        + restart group so the successor — possibly on another host —
+        discovers its predecessor's in-memory state through the
+        control plane during the allocation epoch."""
+        key = "{namespace}/{name}".format(**request.match_info)
+        try:
+            body = await request.json()
+        except ValueError:
+            body = {}
+        url = body.get("url") if isinstance(body, dict) else None
+        if not url:
+            return web.json_response(
+                {"error": "url required"}, status=400
+            )
+        try:
+            group = int(body.get("group", 0))
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "group must be an integer"}, status=400
+            )
+        accepted = await self._offload(
+            self._state.advertise_handoff,
+            key,
+            str(url),
+            group,
+        )
+        if not accepted:
+            return web.json_response(
+                {"error": "no such job (or stale group)"}, status=404
+            )
+        return web.json_response({"ok": True})
+
+    async def _get_handoff(self, request: web.Request) -> web.Response:
+        key = "{namespace}/{name}".format(**request.match_info)
+        if self._state.get_job(key) is None:
+            return web.json_response(
+                {"error": "no such job"}, status=404
+            )
+        handoff = self._state.get_handoff(key)
+        return web.json_response(handoff or {})
+
     async def _healthz(self, request: web.Request) -> web.Response:
         return web.json_response({"ok": True})
 
@@ -576,6 +619,30 @@ class Supervisor(ThreadedHttpServer):
             "slot-second).",
         )
         b.family(
+            "adaptdl_ckpt_delta_ratio",
+            "gauge",
+            "Last delta checkpoint's bytes over the last full "
+            "snapshot's (from restartStats; 1 until a delta lands).",
+        )
+        b.family(
+            "adaptdl_ckpt_save_bytes",
+            "gauge",
+            "Serialized bytes of the job's last checkpoint save, by "
+            "kind (full vs delta).",
+        )
+        b.family(
+            "adaptdl_handoff_seconds",
+            "gauge",
+            "Duration of the job's last peer-to-peer state handoff "
+            "fetch (successor side).",
+        )
+        b.family(
+            "adaptdl_handoff_bytes",
+            "gauge",
+            "Bytes transferred in the job's last peer-to-peer state "
+            "handoff.",
+        )
+        b.family(
             "adaptdl_alloc_decide_seconds",
             "histogram",
             "Allocator decision latency per cycle, by mode "
@@ -645,6 +712,30 @@ class Supervisor(ThreadedHttpServer):
                     "adaptdl_job_batch_size",
                     labels,
                     hints["initBatchSize"],
+                )
+            stats = hints.get("restartStats") or {}
+            if stats.get("saveBytes") is not None:
+                b.sample(
+                    "adaptdl_ckpt_save_bytes",
+                    {**labels, "kind": stats.get("saveKind", "full")},
+                    stats["saveBytes"],
+                )
+            if stats.get("deltaRatio") is not None:
+                b.sample(
+                    "adaptdl_ckpt_delta_ratio",
+                    labels,
+                    stats["deltaRatio"],
+                )
+            if stats.get("handoffS") is not None:
+                b.sample(
+                    "adaptdl_handoff_seconds",
+                    labels,
+                    stats["handoffS"],
+                )
+                b.sample(
+                    "adaptdl_handoff_bytes",
+                    labels,
+                    stats.get("handoffBytes", 0),
                 )
             b.sample("adaptdl_alloc_epoch", labels, record.alloc_epoch)
             b.sample(
@@ -794,6 +885,12 @@ class Supervisor(ThreadedHttpServer):
                 web.get("/trace/{namespace}/{name}", self._get_trace),
                 web.post(
                     "/preempt/{namespace}/{name}", self._preempt
+                ),
+                web.put(
+                    "/handoff/{namespace}/{name}", self._put_handoff
+                ),
+                web.get(
+                    "/handoff/{namespace}/{name}", self._get_handoff
                 ),
                 web.get("/healthz", self._healthz),
                 web.get("/status", self._status),

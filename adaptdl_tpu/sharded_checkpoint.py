@@ -87,6 +87,19 @@ class ShardedTrainerCheckpoint(checkpoint.State):
       sharding_fn: optional ``leaf_path -> PartitionSpec`` for restore
         placement; default restores everything replicated over the
         trainer's mesh (pure data parallelism).
+
+    Delta/handoff interplay: the registry payload here is a tiny
+    pointer, so it rides the delta cadence and the peer-to-peer
+    handoff as one opaque chunk — what moves between incarnations is
+    the *pointer*, and the tensor payload flows through orbax's own
+    per-process shard files with re-shard-on-restore (each process
+    writes/reads only its shards, which is already the "pull exactly
+    the chunks your new sharding needs" semantics at the storage
+    layer). Differential encoding inside the orbax payload would have
+    to live inside orbax's format and is deliberately out of scope;
+    the measured payload size (``payload_nbytes``, device bytes
+    summed at sync time) rides the pointer so the metrics layer can
+    report sharded save volume next to the registry's byte counts.
     """
 
     def __init__(
@@ -103,6 +116,7 @@ class ShardedTrainerCheckpoint(checkpoint.State):
         self._set_state = set_state
         self._sharding_fn = sharding_fn
         self._last_payload_dir: str | None = None
+        self._last_payload_nbytes: int = 0
         # Orbax checkpointer with its array write still in flight
         # (StandardCheckpointer is an AsyncCheckpointer: save()
         # returns once the on-device data is snapshotted and the
@@ -310,6 +324,16 @@ class ShardedTrainerCheckpoint(checkpoint.State):
                 )
             )
         path = _next_payload_dir(self.name)
+        # Measured payload volume for the metrics layer: logical
+        # device bytes summed over leaves (cheap — shape metadata, no
+        # host transfer), recorded in the pointer so restartStats can
+        # report sharded save bytes alongside the registry's.
+        self._last_payload_nbytes = int(
+            sum(
+                getattr(leaf, "nbytes", 0) or 0
+                for leaf in jax.tree.leaves(state)
+            )
+        )
         # A fault here (kill/latency mid-payload-write) leaves only a
         # fresh versioned dir no registry checkpoint references — the
         # previous complete (pointer, payload) pair stays restorable,
@@ -334,16 +358,17 @@ class ShardedTrainerCheckpoint(checkpoint.State):
         self._last_payload_dir = path
 
     def snapshot(self):
-        return {"payload_dir": self._last_payload_dir}
+        return {
+            "payload_dir": self._last_payload_dir,
+            "payload_nbytes": self._last_payload_nbytes,
+        }
 
     def write_snapshot(self, snapshot, fileobj) -> None:
         self._finish_pending()
         pickle.dump(snapshot, fileobj)
 
     def save(self, fileobj) -> None:
-        self.write_snapshot(
-            {"payload_dir": self._last_payload_dir}, fileobj
-        )
+        self.write_snapshot(self.snapshot(), fileobj)
 
     def commit(self) -> None:
         """Registry rename succeeded: every payload dir other than the

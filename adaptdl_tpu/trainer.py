@@ -2109,11 +2109,46 @@ class TrainerCheckpoint(checkpoint.State):
         the async pipeline — must not touch the live state)."""
         pickle.dump(snapshot, fileobj)
 
+    def snapshot_chunks(self, snapshot):
+        """Differential-checkpoint / handoff chunking: one chunk per
+        pytree leaf (params, optimizer moments, GNS mirrors each
+        chunk separately, so an update that only moved the step
+        counter and moments serializes only those leaves) plus one
+        ``treedef`` chunk. Leaf ids are positional — stable across
+        saves because the TrainState's structure is fixed for a
+        job's lifetime; a structure change (a topology transform)
+        changes the treedef chunk's hash and every shifted leaf's id,
+        degrading gracefully to a near-full delta. Runs on the writer
+        thread against the host snapshot only."""
+        leaves, treedef = jax.tree_util.tree_flatten(snapshot)
+        chunks = [("treedef", pickle.dumps(treedef))]
+        chunks.extend(
+            (f"leaf/{i:05d}", pickle.dumps(leaf))
+            for i, leaf in enumerate(leaves)
+        )
+        return chunks
+
+    def load_chunks(self, chunks):
+        mapping = dict(chunks)
+        treedef = pickle.loads(mapping["treedef"])
+        leaves = [
+            pickle.loads(mapping[f"leaf/{i:05d}"])
+            for i in range(treedef.num_leaves)
+        ]
+        self._apply_host_state(
+            jax.tree_util.tree_unflatten(treedef, leaves)
+        )
+
     def save(self, fileobj):
         self.write_snapshot(self.snapshot(), fileobj)
 
     def load(self, fileobj):
-        host_state = pickle.load(fileobj)
+        self._apply_host_state(pickle.load(fileobj))
+
+    def _apply_host_state(self, host_state):
+        """Re-materialize a canonical host snapshot onto the CURRENT
+        trainer's mesh — shared tail of the byte-stream ``load`` and
+        the chunk-reassembled ``load_chunks``/handoff paths."""
         if self._transform_load is not None:
             host_state = self._transform_load(host_state)
         if self._trainer.zero3_blocks is not None:

@@ -598,30 +598,29 @@ def _bench_flash_attention(on_tpu: bool, full: bool) -> dict | None:
 
 
 def _bench_rescale_latency(trainer_factory, dataset, init_bsz, trials=3):
-    """Median checkpoint-save -> restore -> first-step time: the cost
-    of one elastic rescale (reference analog: the checkpoint-restart
-    path, SURVEY §3.4 — the reference never measures it). Returns
-    ``(p50_seconds, breakdown)`` where the breakdown holds per-phase
-    medians: snapshot_s / write_s / restore_s / first_step_s.
+    """Median PLANNED-rescale latency: the cost of one elastic
+    rescale when the successor pulls state peer-to-peer from the
+    doomed incarnation's handoff shard server instead of
+    round-tripping through checkpoint storage. Each trial measures
+    the full planned path — snapshot (critical path), differential
+    durable write (overlapped fallback, ``ADAPTDL_CKPT_FULL_EVERY=2``
+    so it is a *delta* against the steady-state full snapshot),
+    shard-server setup + chunk fetch + re-materialization, first step
+    through the AOT-executable cache — and then the storage restore
+    of the SAME delta-chain checkpoint as the fallback reference.
 
-    The measurement exercises the pipelined save path: the snapshot
-    phase is on the critical path, the background write overlaps the
-    restarted incarnation's construction (as a relaunch overlaps it in
-    production), restore joins the write, and the first step goes
-    through the persistent AOT-executable cache the way a real
-    restarted incarnation with shared storage would. The persistent
-    XLA compilation cache is also enabled for the phase (as
-    initialize_job does in production).
-
-    All phase timing is ``time.monotonic()`` (wall-clock deltas are
-    skew-prone under NTP slew); returns ``(p50, breakdown,
-    trace_summary)`` where ``trace_summary`` is the graftscope
-    per-phase view of the same trials — median span durations keyed by
-    span name (ckpt.snapshot / ckpt.write / ckpt.restore / aot.lookup
-    / aot.compile) plus the span count — emitted on the BENCH JSON
-    line as ``rescale_trace`` alongside the existing stopwatch
-    ``rescale_breakdown``, so the two instruments cross-check each
-    other and BENCH_*.json stays comparable round-over-round."""
+    Returns ``(p50, breakdown, trace_summary)``: ``p50`` is the
+    planned-path median; the breakdown holds per-phase medians
+    (snapshot_s / write_s / handoff_s / first_step_s), the
+    storage-path reference (restore_s, storage_p50_s — what the same
+    rescale would have cost through storage), and ``delta_ratio``
+    (delta bytes / full bytes of the overlapped durable write).
+    ``trace_summary`` is the graftscope per-phase view of the same
+    trials — median span durations keyed by span name (ckpt.snapshot
+    / ckpt.write / handoff.fetch / ckpt.restore / aot.lookup /
+    aot.compile) plus the span count — emitted on the BENCH JSON line
+    as ``rescale_trace`` so the two instruments cross-check each
+    other. All timing is ``time.monotonic()``."""
     import tempfile
 
     from adaptdl_tpu import checkpoint as ckpt_mod
@@ -673,20 +672,28 @@ def _rescale_trials(trainer_factory, dataset, init_bsz, trials=3):
 
     from adaptdl_tpu import aot_cache
     from adaptdl_tpu import checkpoint as ckpt_mod
+    from adaptdl_tpu import handoff as handoff_mod
+    from adaptdl_tpu import metrics as metrics_mod
     from adaptdl_tpu import trace
 
     # Bracket the trials in the trace buffer so the summary covers
     # exactly these spans (earlier phases recorded their own).
     trace_start_seq = trace.buffer_seq()
-    times = []
+    planned_times: list[float] = []
+    storage_times: list[float] = []
     parts: dict[str, list] = {
-        "snapshot_s": [], "write_s": [],
-        "restore_s": [], "first_step_s": [],
+        "snapshot_s": [], "write_s": [], "handoff_s": [],
+        "restore_s": [], "first_step_s": [], "delta_ratio": [],
     }
     rng = np.random.default_rng(4)
     for trial in range(trials):
         with tempfile.TemporaryDirectory() as tmp:
             os.environ["ADAPTDL_CHECKPOINT_PATH"] = tmp
+            # Delta cadence 2: the steady-state save below is the
+            # full snapshot, the rescale's overlapped durable write
+            # is a delta against it — the production planned-rescale
+            # shape.
+            os.environ["ADAPTDL_CKPT_FULL_EVERY"] = "2"
             trainer = trainer_factory()
             holder = {"state": trainer.init_state()}
             ck = trainer.make_checkpoint_state(
@@ -708,15 +715,27 @@ def _rescale_trials(trainer_factory, dataset, init_bsz, trials=3):
 
             jax.block_until_ready(m["loss"])
             aot_cache.wait_for_writes()
+            # Steady-state history: the periodic FULL snapshot every
+            # job has long before a rescale, plus one more step so
+            # the rescale-time state genuinely differs from it.
+            ckpt_mod.save_all_states()
+            holder["state"], m = step_fn(holder["state"], batch)
+            jax.block_until_ready(m["loss"])
 
             start = time.monotonic()
-            # Pipelined save: the snapshot phase blocks; the write
-            # runs behind the restarted incarnation's construction,
-            # exactly as it runs behind the relaunch in production.
+            # Pipelined save: the snapshot phase blocks; the (delta)
+            # write runs behind the restarted incarnation's
+            # construction, exactly as behind a relaunch in
+            # production — it is the durable FALLBACK; the restore
+            # itself goes peer-to-peer below.
             handle = ckpt_mod.save_all_states(wait=False)
             snapshot_s = time.monotonic() - start
-            # "Restart": a fresh trainer (new step cache) restoring
-            # the saved state, then one step to readiness.
+            # The doomed incarnation's shard server, serving its
+            # in-memory snapshot chunks (in production this is the
+            # detached child spawn_server leaves behind).
+            server = handoff_mod.serve_states()
+            # "Restart": a fresh trainer (new step cache) pulling the
+            # saved state from the peer, then one step to readiness.
             trainer2 = trainer_factory()
             holder2 = {"state": trainer2.init_state()}
             ck.unregister()
@@ -725,34 +744,69 @@ def _rescale_trials(trainer_factory, dataset, init_bsz, trials=3):
                 lambda s: holder2.__setitem__("state", s),
                 name=f"bench-rescale-{trial}",
             )
+            handoff_mod.set_source(server.url)
             t0 = time.monotonic()
-            # Joins the background write; a False return means the
-            # write failed (load_state logs-and-proceeds from older
-            # checkpoints by design) and the trial would silently
-            # time a restore that restored nothing.
             if not ckpt_mod.load_state(ck2):
                 raise RuntimeError(
-                    "rescale trial: checkpoint restore found no "
-                    "complete checkpoint (background write failed?)"
+                    "rescale trial: restore found neither the peer "
+                    "nor a complete checkpoint"
                 )
-            restore_s = time.monotonic() - t0
+            handoff_s = time.monotonic() - t0
             t0 = time.monotonic()
             step_fn2 = trainer2.train_step(atomic, 0)
             s2, m2 = step_fn2(holder2["state"], batch)
             jax.block_until_ready(m2["loss"])
             first_step_s = time.monotonic() - t0
-            times.append(time.monotonic() - start)
+            planned_times.append(time.monotonic() - start)
+            server.stop()
+            handoff_mod._reset_client_state()
+            # Storage-path reference: the SAME rescale through the
+            # durable delta-chain checkpoint (what every unplanned
+            # restart pays, and what the planned path just skipped).
+            handle.wait()
+            trainer3 = trainer_factory()
+            holder3 = {"state": trainer3.init_state()}
+            ck2.unregister()
+            ck3 = trainer3.make_checkpoint_state(
+                lambda: holder3["state"],
+                lambda s: holder3.__setitem__("state", s),
+                name=f"bench-rescale-{trial}",
+            )
+            t0 = time.monotonic()
+            if not ckpt_mod.load_state(ck3):
+                raise RuntimeError(
+                    "rescale trial: storage restore found no "
+                    "complete checkpoint (background write failed?)"
+                )
+            restore_s = time.monotonic() - t0
+            t0 = time.monotonic()
+            step_fn3 = trainer3.train_step(atomic, 0)
+            s3, m3 = step_fn3(holder3["state"], batch)
+            jax.block_until_ready(m3["loss"])
+            storage_first_step_s = time.monotonic() - t0
+            storage_times.append(
+                snapshot_s + restore_s + storage_first_step_s
+            )
+            stats = metrics_mod.restart_stats() or {}
             parts["snapshot_s"].append(snapshot_s)
             parts["write_s"].append(handle.write_s)
+            parts["handoff_s"].append(handoff_s)
             parts["restore_s"].append(restore_s)
             parts["first_step_s"].append(first_step_s)
-            ck2.unregister()
+            if stats.get("deltaRatio") is not None:
+                parts["delta_ratio"].append(stats["deltaRatio"])
+            ck3.unregister()
             os.environ.pop("ADAPTDL_CHECKPOINT_PATH", None)
-    p50 = float(np.median(times))
+            os.environ.pop("ADAPTDL_CKPT_FULL_EVERY", None)
+    p50 = float(np.median(planned_times))
     breakdown = {
         key: round(float(np.median(vals)), 4)
         for key, vals in parts.items()
+        if vals
     }
+    breakdown["storage_p50_s"] = round(
+        float(np.median(storage_times)), 4
+    )
     trial_spans = [
         rec
         for rec in trace.snapshot_spans()
@@ -768,8 +822,10 @@ def _rescale_trials(trainer_factory, dataset, init_bsz, trials=3):
         "span_count": len(trial_spans),
     }
     _log(
-        f"rescale: trials={['%.2f' % t for t in times]} p50={p50:.2f}s "
-        f"breakdown={breakdown} trace={trace_summary['phases']}"
+        f"rescale: planned={['%.2f' % t for t in planned_times]} "
+        f"storage={['%.2f' % t for t in storage_times]} "
+        f"p50={p50:.2f}s breakdown={breakdown} "
+        f"trace={trace_summary['phases']}"
     )
     return p50, breakdown, trace_summary
 

@@ -744,6 +744,133 @@ def test_chaos_training_with_corruption_between_incarnations(
     np.testing.assert_array_equal(sim.w, w_base)
 
 
+# ---- handoff failure: durable fallback, bit-for-bit -------------------
+
+
+class _ChunkySimState(_SimState):
+    """Delta/handoff-capable form of the sim state: the weight
+    vector and the step counter are separate chunks."""
+
+    def snapshot_chunks(self, snapshot):
+        blob = bytes(snapshot)
+        return [("w", blob[:-8]), ("step", blob[-8:])]
+
+    def load_chunks(self, chunks):
+        import io
+
+        mapping = dict(chunks)
+        self.sim.w = np.load(
+            io.BytesIO(mapping["w"]), allow_pickle=False
+        )
+        self.sim.step = int.from_bytes(mapping["step"], "big")
+
+
+def _run_sim_with_planned_rescale(rescale_at, total_steps, fault=None):
+    """Train, then at ``rescale_at`` do a PLANNED rescale: durable
+    save + in-memory shard server (the doomed side), fresh objects +
+    peer-first restore (the successor side). ``fault`` optionally
+    breaks the handoff mid-flight — the restore must then come out of
+    the durable checkpoint with an identical state."""
+    from adaptdl_tpu import handoff
+
+    sim = _TrainerSim()
+    state = _ChunkySimState(sim)
+    while sim.step < rescale_at:
+        sim.train_step()
+    checkpoint.save_all_states()  # the drain's durable fallback
+    server = handoff.serve_states()
+    try:
+        checkpoint._reset_registry()  # the doomed process "exits"
+        if fault is not None:
+            faults.configure(fault, seed=SEED)
+        sim = _TrainerSim()
+        state = _ChunkySimState(sim)
+        handoff.set_source(server.url)
+        assert checkpoint.load_state(state)
+    finally:
+        faults.configure(None)
+        server.stop()
+    assert sim.step == rescale_at, "successor resumed at the drain"
+    while sim.step < total_steps:
+        sim.train_step()
+    return sim.w.copy()
+
+
+def test_handoff_serve_fault_falls_back_bit_for_bit(
+    tmp_path, monkeypatch
+):
+    """The shard server 500ing every chunk request mid-rescale: the
+    successor falls back to the durable checkpoint and finishes with
+    EXACTLY the undisturbed run's final state."""
+    baseline_dir = tmp_path / "baseline"
+    chaos_dir = tmp_path / "chaos"
+    baseline_dir.mkdir()
+    chaos_dir.mkdir()
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(baseline_dir))
+    w_base = _run_sim_with_planned_rescale(
+        rescale_at=10, total_steps=20
+    )
+    checkpoint._reset_registry()
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(chaos_dir))
+    w_chaos = _run_sim_with_planned_rescale(
+        rescale_at=10, total_steps=20, fault="handoff.serve=fail@1+"
+    )
+    np.testing.assert_array_equal(w_chaos, w_base)
+
+
+def test_handoff_fetch_fault_falls_back_bit_for_bit(
+    tmp_path, monkeypatch
+):
+    """Same equality with the failure on the successor's side (the
+    fetch path dies before the first chunk arrives)."""
+    baseline_dir = tmp_path / "baseline"
+    chaos_dir = tmp_path / "chaos"
+    baseline_dir.mkdir()
+    chaos_dir.mkdir()
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(baseline_dir))
+    w_base = _run_sim_with_planned_rescale(
+        rescale_at=10, total_steps=20
+    )
+    checkpoint._reset_registry()
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(chaos_dir))
+    w_chaos = _run_sim_with_planned_rescale(
+        rescale_at=10, total_steps=20, fault="handoff.fetch=fail@1+"
+    )
+    np.testing.assert_array_equal(w_chaos, w_base)
+
+
+def test_delta_chain_training_matches_undisturbed(
+    tmp_path, monkeypatch
+):
+    """Differential checkpointing under a crash: periodic delta saves
+    between fulls, a mid-run death, and the restored trajectory still
+    EQUALS the undisturbed (delta-free) run's final state."""
+    baseline_dir = tmp_path / "baseline"
+    chaos_dir = tmp_path / "chaos"
+    baseline_dir.mkdir()
+    chaos_dir.mkdir()
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(baseline_dir))
+    w_base, _ = _run_sim(total_steps=30, save_every=5)
+    checkpoint._reset_registry()
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(chaos_dir))
+    monkeypatch.setenv("ADAPTDL_CKPT_FULL_EVERY", "3")
+    sim = _TrainerSim()
+    _ChunkySimState(sim)
+    while sim.step < 22:
+        sim.train_step()
+        if sim.step % 5 == 0:
+            checkpoint.save_all_states()  # full/delta per the cadence
+    checkpoint._reset_registry()  # crash at step 22
+    sim = _TrainerSim()
+    state = _ChunkySimState(sim)
+    assert checkpoint.load_state(state)
+    assert sim.step == 20, "restored the newest delta-chain version"
+    while sim.step < 30:
+        sim.train_step()
+    checkpoint.save_all_states()
+    np.testing.assert_array_equal(sim.w, w_base)
+
+
 # ---- runner retry budget under injected failure -----------------------
 
 

@@ -204,3 +204,96 @@ def test_async_save_error_surfaces_on_wait(tmp_path, monkeypatch):
     state.value = None
     assert checkpoint.load_state(state)
     assert state.value == 1, "previous checkpoint intact"
+
+
+class ChunkVal(checkpoint.State):
+    """Delta-capable Val: each dict entry is one chunk."""
+
+    def __init__(self, name, parts=None):
+        super().__init__(name)
+        self.parts = dict(parts or {})
+
+    def save(self, fileobj):
+        pickle.dump(self.parts, fileobj)
+
+    def load(self, fileobj):
+        self.parts = pickle.load(fileobj)
+
+    def snapshot_chunks(self, snapshot):
+        return [
+            (key, pickle.dumps(value))
+            for key, value in sorted(pickle.loads(snapshot).items())
+        ]
+
+    def load_chunks(self, chunks):
+        self.parts = {k: pickle.loads(v) for k, v in chunks}
+
+
+def test_crash_between_rename_and_prune_on_delta_save(
+    tmp_path, monkeypatch
+):
+    """The kill-between-rename-and-prune window on a DELTA save: the
+    full base, the superseded delta, and the new delta all survive;
+    loads take the newest chain, and the next completed save prunes
+    exactly the stale delta (never the chain's base)."""
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    monkeypatch.setenv("ADAPTDL_CKPT_FULL_EVERY", "10")
+    state = ChunkVal("v", {"a": 1})
+    checkpoint.save_all_states()  # full base
+    state.parts["a"] = 2
+    checkpoint.save_all_states()  # d1
+    state.parts["a"] = 3
+
+    real_fsync = checkpoint._fsync_dir
+    calls = {"n": 0}
+
+    def die_after_rename(path):
+        real_fsync(path)
+        if path == str(tmp_path):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise KeyboardInterrupt(
+                    "killed between rename and prune"
+                )
+
+    monkeypatch.setattr(checkpoint, "_fsync_dir", die_after_rename)
+    with pytest.raises(KeyboardInterrupt):
+        checkpoint.save_all_states()  # d2, dies pre-prune
+    monkeypatch.setattr(checkpoint, "_fsync_dir", real_fsync)
+
+    dirs = sorted(
+        e for e in os.listdir(tmp_path) if e.startswith("checkpoint-")
+    )
+    assert len(dirs) == 3, "base + d1 + d2 all on disk"
+    state.parts = None
+    assert checkpoint.load_state(state)
+    assert state.parts == {"a": 3}, "newest chain wins"
+
+    state.parts["a"] = 4
+    checkpoint.save_all_states()  # d3 completes; prunes d1 + d2
+    dirs = sorted(
+        e for e in os.listdir(tmp_path) if e.startswith("checkpoint-")
+    )
+    assert len(dirs) == 2, "chain base + newest delta only"
+    state.parts = None
+    assert checkpoint.load_state(state)
+    assert state.parts == {"a": 4}
+
+
+def test_async_delta_save_is_point_in_time(tmp_path, monkeypatch):
+    """wait=False on a delta save: the chunking runs on the writer
+    thread against the SNAPSHOT, so mutations after the snapshot
+    phase never leak into the delta being written."""
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    monkeypatch.setenv("ADAPTDL_CKPT_FULL_EVERY", "10")
+    state = ChunkVal("v", {"a": "captured"})
+    checkpoint.save_all_states()  # full base
+    state.parts["a"] = "captured-2"
+    handle = checkpoint.save_all_states(wait=False)
+    state.parts["a"] = "mutated-after-snapshot"
+    handle.wait()
+    assert handle.kind == "delta"
+    assert handle.total_bytes > 0
+    state.parts = None
+    assert checkpoint.load_state(state)
+    assert state.parts == {"a": "captured-2"}
