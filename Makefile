@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched chaos-preempt trace-gate rescale-fast simgate bench-sched probe
+.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched chaos-preempt trace-gate rescale-fast meshgate simgate bench-sched probe
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -79,6 +79,18 @@ trace-gate:
 rescale-fast:
 	$(CPU_ENV) $(PY) -m pytest tests/test_delta_handoff.py \
 	    tests/test_bench.py::test_rescale_breakdown_sums_consistently \
+	    -q --durations=5
+
+# Mesh-shape elasticity gate (docs/checkpointing.md "Reshard-aware
+# handoff", docs/scheduler.md "Mesh-shape search"): a sharded trainer
+# rescaled across a parallelism change on the CPU harness restores
+# BIT-identically (durable + peer-to-peer paths, incl. the slow e2e
+# tier-1 skips), a range-pulling successor's handoff bytes ~ its
+# shard fraction, the AOT cache never serves a wrong-shape
+# executable, and dp-only policy outputs stay bit-identical.
+meshgate:
+	$(CPU_ENV) $(PY) -m pytest tests/test_meshgate.py \
+	    tests/test_mesh_reshard.py tests/test_mesh_equivalence.py \
 	    -q --durations=5
 
 # graftsim gate (docs/simulator.md): the committed 1k-job / 10k-slot

@@ -135,6 +135,14 @@ def fingerprint(trainer: Any, key: tuple, args: tuple) -> str:
                 }
             )
         ),
+        # Together these two parts pin the NAMED mesh shape —
+        # mesh.shape is exactly zip(axis_names, devices.shape) — so an
+        # executable compiled for one (dp, sp, tp, ss, ep)
+        # factorization can never serve a successor that rescaled to a
+        # different shape over the same device count (the collectives
+        # baked into the program are shape-specific). The mesh-shape
+        # fingerprint test in tests/test_mesh_reshard.py enforces the
+        # invariant.
         repr(mesh.devices.shape),
         repr(tuple(mesh.axis_names)),
         repr(key),

@@ -164,6 +164,29 @@ class State:
         non-None at save time."""
         raise NotImplementedError
 
+    def handoff_shard_plan(self, chunk_rows: dict) -> dict | None:
+        """Opt-in to shard-map-keyed range pulls on the peer-to-peer
+        handoff path: given ``{chunk_id: leading_axis_rows}`` for the
+        chunks the peer serves in row parts, return the row spans
+        THIS incarnation actually needs — ``{chunk_id: (lo, hi)}``,
+        half-open, chunk ids omitted from the dict are fetched whole
+        — or ``None`` to fetch everything (the default, and the only
+        correct answer for an incarnation that materializes full
+        leaves). A resharding successor whose mesh gives this process
+        only a fraction of each leaf returns that fraction here, and
+        the handoff client pulls only the covering parts via the
+        range endpoint instead of bulk-fetching full leaves."""
+        return None
+
+    def load_chunk_rows(self, chunks: list, partial: list) -> None:
+        """Restore from a shard-plan fetch: ``chunks`` are whole
+        ``(chunk_id, bytes)`` pairs (chunks outside the plan);
+        ``partial`` are ``(chunk_id, lo, hi, total_rows, ndarray)``
+        row ranges covering at least the span
+        :meth:`handoff_shard_plan` asked for. Only called for states
+        whose plan was non-None."""
+        raise NotImplementedError
+
     def commit(self) -> None:
         """Hook: the checkpoint containing this state's :meth:`save`
         output is now durably on disk (the registry rename succeeded).
@@ -508,6 +531,35 @@ def _chunk_sha(data: bytes) -> str:
     return hashlib.sha256(data).hexdigest()
 
 
+def writer_topology() -> list[int]:
+    """The writing incarnation's mesh shape ``[dp, sp, tp, ss, ep]``.
+
+    Recorded in every chunk container and the dir manifest so the
+    delta chain is KEYED on the mesh shape: a delta written under one
+    parallelism must never be applied over a base written under
+    another (the canonical host chunks are shape-independent today,
+    but the chain refuses rather than assumes — a future
+    shape-dependent chunking would corrupt silently otherwise), and a
+    resharding successor can see the predecessor's shape without
+    deserializing any payload."""
+    sp, tp, ss, ep = (
+        env.seq_shards(),
+        env.model_shards(),
+        env.stage_shards(),
+        env.expert_shards(),
+    )
+    try:
+        from adaptdl_tpu import metrics as metrics_mod
+
+        sp, tp, ss, ep, _micro = metrics_mod.active_topology()
+    except Exception:  # noqa: BLE001 - metrics is optional here
+        pass
+    return [
+        int(env.data_parallel_replicas()),
+        int(sp), int(tp), int(ss), int(ep),
+    ]
+
+
 def _write_snapshots(
     root: str,
     restart: int,
@@ -530,6 +582,7 @@ def _write_snapshots(
     # (external cleanup must degrade to a full save, not a dangling
     # chain).
     base = _delta_base
+    topology = writer_topology()
     want_delta = (
         not force_full
         and full_every > 1
@@ -537,6 +590,12 @@ def _write_snapshots(
         and base is not None
         and base["root"] == root
         and os.path.isdir(os.path.join(root, base["dir"]))
+        # Mesh-shape key: a delta may only extend a chain whose full
+        # base was written under the SAME (dp, sp, tp, ss, ep). A
+        # topology change inside one process (a restart-free reshape,
+        # or the bench building successive trainers) degrades to a
+        # full save instead of chaining across shapes.
+        and base.get("topology") == topology
     )
     # Write into a fresh temp dir on the same filesystem, then atomically
     # rename to a *new* versioned name — the previous complete checkpoint
@@ -578,6 +637,7 @@ def _write_snapshots(
                 {
                     "format": "chunked-delta",
                     "base": base["dir"],
+                    "topology": topology,
                     "order": order,
                     "chunk_sha": sha_table,
                     "chunks": changed,
@@ -588,6 +648,7 @@ def _write_snapshots(
         pickle.dump(
             {
                 "format": "chunked-full",
+                "topology": topology,
                 "order": order,
                 "chunks": dict(chunks),
             },
@@ -663,6 +724,7 @@ def _write_snapshots(
                     "seq": seq,
                     "kind": save_kind,
                     "chain": chain,
+                    "topology": topology,
                     "states": digests,
                 },
                 f,
@@ -710,6 +772,7 @@ def _write_snapshots(
             {
                 "root": root,
                 "dir": f"checkpoint-{restart}.{seq}",
+                "topology": topology,
                 "tables": new_tables,
             }
             if new_tables
@@ -880,6 +943,25 @@ def _load_payload(root: str, ckpt: str, state: State) -> None:
         raise ValueError(
             f"delta base {base_dir} holds no chunked-full container "
             f"for state {state.name!r}"
+        )
+    # Mesh-shape key of the chain: the delta and its full base must
+    # have been written under the same (dp, sp, tp, ss, ep). The
+    # writer enforces this, so a mismatch here means the chain was
+    # assembled from dirs of different incarnations' shapes (external
+    # copy, bug) — refuse and let the caller fall back rather than
+    # reconstruct a frankenstate. Containers that predate the key
+    # (no "topology") are trusted as before.
+    delta_topo = container.get("topology")
+    base_topo = base_container.get("topology")
+    if (
+        delta_topo is not None
+        and base_topo is not None
+        and delta_topo != base_topo
+    ):
+        raise ValueError(
+            f"delta for state {state.name!r} was written under mesh "
+            f"shape {delta_topo} but its base {base_dir} under "
+            f"{base_topo}; refusing the cross-shape chain"
         )
     base_chunks = base_container["chunks"]
     sha_table = container.get("chunk_sha") or {}

@@ -445,7 +445,9 @@ def _cmd_sim(args) -> int:
         spot_fraction=args.spot_fraction,
         reclaims_per_slot_hour=args.reclaims_per_slot_hour,
     )
-    report = run_trace(records, fixed=args.fixed, **kwargs)
+    report = run_trace(
+        records, fixed=args.fixed, dp_only=args.dp_only, **kwargs
+    )
     print(report.render())
     payload = {
         "summary": report.summary(),
@@ -462,6 +464,18 @@ def _cmd_sim(args) -> int:
             f"\ngoodput retention vs fixed allocation: "
             f"{retention:.4f} (>= 1.0 means the adaptive policy "
             "wins)"
+        )
+    if args.compare_dp_only and not args.fixed and not args.dp_only:
+        baseline = run_trace(records, dp_only=True, **kwargs)
+        retention = report.summary()["avg_goodput_x_ideal"] / max(
+            baseline.summary()["avg_goodput_x_ideal"], 1e-9
+        )
+        payload["dp_only_baseline"] = baseline.summary()
+        payload["goodput_retention_vs_dp_only"] = round(retention, 4)
+        print(
+            f"\ngoodput retention vs the dp-only policy: "
+            f"{retention:.4f} (>= 1.0 means mesh-shape search wins "
+            "on this trace)"
         )
     if args.json:
         with open(args.json, "w", encoding="utf-8") as f:
@@ -907,6 +921,18 @@ def main(argv=None) -> int:
         action="store_true",
         help="also run the fixed baseline and print the goodput-"
         "retention ratio",
+    )
+    p.add_argument(
+        "--dp-only",
+        action="store_true",
+        help="strip mesh-shape hints so the policy runs its "
+        "replica-only search (the pre-mesh scheduler)",
+    )
+    p.add_argument(
+        "--compare-dp-only",
+        action="store_true",
+        help="also run the dp-only policy and print the goodput-"
+        "retention ratio mesh-shape search buys on this trace",
     )
     p.add_argument(
         "--spot-fraction",

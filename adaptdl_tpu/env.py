@@ -242,6 +242,25 @@ def handoff_timeout_s() -> float:
     return max(_get_float("ADAPTDL_HANDOFF_TIMEOUT_S", 10.0), 0.1)
 
 
+def handoff_parts() -> int:
+    """Row parts each large leaf chunk is range-addressable in on the
+    handoff shard server (``GET /chunk/{state}/{leaf}@p{i}``): a
+    resharding successor pulls only the parts covering ITS shard-map
+    slice of each leaf instead of bulk-fetching full leaves. 1
+    disables range addressing (every pull is whole-leaf, the pre-mesh
+    behavior); higher values tighten the pulled-bytes bound toward
+    the successor's exact shard fraction at a per-part request cost."""
+    return max(_get_int("ADAPTDL_HANDOFF_PARTS", 8), 1)
+
+
+def handoff_part_min_bytes() -> int:
+    """Leaf chunks smaller than this are never split into range
+    parts — per-part HTTP round-trips would cost more than the bytes
+    they save. Tests lower it to exercise the range path on tiny
+    states."""
+    return max(_get_int("ADAPTDL_HANDOFF_PART_MIN_BYTES", 65536), 0)
+
+
 def supervisor_url() -> str | None:
     """Base URL of the cluster supervisor (rendezvous + sched hints)."""
     return _get_str("ADAPTDL_SUPERVISOR_URL")

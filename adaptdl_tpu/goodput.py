@@ -182,6 +182,62 @@ def _log_optim_time(xp, params, accum_time, network_time):
     return xp.log(accum_time**gamma + network_time**gamma) / gamma
 
 
+def mesh_shape_grid(
+    max_seq_shards: int = 1,
+    max_model_shards: int = 1,
+    max_stage_shards: int = 1,
+    max_expert_shards: int = 1,
+    num_chips: int | None = None,
+    max_candidates: int = 64,
+) -> tuple[tuple[int, int, int, int], ...]:
+    """The bounded candidate set of mesh shapes ``(sp, tp, ss, ep)``
+    the scheduler may factorize a job's chips into.
+
+    Per-axis candidate values are the powers of two up to the job's
+    advertised limit plus — when ``num_chips`` is known — every
+    divisor of the chip count within the limit, so non-power-of-two
+    slice counts (12 chips -> tp=3) are searchable instead of falling
+    through to pure DP. The cross product is filtered to shapes whose
+    group size divides ``num_chips`` (when given), deduplicated, and
+    truncated deterministically to ``max_candidates`` smallest-group-
+    first — the same bounded-candidate philosophy as the incremental
+    allocator's slice-inventory cap. ``(1, 1, 1, 1)`` (pure DP) is
+    always first and never truncated away, so a dp-only job's grid is
+    exactly ``((1, 1, 1, 1),)``.
+    """
+
+    def axis_values(limit: int) -> list[int]:
+        limit = max(int(limit), 1)
+        values = set()
+        v = 1
+        while v <= limit:
+            values.add(v)
+            v *= 2
+        if num_chips:
+            for d in range(1, min(limit, int(num_chips)) + 1):
+                if num_chips % d == 0:
+                    values.add(d)
+        return sorted(values)
+
+    shapes = set()
+    for sp in axis_values(max_seq_shards):
+        for tp in axis_values(max_model_shards):
+            for ss in axis_values(max_stage_shards):
+                for ep in axis_values(max_expert_shards):
+                    group = sp * tp * ss * ep
+                    if num_chips and (
+                        group > num_chips or num_chips % group
+                    ):
+                        continue
+                    shapes.add((sp, tp, ss, ep))
+    shapes.add((1, 1, 1, 1))
+    ordered = sorted(
+        shapes, key=lambda s: (s[0] * s[1] * s[2] * s[3], s)
+    )
+    cap = max(int(max_candidates), 1)
+    return tuple(ordered[:cap])
+
+
 class GoodputFunction:
     """Evaluates and optimizes goodput for one job's fitted parameters."""
 
@@ -407,6 +463,7 @@ class GoodputFunction:
         max_pipeline_micro: int = 8,
         max_expert_shards: int = 1,
         pipeline_chunks: int = 0,
+        shape_grid=None,
     ):
         """Best configuration over (data, seq, model, stage, expert)
         factorizations AND the pipeline microbatch count.
@@ -432,6 +489,14 @@ class GoodputFunction:
         GPipe (v = 1) when the chunks don't divide or none were
         declared.
 
+        ``shape_grid`` overrides the power-of-two enumeration with an
+        explicit candidate set of ``(sp, tp, ss, ep)`` shapes (see
+        :func:`mesh_shape_grid`) — how a job advertises non-pow2
+        factorizations. ``None`` keeps the default enumeration from
+        the ``max_*`` limits, whose all-ones case reduces exactly to
+        one :meth:`optimize` call (the dp-only path is the special
+        case, not a separate code path).
+
         Returns ``(goodput, atomic_bsz, accum_steps, seq_shards,
         model_shards, stage_shards, expert_shards, pipeline_micro)``,
         vectorized like :meth:`optimize`.
@@ -451,12 +516,25 @@ class GoodputFunction:
             return out
 
         micro_candidates = pow2s(max(int(max_pipeline_micro), 1))
+        if shape_grid is not None:
+            base_shapes = [
+                (
+                    max(int(sp), 1), max(int(tp), 1),
+                    max(int(ss), 1), max(int(ep), 1),
+                )
+                for sp, tp, ss, ep in shape_grid
+            ] or [(1, 1, 1, 1)]
+        else:
+            base_shapes = [
+                (sp, tp, ss, ep)
+                for sp in pow2s(max(int(max_seq_shards), 1))
+                for tp in pow2s(max(int(max_model_shards), 1))
+                for ss in pow2s(max(int(max_stage_shards), 1))
+                for ep in pow2s(max(int(max_expert_shards), 1))
+            ]
         factorizations = [
             (sp, tp, ss, ep, micro)
-            for sp in pow2s(max(int(max_seq_shards), 1))
-            for tp in pow2s(max(int(max_model_shards), 1))
-            for ss in pow2s(max(int(max_stage_shards), 1))
-            for ep in pow2s(max(int(max_expert_shards), 1))
+            for sp, tp, ss, ep in base_shapes
             # M only matters with a pipeline; ss == 1 pins M = 1.
             for micro in (micro_candidates if ss > 1 else [1])
         ]

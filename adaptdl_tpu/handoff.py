@@ -40,6 +40,17 @@ resilient rpc client with an overall deadline
 (``ADAPTDL_HANDOFF_TIMEOUT_S``). Measured transfer time and bytes
 feed ``metrics.record_handoff`` and ride ``restartStats`` so Pollux
 prices planned rescales at their new, storage-free cost.
+
+Reshard-aware range pulls: large leaf chunks are additionally
+advertised in ``ADAPTDL_HANDOFF_PARTS`` row parts (per-part sha256 in
+the manifest, served as ``GET /chunk/{state}/{leaf}@p{i}`` by
+re-slicing the whole-leaf bytes on demand). A successor state that
+declares a shard map (``State.handoff_shard_plan``; see
+:func:`fraction_plan`) pulls only the parts covering ITS row spans of
+each leaf instead of bulk-fetching full leaves — a resharding
+(dp, tp)-change successor's handoff bytes ~ its shard fraction of the
+state. The manifest also carries the writer's mesh shape
+(:func:`peer_topology`) so a successor can see it is resharding.
 """
 
 from __future__ import annotations
@@ -53,6 +64,7 @@ import subprocess
 import sys
 import threading
 import time
+from typing import Any
 
 from aiohttp import web
 
@@ -82,6 +94,50 @@ def _descriptor_path(root: str | None = None) -> str | None:
 
 
 # ---- server side -----------------------------------------------------
+
+
+def _part_bytes(arr, lo: int, hi: int) -> bytes:
+    """Serialized row range ``arr[lo:hi]`` — ONE definition shared by
+    the collect-time sha table and the serve-time slicing, so the
+    bytes a part endpoint returns always hash to what the manifest
+    promised (pickle of the same contiguous slice is deterministic
+    within one interpreter)."""
+    import numpy as np
+
+    return pickle.dumps(np.ascontiguousarray(arr[lo:hi]))
+
+
+def _partition_chunk(
+    data: bytes, max_parts: int, min_bytes: int
+) -> dict | None:
+    """Row-part metadata for one chunk payload, or None when the
+    chunk is not worth (or not capable of) range addressing: too
+    small, not a pickled ndarray, or fewer leading-axis rows than
+    two. ``bounds`` are the balanced part boundaries; per-part sha256
+    and byte counts let the client verify each range pull exactly
+    like a whole-chunk fetch."""
+    if max_parts <= 1 or len(data) < max(min_bytes, 1):
+        return None
+    import numpy as np
+
+    try:
+        value = pickle.loads(data)
+    except Exception:  # noqa: BLE001 - opaque chunk: serve whole
+        return None
+    if not isinstance(value, np.ndarray) or value.ndim < 1:
+        return None
+    rows = int(value.shape[0])
+    if rows < 2:
+        return None
+    k = min(int(max_parts), rows)
+    bounds = [(i * rows) // k for i in range(k + 1)]
+    sha: dict[str, str] = {}
+    nbytes: dict[str, int] = {}
+    for i in range(k):
+        part = _part_bytes(value, bounds[i], bounds[i + 1])
+        sha[str(i)] = checkpoint._chunk_sha(part)
+        nbytes[str(i)] = len(part)
+    return {"rows": rows, "bounds": bounds, "sha": sha, "bytes": nbytes}
 
 
 def collect_chunks(states=None, snapshots=None) -> dict[str, dict]:
@@ -119,6 +175,34 @@ def collect_chunks(states=None, snapshots=None) -> dict[str, dict]:
     return payload
 
 
+def attach_parts(payload: dict[str, dict]) -> dict[str, dict]:
+    """Attach range-addressing part metadata to a collected payload:
+    big ndarray chunks advertise row parts so a resharding successor
+    can pull only ITS slices of each leaf. Runs in the SERVER
+    (HandoffServer construction — for a planned rescale that is the
+    detached child, which idles waiting for the successor), never on
+    the doomed incarnation's drain-critical collect path: the
+    re-pickle + sha pass over every large leaf must not race the
+    preemption notice. Only metadata is retained — part bytes are
+    re-sliced from the whole-leaf payload at serve time, so server
+    memory stays one copy of the state."""
+    max_parts = env.handoff_parts()
+    min_bytes = env.handoff_part_min_bytes()
+    for entry in payload.values():
+        if "parts" in entry:
+            continue
+        parts: dict[str, dict] = {}
+        for cid in entry["order"]:
+            meta = _partition_chunk(
+                entry["chunks"][cid], max_parts, min_bytes
+            )
+            if meta is not None:
+                parts[cid] = meta
+        if parts:
+            entry["parts"] = parts
+    return payload
+
+
 class HandoffServer(ThreadedHttpServer):
     """The doomed incarnation's shard server: an immutable chunk
     payload behind three tiny endpoints. The payload dict is built
@@ -128,11 +212,20 @@ class HandoffServer(ThreadedHttpServer):
     def __init__(
         self, payload: dict[str, dict], group: int | None = None,
         host: str = "127.0.0.1", port: int = 0,
+        topology: list | None = None,
     ):
         super().__init__(host=host, port=port)
-        self._payload = payload
+        self._payload = attach_parts(payload)
         self._group = (
             env.num_restarts() if group is None else int(group)
+        )
+        # The WRITER's mesh shape: computed where the state lived
+        # (the doomed incarnation's active topology) and carried into
+        # the detached child, which has no trainer of its own.
+        self._topology = (
+            checkpoint.writer_topology()
+            if topology is None
+            else list(topology)
         )
         self.done = threading.Event()
 
@@ -141,24 +234,37 @@ class HandoffServer(ThreadedHttpServer):
         return self._group
 
     async def _manifest(self, request: web.Request) -> web.Response:
+        states = {}
+        for name, entry in self._payload.items():
+            desc = {
+                "order": entry["order"],
+                "sha": entry["sha"],
+                "bytes": {
+                    cid: len(entry["chunks"][cid])
+                    for cid in entry["order"]
+                },
+            }
+            if entry.get("parts"):
+                desc["parts"] = entry["parts"]
+            states[name] = desc
         return web.json_response(
             {
                 "group": self._group,
-                "states": {
-                    name: {
-                        "order": entry["order"],
-                        "sha": entry["sha"],
-                        "bytes": {
-                            cid: len(entry["chunks"][cid])
-                            for cid in entry["order"]
-                        },
-                    }
-                    for name, entry in self._payload.items()
-                },
+                # The predecessor's mesh shape [dp, sp, tp, ss, ep]:
+                # a successor compares it with its own to see it is
+                # resharding (and dashboards see what shape served).
+                "topology": self._topology,
+                "states": states,
             }
         )
 
     async def _chunk(self, request: web.Request) -> web.Response:
+        """Range endpoint: ``{chunk}`` addresses a whole chunk, or a
+        row part ``{chunk}@p{i}`` of one — the unit a resharding
+        successor pulls per its shard map. Part bytes are re-sliced
+        from the whole-leaf payload on demand (one state copy in
+        memory; the slice+pickle runs only for ranges actually
+        requested)."""
         try:
             faults.maybe_fail("handoff.serve")
         except faults.InjectedFault as exc:
@@ -170,7 +276,24 @@ class HandoffServer(ThreadedHttpServer):
             return web.json_response(
                 {"error": "no such state"}, status=404
             )
-        data = entry["chunks"].get(request.match_info["chunk"])
+        chunk_id = request.match_info["chunk"]
+        data = entry["chunks"].get(chunk_id)
+        if data is None and "@p" in chunk_id:
+            cid, _, index = chunk_id.rpartition("@p")
+            meta = (entry.get("parts") or {}).get(cid)
+            whole = entry["chunks"].get(cid)
+            if meta is not None and whole is not None:
+                try:
+                    i = int(index)
+                    bounds = meta["bounds"]
+                    if 0 <= i < len(bounds) - 1:
+                        data = _part_bytes(
+                            pickle.loads(whole),
+                            bounds[i],
+                            bounds[i + 1],
+                        )
+                except Exception:  # noqa: BLE001 - malformed part id
+                    data = None
         if data is None:
             return web.json_response(
                 {"error": "no such chunk"}, status=404
@@ -323,7 +446,11 @@ def spawn_server(
             start_new_session=True,
         )
         pickle.dump(
-            {"group": env.num_restarts(), "states": payload},
+            {
+                "group": env.num_restarts(),
+                "topology": checkpoint.writer_topology(),
+                "states": payload,
+            },
             proc.stdin,
         )
         proc.stdin.close()
@@ -351,6 +478,7 @@ def _serve_main() -> int:
         payload["states"],
         group=int(payload["group"]),
         host="0.0.0.0" if cluster else "127.0.0.1",
+        topology=payload.get("topology"),
     )
     server.start()
     advertise_url = server.url
@@ -382,6 +510,7 @@ _manifest_lock = threading.Lock()
 _source_url: str | None = None  # guarded-by: _manifest_lock
 _manifest: dict | None = None  # guarded-by: _manifest_lock
 _manifest_url: str | None = None  # guarded-by: _manifest_lock
+_peer_topology: list | None = None  # guarded-by: _manifest_lock
 _unavailable = False  # guarded-by: _manifest_lock (sticky failure)
 _fetch_stats = {"bytes": 0, "seconds": 0.0}
 _states_applied: set[str] = set()
@@ -391,14 +520,26 @@ def _reset_client_state() -> None:
     """Forget fetched manifests, caches, and the sticky-unavailable
     verdict (test isolation; checkpoint._reset_registry calls it)."""
     global _source_url, _manifest, _manifest_url, _unavailable
+    global _peer_topology
     with _manifest_lock:
         _source_url = None
         _manifest = None
         _manifest_url = None
+        _peer_topology = None
         _unavailable = False
     _fetch_stats["bytes"] = 0
     _fetch_stats["seconds"] = 0.0
     _states_applied.clear()
+
+
+def peer_topology() -> list | None:
+    """The predecessor's mesh shape ``[dp, sp, tp, ss, ep]`` as its
+    shard server advertised it, or None before a manifest was
+    fetched (or from a pre-mesh-key peer). A successor whose own
+    ``checkpoint.writer_topology()`` differs is resharding — its
+    states' shard plans decide what fraction of each leaf to pull."""
+    with _manifest_lock:
+        return list(_peer_topology) if _peer_topology else None
 
 
 def set_source(url: str | None) -> None:
@@ -474,7 +615,9 @@ def discover_url() -> str | None:
     return None
 
 
-def _fetch_manifest(url: str, deadline_s: float) -> dict | None:
+def _fetch_manifest(
+    url: str, deadline_s: float
+) -> tuple[dict, list | None] | None:
     response = rpc.default_client().get(
         f"{url}/manifest",
         endpoint="handoff/manifest",
@@ -487,7 +630,10 @@ def _fetch_manifest(url: str, deadline_s: float) -> dict | None:
         return None
     body = response.json()
     states = body.get("states")
-    return states if isinstance(states, dict) else None
+    if not isinstance(states, dict):
+        return None
+    topology = body.get("topology")
+    return states, topology if isinstance(topology, list) else None
 
 
 def _fetch_state_chunks(
@@ -561,6 +707,118 @@ def _fetch_state_chunks(
     return assembled
 
 
+def _fetch_chunk(
+    client, url: str, name: str, chunk_id: str, deadline: float
+) -> bytes:
+    """One range-endpoint GET with the shared deadline/fault plumbing;
+    raises on any non-200 (the caller falls back to storage)."""
+    remaining = deadline - time.monotonic()
+    if remaining <= 0:
+        raise TimeoutError("handoff fetch deadline exceeded")
+    faults.maybe_fail("handoff.fetch")
+    response = client.get(
+        f"{url}/chunk/{name}/{chunk_id}",
+        endpoint=f"handoff/chunk/{name}",
+        timeout=(2, max(remaining, 0.1)),
+        attempts=2,
+        deadline=remaining,
+        use_circuit=False,
+    )
+    if response.status_code != 200:
+        raise RuntimeError(
+            f"handoff chunk {name}/{chunk_id} returned "
+            f"{response.status_code}"
+        )
+    return response.content
+
+
+def _normalize_plan(plan: dict, parts_meta: dict) -> dict:
+    """Sanitize a state's shard plan: only chunks the peer actually
+    advertises parts for, spans clamped to the row count, and only
+    STRICT subsets kept — a full-span (or degenerate) request is
+    cheaper as a whole-chunk fetch."""
+    normalized = {}
+    for cid, span in (plan or {}).items():
+        meta = parts_meta.get(cid)
+        if meta is None:
+            continue
+        try:
+            lo, hi = int(span[0]), int(span[1])
+        except (TypeError, ValueError, IndexError):
+            continue
+        rows = int(meta["rows"])
+        lo, hi = max(lo, 0), min(hi, rows)
+        if lo >= hi or (lo == 0 and hi == rows):
+            continue
+        normalized[cid] = (lo, hi)
+    return normalized
+
+
+def _fetch_state_ranges(
+    url: str, name: str, entry: dict, plan: dict, deadline: float
+) -> tuple[list, list, int]:
+    """The shard-map-keyed pull: chunks in ``plan`` are fetched as
+    the row PARTS covering the requested span (each part
+    sha256-verified against the manifest's per-part table, then
+    concatenated); every other chunk is fetched whole. Returns
+    ``(whole_chunks, partial, nbytes)`` where ``partial`` entries are
+    ``(chunk_id, cover_lo, cover_hi, total_rows, ndarray)`` — the
+    covering range is part-aligned, so it may extend slightly past
+    the plan's span.
+    Raises on any mismatch/timeout/server error (caller falls back to
+    storage)."""
+    import numpy as np
+
+    client = rpc.default_client()
+    sha_table = entry.get("sha") or {}
+    parts_meta = entry.get("parts") or {}
+    whole: list[tuple[str, bytes]] = []
+    partial: list[tuple[str, int, int, Any]] = []
+    nbytes = 0
+    for cid in entry["order"]:
+        span = plan.get(cid)
+        if span is None:
+            data = _fetch_chunk(client, url, name, cid, deadline)
+            if checkpoint._chunk_sha(data) != sha_table.get(cid):
+                raise ValueError(
+                    f"handoff chunk {name}/{cid} failed sha256"
+                )
+            nbytes += len(data)
+            whole.append((cid, data))
+            continue
+        meta = parts_meta[cid]
+        bounds = meta["bounds"]
+        part_sha = meta.get("sha") or {}
+        lo, hi = span
+        picked = [
+            i
+            for i in range(len(bounds) - 1)
+            if bounds[i + 1] > lo and bounds[i] < hi
+        ]
+        pieces = []
+        for i in picked:
+            data = _fetch_chunk(
+                client, url, name, f"{cid}@p{i}", deadline
+            )
+            if checkpoint._chunk_sha(data) != part_sha.get(str(i)):
+                raise ValueError(
+                    f"handoff part {name}/{cid}@p{i} failed sha256"
+                )
+            nbytes += len(data)
+            pieces.append(pickle.loads(data))
+        cover_lo, cover_hi = bounds[picked[0]], bounds[picked[-1] + 1]
+        partial.append(
+            (
+                cid,
+                cover_lo,
+                cover_hi,
+                int(meta["rows"]),
+                np.concatenate(pieces, axis=0),
+            )
+        )
+    return whole, partial, nbytes
+
+
 def _signal_done(url: str) -> None:
     try:
         rpc.default_client().post(
@@ -579,7 +837,7 @@ def _ensure_manifest() -> tuple[dict, str] | None:
     thread-safe — bootstrap's prefetch thread and the restore path
     both land here). None when no peer is configured/reachable; the
     failure verdict is sticky."""
-    global _manifest, _manifest_url, _unavailable
+    global _manifest, _manifest_url, _unavailable, _peer_topology
     with _manifest_lock:
         if _unavailable:
             return None
@@ -598,22 +856,44 @@ def _ensure_manifest() -> tuple[dict, str] | None:
     deadline_s = env.handoff_timeout_s()
     t0 = time.monotonic()
     try:
-        manifest = _fetch_manifest(url, deadline_s)
+        fetched = _fetch_manifest(url, deadline_s)
     except Exception:  # noqa: BLE001 - peer gone -> storage
         LOG.info(
             "handoff peer at %s unreachable; using the durable "
             "checkpoint", url,
         )
-        manifest = None
+        fetched = None
     with _manifest_lock:
-        if manifest is None:
+        if fetched is None:
             _unavailable = True
             return None
         if _manifest is None:
-            _manifest = manifest
+            _manifest, _peer_topology = fetched
             _manifest_url = url
             _fetch_stats["seconds"] += time.monotonic() - t0
         return _manifest, _manifest_url
+
+
+def fraction_plan(
+    chunk_rows: dict, shard: int, num_shards: int
+) -> dict:
+    """The balanced shard map for shard ``shard`` of ``num_shards``:
+    for every range-addressable chunk, the row span
+    ``[shard * rows // num_shards, (shard + 1) * rows // num_shards)``
+    — the slice a successor process owning that fraction of each leaf
+    needs. The canonical ``shard_plan_fn`` for launchers whose
+    resharded successors split leaves evenly (and the unit the range-
+    pull acceptance bench measures bytes against)."""
+    num_shards = max(int(num_shards), 1)
+    shard = min(max(int(shard), 0), num_shards - 1)
+    plan = {}
+    for cid, rows in chunk_rows.items():
+        rows = int(rows)
+        lo = (shard * rows) // num_shards
+        hi = ((shard + 1) * rows) // num_shards
+        if hi > lo:
+            plan[cid] = (lo, hi)
+    return plan
 
 
 def prefetch() -> bool:
@@ -652,32 +932,86 @@ def try_restore(state: "checkpoint.State") -> bool:
     entry = manifest.get(state.name)
     if entry is None:
         return False
+    # Shard-map-keyed range pull: a state that knows it only needs a
+    # row fraction of the peer's leaves (a resharding successor)
+    # returns spans here, and only the covering parts cross the wire.
+    # Everything else (plan None, peer without parts, any plan error)
+    # takes the full-pull path unchanged.
+    plan: dict = {}
+    parts_meta = entry.get("parts") or {}
+    if parts_meta:
+        try:
+            raw_plan = state.handoff_shard_plan(
+                {
+                    cid: int(meta["rows"])
+                    for cid, meta in parts_meta.items()
+                }
+            )
+        except Exception:  # noqa: BLE001 - plan is an optimization
+            LOG.warning(
+                "handoff shard plan failed for state %r; pulling "
+                "full leaves", state.name, exc_info=True,
+            )
+            raw_plan = None
+        if raw_plan:
+            plan = _normalize_plan(raw_plan, parts_meta)
     deadline = time.monotonic() + env.handoff_timeout_s()
     t0 = time.monotonic()
-    try:
-        with trace.span(
-            "handoff.fetch", state=state.name
-        ) as attrs:
-            chunks = _fetch_state_chunks(
-                manifest_url, state.name, entry, deadline
+    nbytes = 0
+    fetched = False
+    if plan:
+        # The range pull is an OPTIMIZATION over the same peer: any
+        # failure here (part 404, part-sha mismatch, a state whose
+        # plan outran its load_chunk_rows) retries as a full-leaf
+        # pull before anything falls back to storage — a client-side
+        # plan bug must not cost the whole process its fast restart.
+        try:
+            with trace.span(
+                "handoff.fetch", state=state.name, ranged=True
+            ) as attrs:
+                whole, partial, nbytes = _fetch_state_ranges(
+                    manifest_url, state.name, entry, plan, deadline
+                )
+                attrs["bytes"] = nbytes
+                with trace.span(
+                    "handoff.restore", state=state.name
+                ):
+                    state.load_chunk_rows(whole, partial)
+            fetched = True
+        except Exception:  # noqa: BLE001 - downgrade to full pull
+            LOG.warning(
+                "handoff range pull failed for state %r; retrying "
+                "the full-leaf pull from the same peer",
+                state.name,
+                exc_info=True,
             )
-            nbytes = sum(len(data) for _, data in chunks)
-            attrs["bytes"] = nbytes
-            with trace.span("handoff.restore", state=state.name):
-                if [cid for cid, _ in chunks] == [RAW_CHUNK]:
-                    state.load(io.BytesIO(chunks[0][1]))
-                else:
-                    state.load_chunks(chunks)
-    except Exception:  # noqa: BLE001 - peer failure -> storage
-        LOG.warning(
-            "handoff fetch failed for state %r; falling back to the "
-            "durable checkpoint",
-            state.name,
-            exc_info=True,
-        )
-        with _manifest_lock:
-            _unavailable = True
-        return False
+    if not fetched:
+        try:
+            with trace.span(
+                "handoff.fetch", state=state.name, ranged=False
+            ) as attrs:
+                chunks = _fetch_state_chunks(
+                    manifest_url, state.name, entry, deadline
+                )
+                nbytes = sum(len(data) for _, data in chunks)
+                attrs["bytes"] = nbytes
+                with trace.span(
+                    "handoff.restore", state=state.name
+                ):
+                    if [cid for cid, _ in chunks] == [RAW_CHUNK]:
+                        state.load(io.BytesIO(chunks[0][1]))
+                    else:
+                        state.load_chunks(chunks)
+        except Exception:  # noqa: BLE001 - peer failure -> storage
+            LOG.warning(
+                "handoff fetch failed for state %r; falling back to "
+                "the durable checkpoint",
+                state.name,
+                exc_info=True,
+            )
+            with _manifest_lock:
+                _unavailable = True
+            return False
     elapsed = time.monotonic() - t0
     _fetch_stats["bytes"] += nbytes
     _fetch_stats["seconds"] += elapsed

@@ -99,6 +99,10 @@ class MetricsState:
     # Interleaved-schedule chunk count the model can split into
     # (0 = plain GPipe only); see parallel/pipeline.py.
     pipeline_chunks: int = 0
+    # Explicit candidate mesh shapes ((sp, tp, ss, ep) tuples) posted
+    # as the meshShapeGrid hint; None advertises only the max_* limits
+    # (the scheduler then enumerates powers of two).
+    mesh_shape_grid: tuple | None = None
     progress: float = 0.0
     # Measured checkpoint pipeline timings (checkpoint.save_all_states
     # records them): the last save's snapshot/write phase durations,
@@ -217,6 +221,7 @@ def set_topology_config(
     max_expert_shards: int = 1,
     max_pipeline_micro: int | None = None,
     pipeline_chunks: int = 0,
+    mesh_shape_grid=None,
 ) -> None:
     """Advertise how far this job can shard each sample/model
     (sequence shards need ring attention; model shards need a
@@ -226,7 +231,12 @@ def set_topology_config(
     ``max_pipeline_micro`` caps the GPipe M it may pick (defaults to
     the larger of 8 and the job's default M); ``pipeline_chunks``
     declares the interleaved schedule's uniform chunk count (jobs
-    built on ``interleaved_loss``; 0 = plain GPipe only)."""
+    built on ``interleaved_loss``; 0 = plain GPipe only).
+    ``mesh_shape_grid`` posts an EXPLICIT candidate shape set
+    ((sp, tp, ss, ep) tuples — ``goodput.mesh_shape_grid`` builds
+    one) instead of the limits-derived power-of-two enumeration, for
+    jobs whose model code supports non-pow2 factorizations or only a
+    sparse subset of the cross product."""
     _state.max_seq_shards = max(int(max_seq_shards), 1)
     _state.max_model_shards = max(int(max_model_shards), 1)
     _state.max_stage_shards = max(int(max_stage_shards), 1)
@@ -236,6 +246,14 @@ def set_topology_config(
         max_pipeline_micro = max(8, _state.pipeline_microbatches)
     _state.max_pipeline_micro = max(int(max_pipeline_micro), 1)
     _state.pipeline_chunks = max(int(pipeline_chunks), 0)
+    _state.mesh_shape_grid = (
+        tuple(
+            (int(sp), int(tp), int(ss), int(ep))
+            for sp, tp, ss, ep in mesh_shape_grid
+        )
+        if mesh_shape_grid
+        else None
+    )
 
 
 def _topology_suffix() -> tuple[int, int, int, int, int]:
@@ -546,6 +564,10 @@ def fit_and_report_now() -> None:
     hints["maxPipelineMicro"] = _state.max_pipeline_micro
     hints["pipelineMicrobatches"] = _topology_suffix()[4]
     hints["pipelineChunks"] = _state.pipeline_chunks
+    if _state.mesh_shape_grid is not None:
+        hints["meshShapeGrid"] = [
+            list(shape) for shape in _state.mesh_shape_grid
+        ]
     stats = restart_stats()
     if stats is not None:
         # Measured rescale cost: the supervisor prices checkpoint-
@@ -617,6 +639,7 @@ class _MetricsCheckpoint(checkpoint.State):
             "max_expert_shards": _state.max_expert_shards,
             "pipeline_microbatches": _state.pipeline_microbatches,
             "max_pipeline_micro": _state.max_pipeline_micro,
+            "mesh_shape_grid": _state.mesh_shape_grid,
             "progress": _state.progress,
             # The save that persists this payload is still in flight
             # when these are read back, so they describe the PREVIOUS
@@ -681,6 +704,10 @@ class _MetricsCheckpoint(checkpoint.State):
         _state.pipeline_microbatches = old_micro
         _state.max_pipeline_micro = payload.get(
             "max_pipeline_micro", max(8, old_micro)
+        )
+        grid = payload.get("mesh_shape_grid")
+        _state.mesh_shape_grid = (
+            tuple(tuple(shape) for shape in grid) if grid else None
         )
         _state.progress = payload["progress"]
 

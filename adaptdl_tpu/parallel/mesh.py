@@ -39,6 +39,60 @@ def stack_params(per_shard: list) -> object:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_shard)
 
 
+def topology_axes(
+    data_shards: int,
+    seq_shards: int = 1,
+    model_shards: int = 1,
+    stage_shards: int = 1,
+    expert_shards: int = 1,
+) -> dict[str, int]:
+    """Mesh axes for a scheduler-assigned ``(dp, sp, tp, ss, ep)``
+    factorization, in the canonical order (data outermost; the
+    heavier per-layer collectives ride the inner axes, which follow
+    the faster-varying device enumeration — ICI on TPU). Axes of size
+    1 are omitted so a pure-DP topology builds the exact same mesh as
+    the pre-mesh default path."""
+    axes = {DATA_AXIS: max(int(data_shards), 1)}
+    if seq_shards > 1:
+        axes[SEQ_AXIS] = int(seq_shards)
+    if model_shards > 1:
+        axes[MODEL_AXIS] = int(model_shards)
+    if stage_shards > 1:
+        axes[STAGE_AXIS] = int(stage_shards)
+    if expert_shards > 1:
+        axes[EXPERT_AXIS] = int(expert_shards)
+    return axes
+
+
+def create_mesh_from_topology(*, devices=None) -> Mesh:
+    """Build the mesh the scheduler's published topology asks for.
+
+    Reads the launcher-exported topology (``ADAPTDL_SEQ_SHARDS`` /
+    ``ADAPTDL_MODEL_SHARDS`` / ``ADAPTDL_STAGE_SHARDS`` /
+    ``ADAPTDL_EXPERT_SHARDS``) and the chip grant
+    (``ADAPTDL_NUM_REPLICAS``, which the scheduler exports as the
+    job's CHIP count), factors the chips into
+    ``dp = chips // (sp * tp * ss * ep)`` data-parallel groups, and
+    returns the mesh over exactly that many devices. This is the path
+    by which an allocator-chosen ``(dp, tp, pp)`` shape becomes a
+    real device mesh without any per-job launcher code; with every
+    shard axis at 1 it degenerates to the default one-"data"-axis
+    mesh over the chip grant.
+    """
+    from adaptdl_tpu import env
+
+    sp = env.seq_shards()
+    tp = env.model_shards()
+    ss = env.stage_shards()
+    ep = env.expert_shards()
+    dp = env.data_parallel_replicas()
+    axes = topology_axes(dp, sp, tp, ss, ep)
+    total = dp * sp * tp * ss * ep
+    if devices is None:
+        devices = jax.devices()[:total]
+    return create_mesh(axes, devices=devices)
+
+
 def create_mesh(
     axes: dict[str, int] | None = None,
     *,

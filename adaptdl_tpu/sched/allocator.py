@@ -98,6 +98,7 @@ def job_info_from_hints(
     preemptible = bool(spec.get("preemptible", True))
     speedup_fn = None
     max_replicas = max(min_replicas, 1)
+    mesh_grid = None
     if hints and hints.get("perfParams") and hints.get("gradParams"):
         perf = PerfParams(**hints["perfParams"])
         grad = GradParams(**hints["gradParams"])
@@ -105,6 +106,12 @@ def job_info_from_hints(
             perf, grad, hints["initBatchSize"]
         )
         bounds = hints.get("localBszBounds")
+        raw_grid = hints.get("meshShapeGrid")
+        if raw_grid:
+            mesh_grid = tuple(
+                (int(sp), int(tp), int(ss), int(ep))
+                for sp, tp, ss, ep in raw_grid
+            )
         speedup_fn = SpeedupFunction(
             goodput_fn,
             max_batch_size=hints.get("maxBatchSize"),
@@ -121,6 +128,7 @@ def job_info_from_hints(
                 or 8
             ),
             pipeline_chunks=int(hints.get("pipelineChunks") or 0),
+            mesh_shape_grid=mesh_grid,
         )
         profiled = int(hints.get("maxProfiledReplicas") or 1)
         # Profiling gates scale-up: at most double what was measured.
@@ -142,6 +150,7 @@ def job_info_from_hints(
         preemptible=preemptible,
         restart_penalty=_penalty_from_cost(restart_cost_s),
         restart_cost_s=restart_cost_s,
+        mesh_shape_grid=mesh_grid,
     )
 
 

@@ -36,6 +36,7 @@ class SpeedupFunction:
         max_expert_shards: int = 1,
         max_pipeline_micro: int = 8,
         pipeline_chunks: int = 0,
+        mesh_shape_grid=None,
     ):
         self._goodput_fn = goodput_fn
         self._max_batch_size = max_batch_size
@@ -47,6 +48,18 @@ class SpeedupFunction:
         self._max_expert_shards = max(int(max_expert_shards or 1), 1)
         self._max_pipeline_micro = max(int(max_pipeline_micro or 1), 1)
         self._pipeline_chunks = max(int(pipeline_chunks or 0), 0)
+        # Explicit candidate mesh shapes (goodput.mesh_shape_grid /
+        # the job's meshShapeGrid hint). None keeps the max_*-derived
+        # power-of-two enumeration, so dp-only jobs (all limits 1, no
+        # grid) take the IDENTICAL search the pre-mesh scheduler ran.
+        self._mesh_shape_grid = (
+            tuple(
+                (int(sp), int(tp), int(ss), int(ep))
+                for sp, tp, ss, ep in mesh_shape_grid
+            )
+            if mesh_shape_grid
+            else None
+        )
         # Base goodput: one chip on one slice.
         base, *_ = self._optimize(np.array([1]), np.array([1]))
         self._base_goodput = float(np.atleast_1d(base)[0])
@@ -68,7 +81,14 @@ class SpeedupFunction:
             max_expert_shards=self._max_expert_shards,
             max_pipeline_micro=self._max_pipeline_micro,
             pipeline_chunks=self._pipeline_chunks,
+            shape_grid=self._mesh_shape_grid,
         )
+
+    @property
+    def mesh_shape_grid(self):
+        """The explicit candidate shapes this job advertised, or None
+        when the search runs on the max_*-derived enumeration."""
+        return self._mesh_shape_grid
 
     def best_config(
         self, num_nodes: int, num_chips: int

@@ -30,6 +30,13 @@ class JobInfo:
     # restart jobs migrate to on-demand slices while cheap-restart
     # jobs soak up spot. None -> the policy's assumed default.
     restart_cost_s: float | None = None
+    # Candidate mesh shapes ((sp, tp, ss, ep) tuples) the scheduler
+    # may factorize this job's chips into — the job's meshShapeGrid
+    # hint, carried so policy-level consumers (sim, dashboards,
+    # dp-only equivalence tests) can see the searchable shape set
+    # without reaching into the speedup function. None/((1,1,1,1),)
+    # means the job is schedulable as pure data-parallel only.
+    mesh_shape_grid: tuple | None = None
 
     def __post_init__(self):
         assert self.max_replicas > 0

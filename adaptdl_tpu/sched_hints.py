@@ -51,6 +51,13 @@ SCHED_HINTS_KEYS = (
     "maxPipelineMicro",
     "pipelineMicrobatches",
     "pipelineChunks",
+    # Explicit candidate mesh shapes: a list of [sp, tp, ss, ep]
+    # 4-lists (goodput.mesh_shape_grid's output shape). Optional — a
+    # job that only posts max*Shards limits gets the power-of-two
+    # enumeration; posting a grid makes non-pow2 factorizations (12
+    # chips -> tp=3) searchable and pins the scheduler to EXACTLY the
+    # shapes the job's model code can actually build.
+    "meshShapeGrid",
     # Measured rescale-cost components (metrics.restart_stats):
     # snapshotS/writeS of the last checkpoint save, restoreS of this
     # incarnation's restore, overlapFrac, numRetunes — the allocator
@@ -83,6 +90,22 @@ def validate_hints(hints: dict[str, Any]) -> None:
         hints["restartStats"], dict
     ):
         raise ValueError("restartStats must be an object")
+    if hints.get("meshShapeGrid") is not None:
+        grid = hints["meshShapeGrid"]
+        if not isinstance(grid, (list, tuple)):
+            raise ValueError("meshShapeGrid must be a list of shapes")
+        for shape in grid:
+            if (
+                not isinstance(shape, (list, tuple))
+                or len(shape) != 4
+                or not all(
+                    isinstance(a, int) and a >= 1 for a in shape
+                )
+            ):
+                raise ValueError(
+                    "meshShapeGrid entries must be [sp, tp, ss, ep] "
+                    f"lists of positive ints; got {shape!r}"
+                )
 
 
 # After a failed /config fetch, the rpc client's circuit breaker

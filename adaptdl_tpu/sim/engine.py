@@ -59,6 +59,23 @@ PROFILE_DELAY_S = 15.0
 _EPS = 1e-9
 
 
+_DP_TOPO = (1, 1, 1, 1, 1)
+
+
+def _topo_tuple(topology: dict | None) -> tuple[int, int, int, int, int]:
+    """A published topology dict as the (sp, tp, ss, ep, micro) tuple
+    the goodput model prices."""
+    topology = topology or {}
+    ss = max(int(topology.get("stageShards", 1)), 1)
+    return (
+        max(int(topology.get("seqShards", 1)), 1),
+        max(int(topology.get("modelShards", 1)), 1),
+        ss,
+        max(int(topology.get("expertShards", 1)), 1),
+        max(int(topology.get("pipelineMicro", 1)), 1) if ss > 1 else 1,
+    )
+
+
 @dataclass
 class _SimJob:
     spec: SimJobSpec
@@ -68,6 +85,7 @@ class _SimJob:
     work_done: float = 0.0
     goodput: float = 0.0  # current useful-examples/s (0 = stalled)
     alloc: tuple[str, ...] = ()
+    topo: tuple = _DP_TOPO  # published mesh shape the job runs
     restart_until: float = 0.0
     gen: int = 0  # bumped on any rate change; stale finish events die
     first_alloc_t: float | None = None
@@ -75,28 +93,49 @@ class _SimJob:
     restarts: int = 0
     profiled: int = 0  # maxProfiledReplicas last posted
     hints_pending: bool = False
+    mesh_assignments: int = 0  # times published with a non-DP shape
     _cache: dict = field(default_factory=dict)
 
     @property
     def done(self) -> bool:
         return self.finish_t is not None
 
-    def rate_at(self, num_nodes: int, replicas: int) -> float:
-        """Best adaptive goodput of this job at (slices, replicas)
-        under its own fitted model (the dataloader self-tunes its
-        batch geometry locally). Cached — the same points recur every
-        cycle."""
-        key = (num_nodes, replicas)
+    def rate_at(
+        self,
+        num_nodes: int,
+        replicas: int,
+        topo: tuple = _DP_TOPO,
+    ) -> float:
+        """Best adaptive goodput of this job at (slices, chips) under
+        the published mesh shape and its own fitted model (the
+        dataloader self-tunes its batch geometry locally). ``topo``
+        is (sp, tp, ss, ep, micro); the chips factor as dp =
+        replicas // (sp*tp*ss*ep) data-parallel groups. Cached — the
+        same points recur every cycle."""
+        key = (num_nodes, replicas, topo)
         if key not in self._cache:
-            if replicas <= 0:
+            sp, tp, ss, ep, micro = topo
+            group = sp * tp * ss * ep
+            dp = replicas // group if group > 1 else replicas
+            if replicas <= 0 or dp <= 0 or dp * group != replicas:
+                # Unfactorizable publication (shouldn't happen — the
+                # policy derives the shape from the chip count);
+                # price it as dp-only rather than stall the job.
+                dp, sp, tp, ss, ep, micro = replicas, 1, 1, 1, 1, 1
+            if dp <= 0:
                 self._cache[key] = 0.0
             else:
                 goodput, _, _ = self.goodput_fn.optimize(
-                    np.asarray([num_nodes]),
-                    np.asarray([replicas]),
+                    np.asarray([min(num_nodes, dp)]),
+                    np.asarray([dp]),
                     max_batch_size=self.spec.max_bsz,
                     atomic_bsz_range=self.spec.bounds,
                     accumulation=True,
+                    seq_shards=sp,
+                    model_shards=tp,
+                    stage_shards=ss,
+                    pipeline_micro=micro,
+                    expert_shards=ep,
                 )
                 self._cache[key] = float(np.atleast_1d(goodput)[0])
         return self._cache[key]
@@ -134,10 +173,16 @@ class ClusterSim:
         policy: PolluxPolicy | None = None,
         dirty_threshold: float | None = None,
         full_every: int | None = None,
+        dp_only: bool = False,
     ):
         self.clock = VirtualClock()
         self.queue = EventQueue()
         self.fixed = bool(fixed)
+        # dp_only strips the mesh hints (max*Shards / meshShapeGrid)
+        # from every job's posts, so the REAL policy runs its
+        # replica-only search — the comparison arm that prices what
+        # mesh-shape elasticity is worth on a trace.
+        self.dp_only = bool(dp_only)
         self.chips_per_slice = int(chips_per_slice)
         self.interval = float(interval)
         self.max_sim_s = float(max_sim_s)
@@ -270,12 +315,18 @@ class ClusterSim:
     # -- shared helpers ------------------------------------------------
 
     def _set_allocation(
-        self, job: _SimJob, alloc: tuple[str, ...], now: float
+        self,
+        job: _SimJob,
+        alloc: tuple[str, ...],
+        now: float,
+        topo: tuple = _DP_TOPO,
     ) -> None:
-        """Apply an allocation change to the simulated job: charge a
-        checkpoint-restart when it leaves a non-empty allocation,
-        recompute its goodput, and re-arm its completion event."""
-        if alloc == job.alloc:
+        """Apply an allocation (or mesh-shape) change to the simulated
+        job: charge a checkpoint-restart when it leaves a non-empty
+        allocation (a topology change restarts too — the worker
+        rebuilds its mesh), recompute its goodput at the published
+        shape, and re-arm its completion event."""
+        if alloc == job.alloc and topo == job.topo:
             return
         if job.alloc:
             job.restarts += 1
@@ -283,7 +334,10 @@ class ClusterSim:
                 job.restart_until, now + job.spec.restart_cost_s
             )
         job.alloc = alloc
+        job.topo = topo
         job.gen += 1
+        if alloc and topo != _DP_TOPO:
+            job.mesh_assignments += 1
         replicas = len(alloc)
         nodes = len(set(alloc))
         if not replicas:
@@ -295,7 +349,7 @@ class ClusterSim:
             # paper's comparison arm).
             job.goodput = job.ideal_rate
         else:
-            job.goodput = job.rate_at(nodes, replicas)
+            job.goodput = job.rate_at(nodes, replicas, topo)
         if replicas and job.first_alloc_t is None:
             job.first_alloc_t = now
             self.queue.push(
@@ -437,7 +491,8 @@ class ClusterSim:
             self.allocator.optimize_once()
         finally:
             self._decide_s.append(time.monotonic() - wall)
-        # Mirror the published allocations onto the simulated jobs.
+        # Mirror the published allocations (and mesh shapes) onto the
+        # simulated jobs.
         for key, job in self.jobs.items():
             if job.done:
                 continue
@@ -445,7 +500,10 @@ class ClusterSim:
             if record is None or record.status in FINISHED:
                 continue
             self._set_allocation(
-                job, tuple(record.allocation), now
+                job,
+                tuple(record.allocation),
+                now,
+                topo=_topo_tuple(record.topology),
             )
             # A job still below its profiling cap keeps nudging the
             # allocator — the stand-in for the periodic sched-hints
@@ -481,7 +539,11 @@ class ClusterSim:
         job.profiled = max(job.profiled, len(job.alloc), 1)
         self.state.update(
             job.spec.key,
-            hints=hints_payload(job.spec, profiled=job.profiled),
+            hints=hints_payload(
+                job.spec,
+                profiled=job.profiled,
+                dp_only=self.dp_only,
+            ),
         )
 
     def _handle_finish(self, event: Event) -> None:
@@ -682,6 +744,14 @@ class SimReport:
                 job.restarts for job in self.jobs.values()
             ),
             "preempt_notices": sim._preempt_notices,
+            "dp_only": sim.dp_only,
+            # Jobs the policy ever shaped beyond pure data-parallel —
+            # the head count mesh-shape elasticity actually touched.
+            "mesh_shaped_jobs": sum(
+                1
+                for job in self.jobs.values()
+                if job.mesh_assignments > 0
+            ),
         }
 
     def summary_json(self) -> str:

@@ -26,7 +26,7 @@ import json
 import random
 from dataclasses import dataclass
 
-from adaptdl_tpu.goodput import GradParams, PerfParams
+from adaptdl_tpu.goodput import GradParams, PerfParams, mesh_shape_grid
 
 
 @dataclass(frozen=True)
@@ -41,9 +41,21 @@ class SimCategory:
     duration_mean_s: float  # mean ideal runtime at `requested`
     restart_mean_s: float  # mean checkpoint-restart cost
     compute_scale: float  # scales the per-step compute constants
+    # Mesh-shape limits a job of this category advertises (the
+    # max*Shards hints); > 1 makes the category a LARGE-MODEL
+    # workload the replica-only scheduler cannot shape correctly —
+    # its jobs post a meshShapeGrid and the policy may factorize
+    # their chips as (dp, tp, pp) meshes.
+    max_model_shards: int = 1
+    max_stage_shards: int = 1
 
 
-# Pollux evaluation mix: 72/20/6/2 (% of arrivals).
+# Pollux evaluation mix 72/20/6/2 (% of arrivals), plus a "mega"
+# large-model tail: jobs whose statistical batch budget is nearly
+# exhausted at their initial batch size (dp scaling hits the
+# efficiency cliff immediately) but whose per-step compute is heavy —
+# exactly the surface where a (dp, tp, pp) factorization wins. Their
+# share is small (2%) but each asks for real capacity.
 CATEGORIES: dict[str, SimCategory] = {
     "small": SimCategory(
         "small", 0.72, 4, 1, 64, 512, (16, 128), 300.0, 10.0, 0.5
@@ -56,6 +68,10 @@ CATEGORIES: dict[str, SimCategory] = {
     ),
     "xlarge": SimCategory(
         "xlarge", 0.02, 64, 16, 512, 8192, (64, 1024), 2400.0, 90.0, 4.0
+    ),
+    "mega": SimCategory(
+        "mega", 0.02, 32, 8, 128, 256, (8, 64), 1800.0, 120.0, 8.0,
+        max_model_shards=8, max_stage_shards=2,
     ),
 }
 
@@ -81,6 +97,13 @@ class SimJobSpec:
     restart_cost_s: float
     perf: PerfParams
     grad: GradParams
+    # Mesh-shape advertisement (large-model categories): the
+    # max*Shards limits and the explicit candidate grid the job's
+    # hints carry. Empty grid = dp-only job (the pre-mesh hint shape,
+    # byte-identical on the wire).
+    max_model_shards: int = 1
+    max_stage_shards: int = 1
+    mesh_shape_grid: tuple = ()
 
 
 def percentile(values: list, q: float) -> float:
@@ -95,14 +118,19 @@ def percentile(values: list, q: float) -> float:
     return float(ordered[rank])
 
 
-def hints_payload(spec: "SimJobSpec", profiled: int = 1) -> dict:
+def hints_payload(
+    spec: "SimJobSpec", profiled: int = 1, dp_only: bool = False
+) -> dict:
     """The sched-hints dict a simulated job posts: its fitted model,
     batch geometry, profiling gate, and restart-stat sample (the
     0.2/0.4/0.4 snapshot/write/restore split). One home — the engine's
     hint events and bench_sched's synthetic jobs must post the same
-    payload shape."""
+    payload shape. Large-model specs additionally post their mesh
+    limits + meshShapeGrid; ``dp_only=True`` strips them (the
+    replica-only policy arm of the retention comparison), leaving the
+    payload byte-identical to a pre-mesh job's."""
     cost = spec.restart_cost_s
-    return {
+    payload = {
         "perfParams": dict(spec.perf._asdict()),
         "gradParams": dict(spec.grad._asdict()),
         "initBatchSize": spec.init_bsz,
@@ -116,6 +144,13 @@ def hints_payload(spec: "SimJobSpec", profiled: int = 1) -> dict:
             "restoreS": round(0.4 * cost, 4),
         },
     }
+    if not dp_only and spec.mesh_shape_grid:
+        payload["maxModelShards"] = spec.max_model_shards
+        payload["maxStageShards"] = spec.max_stage_shards
+        payload["meshShapeGrid"] = [
+            list(shape) for shape in spec.mesh_shape_grid
+        ]
+    return payload
 
 
 def resolve_job(record: dict) -> SimJobSpec:
@@ -142,6 +177,35 @@ def resolve_job(record: dict) -> SimJobSpec:
     # goodput packing exploits.
     sqr = 0.001 * jitter(0.5, 2.0)
     var = sqr * jitter(4.0, 40.0)
+    grid: tuple = ()
+    if cat.max_model_shards > 1 or cat.max_stage_shards > 1:
+        # Large-model category: the extra draws happen only for mesh
+        # categories, AFTER the shared sequence — committed traces of
+        # the pre-mesh categories replay bit-identically. The fitted
+        # surface is tp-favorable by construction: compute is
+        # BATCH-dominated (big beta_c, small alpha_c — the per-chip
+        # share divides by tp), the gradient sync is expensive, the
+        # per-layer TP collectives are cheap, and the batch budget is
+        # nearly exhausted at init (signal-dominated noise), so extra
+        # chips only help by DIVIDING the model, not the data.
+        perf = PerfParams(
+            0.05 * jitter(0.8, 1.2),
+            0.10 * jitter(0.8, 1.2),
+            0.40 * jitter(0.8, 1.2),
+            0.06 * jitter(0.8, 1.2),
+            0.20 * jitter(0.8, 1.2),
+            0.03 * jitter(0.8, 1.2),
+            1.2,
+            alpha_tp=0.002 * jitter(0.7, 1.3),
+            beta_tp=0.0002 * jitter(0.7, 1.3),
+            alpha_pp=0.002 * jitter(0.7, 1.3),
+            beta_pp=0.0002 * jitter(0.7, 1.3),
+        )
+        var = sqr * jitter(1.0, 3.0)
+        grid = mesh_shape_grid(
+            max_model_shards=cat.max_model_shards,
+            max_stage_shards=cat.max_stage_shards,
+        )
     return SimJobSpec(
         key=record["job"],
         category=cat.name,
@@ -155,6 +219,9 @@ def resolve_job(record: dict) -> SimJobSpec:
         restart_cost_s=cat.restart_mean_s * jitter(0.5, 2.0),
         perf=perf,
         grad=GradParams(sqr=sqr, var=var),
+        max_model_shards=cat.max_model_shards,
+        max_stage_shards=cat.max_stage_shards,
+        mesh_shape_grid=grid,
     )
 
 

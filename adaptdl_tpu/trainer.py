@@ -51,7 +51,6 @@ from adaptdl_tpu.parallel.mesh import (
     PARAM_SHARDED_AXES,
     SEQ_AXIS,
     STAGE_AXIS,
-    create_mesh,
 )
 from adaptdl_tpu.scaling_rules import RuleContext, ScalingRule
 
@@ -187,14 +186,20 @@ class ElasticTrainer:
         self.init_batch_size = init_batch_size
         self.scaling_rule = scaling_rule or ScalingRule()
         if mesh is None:
-            # Default mesh: one data-parallel replica per chip of this
-            # job's allocation (ADAPTDL_NUM_REPLICAS, set by the
-            # scheduler or defaulted by initialize_job).
-            from adaptdl_tpu import env as env_mod
-
-            mesh = create_mesh(
-                devices=jax.devices()[: env_mod.num_replicas()]
+            # Default mesh: the scheduler's published topology. With
+            # every shard axis at 1 (the common case) this is one
+            # data-parallel replica per chip of the allocation
+            # (ADAPTDL_NUM_REPLICAS, set by the scheduler or defaulted
+            # by initialize_job); with a published (dp, tp, pp)
+            # factorization the worker builds exactly that mesh — the
+            # last hop of the allocation -> /config -> bootstrap
+            # mesh-shape flow (jobs needing a custom sharded loss
+            # still pass their own mesh, as the examples do).
+            from adaptdl_tpu.parallel.mesh import (
+                create_mesh_from_topology,
             )
+
+            mesh = create_mesh_from_topology()
         self.mesh = mesh
         if precondition not in (None, "adam"):
             raise ValueError(f"unknown precondition: {precondition!r}")
@@ -1981,11 +1986,13 @@ class ElasticTrainer:
         name: str = "elastic_trainer",
         transform_save=None,
         transform_load=None,
+        shard_plan_fn=None,
     ) -> "TrainerCheckpoint":
         return TrainerCheckpoint(
             name, self, get_state, set_state,
             transform_save=transform_save,
             transform_load=transform_load,
+            shard_plan_fn=shard_plan_fn,
         )
 
 
@@ -2015,19 +2022,32 @@ class TrainerCheckpoint(checkpoint.State):
         set_state,
         transform_save=None,
         transform_load=None,
+        shard_plan_fn=None,
     ):
         """``transform_save(host_state) -> host_state`` /
         ``transform_load(host_state) -> host_state`` convert between
         the run layout and a topology-independent canonical disk
         layout — the hook that lets a STRUCTURE-changing topology
         (e.g. pipeline stage restacking, models/pipeline_lm.py) rescale
-        across restarts, where sp/tp only need re-sharding."""
+        across restarts, where sp/tp only need re-sharding.
+
+        ``shard_plan_fn({chunk_id: rows}) -> {chunk_id: (lo, hi)}``
+        declares which leading-axis row span of each leaf THIS
+        process needs on the peer-to-peer handoff path (its shard
+        map): a resharding successor then range-pulls only those
+        parts instead of bulk-fetching full leaves
+        (``handoff.fraction_plan`` builds the balanced-fraction map).
+        Rows outside the plan restore zero-filled, so it is only
+        correct when every requested leaf row this process's devices
+        will actually read is covered — the single-controller default
+        (None) always pulls everything."""
         super().__init__(name)
         self._trainer = trainer
         self._get_state = get_state
         self._set_state = set_state
         self._transform_save = transform_save
         self._transform_load = transform_load
+        self._shard_plan_fn = shard_plan_fn
 
     def snapshot(self):
         """Phase 1 of the save pipeline: a point-in-time HOST copy of
@@ -2135,6 +2155,38 @@ class TrainerCheckpoint(checkpoint.State):
             pickle.loads(mapping[f"leaf/{i:05d}"])
             for i in range(treedef.num_leaves)
         ]
+        self._apply_host_state(
+            jax.tree_util.tree_unflatten(treedef, leaves)
+        )
+
+    def handoff_shard_plan(self, chunk_rows):
+        if self._shard_plan_fn is None:
+            return None
+        return self._shard_plan_fn(chunk_rows)
+
+    def load_chunk_rows(self, chunks, partial):
+        """Shard-plan restore: whole chunks deserialize as usual; a
+        partial leaf materializes zero-filled outside its pulled row
+        range. Safe exactly when the shard plan covers every row this
+        process's devices read (``device_put`` onto a multi-process
+        mesh slices each process's shards locally, so foreign rows
+        are never touched)."""
+        mapping = dict(chunks)
+        spans = {
+            cid: (lo, hi, rows, arr)
+            for cid, lo, hi, rows, arr in partial
+        }
+        treedef = pickle.loads(mapping["treedef"])
+        leaves = []
+        for i in range(treedef.num_leaves):
+            cid = f"leaf/{i:05d}"
+            if cid in mapping:
+                leaves.append(pickle.loads(mapping[cid]))
+                continue
+            lo, hi, rows, arr = spans[cid]
+            full = np.zeros((rows, *arr.shape[1:]), arr.dtype)
+            full[lo:hi] = arr
+            leaves.append(full)
         self._apply_host_state(
             jax.tree_util.tree_unflatten(treedef, leaves)
         )

@@ -830,6 +830,167 @@ def _rescale_trials(trainer_factory, dataset, init_bsz, trials=3):
     return p50, breakdown, trace_summary
 
 
+def _bench_mesh_rescale(trials: int = 3) -> dict | None:
+    """Mesh-shape elasticity's rescale cost: a PLANNED dp -> (dp, tp)
+    reshape where the successor re-materializes the predecessor's
+    peer-served state onto a tensor-parallel mesh, plus the
+    range-pull bytes story — what a shard-map-keyed successor
+    (``handoff.fraction_plan``) pulls versus the full-leaf handoff.
+
+    Reports ``mesh_rescale_p50_s`` (median collect+serve+reshard-
+    restore wall, the reshape's critical path; the durable write
+    overlaps it exactly as in ``_bench_rescale_latency``) and the
+    fraction-pull bytes ratio. All timing ``time.monotonic()``."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from adaptdl_tpu import checkpoint as ckpt_mod
+    from adaptdl_tpu import handoff as handoff_mod
+    from adaptdl_tpu.parallel import create_mesh
+    from adaptdl_tpu.trainer import ElasticTrainer
+    from jax.sharding import PartitionSpec as P
+
+    ndev = len(jax.devices())
+    tp = 2 if ndev >= 2 else 1
+    if tp == 1:
+        return None
+    ndev = (ndev // tp) * tp
+    dim = 256
+    rng = np.random.default_rng(11)
+    data = {
+        "x": rng.normal(size=(64, dim)).astype(np.float32),
+        "label": rng.normal(size=(64,)).astype(np.float32),
+    }
+    params = {
+        "w1": jnp.asarray(
+            rng.normal(size=(dim, dim)).astype(np.float32)
+        ),
+        "w2": jnp.asarray(rng.normal(size=(dim,)).astype(np.float32)),
+    }
+
+    def loss_fn(p, batch, _rng):
+        h = jnp.tanh(batch["x"] @ p["w1"])
+        return jnp.mean((h @ p["w2"] - batch["label"]) ** 2)
+
+    def sharding_fn(path, leaf):
+        # w1's rows shard over the model axis; the rest replicate.
+        if getattr(path[-1], "key", None) == "w1":
+            return P("model")
+        return P()
+
+    def make_dp():
+        return ElasticTrainer(
+            loss_fn, params, optax.sgd(0.1, momentum=0.9), 8,
+            mesh=create_mesh(devices=jax.devices()[:ndev]),
+        )
+
+    def make_tp():
+        return ElasticTrainer(
+            loss_fn, params, optax.sgd(0.1, momentum=0.9), 8,
+            mesh=create_mesh(
+                {"data": ndev // tp, "model": tp},
+                devices=jax.devices()[:ndev],
+            ),
+            param_sharding_fn=sharding_fn,
+        )
+
+    reshape_times: list[float] = []
+    frac_bytes: list[int] = []
+    full_bytes: list[int] = []
+    for trial in range(trials):
+      server = None
+      try:
+        with tempfile.TemporaryDirectory() as tmp:
+            os.environ["ADAPTDL_CHECKPOINT_PATH"] = tmp
+            trainer = make_dp()
+            holder = {"state": trainer.init_state()}
+            ck = trainer.make_checkpoint_state(
+                lambda: holder["state"],
+                lambda s: holder.__setitem__("state", s),
+                name=f"bench-mesh-{trial}",
+            )
+            atomic = max(8 // trainer.num_replicas, 1)
+            step = trainer.train_step(atomic, 0)
+            batch = {
+                k: v[: atomic * trainer.num_replicas]
+                for k, v in data.items()
+            }
+            holder["state"], m = step(
+                holder["state"], trainer.shard_batch(batch)
+            )
+            jax.block_until_ready(m["loss"])
+
+            # Planned reshape: collect+serve the predecessor's state,
+            # re-materialize onto the (dp, tp) mesh peer-to-peer.
+            start = time.monotonic()
+            server = handoff_mod.serve_states()
+            trainer2 = make_tp()
+            holder2 = {"state": trainer2.init_state()}
+            ck.unregister()
+            ck2 = trainer2.make_checkpoint_state(
+                lambda: holder2["state"],
+                lambda s: holder2.__setitem__("state", s),
+                name=f"bench-mesh-{trial}",
+            )
+            handoff_mod.set_source(server.url)
+            if not ckpt_mod.load_state(ck2):
+                raise RuntimeError(
+                    "mesh reshape trial: peer restore failed"
+                )
+            reshape_times.append(time.monotonic() - start)
+            full_bytes.append(handoff_mod._fetch_stats["bytes"])
+
+            # Range-pull arm: a shard-map-keyed successor (one tp
+            # shard's fraction of every leaf) against the same peer.
+            ck2.unregister()
+            handoff_mod._reset_client_state()
+            trainer3 = make_tp()
+            holder3 = {"state": trainer3.init_state()}
+            ck3 = trainer3.make_checkpoint_state(
+                lambda: holder3["state"],
+                lambda s: holder3.__setitem__("state", s),
+                name=f"bench-mesh-{trial}",
+                shard_plan_fn=lambda rows: handoff_mod.fraction_plan(
+                    rows, 0, tp
+                ),
+            )
+            handoff_mod.set_source(server.url)
+            if not ckpt_mod.load_state(ck3):
+                raise RuntimeError(
+                    "mesh reshape trial: range-pull restore failed"
+                )
+            frac_bytes.append(handoff_mod._fetch_stats["bytes"])
+            ck3.unregister()
+      finally:
+        # A failed trial must not leak into later bench phases: the
+        # env var would point at a deleted tempdir, the in-process
+        # shard server would pin the payload, and the handoff
+        # client's sticky manifest would pollute later measurements.
+        if server is not None:
+            server.stop()
+        handoff_mod._reset_client_state()
+        os.environ.pop("ADAPTDL_CHECKPOINT_PATH", None)
+    out = {
+        "mesh_rescale_p50_s": round(
+            float(np.median(reshape_times)), 4
+        ),
+        "mesh_handoff_full_bytes": int(np.median(full_bytes)),
+        "mesh_handoff_frac_bytes": int(np.median(frac_bytes)),
+        "mesh_handoff_bytes_fraction": round(
+            float(
+                np.median(frac_bytes) / max(np.median(full_bytes), 1)
+            ),
+            4,
+        ),
+        "mesh_tp": tp,
+    }
+    _log(f"mesh rescale: {out}")
+    return out
+
+
 def main(quick: bool = False):
     on_tpu = _probe_backend()
     if not on_tpu:
@@ -1048,6 +1209,17 @@ def main(quick: bool = False):
             )
     except Exception as exc:  # noqa: BLE001 - optional metric
         _log(f"rescale bench failed: {exc}")
+    # Mesh-shape reshape: the planned dp -> (dp, tp) rescale path +
+    # the shard-map range-pull bytes vs the full-leaf handoff.
+    mesh_stats = None
+    try:
+        if _remaining() > 45:
+            metrics._reset_state()
+            mesh_stats = _bench_mesh_rescale(
+                trials=3 if _remaining() > 90 else 1
+            )
+    except Exception as exc:  # noqa: BLE001 - optional metric
+        _log(f"mesh rescale bench failed: {exc}")
     # Thousand-job control plane (bench_sched.py): allocator decide
     # p50/p99 at 1k jobs / 10k slots (cold full cycle vs the
     # incremental path) + supervisor per-endpoint p99s under
@@ -1082,6 +1254,8 @@ def main(quick: bool = False):
         result["rescale_breakdown"] = rescale_breakdown
     if rescale_trace is not None:
         result["rescale_trace"] = rescale_trace
+    if mesh_stats:
+        result.update(mesh_stats)
     if sched_stats:
         result.update(sched_stats)
     print(json.dumps(result))

@@ -1,0 +1,19 @@
+"""Known-bad mesh-shape construction path: literals no mesh binds."""
+
+from jax import lax
+
+from adaptdl_tpu.parallel.mesh import create_mesh
+
+
+def build(devices):
+    return create_mesh({"data": 2}, devices=devices)
+
+
+def grad_sync(grads):
+    return lax.pmean(grads, "dta")  # line 13: GC401 typo'd axis
+
+
+def tp_sync(x):
+    # line 18: GC401 — "model" is NOT bound here: this module's only
+    # mesh is the explicit {"data": 2}, not the topology path.
+    return lax.psum(x, "model")

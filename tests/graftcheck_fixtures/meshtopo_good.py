@@ -1,0 +1,31 @@
+"""Known-good mesh-shape construction path: zero findings expected."""
+
+from jax import lax
+
+from adaptdl_tpu.parallel.mesh import (
+    create_mesh,
+    create_mesh_from_topology,
+)
+
+
+def build_custom(devices):
+    # create_mesh's axes dict binds its literal keys.
+    return create_mesh({"data": 4, "grid": 2}, devices=devices)
+
+
+def build_from_topology():
+    # The reshape path binds the canonical axis names with no string
+    # literal at the call site.
+    return create_mesh_from_topology()
+
+
+def grid_sync(x):
+    return lax.psum(x, "grid")  # bound by build_custom's axes dict
+
+
+def tp_sync(x):
+    return lax.pmean(x, "model")  # canonical: the topology mesh
+
+
+def stage_shift(x):
+    return lax.ppermute(x, "stage", [(0, 1)])  # canonical too

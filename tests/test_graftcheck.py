@@ -88,6 +88,21 @@ def test_collective_axis_good():
     assert run_on("axis_good.py") == []
 
 
+def test_mesh_topology_construction_bad():
+    """Literals outside the module's bound axes still flag when the
+    only mesh is an explicit create_mesh without those names."""
+    findings = run_on("meshtopo_bad.py")
+    assert rule_lines(findings, "GC401") == [13, 19]
+    assert {f.rule for f in findings} == {"GC401"}
+
+
+def test_mesh_topology_construction_good():
+    """The mesh-shape construction path (create_mesh axes dicts and
+    create_mesh_from_topology's canonical names) resolves collective
+    literals — a reshaped job's module needs no suppressions."""
+    assert run_on("meshtopo_good.py") == []
+
+
 def test_checkpoint_protocol_bad():
     findings = run_on("ckptproto_bad.py")
     assert rule_lines(findings, "GC501") == [8, 16, 33]

@@ -1,0 +1,193 @@
+"""dp-only equivalence suite: mesh-shape elasticity must be invisible
+to pure data-parallel jobs.
+
+With no multi-dim shapes in any job's grid, every layer of the new
+path — the shape-grid enumeration, the speedup function, and
+``PolluxPolicy.optimize`` / ``optimize_incremental`` — must produce
+BIT-identical outputs to the legacy dp-only construction on fixed
+seeds. This is the guard against a silent regression of the entire
+existing scheduler: the dp-only model is the exact special case
+``tp = pp = 1``, not a separate code path that can drift.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from adaptdl_tpu.goodput import (
+    GoodputFunction,
+    GradParams,
+    PerfParams,
+    mesh_shape_grid,
+)
+from adaptdl_tpu.sched.policy import (
+    JobInfo,
+    NodeInfo,
+    PolluxPolicy,
+    SpeedupFunction,
+)
+
+PERF = PerfParams(0.121, 0.00568, 0.0236, 0.00634, 0.0118, 0.00317, 1.14)
+GRAD = GradParams(sqr=0.00136, var=0.000502)
+
+DP_GRID = ((1, 1, 1, 1),)
+
+
+def _speedup_fn(grid=None):
+    return SpeedupFunction(
+        GoodputFunction(PERF, GRAD, 128),
+        max_batch_size=1280,
+        atomic_bsz_range=(64, 256),
+        accumulation=True,
+        mesh_shape_grid=grid,
+    )
+
+
+def _jobs(grid=None):
+    return {
+        "a": JobInfo(
+            resources={"tpu": 1},
+            speedup_fn=_speedup_fn(grid),
+            creation_timestamp=0.0,
+            min_replicas=0,
+            max_replicas=8,
+            mesh_shape_grid=grid,
+        ),
+        "b": JobInfo(
+            resources={"tpu": 1},
+            speedup_fn=_speedup_fn(grid),
+            creation_timestamp=1.0,
+            min_replicas=1,
+            max_replicas=4,
+            mesh_shape_grid=grid,
+        ),
+        "c": JobInfo(
+            resources={"tpu": 1},
+            speedup_fn=_speedup_fn(grid),
+            creation_timestamp=2.0,
+            min_replicas=0,
+            max_replicas=8,
+            mesh_shape_grid=grid,
+        ),
+    }
+
+
+def _nodes(n=3, chips=4):
+    return {
+        f"slice-{i}": NodeInfo(resources={"tpu": chips})
+        for i in range(n)
+    }
+
+
+def test_goodput_topology_dp_grid_equals_plain_optimize():
+    """optimize_topology over the singleton dp grid IS optimize — the
+    same numbers to the last bit, for both grid spellings."""
+    fn = GoodputFunction(PERF, GRAD, 128)
+    nodes = np.array([1, 1, 2, 2])
+    chips = np.array([1, 4, 4, 8])
+    plain = fn.optimize(
+        nodes, chips, max_batch_size=1280,
+        atomic_bsz_range=(64, 256), accumulation=True,
+    )
+    for grid in (None, DP_GRID):
+        g, bsz, accum, sp, tp, ss, ep, micro = fn.optimize_topology(
+            nodes, chips, max_batch_size=1280,
+            atomic_bsz_range=(64, 256), accumulation=True,
+            shape_grid=grid,
+        )
+        np.testing.assert_array_equal(g, plain[0])
+        np.testing.assert_array_equal(bsz, plain[1])
+        np.testing.assert_array_equal(accum, plain[2])
+        assert not np.any(sp != 1)
+        assert not np.any(tp != 1)
+        assert not np.any(ss != 1)
+        assert not np.any(ep != 1)
+        assert not np.any(micro != 1)
+
+
+def test_speedup_fn_dp_grid_bit_identical_to_legacy():
+    legacy = _speedup_fn(None)
+    gridded = _speedup_fn(DP_GRID)
+    nodes = np.array([1, 1, 2, 2, 3])
+    chips = np.array([1, 4, 4, 8, 12])
+    np.testing.assert_array_equal(
+        legacy(nodes, chips), gridded(nodes, chips)
+    )
+    for n, c in zip(nodes, chips):
+        assert legacy.best_config(int(n), int(c)) == (
+            gridded.best_config(int(n), int(c))
+        )
+
+
+def test_optimize_dp_only_bit_identical_across_grid_spellings():
+    """Full cycles: identical allocations whether dp-only jobs carry
+    no grid (legacy) or the explicit singleton grid — and identical
+    across repeated fresh-policy runs (fixed internal GA seed)."""
+    template = NodeInfo(resources={"tpu": 4})
+    outputs = []
+    for grid in (None, DP_GRID, None, DP_GRID):
+        policy = PolluxPolicy(pop_size=24, generations=20)
+        allocations, desired = policy.optimize(
+            _jobs(grid), _nodes(), {}, template
+        )
+        outputs.append(
+            (sorted((k, tuple(v)) for k, v in allocations.items()),
+             desired)
+        )
+    assert outputs[0] == outputs[1] == outputs[2] == outputs[3]
+
+
+def test_optimize_incremental_dp_only_bit_identical():
+    """Incremental cycles re-searching one dirty job against a pinned
+    background: same equivalence, fixed seeds."""
+    template = NodeInfo(resources={"tpu": 4})
+    base = {
+        "a": ["slice-0", "slice-0"],
+        "b": ["slice-1"],
+        "c": [],
+    }
+    outputs = []
+    for grid in (None, DP_GRID, None, DP_GRID):
+        policy = PolluxPolicy(pop_size=24, generations=20)
+        allocations, desired = policy.optimize_incremental(
+            _jobs(grid),
+            _nodes(),
+            {k: list(v) for k, v in base.items()},
+            template,
+            dirty={"c"},
+        )
+        outputs.append(
+            (sorted((k, tuple(v)) for k, v in allocations.items()),
+             desired)
+        )
+    assert outputs[0] == outputs[1] == outputs[2] == outputs[3]
+
+
+def test_allocator_builds_dp_only_jobinfo_without_grid():
+    """A hint payload with no mesh keys yields exactly the legacy
+    JobInfo: no grid, and the speedup function reports none."""
+    from adaptdl_tpu.sched.allocator import job_info_from_hints
+
+    hints = {
+        "perfParams": dict(PERF._asdict()),
+        "gradParams": dict(GRAD._asdict()),
+        "initBatchSize": 128,
+        "maxBatchSize": 1280,
+        "localBszBounds": [64, 256],
+        "gradientAccumulation": True,
+        "maxProfiledReplicas": 4,
+    }
+    info = job_info_from_hints(hints, {"max_replicas": 8}, 0.0)
+    assert info.mesh_shape_grid is None
+    assert info.speedup_fn.mesh_shape_grid is None
+    # And with a grid posted, both carry it.
+    hints["meshShapeGrid"] = [[1, 1, 1, 1], [1, 2, 1, 1]]
+    info = job_info_from_hints(hints, {"max_replicas": 8}, 0.0)
+    assert info.mesh_shape_grid == ((1, 1, 1, 1), (1, 2, 1, 1))
+    assert info.speedup_fn.mesh_shape_grid == (
+        (1, 1, 1, 1), (1, 2, 1, 1),
+    )
+
+
+def test_mesh_shape_grid_default_is_pure_dp():
+    assert mesh_shape_grid() == DP_GRID

@@ -186,6 +186,70 @@ def test_policy_allocates_dp_sp_mesh_for_long_context():
     assert topo > pure_dp
 
 
+def test_policy_assigns_tp_mesh_to_large_model_job_dp_job_stays():
+    """Acceptance: with mesh-shape search on, a large-model job whose
+    fitted surface is tp-favorable (batch-dominated compute, pricey
+    gradient sync, cheap per-layer TP collectives, batch budget
+    nearly exhausted) is assigned a mesh with tp > 1, while a
+    dp-favorable job in the SAME cycle stays pure data-parallel —
+    deterministically (two fresh policies agree bit-for-bit)."""
+    from adaptdl_tpu.goodput import mesh_shape_grid
+
+    grid = mesh_shape_grid(max_model_shards=8)
+    large_perf = PerfParams(
+        0.05, 0.10, 0.40, 0.06, 0.20, 0.03, 1.2,
+        alpha_tp=0.002, beta_tp=0.0002,
+    )
+    large_fn = SpeedupFunction(
+        GoodputFunction(large_perf, GradParams(0.001, 0.002), 128),
+        max_batch_size=256,
+        atomic_bsz_range=(8, 64),
+        accumulation=True,
+        max_model_shards=8,
+        mesh_shape_grid=grid,
+    )
+
+    def jobs():
+        return {
+            "large-model": JobInfo(
+                resources={"tpu": 1},
+                speedup_fn=large_fn,
+                creation_timestamp=0.0,
+                min_replicas=1,
+                max_replicas=16,
+                mesh_shape_grid=grid,
+            ),
+            "dp-friendly": _job(ts=1.0, max_replicas=8),
+        }
+
+    nodes = {
+        "slice-0": NodeInfo(resources={"tpu": 8}),
+        "slice-1": NodeInfo(resources={"tpu": 8}),
+    }
+    results = []
+    for _ in range(2):
+        policy = PolluxPolicy(pop_size=24, generations=20)
+        allocations, _ = policy.optimize(
+            jobs(), dict(nodes), {}, NodeInfo(resources={"tpu": 8})
+        )
+        results.append({k: sorted(v) for k, v in allocations.items()})
+    assert results[0] == results[1], "must be deterministic"
+    large_chips = len(results[0]["large-model"])
+    dp_chips = len(results[0]["dp-friendly"])
+    assert large_chips >= 2, results[0]
+    _, _, _, tp, _, _, _ = large_fn.best_config(
+        len(set(results[0]["large-model"])), large_chips
+    )
+    assert tp > 1, "large-model job must get a (dp, tp) mesh"
+    if dp_chips:
+        dp_cfg = jobs()["dp-friendly"].speedup_fn.best_config(
+            len(set(results[0]["dp-friendly"])), dp_chips
+        )
+        assert dp_cfg[2:7] == (1, 1, 1, 1, 1), (
+            "dp-favorable job must stay pure data-parallel"
+        )
+
+
 def test_hazard_pricing_places_expensive_restart_on_ondemand():
     """Acceptance: with one spot slice (nonzero reclaim hazard) and
     one on-demand slice, the job with the measured EXPENSIVE restart

@@ -193,6 +193,60 @@ def test_sim_report_renders():
     assert "alloc_decide_p50_s" in text
 
 
+def test_resolve_mega_is_deterministic_and_mesh_hinted():
+    """The large-model category: deterministic expansion, a
+    tp-favorable fitted surface, and mesh hints on the wire that the
+    dp-only arm strips back to the pre-mesh payload shape."""
+    from adaptdl_tpu.sim.workload import hints_payload
+
+    record = {
+        "t": 0.0, "job": "sim/m0", "category": "mega",
+        "seed": 4242, "duration": 900.0, "requested": 8,
+    }
+    a, b = resolve_job(record), resolve_job(record)
+    assert a.perf == b.perf and a.grad == b.grad
+    assert a.mesh_shape_grid and a.mesh_shape_grid == b.mesh_shape_grid
+    assert any(tp > 1 for _, tp, _, _ in a.mesh_shape_grid)
+    hints = hints_payload(a, profiled=8)
+    assert hints["maxModelShards"] == 8
+    assert hints["meshShapeGrid"]
+    stripped = hints_payload(a, profiled=8, dp_only=True)
+    assert "meshShapeGrid" not in stripped
+    assert "maxModelShards" not in stripped
+    # dp-only categories never grow mesh keys at all.
+    small = resolve_job(generate_trace(5, 50.0, seed=9)[0])
+    assert "meshShapeGrid" not in hints_payload(small)
+
+
+def test_sim_mesh_policy_beats_dp_only_on_committed_smoke_trace():
+    """Acceptance: on the committed smoke trace (which contains a
+    large-model job), the mesh-aware policy's goodput retention vs
+    the dp-only policy is >= 1.0, at least one job actually runs a
+    non-DP mesh shape, and the comparison is deterministic."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    records = load_trace(
+        os.path.join(repo, "traces", "smoke-32.jsonl")
+    )
+    assert any(r["category"] == "mega" for r in records), (
+        "the committed smoke trace must exercise the large-model "
+        "category"
+    )
+    kwargs = dict(slices=8, chips_per_slice=8, seed=3, interval=30.0)
+    mesh = run_trace(records, **kwargs).summary()
+    dponly = run_trace(records, dp_only=True, **kwargs).summary()
+    assert mesh["mesh_shaped_jobs"] >= 1, mesh
+    assert dponly["mesh_shaped_jobs"] == 0, dponly
+    assert dponly["dp_only"] is True
+    retention = (
+        mesh["avg_goodput_x_ideal"] / dponly["avg_goodput_x_ideal"]
+    )
+    assert retention >= 1.0, (retention, mesh, dponly)
+    again = run_trace(records, **kwargs)
+    assert json.loads(again.summary_json()) == mesh
+
+
 def test_virtual_clock_drives_cluster_state():
     """The simulated ClusterState's completion-time summary is in
     VIRTUAL seconds — proof the injected clock (not the wall clock)

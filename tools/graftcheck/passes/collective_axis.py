@@ -66,9 +66,21 @@ _AXIS_BINDERS = {
     "make_jax_mesh",
     "build_mesh",
     "mesh",
+    "create_mesh",
     "PartitionSpec",
     "NamedSharding",
 }
+
+# The scheduler-topology mesh-construction path (parallel/mesh.py):
+# these build the mesh FROM the published (dp, sp, tp, ss, ep) shape,
+# so they bind exactly the canonical axis names without any string
+# literal appearing at the call site — a module whose only mesh comes
+# from the reshape path still resolves its collective literals.
+_TOPOLOGY_BINDERS = {
+    "create_mesh_from_topology",
+    "topology_axes",
+}
+_CANONICAL_AXES = {"data", "seq", "model", "stage", "expert"}
 
 _AXIS_KWARGS = {"axis_name", "axis_names", "axes"}
 
@@ -102,11 +114,14 @@ def _declared_axes(sf: SourceFile) -> tuple[set[str], set[str]]:
             }
     for node in sf.walk():
         if isinstance(node, ast.Call):
-            if _last(dotted_name(node.func)) in _AXIS_BINDERS:
+            short = _last(dotted_name(node.func))
+            if short in _AXIS_BINDERS:
                 for arg in node.args:
                     axes |= _strings_in(arg)
                 for kw in node.keywords:
                     axes |= _strings_in(kw.value)
+            if short in _TOPOLOGY_BINDERS:
+                axes |= _CANONICAL_AXES
         elif isinstance(node, ast.Assign):
             for target in node.targets:
                 if not isinstance(target, ast.Name):
