@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched chaos-preempt trace-gate rescale-fast meshgate simgate bench-sched probe
+.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched chaos-preempt trace-gate rescale-fast meshgate simgate watchgate bench-sched probe
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -100,6 +100,17 @@ meshgate:
 # baseline must hold >= 1.0, inside the wall budget.
 simgate:
 	$(CPU_ENV) $(PY) -m pytest tests/test_simgate.py -q --durations=5
+
+# graftwatch gate (docs/observability.md "Goodput accounting &
+# decision provenance"): watch sampling must cost < 1% of allocator
+# cycle time on the CPU harness, ring stores stay bounded under a
+# multi-threaded hammer, explain records are bit-identical across
+# fixed-seed cycles (full AND incremental paths), and the sim-driven
+# per-tenant fairness/drift summary is bit-identical across two
+# fixed-seed runs (the 1k-job version rides the slow tier).
+watchgate:
+	$(CPU_ENV) $(PY) -m pytest tests/test_watch.py \
+	    tests/test_watchgate.py -q --durations=5
 
 # Thousand-job control-plane bench standalone (bench.py also merges
 # these keys into the BENCH json): allocator decide p50/p99 at 1k

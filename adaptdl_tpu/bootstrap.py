@@ -107,10 +107,20 @@ def start_heartbeat() -> threading.Event | None:
     stop = threading.Event()
     rank = env.process_rank()
 
+    # Imported here, not at module top: metrics pulls in the goodput
+    # stack, which bootstrap must not load before jax is configured.
+    from adaptdl_tpu import metrics
+
     def loop():
         sched_hints.send_heartbeat(rank=rank)
         while not stop.wait(interval):
-            sched_hints.send_heartbeat(rank=rank)
+            # The rank's smoothed step time rides the beat it already
+            # sends — graftwatch turns per-rank outliers into the
+            # adaptdl_slot_suspect straggler gauge.
+            sched_hints.send_heartbeat(
+                rank=rank,
+                step_time_ewma=metrics.step_time_ewma(),
+            )
             # Every rank's buffered spans reach the supervisor on the
             # heartbeat cadence — the hint-cadence flush only runs on
             # rank 0's fit thread, and a straggling rank>0 restore is

@@ -333,6 +333,205 @@ def _cmd_status(args) -> int:
     return 0
 
 
+def _fmt_rate(value) -> str:
+    return f"{value:.1f}" if isinstance(value, (int, float)) else "-"
+
+
+def _render_top(payload: dict) -> None:
+    """One frame of the live cluster view: cluster utilization, the
+    per-tenant fairness table, and the per-job goodput table."""
+    cluster = (payload.get("cluster") or [])
+    latest = cluster[-1] if cluster else {}
+    print(
+        f"cluster: {latest.get('jobs', 0)} active job(s), "
+        f"{latest.get('chipsAllocated', 0)}/"
+        f"{latest.get('chipsTotal', 0)} chips allocated "
+        f"(utilization {latest.get('utilization', 0.0):.2f}), "
+        f"{payload.get('samples', 0)} watch sample(s)"
+    )
+    tenants = payload.get("tenants") or {}
+    if tenants:
+        rows = [("TENANT", "JOBS", "CHIPS", "SHARE", "RHO", "SLO-BURN")]
+        for tenant, info in sorted(tenants.items()):
+            series = info.get("series") or []
+            last = series[-1] if series else {}
+            rho = last.get("rho")
+            rows.append(
+                (
+                    tenant,
+                    f"{last.get('running', 0)}/{last.get('jobs', 0)}",
+                    str(last.get("chips", 0)),
+                    f"{last.get('share', 0.0):.3f}",
+                    f"{rho:.2f}" if rho is not None else "-",
+                    str(info.get("burn", 0)),
+                )
+            )
+        print()
+        _print_table(rows)
+    jobs = payload.get("jobs") or {}
+    if jobs:
+        rows = [
+            (
+                "JOB", "TENANT", "REPLICAS", "MEASURED", "PREDICTED",
+                "DRIFT", "REPROFILE", "RHO",
+            )
+        ]
+        for key, info in sorted(jobs.items()):
+            last = info.get("latest") or {}
+            drift = info.get("drift")
+            rho = last.get("rho")
+            rows.append(
+                (
+                    key,
+                    info.get("tenant", "-"),
+                    str(last.get("replicas", 0)),
+                    _fmt_rate(last.get("measured")),
+                    _fmt_rate(last.get("predicted")),
+                    f"{drift:.3f}" if drift is not None else "-",
+                    "YES" if info.get("reprofile") else "no",
+                    f"{rho:.2f}" if rho is not None else "-",
+                )
+            )
+        print()
+        _print_table(rows)
+    suspects = payload.get("suspectSlots") or {}
+    if suspects:
+        print(
+            "\nsuspect slots (straggling step times): "
+            + ", ".join(
+                f"{slot} ({info['job']} rank {info['rank']}, "
+                f"{info['ratio']:.2f}x median)"
+                for slot, info in sorted(suspects.items())
+            )
+        )
+
+
+def _cmd_top(args) -> int:
+    """Live cluster view (graftwatch): per-tenant goodput share and
+    fairness, per-job measured-vs-predicted goodput with the drift
+    monitor's re-profiling flags, and straggler-suspect slots —
+    rendered from one GET /watch. ``--watch N`` re-renders every N
+    seconds until interrupted."""
+    import time as _time
+
+    from adaptdl_tpu import rpc
+
+    # Ctrl-C must exit cleanly wherever the loop happens to be —
+    # mid-fetch (the common case; the request dominates each
+    # iteration) as much as mid-sleep.
+    try:
+        while True:
+            payload = rpc.default_client().get(
+                f"{args.supervisor}/watch",
+                endpoint="cli/watch",
+                timeout=10,
+                attempts=3,
+                deadline=30.0,
+            ).json()
+            _render_top(payload)
+            if not args.watch:
+                return 0
+            _time.sleep(args.watch)
+            print()
+    except KeyboardInterrupt:
+        return 0
+
+
+def _cmd_explain(args) -> int:
+    """Decision provenance for one job: why the allocator's last
+    cycle gave it THIS allocation and mesh shape — the winning
+    candidate's objective terms and the top-k losers with the term
+    that killed each (speedup, restart penalty, hazard x restart
+    cost, util band)."""
+    from adaptdl_tpu import rpc
+
+    response = rpc.default_client().get(
+        f"{args.supervisor}/explain/{args.job}",
+        endpoint="cli/explain",
+        timeout=10,
+        attempts=3,
+        deadline=30.0,
+    )
+    payload = response.json()
+    if response.status_code == 404 or "latest" not in payload:
+        print(
+            payload.get("error", f"no explain record for {args.job}"),
+            file=sys.stderr,
+        )
+        return 1
+    # Render the last cycle that actually RE-DECIDED the job (with
+    # objective terms); incremental pass-through cycles only pin.
+    latest = payload.get("lastDecision") or payload["latest"]
+    newest = payload["latest"]
+    alloc = latest.get("alloc") or []
+    slots = sorted(set(alloc))
+    print(
+        f"job {args.job}  cycle {latest.get('cycle')} "
+        f"({latest.get('mode')})"
+    )
+    if latest.get("pinned"):
+        print(
+            f"  pinned: kept its allocation untouched this cycle "
+            f"({len(alloc)} replica(s) on {', '.join(slots) or '-'})"
+        )
+    else:
+        print(
+            f"  winning allocation: {len(alloc)} replica(s) on "
+            f"{', '.join(slots) or '(none)'}"
+        )
+        if newest.get("pinned") and newest.get("cycle") != latest.get(
+            "cycle"
+        ):
+            print(
+                f"  (pinned unchanged through cycle "
+                f"{newest.get('cycle')})"
+            )
+    mesh = latest.get("meshShape")
+    if mesh:
+        print(
+            "  mesh shape: "
+            f"sp={mesh.get('seqShards', 1)} "
+            f"tp={mesh.get('modelShards', 1)} "
+            f"pp={mesh.get('stageShards', 1)} "
+            f"ep={mesh.get('expertShards', 1)} "
+            f"micro={mesh.get('pipelineMicro', 1)}"
+        )
+    if latest.get("speedup") is not None:
+        print(
+            "  objective terms: "
+            f"speedup={latest['speedup']:.4f} "
+            f"(scaled {latest.get('scaledSpeedup', 0.0):.4f}), "
+            f"restartPenalty={latest.get('restartPenalty', 0.0):.3f}"
+            f"{' (moved)' if latest.get('moved') else ''}, "
+            f"hazardLoss={latest.get('hazardLoss', 0.0):.4f}"
+        )
+    cycle = payload.get("cycle") or {}
+    winner = cycle.get("winner")
+    if winner:
+        print(
+            f"  cycle winner: objective {winner['objective']:.4f} "
+            f"over {cycle.get('candidates', 0)} candidate(s), "
+            f"{winner['nodes']} slice(s) active"
+        )
+    losers = cycle.get("losers") or []
+    if losers:
+        print("  losing candidates:")
+        for loser in losers:
+            print(
+                f"    objective {loser['objective']:.4f} "
+                f"({loser['nodes']} slice(s)) — killed by "
+                f"{loser['killedBy']}"
+            )
+    history = payload.get("history") or []
+    if len(history) > 1:
+        print(
+            f"  history: {len(history)} retained decision(s), "
+            f"cycles {history[0].get('cycle')}.."
+            f"{history[-1].get('cycle')}"
+        )
+    return 0
+
+
 def _cmd_trace(args) -> int:
     """Render a job's stitched rescale trace (graftscope): fetch the
     supervisor's merged worker+supervisor span view, pick one trace
@@ -452,6 +651,9 @@ def _cmd_sim(args) -> int:
     payload = {
         "summary": report.summary(),
         "latency": report.latency(),
+        # graftwatch's deterministic per-tenant fairness/drift summary
+        # (tenant = workload category) — the sim-side record stream.
+        "watch": report.watch_summary(),
     }
     if args.compare_fixed and not args.fixed:
         baseline = run_trace(records, fixed=True, **kwargs)
@@ -864,6 +1066,33 @@ def main(argv=None) -> int:
     )
     p.add_argument("--supervisor", required=True)
     p.set_defaults(fn=_cmd_status)
+
+    p = sub.add_parser(
+        "top",
+        help="live cluster view (graftwatch): per-tenant goodput "
+        "share/fairness, per-job measured vs predicted goodput with "
+        "drift flags, straggler-suspect slots",
+    )
+    p.add_argument("--supervisor", required=True)
+    p.add_argument(
+        "--watch",
+        type=float,
+        default=0.0,
+        metavar="SECONDS",
+        help="re-render every SECONDS until interrupted "
+        "(default: one shot)",
+    )
+    p.set_defaults(fn=_cmd_top)
+
+    p = sub.add_parser(
+        "explain",
+        help="decision provenance for one job: the winning "
+        "allocation + mesh shape with its objective terms, and the "
+        "losing candidates with the term that killed each",
+    )
+    p.add_argument("job", help="namespace/name")
+    p.add_argument("--supervisor", required=True)
+    p.set_defaults(fn=_cmd_explain)
 
     p = sub.add_parser(
         "trace",

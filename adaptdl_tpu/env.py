@@ -345,6 +345,52 @@ def traceparent() -> str | None:
     return _get_str("ADAPTDL_TRACEPARENT")
 
 
+def watch_buffer_size() -> int:
+    """Samples retained per graftwatch time series (per-job, per-
+    tenant, and cluster ring buffers alike): oldest samples are
+    evicted first, so a long-lived cluster holds a bounded window of
+    goodput/fairness history, never an unbounded log."""
+    return max(_get_int("ADAPTDL_WATCH_BUFFER", 512), 8)
+
+
+def watch_drift_window() -> int:
+    """Samples in the rolling predicted-vs-measured goodput window
+    behind ``adaptdl_goodput_drift``: the drift ratio is the mean of
+    the last N per-cycle measured/predicted ratios."""
+    return max(_get_int("ADAPTDL_WATCH_DRIFT_WINDOW", 16), 3)
+
+
+def watch_drift_threshold() -> float:
+    """Relative deviation of the rolling drift ratio from 1.0 past
+    which a job is flagged for re-profiling (ratio outside
+    ``[1/(1+t), 1+t]``). Observability-only: the flag is a metric and
+    a /watch field, never a policy input."""
+    return max(_get_float("ADAPTDL_WATCH_DRIFT_THRESHOLD", 0.25), 0.01)
+
+
+def watch_explain_topk() -> int:
+    """Losing candidates kept per allocator-cycle explain record (the
+    top-k Pareto-front solutions that scored below the winner, each
+    with the objective term that killed it)."""
+    return max(_get_int("ADAPTDL_WATCH_EXPLAIN_TOPK", 3), 0)
+
+
+def watch_straggler_factor() -> float:
+    """A rank's heartbeat-reported step-time EWMA above this multiple
+    of its job's median rank EWMA marks the rank's slot suspect
+    (``adaptdl_slot_suspect``). Needs >= 3 reporting ranks — a
+    2-rank job has no majority to define "normal"."""
+    return max(_get_float("ADAPTDL_WATCH_STRAGGLER_FACTOR", 1.5), 1.0)
+
+
+def watch_slo_rho() -> float:
+    """Per-tenant finish-time-fairness SLO: each watch sample where a
+    tenant's mean slowdown rho (requested-ideal goodput over actual)
+    exceeds this bumps the tenant's
+    ``adaptdl_tenant_slo_burn_total`` burn counter."""
+    return max(_get_float("ADAPTDL_WATCH_SLO_RHO", 3.0), 0.1)
+
+
 def fault_spec_raw() -> str | None:
     """Fault-injection schedule for chaos testing, as the raw spec
     string (``faults.py`` parses the grammar). Unset — the production

@@ -76,6 +76,8 @@ INJECTION_POINTS = {
     "sup.heartbeat.pre": "heartbeat lease-renewal handler",
     "sup.trace.pre": "worker trace-span intake handler (graftscope)",
     "sup.preempt.pre": "preemption-notice intake handler",
+    "sup.watch.pre": "goodput-accounting snapshot handler (graftwatch)",
+    "sup.explain.pre": "decision-provenance handler (graftwatch)",
     # preemption survival (sched.preemption; an injected fault at
     # preempt.notice SIMULATES a reclaim notice in the listener)
     "preempt.notice": "each listener poll for a reclaim notice",

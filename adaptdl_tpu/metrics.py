@@ -133,6 +133,13 @@ class MetricsState:
     # In-process (atomic_bsz, accum) re-tunes adopted without a
     # checkpoint-restart (the live re-tune fast path).
     num_retunes: int = 0  # guarded-by: _profile_lock
+    # graftwatch inputs: a smoothed step time (piggybacked on
+    # heartbeats for per-slot straggler detection) and the measured
+    # throughput behind the measuredGoodput hint — examples/s EWMA at
+    # the batch geometry of the last profiled step.
+    step_time_ewma: float | None = None  # guarded-by: _profile_lock
+    examples_ewma: float | None = None  # guarded-by: _profile_lock
+    last_global_bsz: int | None = None  # guarded-by: _profile_lock
 
 
 _state = MetricsState()
@@ -307,6 +314,26 @@ def profile_step(
             optim_time = step_time
         entry.optim_time_sum += optim_time
         entry.optim_count += 1
+        # graftwatch's measured half: smooth the step time (straggler
+        # heartbeats) and the realized examples/s at the step's batch
+        # geometry (the measuredGoodput hint). EWMA alpha 0.2 —
+        # a few fit intervals of memory, jitter smoothed out.
+        if step_time > 0:
+            dp = env.data_parallel_replicas()
+            global_bsz = int(atomic_bsz) * (int(accum_steps) + 1) * dp
+            examples_s = global_bsz / step_time
+            alpha = 0.2
+            prev = _state.step_time_ewma
+            _state.step_time_ewma = (
+                step_time if prev is None
+                else (1 - alpha) * prev + alpha * step_time
+            )
+            prev = _state.examples_ewma
+            _state.examples_ewma = (
+                examples_s if prev is None
+                else (1 - alpha) * prev + alpha * examples_s
+            )
+            _state.last_global_bsz = global_bsz
         # The allocator's 2x scale-up gate works in CHIPS (the policy's
         # replica axis is chips once topology search is in play), so
         # profiled coverage must count chips too: a dp=1 x sp=8 run has
@@ -419,6 +446,36 @@ def restart_stats() -> dict | None:
                 sum(_state.restore_per_state.values()), 4
             )
         return stats
+
+
+def step_time_ewma() -> float | None:
+    """This process's smoothed step time (seconds), or None before the
+    first profiled step — what the heartbeat thread piggybacks for
+    graftwatch's straggler detection."""
+    with _profile_lock:
+        return _state.step_time_ewma
+
+
+def measured_goodput() -> float | None:
+    """Realized goodput (useful examples/s): the measured throughput
+    EWMA times the statistical efficiency at the running batch size,
+    under the CURRENT fitted grad params. None until a step has been
+    profiled and grad params exist. This is the measured half of
+    graftwatch's predicted-vs-realized drift monitor — computed from
+    observations, with only the efficiency weighting shared with the
+    model, so a mis-fitted perf model shows up as drift instead of
+    cancelling out."""
+    with _profile_lock:
+        examples = _state.examples_ewma
+        global_bsz = _state.last_global_bsz
+        grad = _state.grad_params
+        init = _state.init_batch_size
+    if examples is None or not global_bsz or grad is None or not init:
+        return None
+    scale = global_bsz / init
+    denom = grad.var / scale + grad.sqr
+    gain = (grad.var + grad.sqr) / denom if denom > 0 else 1.0
+    return examples * gain / scale
 
 
 def update_grad_params(sqr: float, var: float) -> None:
@@ -568,6 +625,11 @@ def fit_and_report_now() -> None:
         hints["meshShapeGrid"] = [
             list(shape) for shape in _state.mesh_shape_grid
         ]
+    measured = measured_goodput()
+    if measured is not None:
+        # graftwatch's drift monitor pairs this with the model's
+        # prediction at the published allocation each allocator cycle.
+        hints["measuredGoodput"] = round(measured, 6)
     stats = restart_stats()
     if stats is not None:
         # Measured rescale cost: the supervisor prices checkpoint-

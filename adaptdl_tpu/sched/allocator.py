@@ -31,6 +31,7 @@ from adaptdl_tpu.sched.state import (
     ClusterState,
     normalize_topology,
 )
+from adaptdl_tpu.watch import tenant_of
 
 LOG = logging.getLogger(__name__)
 
@@ -237,15 +238,71 @@ class Allocator:
                 self._state.mark_job_dirty(key)
             self._last_slots = None
             raise
-        self._state.note_alloc_cycle(
-            time.monotonic() - start, len(dirty), mode
-        )
+        elapsed = time.monotonic() - start
+        self._state.note_alloc_cycle(elapsed, len(dirty), mode)
+        # graftwatch: record the cycle's provenance and fold it into
+        # the goodput/fairness/drift series. Observability only — a
+        # watch failure must never take down (or retro-fail) an
+        # allocation cycle whose publishes already committed.
+        try:
+            self._note_explain(mode)
+            self._watch_sample(elapsed)
+        except Exception:  # noqa: BLE001 - observability is best-effort
+            LOG.exception("graftwatch sampling failed")
         return allocations
+
+    def _watch_sample(self, cycle_s: float) -> None:
+        """One goodput-accounting sample per allocator cycle: every
+        active job's published allocation + posted hints, the slice
+        inventory's capacity, and the cycle's wall cost (the
+        denominator of the watchgate's <1% sampling-overhead gate)."""
+        watch = getattr(self._state, "watch", None)
+        if watch is None:
+            return
+        nodes = self._current_nodes()
+        sizes = [n.resources.get("tpu", 0) for n in nodes.values()]
+        chips_per_slice = max(
+            sizes + [self._template.resources.get("tpu", 1), 1]
+        )
+        jobs_view = []
+        for key, record in sorted(self._state.jobs().items()):
+            if record.status in FINISHED:
+                continue
+            spec = record.spec or {}
+            jobs_view.append(
+                {
+                    "key": key,
+                    "tenant": tenant_of(key, spec),
+                    "alloc": list(record.allocation),
+                    "topology": record.topology,
+                    "batchConfig": record.batch_config,
+                    "hints": record.hints,
+                    # The fairness denominator: the job's asked-for
+                    # fixed allocation (spec "requested", falling back
+                    # to its max) — Pollux's rho is JCT vs exactly
+                    # this ask.
+                    "requested": int(
+                        spec.get("requested")
+                        or spec.get("max_replicas")
+                        or 1
+                    ),
+                }
+            )
+        watch.sample_cycle(
+            jobs_view,
+            total_chips=sum(sizes),
+            chips_per_slice=chips_per_slice,
+            cycle_s=cycle_s,
+        )
 
     def _optimize_once_traced(
         self, decide_attrs: dict, dirty: set[str]
     ) -> tuple[dict[str, list[str]], str]:
         self._cycle += 1
+        # Stale-provenance guard: a cycle that exits early (no jobs,
+        # empty inventory) must not re-publish the PREVIOUS cycle's
+        # explain record as its own.
+        self._policy.last_explain = None
         records = {}
         base = {}
         for key, record in self._state.jobs().items():
@@ -496,6 +553,31 @@ class Allocator:
                 )
                 self._state.publish_retune(key, batch_config)
         return allocations, mode
+
+    def _note_explain(self, mode: str) -> None:
+        """Hand the policy's cycle explain record to the watch store,
+        enriched with each job's PUBLISHED mesh shape (the policy
+        scores shapes inside the speedup number; what actually ships
+        is the topology the publish loop above wrote)."""
+        watch = getattr(self._state, "watch", None)
+        explain = getattr(self._policy, "last_explain", None)
+        if watch is None or explain is None:
+            return
+        # ONE locked snapshot of the job table: an incremental cycle's
+        # explain carries a pinned entry per background job, and a
+        # per-key get_job would take the contended state lock a
+        # thousand times per cycle at the 1k-job design point.
+        records = self._state.jobs()
+        jobs = {}
+        for key, rec in (explain.get("jobs") or {}).items():
+            record = records.get(key)
+            enriched = dict(rec)
+            if record is not None and record.allocation:
+                enriched["meshShape"] = normalize_topology(
+                    record.topology
+                )
+            jobs[key] = enriched
+        watch.note_explain(self._cycle, mode, explain, jobs)
 
     def start(self) -> None:
         # The kick baseline is snapshotted BEFORE each cycle —

@@ -35,6 +35,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from adaptdl_tpu import env
 from adaptdl_tpu.sched.policy import nsga2
 from adaptdl_tpu.sched.policy.utils import JobInfo, NodeInfo
 
@@ -85,6 +86,14 @@ class PolluxPolicy:
         # job is re-searched over its own slices plus the best free
         # slices, not the whole 10k-slot inventory.
         self._incremental_candidates = 64
+        # Decision provenance (graftwatch): optimize()/
+        # optimize_incremental() leave the cycle's explain record
+        # here — candidates scored, winner, top-k losers with the
+        # objective term that killed them, per-job terms. Written by
+        # the allocator thread only; the allocator hands it to the
+        # watch store right after the cycle.
+        self.last_explain: dict | None = None
+        self._last_single_explain: dict | None = None
 
     # -- single-job arrival (cheap path) ------------------------------
 
@@ -164,6 +173,10 @@ class PolluxPolicy:
                 quarantined=quarantined,
             )
         self._last_full_desired = desired
+        explain = self._last_single_explain or _empty_explain(desired)
+        self.last_explain = dict(explain)
+        if self.last_explain.get("kind") == "single":
+            self.last_explain["kind"] = "full"
         return allocations, desired
 
     def _optimize_partitioned(
@@ -268,6 +281,7 @@ class PolluxPolicy:
 
         allocations: dict = {}
         desired_total = 0
+        sub_explains: list[dict] = []
         for part in parts:
             part_jobs = OrderedDict(
                 (key, jobs[key]) for key in part["jobs"]
@@ -292,6 +306,8 @@ class PolluxPolicy:
                 quarantined=set(quarantined) & set(part_nodes),
                 warm=False,
             )
+            if self._last_single_explain is not None:
+                sub_explains.append(self._last_single_explain)
             allocations.update(sub_alloc)
             desired_total += sub_desired
         # Per-partition GA populations are not comparable across
@@ -302,6 +318,9 @@ class PolluxPolicy:
         self._prev_nodes = []
         for key in jobs:
             allocations.setdefault(key, [])
+        self._last_single_explain = _merge_explains(
+            sub_explains, allocations, desired_total
+        )
         return allocations, desired_total
 
     def optimize_incremental(
@@ -345,6 +364,10 @@ class PolluxPolicy:
         }
         dirty = [k for k in jobs if k in set(dirty)]
         if not dirty:
+            # Pure pass-through: provenance records every job pinned.
+            self.last_explain = _empty_explain(desired)
+            self.last_explain["kind"] = "incremental"
+            self.last_explain["jobs"] = _pinned_jobs(base_allocations)
             return allocations, desired
         resources = resources or {}
         background = {
@@ -440,6 +463,15 @@ class PolluxPolicy:
         )
         for key in dirty:
             allocations[key] = sub_alloc.get(key, [])
+        # Provenance: the dirty sub-problem's explain plus pinned
+        # entries for the untouched background.
+        sub_ex = self._last_single_explain or _empty_explain(desired)
+        explain = dict(sub_ex, kind="incremental")
+        explain["desiredNodes"] = desired
+        jobs_ex = _pinned_jobs(background)
+        jobs_ex.update(sub_ex.get("jobs") or {})
+        explain["jobs"] = jobs_ex
+        self.last_explain = explain
         return allocations, desired
 
     def _optimize_single(
@@ -473,6 +505,7 @@ class PolluxPolicy:
             }
             blocked_slots = set(quarantined) & protected
         if not jobs or not nodes:
+            self._last_single_explain = _empty_explain(len(nodes))
             return {}, len(nodes)
 
         def pinned(key, job):
@@ -559,6 +592,8 @@ class PolluxPolicy:
             values, min(len(nodes), desired_nodes)
         )
         if pick is None:
+            self._last_single_explain = _empty_explain(desired_nodes)
+            self._last_single_explain["candidates"] = int(front.size)
             return {}, desired_nodes
         chosen = states[pick]
         allocations = {}
@@ -568,7 +603,86 @@ class PolluxPolicy:
             for s, node_key in enumerate(node_keys):
                 alloc.extend([node_key] * int(chosen[j, s]))
             allocations[key] = alloc
+        self._last_single_explain = self._explain_single(
+            problem, states, pick, list(jobs), allocations,
+            desired_nodes, len(nodes),
+        )
         return allocations, desired_nodes
+
+    def _explain_single(
+        self,
+        problem: "_Problem",
+        states,
+        pick: int,
+        job_keys: list,
+        allocations: dict,
+        desired: int,
+        num_real: int,
+    ) -> dict:
+        """The provenance record of one NSGA-II cycle: every
+        Pareto-front candidate's decomposed objective, the winner, and
+        the top-k losers each labeled with the term that killed it —
+        ``speedup`` (plainly worse), ``restartPenalty`` (would win
+        without the move penalty), ``hazardRestartCost`` (would win
+        without the hazard x restart-cost loss), or ``utilBand``
+        (outside the autoscaler's node budget). Deterministic for
+        fixed inputs — the search is internally seeded."""
+        comps = problem.objective_components(states)
+        budget = min(num_real, desired)
+        eps = 1e-9
+        winner = {
+            "objective": round(float(comps["full"][pick]), 6),
+            "speedup": round(float(comps["base"][pick]), 6),
+            "nodes": int(comps["sizes"][pick]),
+        }
+        order = sorted(
+            range(states.shape[0]),
+            key=lambda i: (-float(comps["full"][i]), int(comps["sizes"][i]), i),
+        )
+        losers = []
+        topk = env.watch_explain_topk()
+        for i in order:
+            if i == pick or len(losers) >= topk:
+                continue
+            if int(comps["sizes"][i]) > budget:
+                killed = "utilBand"
+            elif float(comps["base"][i]) > float(comps["base"][pick]) + eps:
+                killed = (
+                    "hazardRestartCost"
+                    if float(comps["after_restart"][i])
+                    > float(comps["after_restart"][pick]) + eps
+                    else "restartPenalty"
+                )
+            else:
+                killed = "speedup"
+            loser = {
+                "objective": round(float(comps["full"][i]), 6),
+                "speedup": round(float(comps["base"][i]), 6),
+                "nodes": int(comps["sizes"][i]),
+                "killedBy": killed,
+            }
+            # The front routinely holds duplicate states; one line per
+            # distinct losing configuration.
+            if loser not in losers:
+                losers.append(loser)
+        terms = problem.job_terms(states[pick])
+        jobs = {}
+        for j, key in enumerate(job_keys):
+            alloc = allocations.get(key, [])
+            jobs[key] = dict(
+                terms[j],
+                alloc=list(alloc),
+                replicas=len(alloc),
+                nodes=len(set(alloc)),
+            )
+        return {
+            "kind": "single",
+            "candidates": int(states.shape[0]),
+            "winner": winner,
+            "losers": losers,
+            "desiredNodes": int(desired),
+            "jobs": jobs,
+        }
 
     @classmethod
     def _greedy_seeds(cls, job_list, node_list, num_real=None):
@@ -800,6 +914,72 @@ class PolluxPolicy:
         return int(best_nodes)
 
 
+def _empty_explain(desired: int) -> dict:
+    return {
+        "kind": "single",
+        "candidates": 0,
+        "winner": None,
+        "losers": [],
+        "desiredNodes": int(desired),
+        "jobs": {},
+    }
+
+
+def _pinned_jobs(base_allocations: dict) -> dict:
+    """Explain entries for jobs a cycle deliberately did not touch
+    (the incremental path's background): allocation kept, no terms."""
+    return {
+        key: {
+            "alloc": list(alloc),
+            "replicas": len(alloc),
+            "nodes": len(set(alloc)),
+            "pinned": True,
+        }
+        for key, alloc in sorted(base_allocations.items())
+    }
+
+
+def _merge_explains(
+    sub_explains: list[dict], allocations: dict, desired: int
+) -> dict:
+    """Fold per-partition explains into one cycle record: candidates
+    sum, winners sum (the partitions are independent sub-problems of
+    one additive objective), losers re-ranked across partitions and
+    re-truncated to top-k."""
+    merged = _empty_explain(desired)
+    merged["kind"] = "partitioned"
+    win_obj, win_speedup, win_nodes, have_winner = 0.0, 0.0, 0, False
+    losers: list[dict] = []
+    for ex in sub_explains:
+        merged["candidates"] += int(ex.get("candidates", 0))
+        merged["jobs"].update(ex.get("jobs") or {})
+        losers.extend(ex.get("losers") or [])
+        winner = ex.get("winner")
+        if winner:
+            have_winner = True
+            win_obj += winner["objective"]
+            win_speedup += winner["speedup"]
+            win_nodes += winner["nodes"]
+    if have_winner:
+        merged["winner"] = {
+            "objective": round(win_obj, 6),
+            "speedup": round(win_speedup, 6),
+            "nodes": win_nodes,
+        }
+    losers.sort(key=lambda lo: (-lo["objective"], lo["nodes"]))
+    merged["losers"] = losers[: env.watch_explain_topk()]
+    for key, alloc in allocations.items():
+        merged["jobs"].setdefault(
+            key,
+            {
+                "alloc": list(alloc),
+                "replicas": len(alloc),
+                "nodes": len(set(alloc)),
+            },
+        )
+    return merged
+
+
 def _sorted_nodes(nodes: dict) -> OrderedDict:
     """Stable preference order: reliable slices first, then by
     measured hazard within each reliability class."""
@@ -947,6 +1127,71 @@ class _Problem:
         return np.column_stack(
             [-scaled.sum(axis=1), self._cluster_sizes(states)]
         )
+
+    def objective_components(self, states):
+        """Per-candidate decomposition of the scored objective, for
+        decision provenance: ``base`` (scaled speedup sum, no
+        penalties), ``after_restart`` (move penalty applied),
+        ``full`` (hazard expected-loss applied — what evaluate()
+        actually ranks by), and the active cluster ``sizes``. The
+        explain path attributes each loser to the term that flipped
+        its ranking against the winner."""
+        speedups = self._speedups(states)
+        scaled = speedups * self._dominant_share * len(self.nodes)
+        base = scaled.sum(axis=1)
+        moved = (states != self.base_state).any(axis=2)
+        after_restart_per_job = np.where(
+            moved, scaled * (1 - self._restart_penalty[None, :]), scaled
+        )
+        after_restart = after_restart_per_job.sum(axis=1)
+        if self._node_hazard.any():
+            lam = (states > 0).astype(float) @ self._node_hazard
+            loss = np.clip(
+                lam * self._restart_cost_s[None, :],
+                0.0,
+                MAX_HAZARD_LOSS,
+            )
+            full = (after_restart_per_job * (1.0 - loss)).sum(axis=1)
+        else:
+            full = after_restart
+        return {
+            "base": base,
+            "after_restart": after_restart,
+            "full": full,
+            "sizes": self._cluster_sizes(states),
+        }
+
+    def job_terms(self, state):
+        """Per-job objective terms of ONE candidate state — the
+        numbers ``adaptdl-tpu explain`` renders: raw and scaled
+        speedup, whether the job moved (and the restart penalty it
+        paid), and the hazard expected-loss fraction charged."""
+        states = state.reshape(1, *self.shape)
+        speedups = self._speedups(states)[0]
+        scaled = speedups * self._dominant_share * len(self.nodes)
+        moved = (states[0] != self.base_state).any(axis=1)
+        if self._node_hazard.any():
+            lam = (states[0] > 0).astype(float) @ self._node_hazard
+            loss = np.clip(lam * self._restart_cost_s, 0.0, MAX_HAZARD_LOSS)
+        else:
+            loss = np.zeros(self.shape[0])
+        terms = []
+        for j in range(self.shape[0]):
+            terms.append(
+                {
+                    "speedup": round(float(speedups[j]), 6),
+                    "scaledSpeedup": round(float(scaled[j]), 6),
+                    "moved": bool(moved[j]),
+                    "restartPenalty": round(
+                        float(self._restart_penalty[j])
+                        if moved[j]
+                        else 0.0,
+                        6,
+                    ),
+                    "hazardLoss": round(float(loss[j]), 6),
+                }
+            )
+        return terms
 
     def cluster_utilities(self, states):
         """Mean speedup-per-replica weighted by resource share, per

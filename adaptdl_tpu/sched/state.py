@@ -44,6 +44,7 @@ from typing import Any
 
 from adaptdl_tpu import env, faults, trace
 from adaptdl_tpu.sched.journal import StateJournal
+from adaptdl_tpu.watch import WatchStore, tenant_of
 
 LOG = logging.getLogger(__name__)
 
@@ -387,6 +388,12 @@ class ClusterState:
         self._recoveries = 0  # guarded-by: _cond
         self._last_recovery_s: float | None = None  # guarded-by: _cond
         self._torn_records = 0  # guarded-by: _cond
+        # graftwatch: the goodput-accounting / provenance / drift
+        # store (watch.py). In-memory observability, never journaled —
+        # a recovered supervisor starts with empty series, exactly
+        # like the trace ring. Assigned once before any other thread
+        # holds a reference; the store carries its own lock.
+        self.watch = WatchStore(clock=self._clock)
         # Assigned once, before any other thread can hold a reference
         # to this state — mutators then only read it (under _cond).
         self._journal: StateJournal | None = None
@@ -1017,6 +1024,9 @@ class ClusterState:
             self._journal_append(op)
             self._apply_remove_locked(op, self._clock.monotonic())
             self._cond.notify_all()
+        # Watch series die with the job (live path only — replay
+        # starts from an empty store anyway).
+        self.watch.forget_job(key)
 
     def update(self, key: str, **fields: Any) -> None:  # journaled
         with self._cond:
@@ -1529,6 +1539,42 @@ class ClusterState:
                 "last_dirty": self._alloc_last_dirty,
             }
 
+    # -- graftwatch intake (in-memory observability, not journaled) ----
+
+    def observe_measured(self, key: str, goodput: float) -> bool:
+        """Record a job's trainer-reported measured goodput into the
+        watch store, attributed to its tenant. Pure store (no clock,
+        no journal): the simulator's replay-pure emit path calls this
+        every cycle."""
+        with self._cond:
+            record = self._jobs.get(key)
+            if record is None:
+                return False
+            tenant = tenant_of(key, record.spec)
+        # The watch store carries its own lock; called outside _cond
+        # so the two locks never nest.
+        self.watch.observe_measured(key, goodput, tenant=tenant)
+        return True
+
+    def note_step_time(
+        self, key: str, rank: int, seconds: float
+    ) -> bool:
+        """One rank's heartbeat-piggybacked step-time EWMA, attributed
+        to the slot the rank's replica runs on (straggler detection's
+        intake)."""
+        with self._cond:
+            record = self._jobs.get(key)
+            if record is None:
+                return False
+            rank = int(rank)
+            slot = (
+                record.allocation[rank]
+                if 0 <= rank < len(record.allocation)
+                else None
+            )
+        self.watch.note_step_time(key, rank, slot, seconds)
+        return True
+
     # -- readers -------------------------------------------------------
 
     def lifecycle_metrics(self) -> dict:
@@ -1673,6 +1719,7 @@ class ClusterState:
             for key, record in self._jobs.items():
                 jobs[key] = {
                     "status": record.status,
+                    "tenant": tenant_of(key, record.spec),
                     "degraded": record.degraded,
                     "replicas": len(record.allocation),
                     "allocation": list(record.allocation),

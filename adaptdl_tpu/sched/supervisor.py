@@ -216,13 +216,35 @@ class Supervisor(ThreadedHttpServer):
         key = "{namespace}/{name}".format(**request.match_info)
         rank = int(request.match_info["rank"])
         group = _group_param(request)
-        if not await self._offload(
-            self._state.renew_lease,
-            key,
-            rank,
-            self._lease_ttl,
-            group=group,
-        ):
+        # Optional piggyback payload: the rank's step-time EWMA rides
+        # the beat it already sends (straggler detection's intake —
+        # graftwatch turns per-rank outliers into a per-slot
+        # adaptdl_slot_suspect gauge). A beat without a body stays a
+        # plain lease renewal.
+        step_ewma = None
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except ValueError:
+                body = None
+            if isinstance(body, dict):
+                raw = body.get("stepTimeEwma")
+                if (
+                    isinstance(raw, (int, float))
+                    and not isinstance(raw, bool)
+                    and raw > 0
+                ):
+                    step_ewma = float(raw)
+
+        def mutate() -> bool:
+            renewed = self._state.renew_lease(
+                key, rank, self._lease_ttl, group=group
+            )
+            if renewed and step_ewma is not None:
+                self._state.note_step_time(key, rank, step_ewma)
+            return renewed
+
+        if not await self._offload(mutate):
             return web.json_response({"error": "no such job"}, status=404)
         return web.json_response(
             {"ok": True, "ttl": self._lease_ttl}
@@ -242,6 +264,12 @@ class Supervisor(ThreadedHttpServer):
 
         def mutate() -> None:
             self._state.update(key, hints=hints)
+            # graftwatch: the trainer-measured goodput rides the hint
+            # post; the watch store pairs it with the model's
+            # prediction each allocator cycle (the drift monitor).
+            measured = hints.get("measuredGoodput")
+            if isinstance(measured, (int, float)) and measured >= 0:
+                self._state.observe_measured(key, float(measured))
             # Hints are posted from rank 0's fit thread: count them as
             # a liveness beat so chatty jobs never need a dedicated
             # beat.
@@ -408,6 +436,53 @@ class Supervisor(ThreadedHttpServer):
             for kind, rate in preempt["hazardRates"].items()
         }
         payload["preemptionNotices"] = preempt["noticesByKind"]
+        # graftwatch: measured vs predicted goodput, drift, and the
+        # re-profiling flag per job — "is this job healthy" answered
+        # from /status alone, no Prometheus scrape needed. Offloaded:
+        # the watch lock may be contended by a mid-sample allocator
+        # cycle, and the event loop must not wait on it.
+        watch_fields = await self._offload(
+            self._state.watch.status_fields
+        )
+        for key, job in payload["jobs"].items():
+            job.update(watch_fields.get(key, {}))
+        return web.json_response(payload)
+
+    # -- graftwatch: goodput accounting + decision provenance ---------
+
+    @_faultable("sup.watch.pre")
+    async def _watch(self, request: web.Request) -> web.Response:
+        """The watch store's bounded snapshot: cluster utilization and
+        per-tenant goodput-share/fairness series, per-job goodput
+        triple + drift, suspect slots, provenance cycle summaries
+        (the ``adaptdl-tpu top`` payload)."""
+        return web.json_response(
+            await self._offload(self._state.watch.snapshot)
+        )
+
+    @_faultable("sup.explain.pre")
+    async def _explain(self, request: web.Request) -> web.Response:
+        """Decision provenance for one job: the latest allocator-cycle
+        explain record (winning allocation, mesh shape, objective
+        terms) plus retained history and the cycle's top-k losers."""
+        key = "{namespace}/{name}".format(**request.match_info)
+        if self._state.get_job(key) is None:
+            return web.json_response(
+                {"error": "no such job"}, status=404
+            )
+        payload = await self._offload(
+            self._state.watch.explain_for, key
+        )
+        if payload is None:
+            return web.json_response(
+                {
+                    "error": (
+                        "no explain record yet (no allocator cycle "
+                        "has covered this job)"
+                    )
+                },
+                status=404,
+            )
         return web.json_response(payload)
 
     # -- graftscope: worker span intake + stitched per-job timeline --
@@ -655,6 +730,71 @@ class Supervisor(ThreadedHttpServer):
             "Dirty jobs consumed by the last allocator cycle.",
         )
         b.family(
+            "adaptdl_goodput_measured",
+            "gauge",
+            "Trainer-measured goodput (useful examples/s) per job, "
+            "from the measuredGoodput sched hint.",
+        )
+        b.family(
+            "adaptdl_goodput_predicted",
+            "gauge",
+            "Model-predicted goodput per job at its PUBLISHED "
+            "allocation — what the scheduler believed when it "
+            "allocated.",
+        )
+        b.family(
+            "adaptdl_goodput_drift",
+            "gauge",
+            "Rolling measured/predicted goodput ratio per job "
+            "(1 = the fitted model is right; the drift monitor's "
+            "signal).",
+        )
+        b.family(
+            "adaptdl_goodput_reprofile_flag",
+            "gauge",
+            "1 while a job's goodput drift sits outside the "
+            "ADAPTDL_WATCH_DRIFT_THRESHOLD band — the model needs "
+            "re-profiling (observability-only signal).",
+        )
+        b.family(
+            "adaptdl_tenant_goodput_share",
+            "gauge",
+            "Each tenant's share of the cluster's current total "
+            "goodput.",
+        )
+        b.family(
+            "adaptdl_tenant_fairness_rho",
+            "gauge",
+            "Mean finish-time-fairness slowdown per tenant "
+            "(requested-ideal goodput over actual; 1 = running at "
+            "the ask).",
+        )
+        b.family(
+            "adaptdl_tenant_jobs",
+            "gauge",
+            "Active jobs per tenant, by whether they hold an "
+            "allocation.",
+        )
+        b.family(
+            "adaptdl_tenant_slo_burn_total",
+            "counter",
+            "Watch samples in which the tenant's fairness rho "
+            "exceeded the ADAPTDL_WATCH_SLO_RHO target.",
+        )
+        b.family(
+            "adaptdl_slot_suspect",
+            "gauge",
+            "Step-time EWMA of the slot's rank over its job's "
+            "median — above the straggler factor the slot is "
+            "suspect.",
+        )
+        b.family(
+            "adaptdl_cluster_utilization",
+            "gauge",
+            "Allocated chips over total inventory chips at the last "
+            "allocator cycle.",
+        )
+        b.family(
             "adaptdl_supervisor_recoveries_total",
             "counter",
             "Durable-state recoveries this cluster has performed.",
@@ -785,6 +925,71 @@ class Supervisor(ThreadedHttpServer):
                 "adaptdl_alloc_decide_seconds", {"mode": mode}, snap
             )
         b.sample("adaptdl_alloc_dirty_jobs", value=alloc["last_dirty"])
+        # graftwatch: goodput accounting, per-tenant fairness/SLO, the
+        # drift monitor's flags, straggler suspects, and cluster
+        # utilization — the ROADMAP's multi-tenant observability
+        # surface.
+        watch = self._state.watch.metrics_view()
+        for key, job in sorted(watch["jobs"].items()):
+            labels = {"job": key, "tenant": job["tenant"]}
+            if job["measured"] is not None:
+                b.sample(
+                    "adaptdl_goodput_measured", labels, job["measured"]
+                )
+            if job["predicted"] is not None:
+                b.sample(
+                    "adaptdl_goodput_predicted",
+                    labels,
+                    job["predicted"],
+                )
+            if job["drift"] is not None:
+                b.sample(
+                    "adaptdl_goodput_drift", labels, job["drift"]
+                )
+                b.sample(
+                    "adaptdl_goodput_reprofile_flag",
+                    labels,
+                    int(job["reprofile"]),
+                )
+        for tenant, agg in sorted(watch["tenants"].items()):
+            labels = {"tenant": tenant}
+            if agg.get("share") is not None:
+                b.sample(
+                    "adaptdl_tenant_goodput_share",
+                    labels,
+                    agg["share"],
+                )
+            if agg.get("rho") is not None:
+                b.sample(
+                    "adaptdl_tenant_fairness_rho", labels, agg["rho"]
+                )
+            if agg.get("jobs") is not None:
+                b.sample(
+                    "adaptdl_tenant_jobs",
+                    {**labels, "state": "running"},
+                    agg.get("running", 0),
+                )
+                b.sample(
+                    "adaptdl_tenant_jobs",
+                    {**labels, "state": "queued"},
+                    agg["jobs"] - agg.get("running", 0),
+                )
+            b.sample(
+                "adaptdl_tenant_slo_burn_total",
+                labels,
+                agg.get("burn", 0),
+            )
+        for slot, suspect in sorted(watch["suspects"].items()):
+            b.sample(
+                "adaptdl_slot_suspect",
+                {"slot": slot, "job": suspect["job"]},
+                suspect["ratio"],
+            )
+        if watch["cluster"] is not None:
+            b.sample(
+                "adaptdl_cluster_utilization",
+                value=watch["cluster"]["utilization"],
+            )
         recovery = self._state.recovery_info()
         b.sample(
             "adaptdl_supervisor_recoveries_total",
@@ -894,6 +1099,10 @@ class Supervisor(ThreadedHttpServer):
                 ),
                 web.get("/healthz", self._healthz),
                 web.get("/status", self._status),
+                web.get("/watch", self._watch),
+                web.get(
+                    "/explain/{namespace}/{name}", self._explain
+                ),
                 web.get("/metrics", self._metrics),
             ]
         )

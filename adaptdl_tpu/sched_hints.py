@@ -64,6 +64,12 @@ SCHED_HINTS_KEYS = (
     # prices checkpoint-restart moves with these instead of the
     # assumed default penalty.
     "restartStats",
+    # Trainer-measured goodput (useful examples/s: measured
+    # throughput x statistical efficiency at the running batch size).
+    # graftwatch pairs it with the model's prediction every allocator
+    # cycle — the predicted-vs-realized drift monitor's measured
+    # half. Observability-only: the policy never reads it.
+    "measuredGoodput",
 )
 
 
@@ -90,6 +96,16 @@ def validate_hints(hints: dict[str, Any]) -> None:
         hints["restartStats"], dict
     ):
         raise ValueError("restartStats must be an object")
+    if hints.get("measuredGoodput") is not None:
+        measured = hints["measuredGoodput"]
+        if (
+            not isinstance(measured, (int, float))
+            or isinstance(measured, bool)
+            or measured < 0
+        ):
+            raise ValueError(
+                "measuredGoodput must be a non-negative number"
+            )
     if hints.get("meshShapeGrid") is not None:
         grid = hints["meshShapeGrid"]
         if not isinstance(grid, (list, tuple)):
@@ -199,6 +215,7 @@ def send_heartbeat(
     rank: int | None = None,
     job_id: str | None = None,
     group: int | None = None,
+    step_time_ewma: float | None = None,
 ) -> bool:
     """PUT a liveness heartbeat for this worker's lease; False on any
     failure (best-effort — a missed beat only matters if a lease TTL
@@ -206,18 +223,25 @@ def send_heartbeat(
     so the supervisor can tell a doomed incarnation's dying beats from
     its successor's — and so single-process jobs, which never
     register, can still prove a pending allocation epoch alive
-    (transactional rescale's commit quorum)."""
+    (transactional rescale's commit quorum). ``step_time_ewma`` (this
+    rank's smoothed step time, seconds) piggybacks on the beat for
+    graftwatch's per-slot straggler detection — no extra request, no
+    extra cadence."""
     url = env.supervisor_url()
     job_id = job_id if job_id is not None else env.job_id()
     if not url or not job_id:
         return False
     rank = env.process_rank() if rank is None else rank
     group = env.num_restarts() if group is None else group
+    payload = None
+    if step_time_ewma is not None and step_time_ewma > 0:
+        payload = {"stepTimeEwma": float(step_time_ewma)}
     try:
         response = rpc.default_client().put(
             f"{url}/heartbeat/{job_id}/{rank}",
             endpoint=f"heartbeat/{job_id}",
             params={"group": group},
+            json=payload,
             timeout=(0.5, 2),
             attempts=1,
             circuit_threshold=3,

@@ -429,6 +429,13 @@ class ClusterSim:
                 "max_replicas": job.spec.max_replicas,
                 "resources": {"tpu": 1},
                 "preemptible": True,
+                # graftwatch accounting: the workload category is the
+                # tenant (fairness curves per size class), and the
+                # requested fixed allocation is the fairness-rho
+                # denominator — the same ask the trace's duration is
+                # defined against.
+                "tenant": job.spec.category,
+                "requested": job.spec.requested,
             },
         )
         self.state.update(job.spec.key, status="Running")
@@ -482,10 +489,25 @@ class ClusterSim:
         self._alloc_scheduled = True
         self.queue.push(Event(now + delay, ev.ALLOC, {}))
 
+    def _emit_watch(self) -> None:  # replay-pure
+        """graftwatch's measured half, sim-side: every running job's
+        integrated goodput feeds the SAME ClusterState entry point the
+        supervisor's hint intake uses, so the allocator-cycle sampler
+        emits the identical record stream a live cluster would —
+        fairness/drift curves at 1k jobs from a graftsim run,
+        bit-identical at fixed seed (virtual-clock stamps, no wall
+        reads on this path)."""
+        for key in sorted(self.jobs):
+            job = self.jobs[key]
+            if job.done or not job.alloc:
+                continue
+            self.state.observe_measured(key, job.goodput)
+
     def _handle_alloc(self, event: Event) -> None:
         now = event.time
         self._alloc_scheduled = False
         self._alloc_cycles += 1
+        self._emit_watch()
         wall = time.monotonic()
         try:
             self.allocator.optimize_once()
@@ -757,6 +779,18 @@ class SimReport:
     def summary_json(self) -> str:
         """Canonical form for the bit-identical determinism gate."""
         return json.dumps(self.summary(), sort_keys=True)
+
+    def watch_summary(self) -> dict:
+        """graftwatch's deterministic per-tenant fairness/drift
+        summary over the run (tenant = workload category): goodput
+        share, rho percentiles, SLO burn, cluster utilization, drift
+        stats. Fixed seed ⇒ bit-identical (the store is stamped by
+        the virtual clock and samples are rounded at intake)."""
+        return self._sim.state.watch.watch_summary()
+
+    def watch_summary_json(self) -> str:
+        """Canonical form for the watchgate's bit-identical check."""
+        return json.dumps(self.watch_summary(), sort_keys=True)
 
     def latency(self) -> dict:
         """Real wall-clock telemetry (NOT deterministic): per-decision

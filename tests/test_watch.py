@@ -1,0 +1,519 @@
+"""graftwatch: goodput accounting, decision provenance, drift, and
+straggler detection (docs/observability.md "Goodput accounting &
+decision provenance").
+
+Covers the watch store's bounded-memory and thread-safety contracts,
+the drift monitor's re-profiling flag (including the e2e injected
+mis-fitted-model scenario), explain-record determinism on both
+allocator paths, the supervisor's /watch + /explain + enriched
+/status surface, Prometheus conformance of every new metric family,
+and the `top`/`explain` CLI verbs.
+"""
+
+import json
+import threading
+
+import pytest
+import requests
+
+from adaptdl_tpu import cli
+from adaptdl_tpu.sched.allocator import Allocator
+from adaptdl_tpu.sched.policy import JobInfo, NodeInfo, PolluxPolicy
+from adaptdl_tpu.sched.state import ClusterState
+from adaptdl_tpu.sched.supervisor import Supervisor
+from adaptdl_tpu.watch import WatchStore, tenant_of
+from tests import promcheck
+
+HINTS = {
+    "initBatchSize": 128,
+    "localBszBounds": [64, 256],
+    "maxBatchSize": 1280,
+    "maxProfiledReplicas": 2,
+    "gradientAccumulation": True,
+    "gradParams": {"sqr": 0.00136, "var": 0.000502},
+    "perfParams": {
+        "alpha_c": 0.121,
+        "beta_c": 0.00568,
+        "alpha_n": 0.0236,
+        "beta_n": 0.00634,
+        "alpha_r": 0.0118,
+        "beta_r": 0.00317,
+        "gamma": 1.14,
+    },
+}
+
+
+def _speedup_fn(perf_scale: float = 1.0):
+    from adaptdl_tpu.goodput import (
+        GoodputFunction,
+        GradParams,
+        PerfParams,
+    )
+    from adaptdl_tpu.sched.policy import SpeedupFunction
+
+    perf = {
+        k: v * perf_scale if k != "gamma" else v
+        for k, v in HINTS["perfParams"].items()
+    }
+    goodput_fn = GoodputFunction(
+        PerfParams(**perf),
+        GradParams(**HINTS["gradParams"]),
+        HINTS["initBatchSize"],
+    )
+    return SpeedupFunction(
+        goodput_fn,
+        max_batch_size=HINTS["maxBatchSize"],
+        atomic_bsz_range=(64, 256),
+        accumulation=True,
+    )
+
+
+def _job_info(**kwargs):
+    defaults = dict(
+        resources={"tpu": 1},
+        speedup_fn=_speedup_fn(kwargs.pop("perf_scale", 1.0)),
+        creation_timestamp=kwargs.pop("creation_timestamp", 0.0),
+        min_replicas=0,
+        max_replicas=8,
+    )
+    defaults.update(kwargs)
+    return JobInfo(**defaults)
+
+
+@pytest.fixture
+def cluster():
+    state = ClusterState()
+    state.create_job(
+        "test/job", spec={"max_replicas": 8, "requested": 4}
+    )
+    state.update("test/job", status="Running", hints=dict(HINTS))
+    supervisor = Supervisor(state)
+    url = supervisor.start()
+    nodes = {
+        f"slice-{i:02d}": NodeInfo(resources={"tpu": 4})
+        for i in range(2)
+    }
+    allocator = Allocator(
+        state,
+        nodes,
+        policy=PolluxPolicy(pop_size=8, generations=4),
+        interval=1000.0,
+    )
+    yield state, url, allocator
+    supervisor.stop()
+
+
+# -- the bounded, lock-disciplined store ------------------------------
+
+
+def test_ring_store_bounded_under_hammer():
+    """Every ring stays at its bound under concurrent observe /
+    heartbeat / sample traffic from multiple threads — a runaway
+    producer evicts history, never grows memory."""
+    store = WatchStore(buffer=32, drift_window=8)
+    jobs = [f"ns/j{i}" for i in range(4)]
+    errors = []
+
+    def hammer(seed: int):
+        try:
+            for i in range(400):
+                key = jobs[(seed + i) % len(jobs)]
+                store.observe_measured(key, 10.0 + i, tenant="ns")
+                store.note_step_time(key, i % 5, f"slot-{i % 3}", 0.1)
+                store.sample_cycle(
+                    [
+                        {
+                            "key": key,
+                            "tenant": "ns",
+                            "alloc": ["slot-0"] * (i % 3),
+                            "topology": None,
+                            "batchConfig": None,
+                            "hints": HINTS,
+                            "requested": 4,
+                        }
+                    ],
+                    total_chips=8,
+                    chips_per_slice=4,
+                    cycle_s=0.01,
+                )
+                store.note_explain(
+                    i,
+                    "full",
+                    {"kind": "full", "candidates": 1, "losers": []},
+                    {key: {"alloc": [], "replicas": 0}},
+                )
+        except Exception as exc:  # noqa: BLE001 - surfaced below
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=hammer, args=(t,)) for t in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    snapshot = store.snapshot()
+    assert len(snapshot["cluster"]) <= 240
+    for key in jobs:
+        series = store._job_series[key]
+        assert len(series) <= 32
+        assert len(store._drift.get(key, [])) <= 8
+        assert len(store._explain[key]) <= 8
+    for series in store._tenant_series.values():
+        assert len(series) <= 32
+
+
+def test_drift_flag_thresholds():
+    """The rolling measured/predicted ratio flags re-profiling only
+    outside the [1/(1+t), 1+t] band, and only after 3 paired
+    samples."""
+    store = WatchStore(buffer=32, drift_window=8, drift_threshold=0.25)
+    job = {
+        "key": "ns/fit",
+        "tenant": "ns",
+        "alloc": ["s0", "s0"],
+        "topology": None,
+        "batchConfig": None,
+        "hints": HINTS,
+        "requested": 4,
+    }
+    # Measured ~ predicted: healthy model, no flag.
+    predicted = None
+    for _ in range(4):
+        store.sample_cycle([job], 8, 4)
+        predicted = store.metrics_view()["jobs"]["ns/fit"]["predicted"]
+        store.observe_measured("ns/fit", predicted * 1.05, tenant="ns")
+    view = store.metrics_view()["jobs"]["ns/fit"]
+    assert view["drift"] is None or not view["reprofile"]
+    # Mis-fitted model: measured collapses to half the prediction.
+    for _ in range(8):
+        store.observe_measured("ns/fit", predicted * 0.5, tenant="ns")
+        store.sample_cycle([job], 8, 4)
+    view = store.metrics_view()["jobs"]["ns/fit"]
+    assert view["drift"] is not None and view["drift"] < 0.8
+    assert view["reprofile"] is True
+
+
+def test_straggler_suspect_needs_majority():
+    store = WatchStore(straggler_factor=1.5)
+    store.note_step_time("ns/j", 0, "slot-a", 0.10)
+    store.note_step_time("ns/j", 1, "slot-b", 0.40)
+    # Two ranks: no majority to define "normal" — no verdict.
+    assert store.suspect_slots() == {}
+    store.note_step_time("ns/j", 2, "slot-c", 0.11)
+    suspects = store.suspect_slots()
+    assert list(suspects) == ["slot-b"]
+    assert suspects["slot-b"]["rank"] == 1
+    assert suspects["slot-b"]["ratio"] > 1.5
+
+
+def test_tenant_of_prefers_spec_then_namespace():
+    assert tenant_of("team-a/job1") == "team-a"
+    assert tenant_of("team-a/job1", {"tenant": "gold"}) == "gold"
+    assert tenant_of("bare-job") == "default"
+
+
+def test_starved_job_shows_stalled_rho_not_stale_goodput():
+    """A job whose allocation was withdrawn must read as STARVED:
+    its pre-withdrawal measured goodput is history, not a rate — the
+    tenant's rho spikes and burns the SLO instead of looking
+    healthy."""
+    store = WatchStore(slo_rho=3.0)
+    running = {
+        "key": "ns/j",
+        "tenant": "ns",
+        "alloc": ["s0", "s0"],
+        "topology": None,
+        "batchConfig": None,
+        "hints": HINTS,
+        "requested": 4,
+    }
+    store.observe_measured("ns/j", 250.0, tenant="ns")
+    store.sample_cycle([running], 8, 4)
+    assert store.metrics_view()["jobs"]["ns/j"]["measured"] == 250.0
+    starved = dict(running, alloc=[])
+    store.sample_cycle([starved], 8, 4)
+    view = store.metrics_view()
+    assert view["jobs"]["ns/j"]["measured"] is None
+    assert view["jobs"]["ns/j"]["rho"] == 100.0
+    assert view["tenants"]["ns"]["burn"] >= 1
+
+
+def test_tenant_slo_burn_counts_slow_samples():
+    store = WatchStore(slo_rho=2.0)
+    job = {
+        "key": "ns/slow",
+        "tenant": "ns",
+        "alloc": ["s0"],
+        "topology": None,
+        "batchConfig": None,
+        "hints": HINTS,
+        "requested": 8,
+    }
+    # One chip against an 8-chip ask: rho well above the 2.0 SLO.
+    store.observe_measured("ns/slow", 1.0, tenant="ns")
+    for _ in range(3):
+        store.sample_cycle([job], 8, 4)
+    view = store.metrics_view()["tenants"]["ns"]
+    assert view["burn"] == 3
+    assert view["rho"] > 2.0
+
+
+# -- explain-record determinism (full and incremental paths) ----------
+
+
+def _explain_inputs():
+    jobs = {
+        "t/a": _job_info(creation_timestamp=0.0),
+        "t/b": _job_info(creation_timestamp=1.0, perf_scale=2.0),
+        "t/c": _job_info(creation_timestamp=2.0),
+    }
+    nodes = {
+        f"slice-{i:02d}": NodeInfo(
+            resources={"tpu": 4}, preemptible=i >= 2
+        )
+        for i in range(4)
+    }
+    base = {"t/a": ["slice-00"], "t/b": [], "t/c": ["slice-01"]}
+    template = NodeInfo(resources={"tpu": 4})
+    return jobs, nodes, base, template
+
+
+def test_explain_deterministic_full_path():
+    records = []
+    for _ in range(2):
+        jobs, nodes, base, template = _explain_inputs()
+        policy = PolluxPolicy(pop_size=16, generations=8)
+        policy.optimize(jobs, nodes, base, template)
+        records.append(json.dumps(policy.last_explain, sort_keys=True))
+    assert records[0] == records[1]
+    explain = json.loads(records[0])
+    assert explain["kind"] == "full"
+    assert explain["candidates"] > 0
+    assert explain["winner"]["objective"] > 0
+    assert set(explain["jobs"]) == {"t/a", "t/b", "t/c"}
+    for rec in explain["jobs"].values():
+        assert {"alloc", "replicas", "speedup", "restartPenalty",
+                "hazardLoss"} <= set(rec)
+    for loser in explain["losers"]:
+        assert loser["killedBy"] in (
+            "speedup", "restartPenalty", "hazardRestartCost",
+            "utilBand",
+        )
+
+
+def test_explain_deterministic_incremental_path():
+    records = []
+    for _ in range(2):
+        jobs, nodes, base, template = _explain_inputs()
+        policy = PolluxPolicy(pop_size=16, generations=8)
+        policy.optimize(jobs, nodes, base, template)
+        dirty_jobs = {"t/b": jobs["t/b"]}
+        policy.optimize_incremental(
+            dirty_jobs,
+            nodes,
+            {"t/a": ["slice-00"], "t/b": [], "t/c": ["slice-01"]},
+            template,
+            dirty={"t/b"},
+        )
+        records.append(json.dumps(policy.last_explain, sort_keys=True))
+    assert records[0] == records[1]
+    explain = json.loads(records[0])
+    assert explain["kind"] == "incremental"
+    # The untouched background is recorded pinned; the dirty job got
+    # real terms.
+    assert explain["jobs"]["t/a"]["pinned"] is True
+    assert "speedup" in explain["jobs"]["t/b"]
+
+
+def test_explain_incremental_passthrough_records_pinned_jobs():
+    jobs, nodes, base, template = _explain_inputs()
+    policy = PolluxPolicy(pop_size=16, generations=8)
+    policy.optimize_incremental(
+        {}, nodes, base, template, dirty=set()
+    )
+    explain = policy.last_explain
+    assert explain["kind"] == "incremental"
+    assert explain["candidates"] == 0
+    assert explain["jobs"]["t/a"]["pinned"] is True
+
+
+# -- supervisor surface: /watch, /explain, /status, /metrics ----------
+
+
+def test_explain_endpoint_and_cli_render(cluster, capsys):
+    """Acceptance: one rescale yields a retrievable explain record,
+    and `adaptdl-tpu explain` names the winning allocation, its mesh
+    shape, and the objective terms."""
+    state, url, allocator = cluster
+    allocator.optimize_once()
+    assert state.get_allocation("test/job")
+    # Incremental pass-through cycles must not evict (or mis-match)
+    # the real decision's winner/losers.
+    for _ in range(10):
+        allocator.optimize_once()
+    payload = requests.get(f"{url}/explain/test/job", timeout=5).json()
+    latest = payload["lastDecision"]
+    assert latest["alloc"] == state.get_allocation("test/job")
+    assert latest["meshShape"]["modelShards"] >= 1
+    assert latest["speedup"] > 0
+    assert payload["cycle"]["candidates"] > 0
+    assert payload["cycle"]["winner"] is not None
+    assert payload["latest"]["pinned"] is True
+    # Unknown jobs 404.
+    assert (
+        requests.get(f"{url}/explain/test/nope", timeout=5).status_code
+        == 404
+    )
+    # CLI rendering names the allocation, mesh shape, and terms.
+    rc = cli.main(["explain", "test/job", "--supervisor", url])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "winning allocation" in out
+    assert "mesh shape" in out
+    assert "objective terms" in out
+    assert "speedup=" in out
+
+
+def test_watch_endpoint_and_top_cli(cluster, capsys):
+    state, url, allocator = cluster
+    state.observe_measured("test/job", 55.0)
+    allocator.optimize_once()
+    payload = requests.get(f"{url}/watch", timeout=5).json()
+    assert payload["samples"] >= 1
+    assert payload["cluster"][-1]["chipsTotal"] == 8
+    assert payload["jobs"]["test/job"]["latest"]["measured"] == 55.0
+    assert payload["jobs"]["test/job"]["tenant"] == "test"
+    assert "test" in payload["tenants"]
+    rc = cli.main(["top", "--supervisor", url])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "cluster:" in out
+    assert "TENANT" in out
+    assert "test/job" in out
+
+
+def test_status_reports_goodput_health(cluster):
+    """Satellite: /status answers "is this job healthy" without a
+    Prometheus scrape — measured vs predicted goodput, tenant,
+    drift."""
+    state, url, allocator = cluster
+    state.observe_measured("test/job", 42.0)
+    allocator.optimize_once()
+    job = requests.get(f"{url}/status", timeout=5).json()["jobs"][
+        "test/job"
+    ]
+    assert job["tenant"] == "test"
+    assert job["goodputMeasured"] == 42.0
+    assert job["goodputPredicted"] > 0
+    assert "goodputDrift" in job
+    assert "reprofile" in job
+
+
+def test_heartbeat_step_times_feed_suspect_gauge(cluster):
+    state, url, allocator = cluster
+    allocator.optimize_once()
+    for rank, ewma in ((0, 0.1), (1, 0.11), (2, 0.52)):
+        r = requests.put(
+            f"{url}/heartbeat/test/job/{rank}",
+            json={"stepTimeEwma": ewma},
+            timeout=5,
+        )
+        assert r.status_code == 200
+    suspects = state.watch.suspect_slots()
+    assert len(suspects) == 1
+    (info,) = suspects.values()
+    assert info["rank"] == 2
+    # A body-less heartbeat stays a plain lease renewal.
+    assert (
+        requests.put(
+            f"{url}/heartbeat/test/job/0", timeout=5
+        ).status_code
+        == 200
+    )
+
+
+def test_metrics_conformant_with_watch_families(cluster):
+    """Satellite: promcheck conformance for every new metric family,
+    with real samples present."""
+    state, url, allocator = cluster
+    for _ in range(4):
+        # Fresh observation per cycle, like the trainer's fit cadence
+        # (a sticky value pairs with a prediction only once).
+        state.observe_measured("test/job", 40.0)
+        allocator.optimize_once()
+    for rank, ewma in ((0, 0.1), (1, 0.11), (2, 0.5)):
+        requests.put(
+            f"{url}/heartbeat/test/job/{rank}",
+            json={"stepTimeEwma": ewma},
+            timeout=5,
+        )
+    text = requests.get(f"{url}/metrics", timeout=5).text
+    parsed = promcheck.validate_exposition(text)
+    families = parsed["families"]
+    for family in (
+        "adaptdl_goodput_measured",
+        "adaptdl_goodput_predicted",
+        "adaptdl_goodput_drift",
+        "adaptdl_goodput_reprofile_flag",
+        "adaptdl_tenant_goodput_share",
+        "adaptdl_tenant_fairness_rho",
+        "adaptdl_tenant_jobs",
+        "adaptdl_tenant_slo_burn_total",
+        "adaptdl_slot_suspect",
+        "adaptdl_cluster_utilization",
+    ):
+        assert family in families, family
+        assert families[family]["samples"], family
+
+
+def test_mis_fitted_model_drives_drift_past_threshold(cluster):
+    """Acceptance e2e: an injected mis-fitted goodput model (posted
+    hints predict far more than the job measures) drives
+    adaptdl_goodput_drift past the threshold and flags
+    re-profiling."""
+    state, url, allocator = cluster
+    hints = dict(HINTS, measuredGoodput=1.0)  # model predicts ~300
+    # The trainer re-posts on the fit cadence; each fresh observation
+    # pairs with one prediction (a sticky value is paired only once).
+    for _ in range(4):
+        r = requests.put(
+            f"{url}/hints/test/job", json=hints, timeout=5
+        )
+        assert r.status_code == 200
+        allocator.optimize_once()
+    text = requests.get(f"{url}/metrics", timeout=5).text
+    drift_lines = [
+        line
+        for line in text.splitlines()
+        if line.startswith("adaptdl_goodput_drift{")
+    ]
+    assert drift_lines
+    drift = float(drift_lines[0].rsplit(" ", 1)[1])
+    assert drift < 0.1
+    flag_lines = [
+        line
+        for line in text.splitlines()
+        if line.startswith("adaptdl_goodput_reprofile_flag{")
+    ]
+    assert flag_lines and flag_lines[0].rsplit(" ", 1)[1] == "1"
+
+
+def test_measured_goodput_hint_validation():
+    from adaptdl_tpu import sched_hints
+
+    sched_hints.validate_hints({"measuredGoodput": 12.5})
+    with pytest.raises(ValueError):
+        sched_hints.validate_hints({"measuredGoodput": -1})
+    with pytest.raises(ValueError):
+        sched_hints.validate_hints({"measuredGoodput": "fast"})
+
+
+def test_forget_job_prunes_series(cluster):
+    state, url, allocator = cluster
+    state.observe_measured("test/job", 40.0)
+    allocator.optimize_once()
+    assert "test/job" in state.watch.metrics_view()["jobs"]
+    state.remove_job("test/job")
+    assert "test/job" not in state.watch.metrics_view()["jobs"]
