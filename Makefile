@@ -27,7 +27,9 @@ examples:
 
 # Full invariant lint: bytecode-compiles everything, then runs the
 # graftcheck passes (docs/static-analysis.md) in --fast smoke mode
-# (per-file cache; a warm run is sub-second, cold a few seconds).
+# (per-file cache; a warm run is sub-second, cold a few seconds —
+# CI budget <6s, see test_package_is_clean_or_baselined). The same
+# analysis is also available as `adaptdl-tpu check`.
 lint:
 	$(PY) -m compileall -q adaptdl_tpu examples tutorial tests bench.py __graft_entry__.py tools
 	$(PY) -m tools.graftcheck --fast adaptdl_tpu

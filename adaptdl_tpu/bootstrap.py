@@ -34,7 +34,7 @@ _DISCOVER_ATTEMPTS = 3
 _DISCOVER_DEADLINE = 700.0
 
 
-def _discover_peers() -> dict[int, str] | None:
+def _discover_peers() -> dict[int, str] | None:  # wire: produces=register
     """Register with the supervisor and wait for all peer processes.
 
     Both calls ride the resilient rpc client: a transient supervisor

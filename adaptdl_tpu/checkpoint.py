@@ -560,6 +560,29 @@ def writer_topology() -> list[int]:
     ]
 
 
+def _manifest_payload(  # wire: produces=ckpt_manifest
+    restart: int,
+    seq: int,
+    save_kind: str,
+    chain: list,
+    topology: list,
+    digests: dict,
+) -> dict:
+    """The integrity manifest's wire form (the `ckpt_manifest`
+    family in adaptdl_tpu/wire.py): version/restart/seq/kind/chain
+    are operator-facing stamps; the load path proves completeness and
+    integrity from `states` alone."""
+    return {
+        "version": 1,
+        "restart": restart,
+        "seq": seq,
+        "kind": save_kind,
+        "chain": chain,
+        "topology": topology,
+        "states": digests,
+    }
+
+
 def _write_snapshots(
     root: str,
     restart: int,
@@ -610,7 +633,9 @@ def _write_snapshots(
     digests: dict[str, dict[str, Any]] = {}
     new_tables: dict[str, dict[str, str]] = {}
 
-    def _serialize(state: "State", snap: Any, writer) -> dict:
+    def _serialize(  # wire: produces=ckpt_container # wire: produces=ckpt_manifest
+        state: "State", snap: Any, writer
+    ) -> dict:
         """Write one state's payload (raw, chunked-full, or delta)
         through ``writer``; returns the manifest-entry extras."""
         chunks = (
@@ -658,7 +683,9 @@ def _write_snapshots(
             new_tables[state.name] = sha_table
         return {"kind": "full"}
 
-    def write_one(state: "State", snap: Any) -> None:
+    def write_one(  # wire: produces=ckpt_manifest # wire: produces=ckpt_per_state
+        state: "State", snap: Any
+    ) -> None:
         t0 = time.monotonic()
         faults.maybe_fail("ckpt.write.state")
         path = os.path.join(tmpdir, state.name)
@@ -718,15 +745,9 @@ def _write_snapshots(
         manifest_path = os.path.join(tmpdir, MANIFEST_NAME)
         with open(manifest_path, "w", encoding="utf-8") as f:
             json.dump(
-                {
-                    "version": 1,
-                    "restart": restart,
-                    "seq": seq,
-                    "kind": save_kind,
-                    "chain": chain,
-                    "topology": topology,
-                    "states": digests,
-                },
+                _manifest_payload(
+                    restart, seq, save_kind, chain, topology, digests
+                ),
                 f,
                 sort_keys=True,
             )
@@ -815,7 +836,7 @@ _bad_dirs: set[str] = set()
 _loaded_from: dict[str, str] = {}
 
 
-def read_manifest(ckpt: str) -> dict | None:
+def read_manifest(ckpt: str) -> dict | None:  # wire: consumes=ckpt_manifest
     """The checkpoint dir's integrity manifest: a dict, ``None`` when
     absent (pre-manifest checkpoint), or raises ``ValueError`` when
     present but unparseable/malformed — the dir then cannot be
@@ -835,7 +856,9 @@ def read_manifest(ckpt: str) -> dict | None:
     return manifest
 
 
-def _verify_state_payload(ckpt: str, name: str) -> str:
+def _verify_state_payload(  # wire: consumes=ckpt_manifest
+    ckpt: str, name: str
+) -> str:
     """Integrity verdict for one state's payload in one checkpoint
     dir: ``"ok"`` (safe to load), ``"skip"`` (state not in this
     checkpoint — try an older dir, dir stays trusted), or
@@ -886,7 +909,9 @@ class CheckpointUnreadableError(RuntimeError):
     """
 
 
-def _load_payload(root: str, ckpt: str, state: State) -> None:
+def _load_payload(  # wire: consumes=ckpt_manifest # wire: consumes=ckpt_container
+    root: str, ckpt: str, state: State
+) -> None:
     """Deserialize one state's payload from one checkpoint dir: raw
     (pre-delta) payloads go straight to :meth:`State.load`; chunked
     containers are reassembled — a delta is reconstructed over its

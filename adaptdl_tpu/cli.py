@@ -337,7 +337,7 @@ def _fmt_rate(value) -> str:
     return f"{value:.1f}" if isinstance(value, (int, float)) else "-"
 
 
-def _render_top(payload: dict) -> None:
+def _render_top(payload: dict) -> None:  # wire: consumes=watch
     """One frame of the live cluster view: cluster utilization, the
     per-tenant fairness table, and the per-job goodput table."""
     cluster = (payload.get("cluster") or [])
@@ -437,7 +437,7 @@ def _cmd_top(args) -> int:
         return 0
 
 
-def _cmd_explain(args) -> int:
+def _cmd_explain(args) -> int:  # wire: consumes=explain,topology
     """Decision provenance for one job: why the allocator's last
     cycle gave it THIS allocation and mesh shape — the winning
     candidate's objective terms and the top-k losers with the term
@@ -532,7 +532,7 @@ def _cmd_explain(args) -> int:
     return 0
 
 
-def _cmd_trace(args) -> int:
+def _cmd_trace(args) -> int:  # wire: consumes=trace_payload,trace_span
     """Render a job's stitched rescale trace (graftscope): fetch the
     supervisor's merged worker+supervisor span view, pick one trace
     (the current decision's, else the newest, else --trace-id), print
@@ -684,6 +684,66 @@ def _cmd_sim(args) -> int:
             json.dump(payload, f, sort_keys=True, indent=2)
         print(f"\nwrote report JSON to {args.json}", file=sys.stderr)
     return 0
+
+
+def _cmd_check(args) -> int:
+    """Operator-facing graftcheck: the same analyzer `make
+    graftcheck` runs (wire contracts, endpoint conformance, lock /
+    journal / replay discipline), without needing the Makefile.
+    Exit-code semantics are graftcheck's own: 0 = clean beyond the
+    committed baseline, 1 = new findings, 2 = usage error."""
+    try:
+        from tools.graftcheck.__main__ import main as graftcheck_main
+    except ImportError:
+        print(
+            "check needs the graftcheck analyzer (tools/graftcheck) "
+            "on PYTHONPATH — run from a source checkout of the repo",
+            file=sys.stderr,
+        )
+        return 2
+    # graftcheck anchors everything cwd-relative: the wire/faults
+    # contracts, the protocols doc, the committed baseline, and its
+    # --fast cache. Run from anywhere by re-anchoring at the source
+    # checkout this package was imported from — otherwise the
+    # contract files silently fail to load and the verb reports a
+    # false clean.
+    import os
+
+    import adaptdl_tpu as _pkg
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(_pkg.__file__))
+    )
+    if os.getcwd() != repo_root and os.path.isdir(
+        os.path.join(repo_root, "tools", "graftcheck")
+    ):
+        args.paths = [
+            os.path.abspath(p) if os.path.exists(p) else p
+            for p in args.paths
+        ]
+        for attr in ("baseline", "docs_dir"):
+            value = getattr(args, attr)
+            if value:
+                setattr(args, attr, os.path.abspath(value))
+        os.chdir(repo_root)
+    argv = list(args.paths)
+    if args.fast:
+        argv.append("--fast")
+    if args.format != "text":
+        argv.extend(["--format", args.format])
+    if args.rules:
+        argv.extend(["--rules", args.rules])
+    if args.docs_dir:
+        argv.extend(["--docs-dir", args.docs_dir])
+    if args.baseline:
+        argv.extend(["--baseline", args.baseline])
+    if args.write_baseline:
+        argv.append("--write-baseline")
+    if args.list_rules:
+        argv.append("--list-rules")
+    if args.quiet:
+        argv.append("--quiet")
+    return graftcheck_main(argv)
 
 
 def _cmd_hints(args) -> int:
@@ -1193,6 +1253,37 @@ def main(argv=None) -> int:
         "--json", default=None, help="write summary+latency JSON here"
     )
     p.set_defaults(fn=_cmd_sim)
+
+    p = sub.add_parser(
+        "check",
+        help="run the graftcheck static analyzer (wire contracts, "
+        "endpoint conformance, lock/journal/replay discipline); "
+        "exit 0 clean, 1 new findings, 2 usage error",
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        default=["adaptdl_tpu"],
+        help="files or directories to analyze (default: adaptdl_tpu)",
+    )
+    p.add_argument(
+        "--fast",
+        action="store_true",
+        help="smoke mode: reuse cached results for unchanged files",
+    )
+    p.add_argument(
+        "--format", choices=("text", "json", "sarif"), default="text"
+    )
+    p.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule-id prefixes (e.g. GC10,GC1101)",
+    )
+    p.add_argument("--baseline", default=None)
+    p.add_argument("--docs-dir", default=None)
+    p.add_argument("--write-baseline", action="store_true")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("-q", "--quiet", action="store_true")
+    p.set_defaults(fn=_cmd_check)
 
     p = sub.add_parser("hints", help="show a job's posted sched hints")
     p.add_argument("job", help="namespace/name")

@@ -335,7 +335,7 @@ class AdaptiveDataLoader:
             return atomic_bsz, int(accum_steps)
         return self._atomic_bsz, self._accum_steps
 
-    def _supervisor_decision(
+    def _supervisor_decision(  # wire: consumes=config,batch_config
         self, num_replicas: int
     ) -> tuple[int, int] | None:
         """The allocator's published (atomicBsz, accumSteps) for this

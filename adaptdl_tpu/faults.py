@@ -72,12 +72,21 @@ INJECTION_POINTS = {
     "sup.register.pre": "worker registration handler",
     "sup.discover.pre": "rendezvous long-poll handler",
     "sup.hints.pre": "sched-hints intake handler",
+    "sup.hints.get.pre": "sched-hints readback handler",
     "sup.config.pre": "job-config snapshot handler",
     "sup.heartbeat.pre": "heartbeat lease-renewal handler",
     "sup.trace.pre": "worker trace-span intake handler (graftscope)",
+    "sup.trace.get.pre": "stitched per-job timeline handler",
     "sup.preempt.pre": "preemption-notice intake handler",
     "sup.watch.pre": "goodput-accounting snapshot handler (graftwatch)",
     "sup.explain.pre": "decision-provenance handler (graftwatch)",
+    "sup.handoff.pre": "handoff advertisement intake handler",
+    "sup.handoff.get.pre": "handoff discovery handler",
+    "sup.status.pre": "operator status snapshot handler",
+    "sup.metrics.pre": "prometheus exposition handler",
+    # admission webhook (sched.validator; injected faults become 500s,
+    # which the API server's failurePolicy treats as a rejection)
+    "webhook.validate.pre": "AdaptDLJob admission-review handler",
     # preemption survival (sched.preemption; an injected fault at
     # preempt.notice SIMULATES a reclaim notice in the listener)
     "preempt.notice": "each listener poll for a reclaim notice",

@@ -69,7 +69,10 @@ from typing import Any
 from aiohttp import web
 
 from adaptdl_tpu import checkpoint, env, faults, rpc, trace
-from adaptdl_tpu.sched.http_server import ThreadedHttpServer
+from adaptdl_tpu.sched.http_server import (
+    ThreadedHttpServer,
+    faultable as _faultable,
+)
 
 LOG = logging.getLogger(__name__)
 
@@ -107,7 +110,7 @@ def _part_bytes(arr, lo: int, hi: int) -> bytes:
     return pickle.dumps(np.ascontiguousarray(arr[lo:hi]))
 
 
-def _partition_chunk(
+def _partition_chunk(  # wire: produces=handoff_manifest
     data: bytes, max_parts: int, min_bytes: int
 ) -> dict | None:
     """Row-part metadata for one chunk payload, or None when the
@@ -140,7 +143,9 @@ def _partition_chunk(
     return {"rows": rows, "bounds": bounds, "sha": sha, "bytes": nbytes}
 
 
-def collect_chunks(states=None, snapshots=None) -> dict[str, dict]:
+def collect_chunks(  # wire: produces=handoff_manifest
+    states=None, snapshots=None
+) -> dict[str, dict]:
     """Snapshot every registered state into its handoff chunk set:
     ``{name: {"order": [ids], "chunks": {id: bytes}, "sha": {id:
     hex}}}``. Chunk-capable states chunk per-leaf (their
@@ -175,7 +180,9 @@ def collect_chunks(states=None, snapshots=None) -> dict[str, dict]:
     return payload
 
 
-def attach_parts(payload: dict[str, dict]) -> dict[str, dict]:
+def attach_parts(  # wire: produces=handoff_manifest # wire: consumes=handoff_manifest
+    payload: dict[str, dict]
+) -> dict[str, dict]:
     """Attach range-addressing part metadata to a collected payload:
     big ndarray chunks advertise row parts so a resharding successor
     can pull only ITS slices of each leaf. Runs in the SERVER
@@ -233,7 +240,10 @@ class HandoffServer(ThreadedHttpServer):
     def group(self) -> int:
         return self._group
 
-    async def _manifest(self, request: web.Request) -> web.Response:
+    @_faultable("handoff.serve")
+    async def _manifest(  # wire: produces=handoff_manifest
+        self, request: web.Request
+    ) -> web.Response:
         states = {}
         for name, entry in self._payload.items():
             desc = {
@@ -258,6 +268,7 @@ class HandoffServer(ThreadedHttpServer):
             }
         )
 
+    @_faultable("handoff.serve")
     async def _chunk(self, request: web.Request) -> web.Response:
         """Range endpoint: ``{chunk}`` addresses a whole chunk, or a
         row part ``{chunk}@p{i}`` of one — the unit a resharding
@@ -265,12 +276,6 @@ class HandoffServer(ThreadedHttpServer):
         from the whole-leaf payload on demand (one state copy in
         memory; the slice+pickle runs only for ranges actually
         requested)."""
-        try:
-            faults.maybe_fail("handoff.serve")
-        except faults.InjectedFault as exc:
-            return web.json_response(
-                {"error": f"injected fault: {exc}"}, status=500
-            )
         entry = self._payload.get(request.match_info["state"])
         if entry is None:
             return web.json_response(
@@ -302,18 +307,13 @@ class HandoffServer(ThreadedHttpServer):
             body=data, content_type="application/octet-stream"
         )
 
+    @_faultable("handoff.serve")
     async def _state(self, request: web.Request) -> web.Response:
         """Bulk form: one state's whole chunk container in a single
         response — the successor's default when it needs every chunk
         (pure data parallelism), saving a per-chunk round-trip per
         pytree leaf; the range-addressed ``/chunk`` endpoint remains
         for partial pulls."""
-        try:
-            faults.maybe_fail("handoff.serve")
-        except faults.InjectedFault as exc:
-            return web.json_response(
-                {"error": f"injected fault: {exc}"}, status=500
-            )
         entry = self._payload.get(request.match_info["state"])
         if entry is None:
             return web.json_response(
@@ -326,7 +326,10 @@ class HandoffServer(ThreadedHttpServer):
             content_type="application/octet-stream",
         )
 
-    async def _done(self, request: web.Request) -> web.Response:
+    @_faultable("handoff.serve")
+    async def _done(  # idempotent
+        self, request: web.Request
+    ) -> web.Response:
         self.done.set()
         return web.json_response({"ok": True})
 
@@ -356,7 +359,7 @@ def serve_states(
     return server
 
 
-def _advertise(url: str, group: int) -> None:
+def _advertise(url: str, group: int) -> None:  # wire: produces=handoff_ad
     """Best-effort advertisement of the shard server: the discovery
     descriptor beside the checkpoints, and the supervisor's
     ``PUT /handoff/{job}`` so a successor on another host finds the
@@ -406,7 +409,7 @@ def withdraw_descriptor(root: str | None = None) -> None:
             pass
 
 
-def spawn_server(
+def spawn_server(  # wire: produces=handoff_payload
     states=None, snapshots=None
 ) -> "subprocess.Popen | None":
     """Fork the shard server into a detached child so it outlives
@@ -464,7 +467,7 @@ def spawn_server(
     return proc
 
 
-def _serve_main() -> int:
+def _serve_main() -> int:  # wire: consumes=handoff_payload
     """Entry point of the spawned child: read the payload, serve,
     advertise, linger until fetched or TTL. In cluster mode (a
     supervisor is configured, so the successor may land on another
@@ -558,7 +561,7 @@ def _advertised_group(body) -> int | None:
         return None
 
 
-def discover_url() -> str | None:
+def discover_url() -> str | None:  # wire: consumes=handoff_ad
     """Where the predecessor's shard server lives, if anywhere:
     explicit override (``set_source`` / ``ADAPTDL_HANDOFF_URL``),
     then the supervisor's advertisement, then the descriptor file
@@ -615,7 +618,7 @@ def discover_url() -> str | None:
     return None
 
 
-def _fetch_manifest(
+def _fetch_manifest(  # wire: consumes=handoff_manifest
     url: str, deadline_s: float
 ) -> tuple[dict, list | None] | None:
     response = rpc.default_client().get(
@@ -636,7 +639,7 @@ def _fetch_manifest(
     return states, topology if isinstance(topology, list) else None
 
 
-def _fetch_state_chunks(
+def _fetch_state_chunks(  # wire: consumes=handoff_manifest
     url: str, name: str, entry: dict, deadline: float
 ) -> list[tuple[str, bytes]]:
     """Pull one state's chunks, sha256-verifying each against the
@@ -732,7 +735,9 @@ def _fetch_chunk(
     return response.content
 
 
-def _normalize_plan(plan: dict, parts_meta: dict) -> dict:
+def _normalize_plan(  # wire: consumes=handoff_manifest
+    plan: dict, parts_meta: dict
+) -> dict:
     """Sanitize a state's shard plan: only chunks the peer actually
     advertises parts for, spans clamped to the row count, and only
     STRICT subsets kept — a full-span (or degenerate) request is
@@ -754,7 +759,7 @@ def _normalize_plan(plan: dict, parts_meta: dict) -> dict:
     return normalized
 
 
-def _fetch_state_ranges(
+def _fetch_state_ranges(  # wire: consumes=handoff_manifest
     url: str, name: str, entry: dict, plan: dict, deadline: float
 ) -> tuple[list, list, int]:
     """The shard-map-keyed pull: chunks in ``plan`` are fetched as
@@ -914,7 +919,9 @@ def mark_unavailable() -> None:
         _unavailable = True
 
 
-def try_restore(state: "checkpoint.State") -> bool:
+def try_restore(  # wire: consumes=handoff_manifest,handoff_fetch_stats
+    state: "checkpoint.State"
+) -> bool:
     """Restore one state from the predecessor's shard server; False
     when no peer is configured/discoverable, the state isn't in the
     peer's manifest, or anything at all fails — the caller

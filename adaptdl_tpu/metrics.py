@@ -396,7 +396,7 @@ def record_retune() -> None:
         _state.num_retunes += 1
 
 
-def restart_stats() -> dict | None:
+def restart_stats() -> dict | None:  # wire: produces=restart_stats
     """Measured rescale-cost components for the sched-hints payload:
     ``snapshotS``/``writeS`` from the last save, ``restoreS`` summed
     over this incarnation's state restores, ``overlapFrac`` = the
@@ -595,7 +595,7 @@ def _ensure_atexit_join() -> None:
     atexit.register(_join)
 
 
-def fit_and_report_now() -> None:
+def fit_and_report_now() -> None:  # wire: produces=sched_hints
     """Refit perf params and (best-effort) post sched hints."""
     perf = _fit()
     with _profile_lock:

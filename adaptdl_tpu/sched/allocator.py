@@ -43,7 +43,9 @@ LOG = logging.getLogger(__name__)
 RESTART_AMORTIZATION_S = 300.0
 
 
-def restart_cost_s_from_stats(stats: dict | None) -> float | None:
+def restart_cost_s_from_stats(  # wire: consumes=restart_stats
+    stats: dict | None,
+) -> float | None:
     """Raw measured rescale cost in seconds from a job's posted
     restartStats. Only the phases on the rescale critical path count:
     the final pre-exit save blocks (snapshot + write) and the restore
@@ -88,7 +90,7 @@ def slot_kind(node: NodeInfo) -> str:
     return "spot" if node.preemptible else "ondemand"
 
 
-def job_info_from_hints(
+def job_info_from_hints(  # wire: consumes=sched_hints # wire: consumes=job_spec
     hints: dict | None, spec: dict, creation_timestamp: float
 ) -> JobInfo:
     """JobInfo for the policy; falls back to single-replica until the
@@ -251,7 +253,9 @@ class Allocator:
             LOG.exception("graftwatch sampling failed")
         return allocations
 
-    def _watch_sample(self, cycle_s: float) -> None:
+    def _watch_sample(  # wire: produces=watch_job # wire: consumes=job_spec
+        self, cycle_s: float
+    ) -> None:
         """One goodput-accounting sample per allocator cycle: every
         active job's published allocation + posted hints, the slice
         inventory's capacity, and the cycle's wall cost (the
@@ -295,7 +299,7 @@ class Allocator:
             cycle_s=cycle_s,
         )
 
-    def _optimize_once_traced(
+    def _optimize_once_traced(  # wire: produces=batch_config,topology,job_spec
         self, decide_attrs: dict, dirty: set[str]
     ) -> tuple[dict[str, list[str]], str]:
         self._cycle += 1
@@ -554,7 +558,9 @@ class Allocator:
                 self._state.publish_retune(key, batch_config)
         return allocations, mode
 
-    def _note_explain(self, mode: str) -> None:
+    def _note_explain(  # wire: produces=explain # wire: consumes=explain
+        self, mode: str
+    ) -> None:
         """Hand the policy's cycle explain record to the watch store,
         enriched with each job's PUBLISHED mesh shape (the policy
         scores shapes inside the speedup number; what actually ships

@@ -10,9 +10,35 @@ main. One implementation here; subclasses provide ``build_app``.
 from __future__ import annotations
 
 import asyncio
+import functools
 import threading
 
 from aiohttp import web
+
+from adaptdl_tpu import faults
+
+
+def faultable(point: str):
+    """Route an aiohttp handler method through a named injection
+    point: an injected fault becomes a 500 — the transient server
+    error the resilient rpc client retries through (and the handoff
+    fetch side treats as "fall back to storage"). One definition for
+    every ThreadedHttpServer subclass's handlers."""
+
+    def decorate(handler):
+        @functools.wraps(handler)
+        async def wrapped(self, request: web.Request) -> web.Response:
+            try:
+                faults.maybe_fail(point)
+            except faults.InjectedFault as exc:
+                return web.json_response(
+                    {"error": f"injected fault: {exc}"}, status=500
+                )
+            return await handler(self, request)
+
+        return wrapped
+
+    return decorate
 
 
 class ThreadedHttpServer:

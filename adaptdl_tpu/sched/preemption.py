@@ -125,7 +125,7 @@ def notice_state() -> dict | None:
         return dict(_notice) if _notice is not None else None
 
 
-def drain_remaining_s() -> float | None:
+def drain_remaining_s() -> float | None:  # wire: consumes=preempt_notice
     """Seconds left in the drain budget (None without a notice)."""
     with _notice_lock:
         if _notice is None:
@@ -142,7 +142,7 @@ def reset_notice() -> None:
         _notice = None
 
 
-def deliver_notice(
+def deliver_notice(  # wire: produces=preempt_notice
     source: str = "metadata",
     notice_s: float | None = None,
     notify: bool = True,
@@ -195,7 +195,9 @@ def deliver_notice(
     return True
 
 
-def notify_supervisor(job_id: str | None = None) -> bool:
+def notify_supervisor(  # wire: produces=preempt,preempt_notice # wire: consumes=preempt_notice
+    job_id: str | None = None,
+) -> bool:
     """POST the active notice to the supervisor (idempotent there: one
     drain per incarnation no matter how many replicas report). Best
     effort with retries bounded well inside the notice window — the
@@ -234,7 +236,9 @@ def notify_supervisor(job_id: str | None = None) -> bool:
 # ---- urgent drain ----------------------------------------------------
 
 
-def urgent_drain() -> dict:
+def urgent_drain(  # wire: produces=preempt_notice,drain_report
+    # wire: consumes=preempt_notice
+) -> dict:
     """The notice-driven final checkpoint: join any in-flight async
     write (``save_all_states`` waits for it before starting — two
     saves can never race into one version dir), then run the blocking
@@ -315,7 +319,7 @@ def urgent_drain() -> dict:
     }
 
 
-def _expected_save_s() -> float | None:
+def _expected_save_s() -> float | None:  # wire: consumes=restart_stats
     """Measured blocking-save cost (snapshot + write of the last
     save) from the metrics engine, None until one was measured."""
     try:

@@ -62,7 +62,9 @@ _ALLOC_DECIDE_BUCKETS = (
 )
 
 
-def normalize_topology(topology: dict | None) -> dict:
+def normalize_topology(  # wire: produces=topology # wire: consumes=topology
+    topology: dict | None,
+) -> dict:
     """Canonical form for launch-config comparisons: ``None`` and the
     explicit pure-DP dict are the SAME configuration — treating them
     as different would restart every job the first time it posts
@@ -192,7 +194,7 @@ class JobRecord:
     drain_deadline: float | None = None
 
 
-def _job_to_dict(record: JobRecord) -> dict:
+def _job_to_dict(record: JobRecord) -> dict:  # wire: produces=job_snapshot
     """JSON-serializable snapshot form of one job record. Lease
     deadlines are monotonic-clock values, meaningless across a
     process restart — only the set of lease-holding ranks persists
@@ -229,7 +231,7 @@ def _job_to_dict(record: JobRecord) -> dict:
     }
 
 
-def _job_from_dict(payload: dict) -> JobRecord:  # replay-pure
+def _job_from_dict(payload: dict) -> JobRecord:  # replay-pure # wire: consumes=job_snapshot
     record = JobRecord(key=payload["key"])
     record.spec = dict(payload.get("spec") or {})
     record.hints = payload.get("hints")
@@ -422,7 +424,7 @@ class ClusterState:
             self._journal.write_snapshot(self._snapshot_payload_locked())
         self._journal.append(op)
 
-    def _snapshot_payload_locked(self) -> dict:  # holds-lock: _cond
+    def _snapshot_payload_locked(self) -> dict:  # holds-lock: _cond # wire: produces=sched_snapshot
         return {
             "version": 1,
             "jobs": {
@@ -446,7 +448,10 @@ class ClusterState:
             "preempt_notices": dict(self._preempt_notices),
         }
 
-    def _recover(self) -> None:  # journaled
+    def _recover(  # journaled # wire: produces=journal_op
+        # wire: consumes=sched_snapshot
+        self,
+    ) -> None:
         """Rebuild state from snapshot+journal, then open the
         reconciliation window: recovered leases get grace deadlines and
         pending epochs fresh commit deadlines, so live workers can
@@ -564,7 +569,7 @@ class ClusterState:
 
     # -- replay/apply layer (shared by live mutators and recovery) -----
 
-    def _apply_locked(self, op: dict, now: float) -> Any:  # holds-lock: _cond # replay-pure
+    def _apply_locked(self, op: dict, now: float) -> Any:  # holds-lock: _cond # replay-pure # wire: consumes=journal_op
         """Dispatch one journal op to its apply function. ``now`` is
         the caller's monotonic stamp: live mutators read the clock
         BEFORE applying, recovery passes one replay-wide stamp — the
@@ -598,7 +603,7 @@ class ClusterState:
             return None
         raise ValueError(f"unknown journal op {kind!r}")
 
-    def _apply_create_locked(  # holds-lock: _cond # replay-pure
+    def _apply_create_locked(  # holds-lock: _cond # replay-pure # wire: consumes=journal_op
         self, op: dict, now: float
     ) -> JobRecord:
         key = op["key"]
@@ -619,13 +624,13 @@ class ClusterState:
         self._dirty.add(key)
         return record
 
-    def _apply_remove_locked(self, op: dict, now: float) -> None:  # holds-lock: _cond # replay-pure
+    def _apply_remove_locked(self, op: dict, now: float) -> None:  # holds-lock: _cond # replay-pure # wire: consumes=journal_op
         self._jobs.pop(op["key"], None)
         # A departure frees capacity — counted toward the allocator's
         # dirtiness (redistribution to survivors rides full cycles).
         self._dirty.add(op["key"])
 
-    def _apply_update_locked(  # holds-lock: _cond # replay-pure
+    def _apply_update_locked(  # holds-lock: _cond # replay-pure # wire: consumes=journal_op
         self, op: dict, now: float
     ) -> None:
         record = self._jobs[op["key"]]
@@ -735,7 +740,7 @@ class ClusterState:
             # is immediately the rollback target.
             self._promote_committed_locked(record)
 
-    def _apply_retune_locked(self, op: dict, now: float) -> None:  # holds-lock: _cond # replay-pure
+    def _apply_retune_locked(self, op: dict, now: float) -> None:  # holds-lock: _cond # replay-pure # wire: consumes=journal_op
         record = self._jobs[op["key"]]
         record.batch_config = dict(op["batch_config"])
         record.retunes += 1
@@ -754,7 +759,7 @@ class ClusterState:
             return
         record.alloc_fresh.add(rank)
 
-    def _apply_register_locked(  # holds-lock: _cond # replay-pure
+    def _apply_register_locked(  # holds-lock: _cond # replay-pure # wire: consumes=journal_op
         self, op: dict, now: float
     ) -> bool:
         record = self._jobs[op["key"]]
@@ -788,7 +793,7 @@ class ClusterState:
             self._note_liveness_locked(record, rank)
         return accepted
 
-    def _apply_lease_locked(self, op: dict, now: float) -> None:  # holds-lock: _cond # replay-pure
+    def _apply_lease_locked(self, op: dict, now: float) -> None:  # holds-lock: _cond # replay-pure # wire: consumes=journal_op
         record = self._jobs[op["key"]]
         group = op.get("group")
         rank = int(op["rank"])
@@ -817,7 +822,7 @@ class ClusterState:
             record.leases[rank] = now + float(op["ttl"])
         self._note_liveness_locked(record, rank)
 
-    def _apply_lease_expiry_locked(  # holds-lock: _cond # replay-pure
+    def _apply_lease_expiry_locked(  # holds-lock: _cond # replay-pure # wire: consumes=journal_op
         self, op: dict, now: float
     ) -> None:
         record = self._jobs[op["key"]]
@@ -853,7 +858,7 @@ class ClusterState:
             dict(record.batch_config) if record.batch_config else None
         )
 
-    def _apply_commit_locked(self, op: dict, now: float) -> None:  # holds-lock: _cond # replay-pure
+    def _apply_commit_locked(self, op: dict, now: float) -> None:  # holds-lock: _cond # replay-pure # wire: consumes=journal_op
         record = self._jobs[op["key"]]
         self._promote_committed_locked(record)
         record.alloc_state = "committed"
@@ -876,7 +881,7 @@ class ClusterState:
         for slot in set(record.allocation):
             self._slot_strikes.pop(slot, None)
 
-    def _apply_rollback_locked(  # holds-lock: _cond # replay-pure
+    def _apply_rollback_locked(  # holds-lock: _cond # replay-pure # wire: consumes=journal_op
         self, op: dict, now: float
     ) -> None:
         record = self._jobs[op["key"]]
@@ -929,7 +934,7 @@ class ClusterState:
             float(ts),
         )
 
-    def _apply_preempt_locked(  # holds-lock: _cond # replay-pure
+    def _apply_preempt_locked(  # holds-lock: _cond # replay-pure # wire: consumes=journal_op
         self, op: dict, now: float
     ) -> None:
         """A reclaim notice: the job starts draining, its slots leave
@@ -969,8 +974,8 @@ class ClusterState:
                 slots=len(op.get("slots", [])),
             )
 
-    def _maybe_commit_locked(  # holds-lock: _cond
-        self, record: JobRecord  # journaled
+    def _maybe_commit_locked(  # holds-lock: _cond # journaled
+        self, record: JobRecord  # wire: produces=journal_op
     ) -> None:
         """Commit the pending epoch once the new group's liveness
         quorum is reached: every expected worker process has proven
@@ -999,7 +1004,7 @@ class ClusterState:
 
     # -- mutators (journaled) ------------------------------------------
 
-    def create_job(  # journaled
+    def create_job(  # journaled # wire: produces=journal_op
         self, key: str, spec: dict | None = None
     ) -> JobRecord:
         with self._cond:
@@ -1016,7 +1021,7 @@ class ClusterState:
             self._cond.notify_all()
             return record
 
-    def remove_job(self, key: str) -> None:  # journaled
+    def remove_job(self, key: str) -> None:  # journaled # wire: produces=journal_op
         with self._cond:
             if key not in self._jobs:
                 return
@@ -1028,7 +1033,7 @@ class ClusterState:
         # starts from an empty store anyway).
         self.watch.forget_job(key)
 
-    def update(self, key: str, **fields: Any) -> None:  # journaled
+    def update(self, key: str, **fields: Any) -> None:  # journaled # wire: produces=journal_op
         with self._cond:
             self._jobs[key]  # KeyError on unknown jobs, like before
             op = {
@@ -1041,7 +1046,7 @@ class ClusterState:
             self._apply_update_locked(op, self._clock.monotonic())
             self._cond.notify_all()
 
-    def advertise_handoff(  # journaled
+    def advertise_handoff(  # journaled # wire: produces=journal_op
         self, key: str, url: str, group: int
     ) -> bool:
         """Record where a draining incarnation's handoff shard server
@@ -1067,14 +1072,16 @@ class ClusterState:
             self._cond.notify_all()
             return True
 
-    def _apply_handoff_locked(self, op: dict, now: float) -> None:  # holds-lock: _cond # replay-pure
+    def _apply_handoff_locked(self, op: dict, now: float) -> None:  # holds-lock: _cond # replay-pure # wire: consumes=journal_op
         record = self._jobs.get(op["key"])
         if record is None:
             return
         record.handoff_url = op["url"]
         record.handoff_group = int(op["group"])
 
-    def get_handoff(self, key: str) -> dict | None:
+    def get_handoff(  # wire: produces=handoff_ad
+        self, key: str
+    ) -> dict | None:
         """The job's current handoff advertisement (None when absent):
         ``{"url", "group"}`` — the successor validates the group
         against its own restart count before trusting the peer."""
@@ -1087,7 +1094,7 @@ class ClusterState:
                 "group": record.handoff_group,
             }
 
-    def publish_retune(  # journaled
+    def publish_retune(  # journaled # wire: produces=journal_op
         self, key: str, batch_config: dict
     ) -> bool:
         """Record a batch-config-only decision: updates the published
@@ -1110,7 +1117,7 @@ class ClusterState:
             self._cond.notify_all()
             return True
 
-    def register_worker(  # journaled
+    def register_worker(  # journaled # wire: produces=journal_op
         self,
         key: str,
         group: int,
@@ -1144,7 +1151,7 @@ class ClusterState:
             self._cond.notify_all()
             return accepted
 
-    def renew_lease(  # journaled
+    def renew_lease(  # journaled # wire: produces=journal_op
         self,
         key: str,
         rank: int,
@@ -1189,7 +1196,7 @@ class ClusterState:
             self._maybe_commit_locked(record)
             return True
 
-    def expire_stale_leases(  # journaled
+    def expire_stale_leases(  # journaled # wire: produces=journal_op
         self, now: float | None = None
     ) -> list[tuple[str, int]]:
         """Expire every lease whose deadline has passed on a Running
@@ -1230,7 +1237,7 @@ class ClusterState:
                 self._cond.notify_all()
         return expired
 
-    def expire_overdue_allocations(  # journaled
+    def expire_overdue_allocations(  # journaled # wire: produces=journal_op
         self, now: float | None = None
     ) -> list[str]:
         """Roll back every pending allocation epoch whose commit
@@ -1273,7 +1280,7 @@ class ClusterState:
 
     # -- preemption survival -------------------------------------------
 
-    def report_preemption(  # journaled
+    def report_preemption(  # journaled # wire: produces=journal_op
         self,
         key: str,
         group: int | None = None,
@@ -1624,7 +1631,9 @@ class ClusterState:
                 return None
             return dict(record.batch_config)
 
-    def get_config_snapshot(self, key: str) -> dict | None:
+    def get_config_snapshot(  # wire: produces=config
+        self, key: str
+    ) -> dict | None:
         """The job's full current decision — allocation, topology,
         batch config, re-tune counter, restart group — as ONE locked
         snapshot. The supervisor's /config endpoint serves exactly

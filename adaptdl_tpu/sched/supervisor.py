@@ -67,35 +67,17 @@ from collections import deque
 
 from aiohttp import web
 
-from adaptdl_tpu import env, faults, sched_hints, trace
-from adaptdl_tpu.sched.http_server import ThreadedHttpServer
+from adaptdl_tpu import env, sched_hints, trace
+from adaptdl_tpu.sched.http_server import (
+    ThreadedHttpServer,
+    faultable as _faultable,
+)
 from adaptdl_tpu.sched.state import ClusterState
 
 LOG = logging.getLogger(__name__)
 
 _POLL_INTERVAL = 0.25
 _DISCOVER_TIMEOUT = 300.0
-
-
-def _faultable(point: str):
-    """Route a handler through a named injection point: an injected
-    fault becomes a 500 — exactly the transient supervisor error the
-    resilient rpc client must absorb."""
-
-    def decorate(handler):
-        @functools.wraps(handler)
-        async def wrapped(self, request: web.Request) -> web.Response:
-            try:
-                faults.maybe_fail(point)
-            except faults.InjectedFault as exc:
-                return web.json_response(
-                    {"error": f"injected fault: {exc}"}, status=500
-                )
-            return await handler(self, request)
-
-        return wrapped
-
-    return decorate
 
 
 def _group_param(request: web.Request) -> int | None:
@@ -183,7 +165,9 @@ class Supervisor(ThreadedHttpServer):
             await asyncio.sleep(_POLL_INTERVAL)
 
     @_faultable("sup.register.pre")
-    async def _register(self, request: web.Request) -> web.Response:
+    async def _register(  # idempotent: keyed-by=rank # wire: consumes=register
+        self, request: web.Request
+    ) -> web.Response:
         key = "{namespace}/{name}".format(**request.match_info)
         group = int(request.match_info["group"])
         rank = int(request.match_info["rank"])
@@ -212,7 +196,9 @@ class Supervisor(ThreadedHttpServer):
         return web.json_response({"ok": True})
 
     @_faultable("sup.heartbeat.pre")
-    async def _heartbeat(self, request: web.Request) -> web.Response:
+    async def _heartbeat(  # idempotent # wire: consumes=heartbeat
+        self, request: web.Request
+    ) -> web.Response:
         key = "{namespace}/{name}".format(**request.match_info)
         rank = int(request.match_info["rank"])
         group = _group_param(request)
@@ -251,7 +237,9 @@ class Supervisor(ThreadedHttpServer):
         )
 
     @_faultable("sup.hints.pre")
-    async def _put_hints(self, request: web.Request) -> web.Response:
+    async def _put_hints(  # idempotent # wire: consumes=sched_hints
+        self, request: web.Request
+    ) -> web.Response:
         key = "{namespace}/{name}".format(**request.match_info)
         hints = await request.json()
         try:
@@ -278,6 +266,7 @@ class Supervisor(ThreadedHttpServer):
         await self._offload(mutate)
         return web.json_response({"ok": True})
 
+    @_faultable("sup.hints.get.pre")
     async def _get_hints(self, request: web.Request) -> web.Response:
         key = "{namespace}/{name}".format(**request.match_info)
         record = self._state.get_job(key)
@@ -309,7 +298,9 @@ class Supervisor(ThreadedHttpServer):
         return web.json_response(snapshot)
 
     @_faultable("sup.preempt.pre")
-    async def _preempt(self, request: web.Request) -> web.Response:
+    async def _preempt(  # idempotent: keyed-by=group # wire: consumes=preempt
+        self, request: web.Request
+    ) -> web.Response:
         """Reclaim-notice intake (``POST /preempt/{job}``): the worker
         reports the notice the moment it lands, so the supervisor
         withdraws the doomed slots and the allocator opens the
@@ -352,7 +343,10 @@ class Supervisor(ThreadedHttpServer):
             {"ok": True, "draining": bool(accepted)}
         )
 
-    async def _put_handoff(self, request: web.Request) -> web.Response:
+    @_faultable("sup.handoff.pre")
+    async def _put_handoff(  # idempotent: keyed-by=group # wire: consumes=handoff_ad
+        self, request: web.Request
+    ) -> web.Response:
         """Shard-server advertisement (``PUT /handoff/{job}``): the
         draining incarnation's spawned handoff server reports its URL
         + restart group so the successor — possibly on another host —
@@ -386,6 +380,7 @@ class Supervisor(ThreadedHttpServer):
             )
         return web.json_response({"ok": True})
 
+    @_faultable("sup.handoff.get.pre")
     async def _get_handoff(self, request: web.Request) -> web.Response:
         key = "{namespace}/{name}".format(**request.match_info)
         if self._state.get_job(key) is None:
@@ -398,6 +393,7 @@ class Supervisor(ThreadedHttpServer):
     async def _healthz(self, request: web.Request) -> web.Response:
         return web.json_response({"ok": True})
 
+    @_faultable("sup.status.pre")
     async def _status(self, request: web.Request) -> web.Response:
         """Operator-facing cluster view: per-job phase + degraded flag
         + allocation epoch/state + lease ages, slot strikes and
@@ -501,7 +497,9 @@ class Supervisor(ThreadedHttpServer):
         )
 
     @_faultable("sup.trace.pre")
-    async def _put_trace(self, request: web.Request) -> web.Response:
+    async def _put_trace(  # idempotent: keyed-by=span # wire: consumes=trace_payload,trace_span
+        self, request: web.Request
+    ) -> web.Response:
         key = "{namespace}/{name}".format(**request.match_info)
         try:
             body = await request.json()
@@ -556,7 +554,9 @@ class Supervisor(ThreadedHttpServer):
         fresh = await self._offload(absorb)
         return web.json_response({"ok": True, "accepted": len(fresh)})
 
-    def _job_trace_spans(self, key: str) -> list[dict]:
+    def _job_trace_spans(  # wire: consumes=trace_span
+        self, key: str
+    ) -> list[dict]:
         """Worker-posted spans merged with this process's own spans
         for the job, deduplicated by span id (in-process workers flush
         spans the local buffer also holds)."""
@@ -591,7 +591,10 @@ class Supervisor(ThreadedHttpServer):
         merged.sort(key=lambda rec: float(rec.get("ts", 0.0)))
         return merged
 
-    async def _get_trace(self, request: web.Request) -> web.Response:
+    @_faultable("sup.trace.get.pre")
+    async def _get_trace(  # wire: produces=trace_payload,envelope
+        self, request: web.Request
+    ) -> web.Response:
         key = "{namespace}/{name}".format(**request.match_info)
         record = self._state.get_job(key)
         if record is None:
@@ -607,6 +610,7 @@ class Supervisor(ThreadedHttpServer):
             }
         )
 
+    @_faultable("sup.metrics.pre")
     async def _metrics(self, request: web.Request) -> web.Response:
         """Prometheus text exposition (reference exports job counters
         from the controller on :9091, controller.py:35-41; here the

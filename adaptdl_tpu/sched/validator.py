@@ -18,7 +18,10 @@ from __future__ import annotations
 
 from typing import Any
 
-from adaptdl_tpu.sched.http_server import ThreadedHttpServer
+from adaptdl_tpu.sched.http_server import (
+    ThreadedHttpServer,
+    faultable as _faultable,
+)
 
 IMMUTABLE_FIELDS = ("template", "min_replicas", "max_replicas")
 
@@ -189,6 +192,10 @@ class AdmissionWebhook(ThreadedHttpServer):
             return False, f"malformed AdaptDLJob object: {exc!r}"
         return True, ""
 
+    # A webhook 500 under injection: the API server's failurePolicy
+    # decides whether the write blocks (Fail) or admits (Ignore) —
+    # the chaos suite exercises both stances.
+    @_faultable("webhook.validate.pre")
     async def _handle_validate(self, request):
         from aiohttp import web
 

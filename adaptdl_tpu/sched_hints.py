@@ -14,6 +14,7 @@ from typing import Any
 
 from adaptdl_tpu import env, rpc, trace
 from adaptdl_tpu.goodput import GradParams, PerfParams
+from adaptdl_tpu.wire import SCHED_HINTS_KEYS
 
 LOG = logging.getLogger(__name__)
 
@@ -27,57 +28,20 @@ PERF_PARAMS_REQUIRED = tuple(
 GRAD_PARAMS_KEYS = tuple(GradParams._fields)
 
 # Hint keys: camelCase on the wire, matching the reference schema and
-# the AdaptDLJob CRD's status.train field; the max*Shards keys
-# advertise the job's sharding limits for the topology search (no
-# reference analog — the reference has no sp/tp/ss/ep axes).
-# maxPipelineMicro caps the GPipe microbatch count the scheduler may
-# choose (data-layer divisibility); pipelineMicrobatches reports the
-# M currently running, for dashboards and the fit. pipelineChunks
-# declares the interleaved schedule's uniform chunk count (0/absent =
-# plain GPipe only) — the topology search prices stage candidates at
-# v = pipelineChunks // ss chunks per device.
-SCHED_HINTS_KEYS = (
-    "initBatchSize",
-    "localBszBounds",
-    "maxBatchSize",
-    "maxProfiledReplicas",
-    "gradientAccumulation",
-    "gradParams",
-    "perfParams",
-    "maxSeqShards",
-    "maxModelShards",
-    "maxStageShards",
-    "maxExpertShards",
-    "maxPipelineMicro",
-    "pipelineMicrobatches",
-    "pipelineChunks",
-    # Explicit candidate mesh shapes: a list of [sp, tp, ss, ep]
-    # 4-lists (goodput.mesh_shape_grid's output shape). Optional — a
-    # job that only posts max*Shards limits gets the power-of-two
-    # enumeration; posting a grid makes non-pow2 factorizations (12
-    # chips -> tp=3) searchable and pins the scheduler to EXACTLY the
-    # shapes the job's model code can actually build.
-    "meshShapeGrid",
-    # Measured rescale-cost components (metrics.restart_stats):
-    # snapshotS/writeS of the last checkpoint save, restoreS of this
-    # incarnation's restore, overlapFrac, numRetunes — the allocator
-    # prices checkpoint-restart moves with these instead of the
-    # assumed default penalty.
-    "restartStats",
-    # Trainer-measured goodput (useful examples/s: measured
-    # throughput x statistical efficiency at the running batch size).
-    # graftwatch pairs it with the model's prediction every allocator
-    # cycle — the predicted-vs-realized drift monitor's measured
-    # half. Observability-only: the policy never reads it.
-    "measuredGoodput",
-)
+# the AdaptDLJob CRD's status.train field. The canonical tuple lives
+# in adaptdl_tpu/wire.py (the declared `sched_hints` wire family —
+# graftcheck's GC10xx pass statically checks every producer and
+# consumer against it); imported above and re-exported from here so
+# existing importers keep working.
 
 
 def empty_hints() -> dict[str, Any]:
     return {key: None for key in SCHED_HINTS_KEYS}
 
 
-def validate_hints(hints: dict[str, Any]) -> None:
+def validate_hints(  # wire: consumes=sched_hints
+    hints: dict[str, Any],
+) -> None:
     unknown = set(hints) - set(SCHED_HINTS_KEYS)
     if unknown:
         raise ValueError(f"unknown sched hint keys: {sorted(unknown)}")
@@ -134,7 +98,9 @@ def validate_hints(hints: dict[str, Any]) -> None:
 _FETCH_BACKOFF_S = 60.0
 
 
-def fetch_job_config(job_id: str | None = None) -> dict | None:
+def fetch_job_config(  # wire: consumes=config
+    job_id: str | None = None,
+) -> dict | None:
     """GET the supervisor's current decision for this job (allocation,
     topology, batchConfig, retunes) — the cluster -> job half of the
     live re-tune fast path. Best-effort like hint posting: training
@@ -211,7 +177,7 @@ def post_sched_hints(
         return False
 
 
-def send_heartbeat(
+def send_heartbeat(  # wire: produces=heartbeat
     rank: int | None = None,
     job_id: str | None = None,
     group: int | None = None,

@@ -118,7 +118,7 @@ def percentile(values: list, q: float) -> float:
     return float(ordered[rank])
 
 
-def hints_payload(
+def hints_payload(  # wire: produces=sched_hints # wire: produces=restart_stats
     spec: "SimJobSpec", profiled: int = 1, dp_only: bool = False
 ) -> dict:
     """The sched-hints dict a simulated job posts: its fitted model,

@@ -262,7 +262,9 @@ def absorb(records: list[dict]) -> None:
 
 
 @contextmanager
-def span(name: str, traceparent: str | None = None, **attrs):
+def span(  # wire: produces=trace_span
+    name: str, traceparent: str | None = None, **attrs
+):
     """Record a monotonic-clock span around the ``with`` body.
 
     ``traceparent`` pins the span to an explicit foreign context (the
@@ -313,7 +315,7 @@ def span(name: str, traceparent: str | None = None, **attrs):
         )
 
 
-def record_span(
+def record_span(  # wire: produces=trace_span
     name: str,
     duration_s: float,
     traceparent: str | None = None,
@@ -346,7 +348,9 @@ def record_span(
     )
 
 
-def event(name: str, traceparent: str | None = None, **attrs) -> None:
+def event(  # wire: produces=trace_span
+    name: str, traceparent: str | None = None, **attrs
+) -> None:
     """Record a zero-duration point event and bump its Prometheus
     counter (``adaptdl_trace_events_total{event=...}``) — retries,
     circuit opens, cache hits/misses, epoch prepares."""
@@ -767,7 +771,9 @@ def prometheus_lines() -> str:
 # ---- worker -> supervisor flush --------------------------------------
 
 
-def flush_to_supervisor(job_id: str | None = None) -> bool:
+def flush_to_supervisor(  # wire: produces=trace_payload
+    job_id: str | None = None,
+) -> bool:
     """Best-effort PUT of this process's not-yet-flushed spans to the
     supervisor's per-job trace store (piggybacked on the sched-hints
     cadence). The flush request itself is untraced — tracing the

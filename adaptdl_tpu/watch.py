@@ -74,7 +74,9 @@ _RHO_STALLED = 100.0
 _DP_TOPO = (1, 1, 1, 1, 1)
 
 
-def tenant_of(key: str, spec: dict | None = None) -> str:
+def tenant_of(  # wire: consumes=job_spec
+    key: str, spec: dict | None = None
+) -> str:
     """A job's accounting tenant: an explicit ``spec["tenant"]`` wins
     (the simulator uses the workload category), else the namespace
     half of the ``namespace/name`` job key."""
@@ -83,7 +85,9 @@ def tenant_of(key: str, spec: dict | None = None) -> str:
     return key.split("/", 1)[0] if "/" in key else "default"
 
 
-def _topo_tuple(topology: dict | None) -> tuple[int, int, int, int, int]:
+def _topo_tuple(  # wire: consumes=topology
+    topology: dict | None,
+) -> tuple[int, int, int, int, int]:
     """A published topology dict as the (sp, tp, ss, ep, micro) tuple
     the goodput model prices. Mirrors ``sched.state.
     normalize_topology`` (micro defaults to 4 when a pipeline is
@@ -240,7 +244,7 @@ class WatchStore:
 
     # -- the per-cycle sample ------------------------------------------
 
-    def sample_cycle(
+    def sample_cycle(  # wire: produces=watch # wire: consumes=watch_job,watch
         self,
         jobs: list[dict],
         total_chips: int,
@@ -416,7 +420,7 @@ class WatchStore:
             if cycle_s is not None:
                 self._cycle_s += max(float(cycle_s), 0.0)
 
-    def _model_locked(self, key: str, hints: dict):  # holds-lock: _lock
+    def _model_locked(self, key: str, hints: dict):  # holds-lock: _lock # wire: consumes=sched_hints
         """Cached GoodputFunction + evaluation memo for a job's fitted
         params; rebuilt when the posted params change."""
         perf = hints.get("perfParams")
@@ -463,7 +467,9 @@ class WatchStore:
                     del memo[k]
         return value
 
-    def _predicted(self, key: str, job: dict):
+    def _predicted(  # wire: consumes=watch_job,batch_config,sched_hints
+        self, key: str, job: dict
+    ):
         """Model-predicted goodput at the PUBLISHED allocation (and
         published batch config when one exists), memoized per (alloc
         shape, batch config)."""
@@ -535,7 +541,9 @@ class WatchStore:
 
         return self._memoized(memo, eval_key, compute)
 
-    def _ideal(self, key: str, job: dict, chips_per_slice: int):
+    def _ideal(  # wire: consumes=watch_job,sched_hints
+        self, key: str, job: dict, chips_per_slice: int
+    ):
         """Model-predicted goodput at the job's requested-ideal fixed
         allocation — the denominator of the fairness slowdown rho."""
         hints = job.get("hints") or {}
@@ -568,7 +576,7 @@ class WatchStore:
 
     # -- decision provenance -------------------------------------------
 
-    def note_explain(
+    def note_explain(  # wire: produces=explain # wire: consumes=explain
         self, cycle: int, mode: str, explain: dict, jobs: dict
     ) -> None:
         """One allocator cycle's provenance: the policy's cycle
@@ -615,7 +623,9 @@ class WatchStore:
                 else:
                     ring.append(record)
 
-    def explain_for(self, key: str) -> dict | None:
+    def explain_for(  # wire: produces=explain # wire: consumes=explain
+        self, key: str
+    ) -> dict | None:
         """A job's provenance view: its latest explain record, the
         last record where the job was actually RE-DECIDED (incremental
         pass-through cycles record it pinned, and an operator asking
@@ -654,7 +664,7 @@ class WatchStore:
 
     # -- straggler detection -------------------------------------------
 
-    def _suspects_locked(self) -> dict[str, dict]:  # holds-lock: _lock
+    def _suspects_locked(self) -> dict[str, dict]:  # holds-lock: _lock # wire: produces=watch
         """Slots whose rank step-time EWMA is an outlier vs the job's
         median: {slot: {"job", "rank", "ratio"}}. Requires >= 3
         reporting ranks per job — no majority, no verdict."""
@@ -731,7 +741,7 @@ class WatchStore:
                 "suspects": self._suspects_locked(),
             }
 
-    def snapshot(self) -> dict:
+    def snapshot(self) -> dict:  # wire: produces=watch
         """The GET /watch payload: bounded series tails + the latest
         aggregates + provenance cycle summaries + overhead counters
         (what the watchgate's <1% sampling gate reads)."""

@@ -1,0 +1,624 @@
+"""The control plane's wire contracts, declared in one place.
+
+Every cross-process payload this system ships — sched hints, the
+``/config`` decision snapshot, journal op records, state snapshots,
+checkpoint manifests, handoff manifests, heartbeat/preempt bodies,
+watch/explain records — is a stringly-typed dict, and the worst bugs
+this repo has shipped were contract drift across exactly those
+boundaries (a stale ``/config`` pairing, an ``op.get("ts")`` replay
+corruption, a stale-group handoff acceptance). This module is the
+single source of truth for the key names: producers and consumers
+import the runtime constants below, and graftcheck's GC10xx
+wire-contract pass statically checks every ``# wire: produces=`` /
+``# wire: consumes=``-annotated function against
+:data:`WIRE_CONTRACTS` — a key written that no family declares, a key
+read that no producer writes, or a defaultless subscript on a
+persisted record each fail the lint at the exact line.
+
+Keep :data:`WIRE_CONTRACTS` (and the route declarations below) plain
+literals — graftcheck parses this module statically, exactly like the
+``INJECTION_POINTS`` catalog in ``faults.py``.
+
+Per-family fields:
+
+- ``keys`` — every key name legal on the wire for this family.
+- ``required`` — keys present in every record since the family's
+  first version: consumers may subscript them without a default.
+  Everything else is version-optional — a consumer of a *persisted*
+  family must read it with ``.get`` (or guard with ``"k" in d``), or
+  replaying a pre-upgrade journal / loading a cross-version
+  checkpoint chain raises ``KeyError`` (GC1004).
+- ``persisted`` — records outlive the process that wrote them
+  (journal, snapshots, checkpoint manifests, peer handoff): the
+  forward/backward-compat rule GC1004 binds.
+- ``unchecked`` — keys produced or consumed OUTSIDE the analyzed
+  package (test harnesses, dashboards, jq, future migrations):
+  exempt from the produced/consumed coverage check (GC1003), still
+  legal at annotated sites.
+- ``open_producers`` / ``open_consumers`` — the whole side is built
+  dynamically (``update(**fields)`` kwargs, the policy's partitioned
+  explain assembly) or read outside the package: skip that side's
+  coverage check entirely.
+"""
+
+from __future__ import annotations
+
+WIRE_CONTRACTS = {
+    # ---- job -> cluster: fitted goodput model + limits (PUT /hints).
+    # camelCase on the wire, mirroring the reference schema and the
+    # AdaptDLJob CRD's status.train field. Persisted: hints ride
+    # `update` journal ops and state snapshots, so a pre-upgrade
+    # journal may lack any key added later.
+    "sched_hints": {
+        "doc": "PUT /hints body (sched_hints.post_sched_hints)",
+        "persisted": True,
+        "keys": (
+            "initBatchSize",
+            "localBszBounds",
+            "maxBatchSize",
+            "maxProfiledReplicas",
+            "gradientAccumulation",
+            "gradParams",
+            "perfParams",
+            "maxSeqShards",
+            "maxModelShards",
+            "maxStageShards",
+            "maxExpertShards",
+            # maxPipelineMicro caps the GPipe microbatch count the
+            # scheduler may choose (data-layer divisibility);
+            # pipelineMicrobatches reports the M currently running;
+            # pipelineChunks declares the interleaved schedule's
+            # uniform chunk count (0/absent = plain GPipe only).
+            "maxPipelineMicro",
+            "pipelineMicrobatches",
+            "pipelineChunks",
+            # Explicit candidate mesh shapes: [sp, tp, ss, ep]
+            # 4-lists. Optional — posting a grid makes non-pow2
+            # factorizations searchable and pins the scheduler to
+            # EXACTLY the shapes the model code can build.
+            "meshShapeGrid",
+            # Measured rescale-cost components (the `restart_stats`
+            # family below): the allocator prices checkpoint-restart
+            # moves with these instead of an assumed penalty.
+            "restartStats",
+            # Trainer-measured goodput (useful examples/s) —
+            # graftwatch's drift monitor pairs it with the model's
+            # prediction; observability-only, the policy never reads
+            # it.
+            "measuredGoodput",
+        ),
+        # Present since the first hint schema: the profiling gate
+        # guarantees a job never posts hints without it.
+        "required": ("initBatchSize",),
+    },
+    # ---- measured rescale-cost components riding the restartStats
+    # hint (metrics.restart_stats): the allocator prices
+    # checkpoint-restart moves with these instead of an assumed
+    # penalty; /metrics exports the save/handoff sizes.
+    "restart_stats": {
+        "doc": "restartStats sub-payload of sched hints",
+        "persisted": True,
+        "keys": (
+            "snapshotS",
+            "writeS",
+            "restoreS",
+            "overlapFrac",
+            "numRetunes",
+            "saveBytes",
+            "saveKind",
+            "deltaRatio",
+            "handoffS",
+            "handoffBytes",
+        ),
+        "required": (),
+        # Read via key loops (allocator.restart_cost_s_from_stats)
+        # and the /metrics renderer's dynamic sweep — no statically
+        # visible per-key consumer sites.
+        "open_consumers": True,
+    },
+    # ---- cluster -> job: the current decision (GET /config).
+    "config": {
+        "doc": "GET /config body (ClusterState.get_config_snapshot)",
+        "persisted": False,
+        "keys": (
+            "allocation",
+            "topology",
+            "batchConfig",
+            "retunes",
+            "group",
+            "traceParent",
+        ),
+        # The job adopts topology via launcher env vars, not /config;
+        # retunes/group are read by dashboards and the test harness.
+        "unchecked": ("topology", "retunes", "group"),
+        "required": (),
+    },
+    # ---- the live re-tune sub-payload (allocator-published batch
+    # configuration). Persisted: it rides `retune` journal ops.
+    "batch_config": {
+        "doc": "batchConfig sub-payload of /config + retune ops",
+        "persisted": True,
+        "keys": ("atomicBsz", "accumSteps"),
+        "required": (),
+    },
+    # ---- worker liveness beat (PUT /heartbeat body).
+    "heartbeat": {
+        "doc": "PUT /heartbeat body (sched_hints.send_heartbeat)",
+        "persisted": False,
+        "keys": ("stepTimeEwma",),
+        "required": (),
+    },
+    # ---- worker registration (PUT /register body).
+    "register": {
+        "doc": "PUT /register body (bootstrap)",
+        "persisted": False,
+        "keys": ("address", "processes"),
+        "required": ("address",),
+    },
+    # ---- reclaim-notice intake (POST /preempt body).
+    "preempt": {
+        "doc": "POST /preempt body (sched.preemption)",
+        "persisted": False,
+        "keys": ("group", "rank", "slot", "noticeS", "traceParent"),
+        # `slot` is posted by external notice agents (the k8s node
+        # watcher) and test harnesses — no in-package producer.
+        "unchecked": ("slot",),
+        "required": (),
+    },
+    # ---- handoff advertisement: PUT/GET /handoff body and the
+    # descriptor file beside the checkpoints.
+    "handoff_ad": {
+        "doc": "PUT/GET /handoff body + handoff descriptor file",
+        "persisted": False,
+        "keys": ("url", "group", "ts"),
+        # The descriptor's write stamp: debugging only, never read.
+        "unchecked": ("ts",),
+        "required": (),
+    },
+    # ---- write-ahead journal records (sched.journal): produced by
+    # `# journaled` mutators, replayed by the `_apply_*` layer. A
+    # consumer subscripting a non-required key breaks replay of
+    # pre-upgrade journals (GC1004).
+    "journal_op": {
+        "doc": "ClusterState journal op records",
+        "persisted": True,
+        "keys": (
+            "op",
+            "key",
+            "spec",
+            "ts",
+            "fields",
+            "batch_config",
+            "group",
+            "rank",
+            "address",
+            "processes",
+            "ttl",
+            "ranks",
+            "withdraw",
+            "strikes",
+            "url",
+            "slots",
+            "kinds",
+            "notice_s",
+            "trace_parent",
+            # `update` op field names reach the journal as
+            # update(**fields) kwargs — written at dozens of call
+            # sites, readable only dynamically.
+            "allocation",
+            "topology",
+            "status",
+            "hints",
+        ),
+        "unchecked": ("allocation", "topology", "status", "hints"),
+        "required": (
+            "op",
+            "key",
+            "fields",
+            "batch_config",
+            "group",
+            "rank",
+            "address",
+            "ttl",
+            "ranks",
+            "url",
+        ),
+    },
+    # ---- durable state snapshots (sched.journal rotation).
+    "sched_snapshot": {
+        "doc": "ClusterState snapshot payload",
+        "persisted": True,
+        "keys": (
+            "version",
+            "jobs",
+            "submitted_total",
+            "completions",
+            "slot_strikes",
+            "quarantined",
+            "rollbacks",
+            "recoveries",
+            "draining_slots",
+            "hazard",
+            "preempt_notices",
+        ),
+        # Format stamp for future migrations; no reader today.
+        "unchecked": ("version",),
+        "required": (),
+    },
+    # ---- one job record inside a state snapshot.
+    "job_snapshot": {
+        "doc": "JobRecord snapshot form (_job_to_dict/_job_from_dict)",
+        "persisted": True,
+        "keys": (
+            "key",
+            "spec",
+            "hints",
+            "allocation",
+            "topology",
+            "batch_config",
+            "retunes",
+            "status",
+            "workers",
+            "group",
+            "lease_ranks",
+            "degraded",
+            "failures",
+            "counted_failures",
+            "creation_timestamp",
+            "restarts",
+            "expected_processes",
+            "committed_allocation",
+            "committed_topology",
+            "committed_batch_config",
+            "alloc_epoch",
+            "alloc_state",
+            "alloc_prepare_group",
+            "alloc_require_bump",
+            "trace_parent",
+            "handoff_url",
+            "handoff_group",
+            "draining",
+        ),
+        "required": ("key",),
+    },
+    # ---- checkpoint integrity manifest (checkpoint/manifest.json).
+    "ckpt_manifest": {
+        "doc": "checkpoint manifest.json writer/reader",
+        "persisted": True,
+        "keys": (
+            "version",
+            "restart",
+            "seq",
+            "kind",
+            "chain",
+            "topology",
+            "states",
+            "sha256",
+            "bytes",
+            "base",
+        ),
+        # Stamps recorded for operators/migrations; the load path
+        # proves integrity from states/sha256/bytes alone.
+        "unchecked": ("version", "restart", "seq", "topology", "chain"),
+        "required": ("states",),
+    },
+    # ---- chunked state container (full/delta payload files + the
+    # handoff bulk /state response).
+    "ckpt_container": {
+        "doc": "chunked-full/chunked-delta state containers",
+        "persisted": True,
+        "keys": (
+            "format",
+            "base",
+            "topology",
+            "order",
+            "chunk_sha",
+            "chunks",
+        ),
+        "required": ("base", "order", "chunks"),
+    },
+    # ---- peer-to-peer handoff manifest (GET /manifest on the shard
+    # server) and its per-state chunk/part tables.
+    "handoff_manifest": {
+        "doc": "handoff shard-server manifest + chunk tables",
+        "persisted": True,
+        "keys": (
+            "group",
+            "topology",
+            "states",
+            "order",
+            "sha",
+            "bytes",
+            "parts",
+            "bounds",
+            "rows",
+            "chunks",
+        ),
+        # Chunk byte sizes and the server's group stamp: dashboards
+        # and debugging (the successor validates the ADVERT's group,
+        # handoff_ad, before ever fetching a manifest).
+        "unchecked": ("bytes", "group"),
+        "required": ("order", "bounds", "rows", "chunks"),
+    },
+    # ---- the spawned shard server's stdin payload.
+    "handoff_payload": {
+        "doc": "spawn_server -> _serve_main pickle payload",
+        "persisted": False,
+        "keys": ("states", "group", "topology"),
+        "required": ("states", "group"),
+    },
+    # ---- graftscope span transport: PUT /trace body and the GET
+    # /trace stitched-timeline response.
+    "trace_payload": {
+        "doc": "PUT/GET /trace envelope",
+        "persisted": False,
+        "keys": ("job", "traceParent", "spans"),
+        "unchecked": ("job",),
+        "required": (),
+    },
+    # ---- allocator-cycle job snapshot handed to the watch store.
+    "watch_job": {
+        "doc": "allocator -> WatchStore per-job snapshot",
+        "persisted": False,
+        "keys": (
+            "key",
+            "tenant",
+            "alloc",
+            "topology",
+            "batchConfig",
+            "hints",
+            "requested",
+        ),
+        "required": ("key",),
+    },
+    # ---- GET /watch payload (WatchStore.snapshot) + its series
+    # records. Consumed by `adaptdl-tpu top`, the watchgate tests,
+    # and dashboards — the CLI reads a subset, so the consumer side
+    # stays open.
+    "watch": {
+        "doc": "GET /watch payload + series records",
+        "persisted": False,
+        "open_consumers": True,
+        "keys": (
+            # snapshot envelope
+            "samples",
+            "cluster",
+            "tenants",
+            "series",
+            "jobs",
+            "latest",
+            "drift",
+            "reprofile",
+            "tenant",
+            "suspectSlots",
+            "cycles",
+            "overhead",
+            "sampleS",
+            "cycleS",
+            # per-job / per-tenant series records
+            "t",
+            "rho",
+            "chips",
+            "measured",
+            "predicted",
+            "ideal",
+            "replicas",
+            "share",
+            "burn",
+            "rate",
+            "rhos",
+            "running",
+            # cluster series records
+            "chipsAllocated",
+            "chipsTotal",
+            "utilization",
+            # suspect-slot records
+            "job",
+            "rank",
+            "ratio",
+        ),
+        "required": (),
+    },
+    # ---- GET /explain payload (decision provenance). The policy's
+    # per-candidate records are assembled across the NSGA partitions
+    # (pollux.py) — the producer side stays open.
+    "explain": {
+        "doc": "GET /explain payload + explain records",
+        "persisted": False,
+        "open_producers": True,
+        "open_consumers": True,
+        "keys": (
+            "job",
+            "jobs",
+            "latest",
+            "lastDecision",
+            "history",
+            "cycle",
+            "mode",
+            "t",
+            "alloc",
+            "meshShape",
+            "pinned",
+            "speedup",
+            "kind",
+            "candidates",
+            "winner",
+            "losers",
+            "desiredNodes",
+            # per-candidate objective terms (pollux winner/losers)
+            "objective",
+            "nodes",
+            "killedBy",
+            "scaledSpeedup",
+            "restartPenalty",
+            "moved",
+            "hazardLoss",
+            "error",
+        ),
+        "required": (),
+    },
+    # ---- job spec (operator YAML / CRD / test harness -> scheduler).
+    "job_spec": {
+        "doc": "JobRecord.spec fields the scheduler reads",
+        "persisted": True,
+        # Specs are authored outside the package (YAML, the CRD, the
+        # simulator's trace records) and journaled via create_job.
+        "open_producers": True,
+        "keys": (
+            "resources",
+            "tpu",
+            "max_replicas",
+            "min_replicas",
+            "preemptible",
+            "requested",
+            "tenant",
+        ),
+        "required": (),
+    },
+    # ---- the scheduler-published mesh factorization.
+    "topology": {
+        "doc": "published topology dict (allocator -> launcher/job)",
+        "persisted": True,
+        "keys": (
+            "seqShards",
+            "modelShards",
+            "stageShards",
+            "expertShards",
+            "pipelineMicro",
+        ),
+        "required": (),
+    },
+    # ---- the in-process preemption-notice record shared by the
+    # listener thread, the supervisor notifier, and the urgent drain.
+    "preempt_notice": {
+        "doc": "sched.preemption notice record (cross-thread)",
+        "persisted": False,
+        "keys": (
+            "source",
+            "noticeS",
+            "budgetS",
+            "deadline",
+            "traceParent",
+            "reported",
+            "drained",
+            "drainS",
+        ),
+        # Diagnostics read by the chaos tests, not the product.
+        "unchecked": ("source", "reported", "drained", "drainS"),
+        "required": (),
+    },
+    # ---- per-state save-timing records (checkpoint -> metrics).
+    "ckpt_per_state": {
+        "doc": "AsyncSaveHandle.per_state timing records",
+        "persisted": False,
+        # The snapshot-side literal lives in save_all_states' device
+        # loop; metrics aggregates the entries dynamically.
+        "open_producers": True,
+        "open_consumers": True,
+        "keys": ("snapshot_s", "write_s", "bytes", "kind"),
+        "required": (),
+    },
+    # ---- one graftscope span record (worker buffer -> PUT /trace ->
+    # supervisor store/metrics -> GET /trace -> CLI waterfall).
+    "trace_span": {
+        "doc": "graftscope span records",
+        "persisted": False,
+        "keys": (
+            "name",
+            "kind",
+            "trace",
+            "span",
+            "parent",
+            "ts",
+            "dur",
+            "attrs",
+            "pid",
+            "tid",
+            "inc",
+            "seq",
+            "error",
+            "job",
+        ),
+        # Read by the Perfetto exporter's dynamic rendering and test
+        # assertions, not by annotated consumers.
+        "unchecked": (
+            "kind",
+            "parent",
+            "tid",
+            "inc",
+            "seq",
+            "error",
+            # attrs content is kwarg-built at every span site
+            "job",
+        ),
+        "required": (),
+    },
+    # ---- the JSON ack/error envelope handlers wrap payloads in.
+    # Legality-only: both sides are open (every handler writes it,
+    # clients mostly read status codes).
+    "envelope": {
+        "doc": "HTTP handler ack/error envelope",
+        "persisted": False,
+        "open_producers": True,
+        "open_consumers": True,
+        "keys": ("ok", "error", "ttl", "accepted", "draining"),
+        "required": (),
+    },
+    # ---- the urgent drain's outcome record (preemption survival).
+    "drain_report": {
+        "doc": "sched.preemption.urgent_drain result",
+        "persisted": False,
+        # Asserted on by the chaos suite, not by product code.
+        "open_consumers": True,
+        "keys": (
+            "durationS",
+            "deadlineMet",
+            "fitPredicted",
+            "joinedInflight",
+        ),
+        "required": (),
+    },
+    # ---- handoff fetch accounting (handoff -> metrics).
+    "handoff_fetch_stats": {
+        "doc": "handoff._fetch_stats counters",
+        "persisted": False,
+        "open_producers": True,
+        "open_consumers": True,
+        "keys": ("bytes", "seconds"),
+        "required": (),
+    },
+}
+
+# ---- endpoint conformance (GC11xx) -----------------------------------
+#
+# Routes probed by actors OUTSIDE this package — the k8s liveness
+# probe hits /healthz, the API server calls the admission webhook's
+# /validate — are exempt from the orphan-endpoint (GC1101) and
+# idempotency-annotation (GC1103) checks: their client side cannot be
+# found in this repo by construction.
+EXTERNAL_ROUTES = ("/healthz", "/validate")
+
+# Routes exempt from the fault-injection-point requirement (GC1104):
+# /healthz must stay an honest liveness probe — an injected 500 there
+# would make the orchestrator kill a healthy supervisor.
+FAULT_EXEMPT_ROUTES = ("/healthz",)
+
+# Server modules whose route tables must be documented in
+# docs/protocols.md (GC1105/GC1106). Fixture servers under tests/ are
+# deliberately not listed.
+DOCUMENTED_SERVERS = (
+    "adaptdl_tpu/sched/supervisor.py",
+    "adaptdl_tpu/handoff.py",
+    "adaptdl_tpu/sched/validator.py",
+)
+
+# ---- runtime constants (producers and consumers import these) --------
+
+SCHED_HINTS_KEYS = WIRE_CONTRACTS["sched_hints"]["keys"]
+CONFIG_KEYS = WIRE_CONTRACTS["config"]["keys"]
+BATCH_CONFIG_KEYS = WIRE_CONTRACTS["batch_config"]["keys"]
+HEARTBEAT_KEYS = WIRE_CONTRACTS["heartbeat"]["keys"]
+REGISTER_KEYS = WIRE_CONTRACTS["register"]["keys"]
+PREEMPT_KEYS = WIRE_CONTRACTS["preempt"]["keys"]
+HANDOFF_AD_KEYS = WIRE_CONTRACTS["handoff_ad"]["keys"]
+JOURNAL_OP_KEYS = WIRE_CONTRACTS["journal_op"]["keys"]

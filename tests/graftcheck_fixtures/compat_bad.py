@@ -1,0 +1,14 @@
+"""Known-bad persisted-record compat fixture (GC1004).
+
+The replay layer of a journaled record subscripts a version-optional
+key: replaying a journal written by a pre-upgrade supervisor (which
+never wrote the key) raises KeyError mid-recovery — the exact bug
+class behind the op["ts"] replay corruption fixed in PR 9.
+"""
+
+
+def apply_preempt(state, op):  # wire: consumes=journal_op
+    state.key = op["key"]  # required since v1: subscript is fine
+    state.slots = op["slots"]  # GC1004: version-optional, no default
+    state.ts = float(op.get("ts") or 0.0)
+    return state

@@ -1,0 +1,14 @@
+"""Known-good persisted-record compat fixture: version-optional keys
+read with defaults or behind a membership guard; required-since-v1
+keys subscripted directly."""
+
+
+def apply_preempt(state, op):  # wire: consumes=journal_op
+    state.key = op["key"]  # required since v1
+    state.slots = op.get("slots") or []
+    state.ts = float(op.get("ts") or 0.0)
+    if "kinds" in op:
+        # The guard proves absence-awareness: the subscript below is
+        # compat-safe for pre-upgrade records.
+        state.kinds = dict(op["kinds"])
+    return state

@@ -1,0 +1,58 @@
+"""Known-bad endpoint-conformance fixture (GC1101-GC1104).
+
+A miniature control-plane server whose route table exhibits every
+conformance gap: an orphan route no client calls, a client calling a
+path no route serves, a retried PUT handler with no idempotency
+annotation, and a handler with no registered fault-injection point.
+"""
+
+from aiohttp import web
+
+from adaptdl_tpu import faults, rpc
+
+
+class MiniServer:
+    async def _pull(self, request: web.Request) -> web.Response:
+        try:
+            faults.maybe_fail("sup.config.pre")
+        except faults.InjectedFault as exc:
+            return web.json_response(
+                {"error": f"injected fault: {exc}"}, status=500
+            )
+        return web.json_response({})
+
+    async def _push(self, request: web.Request) -> web.Response:
+        # GC1103: a retried PUT whose header declares no idempotency
+        # story; GC1104: no registered fault-injection point.
+        return web.json_response({"ok": True})
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.add_routes(
+            [
+                web.get("/pull/{namespace}/{name}", self._pull),
+                web.put("/push/{namespace}/{name}", self._push),
+                # GC1101: no rpc client in the program calls /orphan.
+                web.get("/orphan/{namespace}/{name}", self._pull),
+            ]
+        )
+        return app
+
+
+def pull(url: str, job: str):
+    return rpc.default_client().get(
+        f"{url}/pull/{job}", endpoint=f"pull/{job}"
+    )
+
+
+def push(url: str, job: str, body: dict):
+    return rpc.default_client().put(
+        f"{url}/push/{job}", endpoint=f"push/{job}", json=body
+    )
+
+
+def stray(url: str, job: str):
+    # GC1102: /pul is served by no route — this call can only 404.
+    return rpc.default_client().get(
+        f"{url}/pul/{job}", endpoint=f"pul/{job}"
+    )

@@ -1,0 +1,58 @@
+"""Known-good endpoint-conformance fixture: every route has a client
+caller, mutating handlers declare idempotency, handlers reach
+registered fault points, and the externally-probed /healthz is exempt
+via wire.EXTERNAL_ROUTES."""
+
+from aiohttp import web
+
+from adaptdl_tpu import faults, rpc
+
+
+class MiniServer:
+    async def _pull(self, request: web.Request) -> web.Response:
+        try:
+            faults.maybe_fail("sup.config.pre")
+        except faults.InjectedFault as exc:
+            return web.json_response(
+                {"error": f"injected fault: {exc}"}, status=500
+            )
+        return web.json_response({})
+
+    async def _push(  # idempotent: keyed-by=group
+        self, request: web.Request
+    ) -> web.Response:
+        try:
+            faults.maybe_fail("sup.hints.pre")
+        except faults.InjectedFault as exc:
+            return web.json_response(
+                {"error": f"injected fault: {exc}"}, status=500
+            )
+        return web.json_response({"ok": True})
+
+    async def _healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.add_routes(
+            [
+                web.get("/pull/{namespace}/{name}", self._pull),
+                web.put("/push/{namespace}/{name}", self._push),
+                # Probed by the orchestrator, not by in-package
+                # clients: declared in wire.EXTERNAL_ROUTES.
+                web.get("/healthz", self._healthz),
+            ]
+        )
+        return app
+
+
+def pull(url: str, job: str):
+    return rpc.default_client().get(
+        f"{url}/pull/{job}", endpoint=f"pull/{job}"
+    )
+
+
+def push(url: str, job: str, body: dict):
+    return rpc.default_client().put(
+        f"{url}/push/{job}", endpoint=f"push/{job}", json=body
+    )

@@ -1,0 +1,28 @@
+"""Known-bad wire-contract fixture (GC1001/GC1002).
+
+Judged against the REAL contract in adaptdl_tpu/wire.py: the
+producer misspells a declared key, the consumer reads a misspelled
+key (the drift class behind the stale /config pairing bug), and one
+function names a family the contract does not declare.
+"""
+
+
+def build_config(record):  # wire: produces=config
+    return {
+        "allocation": list(record.allocation),
+        "batchConfig": record.batch_config,
+        "traceParent": record.trace_parent,
+        "allocEpoch": record.alloc_epoch,  # GC1001: undeclared key
+    }
+
+
+def read_config(payload):  # wire: consumes=config
+    allocation = payload.get("alocation") or []  # GC1002: misspelled
+    batch_config = payload.get("batchConfig")
+    return allocation, batch_config
+
+
+def read_unknown_family(payload):  # wire: consumes=confg
+    # GC1002 at the def: a typo'd family name must fail loudly, not
+    # silently disable every check on this function.
+    return payload.get("allocation")

@@ -1,0 +1,25 @@
+"""Known-good wire-contract fixture: declared keys on both sides,
+response envelopes and transport params deliberately out of scope."""
+
+
+def build_config(record):  # wire: produces=config
+    return {
+        "allocation": list(record.allocation),
+        "batchConfig": record.batch_config,
+        "retunes": record.retunes,
+        "group": record.group,
+        "traceParent": record.trace_parent,
+    }
+
+
+def read_config(payload):  # wire: consumes=config
+    allocation = payload.get("allocation") or []
+    batch_config = payload.get("batchConfig")
+    # Transport parameters are the route table's contract, not the
+    # payload's: a query-param dict must not register as key writes.
+    request(params={"group": 3}, headers={"traceparent": "00-"})
+    return allocation, batch_config
+
+
+def request(params=None, headers=None):
+    return params, headers

@@ -262,6 +262,254 @@ def test_escaped_functions_get_no_inferred_locks(tmp_path):
     assert worker.entry_locks == frozenset()
 
 
+# ---- payload flow (GC10xx substrate) --------------------------------
+
+
+def test_payload_accesses_classify_writes_and_reads(tmp_path):
+    prog = _program(
+        tmp_path,
+        {
+            "m.py": (
+                "def build():  # wire: produces=fam\n"
+                '    out = {"written": 1}\n'
+                '    out["stored"] = 2\n'
+                "    return out\n"
+                "\n"
+                "\n"
+                "def read(payload):  # wire: consumes=fam\n"
+                '    a = payload["subscripted"]\n'
+                '    b = payload.get("gotten")\n'
+                '    c = payload.get("defaulted", 0)\n'
+                '    if "probed" in payload:\n'
+                "        return a, b, c\n"
+                "    return None\n"
+            ),
+        },
+    )
+    build = prog.functions["m.py::build"]
+    assert {(a.key, a.mode) for a in prog.payload_accesses(build)} == {
+        ("written", "write"),
+        ("stored", "write"),
+    }
+    read = prog.functions["m.py::read"]
+    assert {(a.key, a.mode) for a in prog.payload_accesses(read)} == {
+        ("subscripted", "subscript"),
+        ("gotten", "get"),
+        ("defaulted", "get"),
+        ("probed", "contains"),
+    }
+
+
+def test_payload_accesses_follow_same_file_helpers(tmp_path):
+    """'Reachable from the builder': keys written in an unannotated
+    same-file helper belong to the annotated caller; a helper with
+    its OWN wire annotation is a cut point."""
+    prog = _program(
+        tmp_path,
+        {
+            "m.py": (
+                "def build():  # wire: produces=fam\n"
+                "    return helper()\n"
+                "\n"
+                "\n"
+                "def helper():\n"
+                '    return {"viaHelper": 1}\n'
+                "\n"
+                "\n"
+                "def other():  # wire: produces=other_fam\n"
+                '    return {"foreign": 1}\n'
+                "\n"
+                "\n"
+                "def build2():  # wire: produces=fam\n"
+                "    return other()\n"
+            ),
+        },
+    )
+    build = prog.functions["m.py::build"]
+    assert {a.key for a in prog.payload_accesses(build)} == {
+        "viaHelper"
+    }
+    build2 = prog.functions["m.py::build2"]
+    assert prog.payload_accesses(build2) == []
+
+
+def test_payload_accesses_skip_transport_and_span_attrs(tmp_path):
+    """Query params/headers dicts and span-attribute writes are
+    transport/trace concerns, not payload keys; string containment
+    (`"/" in key`) is not a key probe."""
+    prog = _program(
+        tmp_path,
+        {
+            "m.py": (
+                "import trace\n"
+                "\n"
+                "\n"
+                "def send(client, key):  # wire: produces=fam\n"
+                '    with trace.span("x") as attrs:\n'
+                '        attrs["attempts"] = 3\n'
+                "    client.put(\n"
+                '        "u", params={"group": 1}, headers={"tp": "0"},\n'
+                '        json={"body": 1},\n'
+                "    )\n"
+                '    return "/" in key\n'
+            ),
+        },
+    )
+    send = prog.functions["m.py::send"]
+    assert {a.key for a in prog.payload_accesses(send)} == {"body"}
+
+
+def test_wire_families_parse_comma_lists(tmp_path):
+    prog = _program(
+        tmp_path,
+        {
+            "m.py": (
+                "def f():  # wire: produces=a,b # wire: consumes=c\n"
+                "    return None\n"
+            ),
+        },
+    )
+    produces, consumes = prog.wire_families(
+        prog.functions["m.py::f"]
+    )
+    assert produces == {"a", "b"}
+    assert consumes == {"c"}
+
+
+# ---- endpoint conformance: route-table parse ------------------------
+
+
+def test_route_table_parse_resolves_handlers(tmp_path):
+    from tools.graftcheck.passes.endpoints import (
+        EndpointConformancePass,
+    )
+
+    prog = _program(
+        tmp_path,
+        {
+            "srv.py": (
+                "from aiohttp import web\n"
+                "\n"
+                "\n"
+                "class S:\n"
+                "    async def _a(self, request):\n"
+                "        return None\n"
+                "\n"
+                "    def build_app(self):\n"
+                "        app = web.Application()\n"
+                "        app.add_routes([\n"
+                '            web.get("/a/{job}", self._a),\n'
+                '            web.put("/b/{job}", self._a),\n'
+                "        ])\n"
+                "        return app\n"
+            ),
+        },
+    )
+    routes = EndpointConformancePass()._routes(prog)
+    assert [(r["method"], r["path"]) for r in routes] == [
+        ("GET", "/a/{job}"),
+        ("PUT", "/b/{job}"),
+    ]
+    assert all(
+        r["handler"] is not None and r["handler"].name == "_a"
+        for r in routes
+    )
+
+
+def test_client_call_extraction_matches_first_segment(tmp_path):
+    from tools.graftcheck.passes.endpoints import (
+        EndpointConformancePass,
+    )
+
+    prog = _program(
+        tmp_path,
+        {
+            "c.py": (
+                "import rpc\n"
+                "\n"
+                "\n"
+                "def go(url, job):\n"
+                "    rpc.client().get(\n"
+                '        f"{url}/config/{job}", endpoint="config"\n'
+                "    )\n"
+                "    rpc.client().post(\n"
+                '        "http://h:1/preempt/x", endpoint="p"\n'
+                "    )\n"
+                "    rpc.client().get(url, endpoint='dynamic')\n"
+                '    d = {}.get("not-a-client")\n'
+            ),
+        },
+    )
+    calls = EndpointConformancePass()._client_calls(prog)
+    assert {(c["method"], c["segment"]) for c in calls} == {
+        ("GET", "config"),
+        ("POST", "preempt"),
+    }
+
+
+def test_fast_cache_refreshes_on_protocols_doc_change(tmp_path):
+    """PR 9's staleness fix, extended to the GC11xx inputs: the
+    protocols doc lives OUTSIDE the analyzed set, so documenting a
+    route must clear the cached GC1105 finding on the next --fast
+    run via the pass's cache_inputs fingerprint."""
+    pkg = tmp_path / "adaptdl_tpu"
+    pkg.mkdir()
+    (pkg / "faults.py").write_text(
+        'INJECTION_POINTS = {\n    "srv.pre": "x",\n}\n'
+    )
+    (pkg / "wire.py").write_text(
+        "WIRE_CONTRACTS = {}\n"
+        "EXTERNAL_ROUTES = ()\n"
+        "FAULT_EXEMPT_ROUTES = ()\n"
+        'DOCUMENTED_SERVERS = ("adaptdl_tpu/srv.py",)\n'
+    )
+    (pkg / "srv.py").write_text(
+        "from aiohttp import web\n"
+        "from adaptdl_tpu import faults, rpc\n"
+        "\n"
+        "\n"
+        "class S:\n"
+        "    async def _a(self, request):\n"
+        '        faults.maybe_fail("srv.pre")\n'
+        "        return None\n"
+        "\n"
+        "    def build_app(self):\n"
+        "        app = web.Application()\n"
+        '        app.add_routes([web.get("/a/{job}", self._a)])\n'
+        "        return app\n"
+        "\n"
+        "\n"
+        "def call(url, job):\n"
+        '    return rpc.get(f"{url}/a/{job}", endpoint="a")\n'
+    )
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "protocols.md").write_text("# Protocols\n\n(none yet)\n")
+    env = dict(os.environ, PYTHONPATH=REPO)
+
+    def run():
+        return subprocess.run(
+            [
+                sys.executable, "-m", "tools.graftcheck",
+                "adaptdl_tpu", "--fast",
+            ],
+            cwd=str(tmp_path),
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+
+    first = run()
+    assert "GC1105" in first.stdout, first.stdout + first.stderr
+    (docs / "protocols.md").write_text(
+        "# Protocols\n\n| GET /a/{job} | pull |\n"
+    )
+    second = run()
+    assert second.returncode == 0, second.stdout + second.stderr
+    assert "GC1105" not in second.stdout
+
+
 # ---- --fast cache fingerprint ---------------------------------------
 
 

@@ -1,0 +1,191 @@
+"""Wire-contract runtime conformance (adaptdl_tpu/wire.py).
+
+The GC10xx/GC11xx passes check the contract statically; this suite
+pins the RUNTIME side — the declared key sets match what the code
+actually serializes — plus regressions for real findings the passes
+surfaced and that were fixed (not baselined) in this repo:
+
+- the supervisor's /handoff endpoints (PR 12) shipped with NO
+  fault-injection point and no idempotency declaration — GC1104/
+  GC1103 flagged them; the fix is pinned here;
+- the explain contract declared a `killed` key while the policy
+  actually writes `killedBy` — GC1003/GC1002 caught the drift at
+  declaration time; the CLI renders `killedBy` and the contract now
+  agrees.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from adaptdl_tpu import wire
+from adaptdl_tpu.faults import INJECTION_POINTS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_contracts_are_well_formed():
+    for family, spec in wire.WIRE_CONTRACTS.items():
+        keys = spec["keys"]
+        assert keys, family
+        assert len(set(keys)) == len(keys), f"{family}: duplicate keys"
+        for field in ("required", "unchecked"):
+            extra = set(spec.get(field, ())) - set(keys)
+            assert not extra, f"{family}.{field} not in keys: {extra}"
+
+
+def test_sched_hints_keys_are_the_wire_family():
+    """sched_hints.SCHED_HINTS_KEYS (the runtime validator's
+    allowlist) IS the declared wire family — one source of truth."""
+    from adaptdl_tpu import sched_hints
+
+    assert sched_hints.SCHED_HINTS_KEYS is wire.SCHED_HINTS_KEYS
+    assert (
+        tuple(wire.WIRE_CONTRACTS["sched_hints"]["keys"])
+        == wire.SCHED_HINTS_KEYS
+    )
+
+
+def test_config_snapshot_serves_exactly_the_declared_keys():
+    """The /config body and the `config` wire family agree key-for-
+    key — a key added to one side without the other fails here AND in
+    graftcheck's GC1003."""
+    from adaptdl_tpu.sched.state import ClusterState
+
+    state = ClusterState(state_dir=None)
+    state.create_job("ns/job")
+    snapshot = state.get_config_snapshot("ns/job")
+    assert set(snapshot) == set(wire.CONFIG_KEYS)
+
+
+def test_job_snapshot_roundtrip_covers_declared_keys():
+    """_job_to_dict writes exactly the `job_snapshot` family — the
+    persisted form a future version must be able to .get its way
+    through."""
+    from adaptdl_tpu.sched.state import JobRecord, _job_to_dict
+
+    payload = _job_to_dict(JobRecord(key="ns/job"))
+    assert set(payload) == set(
+        wire.WIRE_CONTRACTS["job_snapshot"]["keys"]
+    )
+
+
+def test_job_snapshot_loads_pre_upgrade_records():
+    """The GC1004 discipline, exercised: a minimal record carrying
+    only the required keys (what a pre-upgrade journal might hold)
+    must load without KeyError."""
+    from adaptdl_tpu.sched.state import _job_from_dict
+
+    record = _job_from_dict({"key": "ns/job"})
+    assert record.key == "ns/job"
+    assert record.group == 0
+    assert record.handoff_group == -1
+
+
+def test_preempt_body_keys_match_producer():
+    """The preemption notifier posts only declared `preempt` keys
+    (the supervisor consumer reads the same family)."""
+    declared = set(wire.PREEMPT_KEYS)
+    assert {"group", "rank", "noticeS", "traceParent"} <= declared
+
+
+# ---- regressions for real findings the passes surfaced --------------
+
+
+def test_handoff_endpoints_have_fault_points():
+    """PR 12's /handoff endpoints shipped unfaultable — GC1104
+    flagged them; keep the points registered."""
+    for point in (
+        "sup.handoff.pre",
+        "sup.handoff.get.pre",
+        "sup.status.pre",
+        "sup.metrics.pre",
+        "sup.hints.get.pre",
+        "sup.trace.get.pre",
+        "webhook.validate.pre",
+    ):
+        assert point in INJECTION_POINTS, point
+
+
+def test_supervisor_mutating_handlers_declare_idempotency():
+    """Every PUT/POST supervisor handler states how a retry folds
+    into the first attempt (GC1103's contract), parsed from the real
+    module."""
+    from tools.graftcheck.core import IDEMPOTENT_RE, parse_file
+
+    sf = parse_file(
+        os.path.join(REPO, "adaptdl_tpu", "sched", "supervisor.py"),
+        REPO,
+    )
+    import ast
+
+    annotated = {
+        node.name
+        for node in sf.walk()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and IDEMPOTENT_RE.search(sf.def_header_comment(node))
+    }
+    assert {
+        "_register",
+        "_heartbeat",
+        "_put_hints",
+        "_put_trace",
+        "_preempt",
+        "_put_handoff",
+    } <= annotated, annotated
+
+
+def test_explain_contract_uses_killed_by():
+    """The declaration drift GC1003 caught: the policy writes
+    `killedBy`, not `killed` — the contract must track the code."""
+    keys = wire.WIRE_CONTRACTS["explain"]["keys"]
+    assert "killedBy" in keys
+    assert "killed" not in keys
+
+
+def test_new_rules_flow_into_sarif_catalog():
+    """CI uploads SARIF built from RULE_CATALOG: the GC10xx/GC11xx
+    rules must be in it (and therefore in the uploaded rule table)."""
+    from tools.graftcheck.passes import RULE_CATALOG
+
+    for rule in (
+        "GC1001", "GC1002", "GC1003", "GC1004",
+        "GC1101", "GC1102", "GC1103", "GC1104", "GC1105", "GC1106",
+    ):
+        assert rule in RULE_CATALOG, rule
+
+
+def test_cli_check_verb_exit_codes():
+    """`adaptdl-tpu check` wraps graftcheck with its exit-code
+    semantics: 0 clean, 1 findings."""
+    def run(*argv):
+        return subprocess.run(
+            [sys.executable, "-m", "adaptdl_tpu.cli", "check", *argv],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+
+    clean = run(
+        os.path.join("tests", "graftcheck_fixtures", "wire_good.py"),
+        "-q",
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    dirty = run(
+        os.path.join("tests", "graftcheck_fixtures", "wire_bad.py"),
+        "--baseline", "does-not-exist.json", "-q",
+    )
+    assert dirty.returncode == 1
+    assert "GC1002" in dirty.stdout
+    listing = run("--list-rules")
+    assert listing.returncode == 0
+    assert "GC1101" in listing.stdout
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -17,7 +17,7 @@ import tokenize
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable
 
-TOOL_VERSION = "2"
+TOOL_VERSION = "3"
 
 
 def tool_fingerprint(
@@ -90,6 +90,14 @@ DEFAULT_BASELINE = "graftcheck_baseline.json"
 #   def tick():         # graftcheck: stage-seq=pipeline-tick
 #                       all defs sharing a group must run the same
 #                       collective sequence (GC802)
+#   def build():        # wire: produces=config
+#   def read():         # wire: consumes=config,journal_op
+#                       the def's constant dict keys are checked
+#                       against the named payload families declared
+#                       in adaptdl_tpu/wire.py (GC10xx)
+#   async def _put():   # idempotent: keyed-by=group
+#                       retried (PUT/POST) handlers declare how a
+#                       retry folds into the first attempt (GC1103)
 
 GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
 HOLDS_LOCK_RE = re.compile(r"#\s*holds-lock:\s*([A-Za-z_][\w.]*)")
@@ -102,6 +110,10 @@ DISABLE_FILE_RE = re.compile(
 )
 DECLARE_AXES_RE = re.compile(
     r"#\s*graftcheck:\s*declare-axes=([\w,\s-]+)"
+)
+WIRE_RE = re.compile(r"#\s*wire:\s*(produces|consumes)=([\w,-]+)")
+IDEMPOTENT_RE = re.compile(
+    r"#\s*idempotent\b(?::\s*keyed-by=([\w-]+))?"
 )
 
 

@@ -4,6 +4,9 @@ from tools.graftcheck.passes.checkpoint_protocol import (
     CheckpointProtocolPass,
 )
 from tools.graftcheck.passes.collective_axis import CollectiveAxisPass
+from tools.graftcheck.passes.endpoints import (
+    EndpointConformancePass,
+)
 from tools.graftcheck.passes.env_registry import EnvRegistryPass
 from tools.graftcheck.passes.fault_rpc import FaultRpcPass
 from tools.graftcheck.passes.host_sync import HostSyncPass
@@ -16,6 +19,7 @@ from tools.graftcheck.passes.spmd import SpmdDisciplinePass
 from tools.graftcheck.passes.timing_discipline import (
     TimingDisciplinePass,
 )
+from tools.graftcheck.passes.wire import WireContractPass
 
 ALL_PASSES = [
     LockDisciplinePass(),
@@ -28,6 +32,8 @@ ALL_PASSES = [
     JournalDisciplinePass(),
     TimingDisciplinePass(),
     ReplayPurityPass(),
+    WireContractPass(),
+    EndpointConformancePass(),
 ]
 
 RULE_CATALOG = {

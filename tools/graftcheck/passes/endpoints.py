@@ -1,0 +1,511 @@
+"""GC11xx — endpoint conformance for the control-plane servers.
+
+The supervisor's REST face (and the handoff shard server's) is the
+contract between processes that restart independently; every route is
+expected to have a resilient-client caller, a chaos story, an
+idempotency story, and documentation. This pass builds the route
+table straight from the ``web.<method>("/path", handler)`` calls in
+``build_app`` and checks each route:
+
+- **GC1101** — orphan endpoint: no ``rpc.py``-based client call in
+  the package targets the route (clients are recognized by the
+  ``endpoint=`` keyword every RpcClient call carries; the URL's
+  first literal path segment + HTTP method must match). Routes
+  probed by actors outside the package (k8s liveness probes, the
+  API server's webhook calls) are declared in
+  ``adaptdl_tpu/wire.py:EXTERNAL_ROUTES``.
+- **GC1102** — a client call whose literal first path segment (and
+  method) matches no registered route: the call can only ever 404.
+  Checked only when the analyzed set contains at least one route
+  table — analyzing a lone client module proves nothing.
+- **GC1103** — a mutating (PUT/POST) handler without an
+  ``# idempotent`` / ``# idempotent: keyed-by=<field>`` annotation:
+  the resilient client RETRIES these, so every such handler must
+  state how a retry folds into the first attempt.
+- **GC1104** — a handler with no registered fault-injection point
+  (a ``@_faultable("...")`` decorator or an inline
+  ``faults.maybe_fail("...")``, name present in the
+  ``INJECTION_POINTS`` catalog): the chaos suite cannot prove the
+  client side retries through a blip it cannot inject.
+  ``FAULT_EXEMPT_ROUTES`` (e.g. ``/healthz`` — a liveness probe must
+  stay honest) opt out.
+- **GC1105** — a route of a ``DOCUMENTED_SERVERS`` module with no
+  ``METHOD /path`` row in ``docs/protocols.md``.
+- **GC1106** — a ``METHOD /path`` row in ``docs/protocols.md`` that
+  matches no registered route (stale docs; only checked when every
+  documented server module is in the analyzed set).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tools.graftcheck.core import (
+    IDEMPOTENT_RE,
+    Context,
+    Finding,
+    Pass,
+    dotted_name,
+)
+from tools.graftcheck.passes.fault_rpc import _load_catalog
+
+_ROUTE_METHODS = {
+    "get": "GET",
+    "put": "PUT",
+    "post": "POST",
+    "delete": "DELETE",
+    "patch": "PATCH",
+    "head": "HEAD",
+}
+
+_CLIENT_METHODS = {"get": "GET", "put": "PUT", "post": "POST"}
+
+# First literal path segment of a URL expression rendered with \x00
+# placeholders for interpolated parts: "{sup}/config/{job}" renders
+# "\x00/config/\x00" -> "config"; "http://h/healthz" -> "healthz".
+_SEGMENT_RE = re.compile(
+    r"(?:\x00|^(?:https?://[^/\x00]*)?)/([A-Za-z_][\w.-]*)"
+)
+
+_DOC_ROW_RE = re.compile(
+    r"\b(GET|PUT|POST|DELETE|PATCH|HEAD)\s+(/[\w{}/.:@+*-]+)"
+)
+
+
+def _render_url(node: ast.AST) -> str | None:
+    """Literal text of a URL expression, interpolations as \\x00."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for piece in node.values:
+            if isinstance(piece, ast.Constant):
+                parts.append(str(piece.value))
+            else:
+                parts.append("\x00")
+        return "".join(parts)
+    return None
+
+
+def _load_route_config(path: str) -> dict:
+    """EXTERNAL_ROUTES / FAULT_EXEMPT_ROUTES / DOCUMENTED_SERVERS
+    tuples, parsed statically from the wire module (empty when the
+    module or a tuple is missing — absence of config never hides a
+    route, it just exempts nothing)."""
+    config = {
+        "external": set(),
+        "fault_exempt": set(),
+        "documented": set(),
+    }
+    names = {
+        "EXTERNAL_ROUTES": "external",
+        "FAULT_EXEMPT_ROUTES": "fault_exempt",
+        "DOCUMENTED_SERVERS": "documented",
+    }
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return config
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if (
+                isinstance(target, ast.Name)
+                and target.id in names
+                and isinstance(node.value, (ast.Tuple, ast.List))
+            ):
+                config[names[target.id]] = {
+                    elt.value
+                    for elt in node.value.elts
+                    if isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)
+                }
+    return config
+
+
+def _first_segment(path: str) -> str:
+    return path.lstrip("/").split("/", 1)[0]
+
+
+class EndpointConformancePass(Pass):
+    name = "endpoint-conformance"
+    whole_program = True
+    rules = {
+        "GC1101": "endpoint has no rpc-client caller (orphan route)",
+        "GC1102": "rpc client call targets an unregistered path",
+        "GC1103": (
+            "retried (PUT/POST) handler lacks an # idempotent "
+            "annotation"
+        ),
+        "GC1104": (
+            "route handler has no registered fault-injection point"
+        ),
+        "GC1105": "route missing from the protocols doc",
+        "GC1106": "protocols doc row matches no registered route",
+    }
+
+    def _wire_module(self, ctx: Context) -> str:
+        return os.path.join(
+            ctx.root,
+            ctx.options.get("wire_module", "adaptdl_tpu/wire.py"),
+        )
+
+    def _faults_module(self, ctx: Context) -> str:
+        return os.path.join(
+            ctx.root,
+            ctx.options.get("faults_module", "adaptdl_tpu/faults.py"),
+        )
+
+    def _protocols_doc(self, ctx: Context) -> str:
+        return os.path.join(
+            ctx.root,
+            ctx.options.get("protocols_doc", "docs/protocols.md"),
+        )
+
+    def cache_inputs(self, ctx: Context) -> list[str]:
+        """GC11xx findings depend on files outside the analyzed set:
+        the protocols doc (GC1105/1106), the route exemptions in the
+        wire module, and the fault catalog (GC1104) — all fold into
+        the --fast fingerprint so an edit invalidates cached runs."""
+        return [
+            self._protocols_doc(ctx),
+            self._wire_module(ctx),
+            self._faults_module(ctx),
+        ]
+
+    # -- extraction ----------------------------------------------------
+
+    def _routes(self, program) -> list[dict]:
+        routes: list[dict] = []
+        for sf in program.files:
+            for node in sf.walk(ast.Call):
+                name = dotted_name(node.func)
+                if name is None or "." not in name:
+                    continue
+                base, _, method = name.rpartition(".")
+                if method not in _ROUTE_METHODS:
+                    continue
+                if base.rsplit(".", 1)[-1] != "web":
+                    continue
+                if len(node.args) < 2:
+                    continue
+                path = node.args[0]
+                if not (
+                    isinstance(path, ast.Constant)
+                    and isinstance(path.value, str)
+                    and path.value.startswith("/")
+                ):
+                    continue
+                handler = self._resolve_handler(
+                    program, sf, node, node.args[1]
+                )
+                routes.append(
+                    {
+                        "method": _ROUTE_METHODS[method],
+                        "path": path.value,
+                        "handler": handler,
+                        "sf": sf,
+                        "line": node.lineno,
+                        "col": node.col_offset,
+                    }
+                )
+        return routes
+
+    @staticmethod
+    def _resolve_handler(program, sf, call, handler_expr):
+        name = dotted_name(handler_expr)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if parts[0] in ("self", "cls") and len(parts) == 2:
+            for anc in sf.ancestors(call):
+                if isinstance(anc, ast.ClassDef):
+                    from tools.graftcheck.program import _module_key
+
+                    return program._class_method(
+                        _module_key(sf), anc.name, parts[1]
+                    )
+            return None
+        caller = program.function_for_node(
+            sf.enclosing_function(call)
+        )
+        return program.resolve_call(sf, caller, handler_expr)
+
+    def _client_calls(self, program) -> list[dict]:
+        calls: list[dict] = []
+        for sf in program.files:
+            for node in sf.walk(ast.Call):
+                if not isinstance(node.func, ast.Attribute):
+                    continue
+                method = _CLIENT_METHODS.get(node.func.attr)
+                if method is None or not node.args:
+                    continue
+                if not any(
+                    kw.arg == "endpoint" for kw in node.keywords
+                ):
+                    continue
+                rendered = _render_url(node.args[0])
+                if rendered is None:
+                    continue
+                match = _SEGMENT_RE.search(rendered)
+                if match is None:
+                    continue
+                calls.append(
+                    {
+                        "method": method,
+                        "segment": match.group(1),
+                        "sf": sf,
+                        "line": node.lineno,
+                        "col": node.col_offset,
+                    }
+                )
+        return calls
+
+    @staticmethod
+    def _handler_fault_points(route) -> set[str]:
+        """Literal point names the handler references: decorator
+        calls with a constant first argument plus inline
+        ``maybe_fail`` calls anywhere in the body."""
+        info = route["handler"]
+        if info is None:
+            return set()
+        points: set[str] = set()
+        for deco in getattr(info.node, "decorator_list", ()):
+            if isinstance(deco, ast.Call) and deco.args:
+                arg = deco.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    points.add(arg.value)
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = dotted_name(node.func)
+            if name is None:
+                continue
+            if name.rsplit(".", 1)[-1] != "maybe_fail":
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(
+                arg.value, str
+            ):
+                points.add(arg.value)
+        return points
+
+    # -- checks --------------------------------------------------------
+
+    def check_program(self, program, ctx: Context) -> list[Finding]:
+        routes = self._routes(program)
+        if not routes:
+            return []
+        findings: list[Finding] = []
+        config = _load_route_config(self._wire_module(ctx))
+        external = {_first_segment(p) for p in config["external"]}
+        fault_exempt = {
+            _first_segment(p) for p in config["fault_exempt"]
+        }
+        catalog = _load_catalog(self._faults_module(ctx))
+        clients = self._client_calls(program)
+        client_set = {(c["method"], c["segment"]) for c in clients}
+        route_set = {
+            (r["method"], _first_segment(r["path"])) for r in routes
+        }
+
+        doc_path = self._protocols_doc(ctx)
+        doc_rel = os.path.relpath(doc_path, ctx.root).replace(
+            os.sep, "/"
+        )
+        try:
+            with open(doc_path, encoding="utf-8") as f:
+                doc_lines = f.read().splitlines()
+        except OSError:
+            doc_lines = None
+        doc_rows: list[tuple[str, str, int]] = []
+        if doc_lines is not None:
+            for lineno, line in enumerate(doc_lines, 1):
+                for m in _DOC_ROW_RE.finditer(line):
+                    doc_rows.append((m.group(1), m.group(2), lineno))
+        documented = {(method, path) for method, path, _ in doc_rows}
+
+        for route in routes:
+            segment = _first_segment(route["path"])
+            is_external = segment in external
+            handler = route["handler"]
+            handler_sf = (
+                handler.sf if handler is not None else route["sf"]
+            )
+            handler_line = (
+                handler.node.lineno
+                if handler is not None
+                else route["line"]
+            )
+            if (
+                not is_external
+                and (route["method"], segment) not in client_set
+            ):
+                findings.append(
+                    Finding(
+                        file=route["sf"].rel,
+                        line=route["line"],
+                        col=route["col"],
+                        rule="GC1101",
+                        message=(
+                            f"route {route['method']} "
+                            f"{route['path']} has no rpc-client "
+                            "caller in the package (orphan "
+                            "endpoint)"
+                        ),
+                        hint=(
+                            "add the client (via adaptdl_tpu.rpc), "
+                            "or declare the route in "
+                            "wire.EXTERNAL_ROUTES if an external "
+                            "actor calls it"
+                        ),
+                    )
+                )
+            if (
+                not is_external
+                and route["method"] in ("PUT", "POST")
+                # An unresolved handler is unknown, never safe — the
+                # finding lands at the route registration instead.
+                and (
+                    handler is None
+                    or not IDEMPOTENT_RE.search(
+                        handler_sf.def_header_comment(handler.node)
+                    )
+                )
+            ):
+                findings.append(
+                    Finding(
+                        file=handler_sf.rel,
+                        line=handler_line,
+                        col=(
+                            handler.node.col_offset
+                            if handler is not None
+                            else route["col"]
+                        ),
+                        rule="GC1103",
+                        message=(
+                            "handler "
+                            + (
+                                repr(handler.name)
+                                if handler is not None
+                                else "(unresolved)"
+                            )
+                            + f" for {route['method']} "
+                            f"{route['path']} is retried by the rpc "
+                            "client but carries no # idempotent "
+                            "annotation"
+                        ),
+                        hint=(
+                            "annotate the def with `# idempotent` "
+                            "or `# idempotent: keyed-by=<field>` "
+                            "(and make it true)"
+                        ),
+                    )
+                )
+            if segment not in fault_exempt and catalog is not None:
+                points = self._handler_fault_points(route)
+                if not points & catalog:
+                    findings.append(
+                        Finding(
+                            file=handler_sf.rel,
+                            line=handler_line,
+                            col=(
+                                handler.node.col_offset
+                                if handler is not None
+                                else route["col"]
+                            ),
+                            rule="GC1104",
+                            message=(
+                                f"handler for {route['method']} "
+                                f"{route['path']} reaches no "
+                                "registered fault-injection point "
+                                "— the chaos suite cannot exercise "
+                                "this route's failure path"
+                            ),
+                            hint=(
+                                "route it through a registered "
+                                "point (e.g. a @_faultable(...) "
+                                "decorator) and catalog the name "
+                                "in faults.INJECTION_POINTS"
+                            ),
+                        )
+                    )
+            if (
+                doc_lines is not None
+                and route["sf"].rel.replace(os.sep, "/")
+                in config["documented"]
+                and (route["method"], route["path"]) not in documented
+            ):
+                findings.append(
+                    Finding(
+                        file=route["sf"].rel,
+                        line=route["line"],
+                        col=route["col"],
+                        rule="GC1105",
+                        message=(
+                            f"route {route['method']} "
+                            f"{route['path']} has no row in "
+                            f"{doc_rel}"
+                        ),
+                        hint=(
+                            "document the endpoint (method, path, "
+                            "payload keys, idempotency, fault "
+                            "point)"
+                        ),
+                    )
+                )
+
+        for call in clients:
+            if (call["method"], call["segment"]) not in route_set:
+                findings.append(
+                    Finding(
+                        file=call["sf"].rel,
+                        line=call["line"],
+                        col=call["col"],
+                        rule="GC1102",
+                        message=(
+                            f"client {call['method']} call targets "
+                            f"path segment /{call['segment']}, "
+                            "which no registered route serves"
+                        ),
+                        hint=(
+                            "fix the path (or register the route "
+                            "in the server's build_app)"
+                        ),
+                    )
+                )
+
+        # Stale doc rows: only judged when every documented server's
+        # route table is in view.
+        analyzed = {
+            sf.rel.replace(os.sep, "/") for sf in program.files
+        }
+        if doc_lines is not None and config["documented"] <= analyzed:
+            all_routes = {
+                (r["method"], r["path"]) for r in routes
+            }
+            for method, path, lineno in doc_rows:
+                if (method, path) not in all_routes:
+                    findings.append(
+                        Finding(
+                            file=doc_rel,
+                            line=lineno,
+                            col=0,
+                            rule="GC1106",
+                            message=(
+                                f"documented route {method} {path} "
+                                "matches no registered route"
+                            ),
+                            hint=(
+                                "remove the stale row or fix the "
+                                "method/path to match build_app"
+                            ),
+                        )
+                    )
+        return findings

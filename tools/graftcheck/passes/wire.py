@@ -1,0 +1,413 @@
+"""GC10xx — cross-process wire contracts.
+
+Every control-plane boundary in this system is a stringly-typed dict
+(sched hints, the ``/config`` body, journal ops, checkpoint/handoff
+manifests, heartbeat/preempt bodies, watch/explain records), and the
+worst shipped bugs were contract drift across those boundaries. The
+contract is declared ONCE, as plain literals, in
+``adaptdl_tpu/wire.py`` (:data:`WIRE_CONTRACTS`); producer/consumer
+functions carry ``# wire: produces=<family>`` / ``# wire:
+consumes=<family>`` annotations, and this pass compares the constant
+dict keys they touch (the whole-program payload-flow layer,
+:meth:`Program.payload_accesses`) against the declaration:
+
+- **GC1001** — a producer writes a key its declared families do not
+  contain: spelling drift (or an undeclared schema extension) caught
+  at the write.
+- **GC1002** — a consumer reads a key its declared families do not
+  contain: the misspelled-consumer-key bug caught at the exact line,
+  instead of as a silent ``None`` in production.
+- **GC1003** — a declared key no annotated producer ever writes, or
+  no annotated consumer ever reads (reported at the declaration):
+  the contract and the code disagree about what is on the wire.
+- **GC1004** — a consumer of a *persisted* family (journal records,
+  snapshots, checkpoint/handoff manifests) subscripts a
+  version-optional key without a ``.get`` default or ``"k" in d``
+  guard: replaying a pre-upgrade journal or loading a cross-version
+  checkpoint chain would raise ``KeyError``. Keys listed in the
+  family's ``required`` tuple (present since v1) may be subscripted.
+
+Unknown family names in an annotation are GC1001/GC1002 findings at
+the def — a typo'd family would otherwise silence every check on the
+function.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.graftcheck.core import (
+    Context,
+    Finding,
+    Pass,
+    SourceFile,
+)
+
+_ABSENCE_SAFE = ("get", "contains")
+
+
+def _load_contracts(path: str) -> dict | None:
+    """WIRE_CONTRACTS parsed statically from the wire module: family
+    -> {"keys": {key: lineno}, "required": set, "persisted": bool,
+    "unchecked": set, "open_producers": bool, "open_consumers": bool}.
+    None when the module (or the literal) cannot be found."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "WIRE_CONTRACTS"
+            for t in node.targets
+        ):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return None
+        contracts: dict[str, dict] = {}
+        for fam_key, fam_value in zip(
+            node.value.keys, node.value.values
+        ):
+            if not (
+                isinstance(fam_key, ast.Constant)
+                and isinstance(fam_key.value, str)
+                and isinstance(fam_value, ast.Dict)
+            ):
+                continue
+            spec: dict = {
+                "keys": {},
+                "required": set(),
+                "unchecked": set(),
+                "persisted": False,
+                "open_producers": False,
+                "open_consumers": False,
+                "line": fam_key.lineno,
+            }
+            for field, value in zip(
+                fam_value.keys, fam_value.values
+            ):
+                if not (
+                    isinstance(field, ast.Constant)
+                    and isinstance(field.value, str)
+                ):
+                    continue
+                name = field.value
+                if name == "keys" and isinstance(
+                    value, (ast.Tuple, ast.List)
+                ):
+                    for elt in value.elts:
+                        if isinstance(
+                            elt, ast.Constant
+                        ) and isinstance(elt.value, str):
+                            spec["keys"][elt.value] = elt.lineno
+                elif name in ("required", "unchecked") and isinstance(
+                    value, (ast.Tuple, ast.List)
+                ):
+                    spec[name] = {
+                        elt.value
+                        for elt in value.elts
+                        if isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, str)
+                    }
+                elif name in (
+                    "persisted",
+                    "open_producers",
+                    "open_consumers",
+                ) and isinstance(value, ast.Constant):
+                    spec[name] = bool(value.value)
+            contracts[fam_key.value] = spec
+        return contracts
+    return None
+
+
+class WireContractPass(Pass):
+    name = "wire-contract"
+    whole_program = True
+    rules = {
+        "GC1001": (
+            "producer writes a key outside its declared wire families"
+        ),
+        "GC1002": (
+            "consumer reads a key outside its declared wire families"
+        ),
+        "GC1003": (
+            "declared wire key never produced or never consumed"
+        ),
+        "GC1004": (
+            "defaultless subscript of a version-optional key on a "
+            "persisted record"
+        ),
+    }
+
+    def __init__(self):
+        # (path, mtime, size) -> contracts, like FaultRpcPass.
+        self._contract_cache: dict[tuple, dict | None] = {}
+
+    def _wire_module(self, ctx: Context) -> str:
+        return os.path.join(
+            ctx.root,
+            ctx.options.get("wire_module", "adaptdl_tpu/wire.py"),
+        )
+
+    def cache_inputs(self, ctx: Context) -> list[str]:
+        """Every file's cached findings depend on the declared
+        contract: an edited wire.py must refresh --fast results even
+        when the wire module itself is outside the analyzed paths."""
+        return [self._wire_module(ctx)]
+
+    def _contracts(self, ctx: Context) -> dict | None:
+        path = self._wire_module(ctx)
+        try:
+            stat = os.stat(path)
+        except OSError:
+            return None
+        key = (path, stat.st_mtime, stat.st_size)
+        if key not in self._contract_cache:
+            self._contract_cache.clear()
+            self._contract_cache[key] = _load_contracts(path)
+        return self._contract_cache[key]
+
+    def check_program(self, program, ctx: Context) -> list[Finding]:
+        contracts = self._contracts(ctx)
+        if not contracts:
+            return []
+        findings: list[Finding] = []
+        # family -> set of keys actually written / read by annotated
+        # functions anywhere in the program (for GC1003 coverage).
+        produced: dict[str, set[str]] = {
+            fam: set() for fam in contracts
+        }
+        consumed: dict[str, set[str]] = {
+            fam: set() for fam in contracts
+        }
+        wire_rel = os.path.relpath(
+            self._wire_module(ctx), ctx.root
+        ).replace(os.sep, "/")
+
+        for info in program.functions.values():
+            fams_p, fams_c = program.wire_families(info)
+            if not fams_p and not fams_c:
+                continue
+            for fam in sorted((fams_p | fams_c) - set(contracts)):
+                findings.append(
+                    Finding(
+                        file=info.sf.rel,
+                        line=info.node.lineno,
+                        col=info.node.col_offset,
+                        rule=(
+                            "GC1001" if fam in fams_p else "GC1002"
+                        ),
+                        message=(
+                            f"function {info.name!r} names wire "
+                            f"family {fam!r}, which "
+                            f"{wire_rel} does not declare"
+                        ),
+                        hint=(
+                            "declare the family in WIRE_CONTRACTS "
+                            "or fix the annotation"
+                        ),
+                    )
+                )
+            fams_p &= set(contracts)
+            fams_c &= set(contracts)
+            if not fams_p and not fams_c:
+                continue
+            legal_w = {
+                key
+                for fam in fams_p
+                for key in contracts[fam]["keys"]
+            }
+            legal_r = {
+                key
+                for fam in fams_c
+                for key in contracts[fam]["keys"]
+            }
+            accesses = program.payload_accesses(info)
+            # Absence-aware reads, keyed by (receiver, key): only a
+            # .get/in on the SAME record may vouch for a defaultless
+            # subscript — a same-named key on a different dict can't.
+            # Expression receivers (`(body or {}).get(...)`) have no
+            # dotted text and vouch for the key on any receiver.
+            safe_pairs = {
+                (a.receiver, a.key)
+                for a in accesses
+                if a.mode in _ABSENCE_SAFE
+            }
+            safe_any = {
+                key for recv, key in safe_pairs if recv is None
+            }
+
+            def absence_safe(access) -> bool:
+                return (
+                    (access.receiver, access.key) in safe_pairs
+                    or access.key in safe_any
+                )
+            for access in accesses:
+                if access.mode == "write":
+                    if not fams_p:
+                        continue
+                    for fam in fams_p:
+                        if access.key in contracts[fam]["keys"]:
+                            produced[fam].add(access.key)
+                    if access.key not in legal_w:
+                        findings.append(
+                            Finding(
+                                file=info.sf.rel,
+                                line=access.line,
+                                col=access.col,
+                                rule="GC1001",
+                                message=(
+                                    f"{info.name!r} writes key "
+                                    f"{access.key!r}, not declared "
+                                    "for wire "
+                                    f"famil{'ies' if len(fams_p) > 1 else 'y'} "
+                                    f"{', '.join(sorted(fams_p))}"
+                                ),
+                                hint=(
+                                    "fix the spelling, or declare "
+                                    "the key in WIRE_CONTRACTS "
+                                    f"({wire_rel})"
+                                ),
+                            )
+                        )
+                    continue
+                # reads (subscript / get / contains)
+                if not fams_c:
+                    continue
+                for fam in fams_c:
+                    if access.key in contracts[fam]["keys"]:
+                        consumed[fam].add(access.key)
+                if access.key not in legal_r:
+                    findings.append(
+                        Finding(
+                            file=info.sf.rel,
+                            line=access.line,
+                            col=access.col,
+                            rule="GC1002",
+                            message=(
+                                f"{info.name!r} reads key "
+                                f"{access.key!r}, not declared for "
+                                "wire "
+                                f"famil{'ies' if len(fams_c) > 1 else 'y'} "
+                                f"{', '.join(sorted(fams_c))} — no "
+                                "producer writes it"
+                            ),
+                            hint=(
+                                "fix the spelling, or declare the "
+                                "key in WIRE_CONTRACTS "
+                                f"({wire_rel})"
+                            ),
+                        )
+                    )
+                elif access.mode == "subscript":
+                    # Persisted-record compat: subscripting a
+                    # version-optional key breaks replay of
+                    # pre-upgrade journals / cross-version chains.
+                    # A key that ANY consumed family declares safe
+                    # (non-persisted, or required-since-v1) passes.
+                    containing = [
+                        f
+                        for f in sorted(fams_c)
+                        if access.key in contracts[f]["keys"]
+                    ]
+                    fam = containing[0] if containing else None
+                    if (
+                        containing
+                        and all(
+                            contracts[f]["persisted"]
+                            and access.key
+                            not in contracts[f]["required"]
+                            for f in containing
+                        )
+                        and not absence_safe(access)
+                    ):
+                        findings.append(
+                            Finding(
+                                file=info.sf.rel,
+                                line=access.line,
+                                col=access.col,
+                                rule="GC1004",
+                                message=(
+                                    f"{info.name!r} subscripts "
+                                    f"version-optional key "
+                                    f"{access.key!r} of persisted "
+                                    f"family {fam!r} without a "
+                                    "default — replaying a "
+                                    "pre-upgrade record raises "
+                                    "KeyError"
+                                ),
+                                hint=(
+                                    'read it with .get("'
+                                    + access.key
+                                    + '", ...) or guard with "'
+                                    + access.key
+                                    + '" in — or add it to the '
+                                    "family's required tuple if "
+                                    "every version ever written "
+                                    "carries it"
+                                ),
+                            )
+                        )
+
+        # GC1003: contract/code coverage, at the declaration line.
+        # Coverage is only meaningful over the WHOLE program — when
+        # the wire module itself is not in the analyzed set (single
+        # files, fixtures), producers/consumers are legitimately out
+        # of view and only the exact-line checks above apply.
+        analyzed = {
+            sf.rel.replace(os.sep, "/") for sf in program.files
+        }
+        if wire_rel not in analyzed:
+            return findings
+        for fam, spec in sorted(contracts.items()):
+            for key, line in sorted(spec["keys"].items()):
+                if key in spec["unchecked"]:
+                    continue
+                if (
+                    not spec["open_producers"]
+                    and key not in produced[fam]
+                ):
+                    findings.append(
+                        Finding(
+                            file=wire_rel,
+                            line=line,
+                            col=0,
+                            rule="GC1003",
+                            message=(
+                                f"wire key {fam}.{key} is declared "
+                                "but no `# wire: produces` function "
+                                "writes it"
+                            ),
+                            hint=(
+                                "remove the dead key, mark it "
+                                "unchecked (external producer), or "
+                                "annotate the producer"
+                            ),
+                        )
+                    )
+                if (
+                    not spec["open_consumers"]
+                    and key not in consumed[fam]
+                ):
+                    findings.append(
+                        Finding(
+                            file=wire_rel,
+                            line=line,
+                            col=0,
+                            rule="GC1003",
+                            message=(
+                                f"wire key {fam}.{key} is declared "
+                                "but no `# wire: consumes` function "
+                                "reads it"
+                            ),
+                            hint=(
+                                "remove the dead key, mark it "
+                                "unchecked (external consumer), or "
+                                "annotate the consumer"
+                            ),
+                        )
+                    )
+        return findings

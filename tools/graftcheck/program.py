@@ -14,7 +14,13 @@ passes a package-wide view, still ast-only and pure stdlib:
   wrappers);
 - a **lock-set dataflow**: the set of locks *provably held on entry*
   to each function, computed as a fixpoint over the call graph from
-  lexical ``with <lock>:`` scopes and ``# holds-lock:`` annotations.
+  lexical ``with <lock>:`` scopes and ``# holds-lock:`` annotations;
+- a **payload-flow layer**: for functions annotated ``# wire:
+  produces=<family>`` / ``# wire: consumes=<family>``, the constant
+  dict keys written/read in the function and its same-file helpers
+  (:meth:`Program.payload_accesses`) — what the GC10xx wire-contract
+  pass compares against the families declared in
+  ``adaptdl_tpu/wire.py``.
 
 What resolution deliberately does NOT do (and the passes must treat
 as "unknown", never "safe"): dynamic dispatch through non-``self``
@@ -28,10 +34,12 @@ invent one.
 from __future__ import annotations
 
 import ast
+import re
 from dataclasses import dataclass, field
 
 from tools.graftcheck.core import (
     HOLDS_LOCK_RE,
+    WIRE_RE,
     SourceFile,
     dotted_name,
 )
@@ -117,6 +125,200 @@ class FunctionInfo:
     escapes: bool = False
 
 
+@dataclass(frozen=True)
+class KeyAccess:
+    """One constant-string dict-key touch inside a payload function.
+
+    ``mode`` is how the key was touched:
+
+    - ``"write"`` — dict-literal key, ``d["k"] = v``, ``setdefault``;
+    - ``"subscript"`` — defaultless ``d["k"]`` / single-arg ``pop``
+      read (raises ``KeyError`` when the key is absent);
+    - ``"get"`` — ``d.get("k"[, default])`` / ``pop`` with default
+      (absence-safe);
+    - ``"contains"`` — ``"k" in d`` membership probe (absence-aware
+      by construction).
+
+    ``receiver`` is the dotted text of the dict expression (``op``,
+    ``record.spec``), or None for dict-literal keys and
+    non-name-chain receivers — GC1004 uses it so an absence-safe
+    read of a same-named key on a DIFFERENT record cannot vouch for
+    a defaultless subscript.
+    """
+
+    key: str
+    line: int
+    col: int
+    mode: str
+    receiver: str | None = None
+
+
+# Accessors whose string subscripts are URL/transport parameters or
+# process environment, not payload keys: the route table (GC11xx) and
+# the env registry (GC3xx) own those contracts.
+_PARAM_ACCESSORS = {
+    "match_info",
+    "query",
+    "headers",
+    "environ",
+    "rel_url",
+}
+
+
+_KEYISH_RE = re.compile(r"^[A-Za-z_][\w.-]*$")
+
+
+def _receiver_is_params(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    return (
+        name is not None
+        and name.rsplit(".", 1)[-1] in _PARAM_ACCESSORS
+    )
+
+
+def _const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _function_key_accesses(info: FunctionInfo) -> list[KeyAccess]:
+    """Constant-string dict accesses in one function's subtree.
+    Closures are included (they are the function's implementation);
+    nested defs carrying their OWN wire annotation are skipped —
+    their keys belong to their own declared families."""
+    sf = info.sf
+    out: list[KeyAccess] = []
+    # Dict literals passed as `params=`/`headers=` keyword arguments
+    # are URL/transport parameters (query strings, HTTP headers), not
+    # payload bodies — the route table owns that contract.
+    transport_dicts: set[int] = set()
+    # Span-attribute dicts: their content is the trace family's
+    # deliberately-open `attrs` payload, keyed per call site — not a
+    # declarable contract. Two binding forms: `with trace.span(...)
+    # as attrs`, and a parameter following the `*attrs` naming
+    # convention (a traced helper handed its caller's span dict).
+    span_attr_names: set[str] = {
+        arg.arg
+        for arg in (
+            info.node.args.args
+            + info.node.args.posonlyargs
+            + info.node.args.kwonlyargs
+        )
+        if arg.arg == "attrs" or arg.arg.endswith("_attrs")
+    }
+    for node in ast.walk(info.node):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            expr = item.context_expr
+            if not (
+                isinstance(expr, ast.Call)
+                and isinstance(item.optional_vars, ast.Name)
+            ):
+                continue
+            name = dotted_name(expr.func)
+            if name and name.rsplit(".", 1)[-1] == "span":
+                span_attr_names.add(item.optional_vars.id)
+    stack = list(ast.iter_child_nodes(info.node))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) and WIRE_RE.search(sf.def_header_comment(node)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg in ("params", "headers") and isinstance(
+                    kw.value, ast.Dict
+                ):
+                    transport_dicts.add(id(kw.value))
+        if isinstance(node, ast.Dict):
+            if id(node) in transport_dicts:
+                continue
+            for key in node.keys:
+                value = _const_str(key)
+                if value is not None:
+                    out.append(
+                        KeyAccess(
+                            value, key.lineno, key.col_offset, "write"
+                        )
+                    )
+        elif isinstance(node, ast.Subscript):
+            value = _const_str(node.slice)
+            if value is None or _receiver_is_params(node.value):
+                continue
+            if (
+                isinstance(node.value, ast.Name)
+                and node.value.id in span_attr_names
+            ):
+                continue
+            if isinstance(node.ctx, ast.Store):
+                mode = "write"
+            elif isinstance(node.ctx, ast.Load):
+                mode = "subscript"
+            else:
+                continue
+            out.append(
+                KeyAccess(
+                    value,
+                    node.lineno,
+                    node.col_offset,
+                    mode,
+                    dotted_name(node.value),
+                )
+            )
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            method = node.func.attr
+            if method not in ("get", "pop", "setdefault"):
+                continue
+            if not node.args or _receiver_is_params(node.func.value):
+                continue
+            value = _const_str(node.args[0])
+            if value is None:
+                continue
+            if method == "setdefault":
+                mode = "write"
+            elif method == "get" or len(node.args) > 1:
+                mode = "get"
+            else:
+                mode = "subscript"  # pop without default raises
+            out.append(
+                KeyAccess(
+                    value,
+                    node.lineno,
+                    node.col_offset,
+                    mode,
+                    dotted_name(node.func.value),
+                )
+            )
+        elif isinstance(node, ast.Compare):
+            if len(node.ops) == 1 and isinstance(
+                node.ops[0], (ast.In, ast.NotIn)
+            ):
+                value = _const_str(node.left)
+                # Only identifier-shaped constants count — `"/" in
+                # key` is substring containment, not a key probe.
+                if (
+                    value is not None
+                    and _KEYISH_RE.match(value)
+                    and not _receiver_is_params(node.comparators[0])
+                ):
+                    out.append(
+                        KeyAccess(
+                            value,
+                            node.left.lineno,
+                            node.left.col_offset,
+                            "contains",
+                            dotted_name(node.comparators[0]),
+                        )
+                    )
+    return out
+
+
 def _module_key(sf: SourceFile) -> str:
     """Import-style module name for a SourceFile, derived from its
     analysis-relative path (``adaptdl_tpu/sched/state.py`` ->
@@ -166,6 +368,7 @@ class Program:
         # filled at index time so bare-name resolution never walks.
         self._nested: dict[ast.AST, dict[str, FunctionInfo]] = {}
         self._resolve_memo: dict[tuple, FunctionInfo | None] = {}
+        self._payload_memo: dict[str, list[KeyAccess]] = {}
         for sf in self.files:
             self.modules[_module_key(sf)] = sf
         for sf in self.files:
@@ -565,6 +768,80 @@ class Program:
             info.entry_locks = (
                 frozenset() if resolved is None else resolved
             )
+
+    # -- payload flow (wire-contract support, GC10xx) ------------------
+
+    def wire_families(
+        self, info: FunctionInfo
+    ) -> tuple[frozenset[str], frozenset[str]]:
+        """(produced, consumed) payload families from the def's
+        ``# wire: produces=`` / ``# wire: consumes=`` annotations."""
+        produces: set[str] = set()
+        consumes: set[str] = set()
+        for verb, families in WIRE_RE.findall(
+            info.sf.def_header_comment(info.node)
+        ):
+            names = {
+                name.strip()
+                for name in families.split(",")
+                if name.strip()
+            }
+            (produces if verb == "produces" else consumes).update(
+                names
+            )
+        return frozenset(produces), frozenset(consumes)
+
+    def payload_accesses(
+        self, info: FunctionInfo
+    ) -> list["KeyAccess"]:
+        """Every constant-string dict key the function touches —
+        the payload-flow substrate of the GC10xx wire-contract pass.
+
+        Collection covers the annotated function's whole subtree
+        (closures are its implementation, exactly as the journal
+        pass treats them) plus helpers reachable over resolved call
+        edges **in the same file**; traversal stops at functions that
+        carry their OWN wire annotation (their keys belong to their
+        own declared families, not the caller's). Reads through
+        request/framework accessors (``match_info``, ``query``,
+        ``headers``, ``environ``) are URL/transport parameters, not
+        payload keys, and are skipped.
+        """
+        if info.qualname not in self._payload_memo:
+            self._payload_memo[info.qualname] = (
+                self._collect_payload_accesses(info)
+            )
+        return self._payload_memo[info.qualname]
+
+    def _collect_payload_accesses(
+        self, root: FunctionInfo
+    ) -> list["KeyAccess"]:
+        accesses: list[KeyAccess] = []
+        seen = {root.qualname}
+        queue = [root]
+        while queue:
+            info = queue.pop()
+            accesses.extend(_function_key_accesses(info))
+            decorators = tuple(
+                getattr(info.node, "decorator_list", ())
+            )
+            for site in info.call_sites:
+                callee = site.callee
+                if (
+                    callee is None
+                    or callee.qualname in seen
+                    or callee.sf is not info.sf
+                    # Decorator applications run at def time, not as
+                    # part of the function's payload logic.
+                    or site.node in decorators
+                ):
+                    continue
+                produces, consumes = self.wire_families(callee)
+                if produces or consumes:
+                    continue  # its keys belong to its own families
+                seen.add(callee.qualname)
+                queue.append(callee)
+        return accesses
 
     # -- reachability helpers ------------------------------------------
 
