@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched chaos-preempt trace-gate rescale-fast meshgate simgate watchgate bench-sched probe
+.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched chaos-preempt trace-gate rescale-fast meshgate simgate watchgate warmgate bench-sched probe
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -113,6 +113,19 @@ simgate:
 watchgate:
 	$(CPU_ENV) $(PY) -m pytest tests/test_watch.py \
 	    tests/test_watchgate.py -q --durations=5
+
+# Zero-downtime-rescale gate (docs/scheduler.md "Speculative
+# warm-up", docs/checkpointing.md "Differential shard encoding"): a
+# fixed-seed planned rescale with warm-up ON must cut over to the
+# pre-warmed successor with steps_lost == 0 and ZERO ckpt.restore
+# storage spans (pure differential peer-pull), the differential pull
+# must move strictly fewer bytes than a full pull, and every
+# speculation failure (spawn fault, successor killed mid-warm-up,
+# mispredicted/rolled-back candidate, incumbent crash before cutover)
+# must fall back loss-equal to the cold planned path.
+warmgate:
+	$(CPU_ENV) ADAPTDL_FAULT_SEED=1234 $(PY) -m pytest \
+	    tests/test_warm_rescale.py -q --durations=10
 
 # Thousand-job control-plane bench standalone (bench.py also merges
 # these keys into the BENCH json): allocator decide p50/p99 at 1k

@@ -1028,6 +1028,19 @@ def load_state(state: State) -> bool:
     root = env.checkpoint_path()
     if root is None:
         return False
+    # Speculative warm-up hold point: in a warm successor
+    # (ADAPTDL_WARMUP=1) everything above this line — imports, jax
+    # init, trainer build, AOT compile — ran while the incumbent was
+    # still training. maybe_hold() prefetches the peer's chunks into
+    # the differential cache, marks the process ready, and blocks
+    # until the runner cuts traffic over (or exits gracefully on a
+    # discard); a normal launch falls straight through.
+    try:
+        from adaptdl_tpu.sched import warmup as warmup_mod
+
+        warmup_mod.maybe_hold()
+    except ImportError:  # pragma: no cover - minimal installs
+        pass
     # Planned-rescale fast path FIRST, before joining any in-flight
     # background write: the peer's chunks are snapshot no earlier
     # than that write's own snapshot phase, so serving them cannot

@@ -261,6 +261,71 @@ def handoff_part_min_bytes() -> int:
     return max(_get_int("ADAPTDL_HANDOFF_PART_MIN_BYTES", 65536), 0)
 
 
+def handoff_diff_enabled() -> bool:
+    """Whether handoff pulls are *differential*: chunks whose content
+    hash already sits in the warm-up prefetch cache are reused instead
+    of re-fetched, so a warm successor pulls only the shards that
+    changed between its prefetch and the incumbent's final drain
+    snapshot. Default ON — a sha mismatch simply re-fetches, so the
+    restored bytes are identical either way; the knob exists to pin
+    the full-pull behavior in benchmarks and bisections."""
+    knob = os.environ.get("ADAPTDL_HANDOFF_DIFF", "on")
+    return knob.lower() in ("on", "1", "true", "yes")
+
+
+def sharded_hash_enabled() -> bool:
+    """Whether sharded (orbax-backed) saves hash each addressable
+    shard and record a per-save ``shard_delta`` (changed shards /
+    bytes vs the previous save) in the checkpoint pointer. Default ON;
+    the hash pass is one host transfer of the state per save — turn
+    off for jobs where that dominates the save path. Accounting only:
+    restores never depend on the hash sidecar."""
+    knob = os.environ.get("ADAPTDL_SHARDED_HASHES", "on")
+    return knob.lower() in ("on", "1", "true", "yes")
+
+
+def warmup_enabled() -> bool:
+    """Whether the runners speculatively warm a successor for a
+    planned rescale: when the allocator's published candidate matches
+    the drifted launch config, the successor process is spawned —
+    imports, jax init, AOT compile, differential shard prefetch —
+    BEFORE the incumbent is signalled, and the commit epoch only cuts
+    traffic over. Default OFF (unset/empty): any warm-up failure or a
+    mispredicted candidate falls back to the cold planned path."""
+    knob = os.environ.get("ADAPTDL_WARMUP_ENABLED", "")
+    return knob.lower() in ("on", "1", "true", "yes")
+
+
+def warmup_flag() -> bool:
+    """Set by the runner IN the warm successor's environment
+    (``ADAPTDL_WARMUP=1``): tells the job process it is a speculative
+    warm-up — it must prepare (build, compile, prefetch), mark the
+    ready file, and hold before restoring state until the runner
+    writes the cutover file."""
+    knob = os.environ.get("ADAPTDL_WARMUP", "")
+    return knob.lower() in ("on", "1", "true", "yes")
+
+
+def warmup_ready_file() -> str | None:
+    """Path the warm successor touches once warm (runner-provided);
+    the runner waits for it before signalling the incumbent."""
+    return _get_str("ADAPTDL_WARMUP_READY_FILE")
+
+
+def warmup_cutover_file() -> str | None:
+    """Path the runner writes at cutover (``go``) or discard
+    (``abort``); the held warm successor polls it to proceed or exit."""
+    return _get_str("ADAPTDL_WARMUP_CUTOVER_FILE")
+
+
+def warmup_deadline_s() -> float:
+    """Longest the runner waits for a warm successor to mark itself
+    ready before discarding it and rescaling cold — warm-up must never
+    delay a rescale by more than it saves. Also bounds how long a held
+    successor waits for the cutover file before exiting."""
+    return max(_get_float("ADAPTDL_WARMUP_DEADLINE_S", 20.0), 0.1)
+
+
 def supervisor_url() -> str | None:
     """Base URL of the cluster supervisor (rendezvous + sched hints)."""
     return _get_str("ADAPTDL_SUPERVISOR_URL")

@@ -82,6 +82,7 @@ INJECTION_POINTS = {
     "sup.explain.pre": "decision-provenance handler (graftwatch)",
     "sup.handoff.pre": "handoff advertisement intake handler",
     "sup.handoff.get.pre": "handoff discovery handler",
+    "sup.candidate.pre": "candidate-allocation readback handler",
     "sup.status.pre": "operator status snapshot handler",
     "sup.metrics.pre": "prometheus exposition handler",
     # admission webhook (sched.validator; injected faults become 500s,
@@ -94,6 +95,11 @@ INJECTION_POINTS = {
     # worker lifecycle backends (sched.local_runner / sched.multi_runner)
     "runner.launch.pre": "before a worker subprocess launch",
     "runner.supervise.poll": "each supervision poll cycle",
+    # speculative warm-up (sched.warmup + handoff warm prefetch; a
+    # fault at any point falls back to the cold planned-rescale path)
+    "warmup.spawn": "before a warm successor subprocess is spawned",
+    "warmup.prefetch": "warm successor's differential chunk prefetch",
+    "warmup.cutover": "before a warm successor adopts at cutover",
     # durable cluster state (sched.journal / sched.state)
     "sched.journal_write": "before a journal record is written+fsynced",
     "sched.snapshot_write": "before a state snapshot is written",

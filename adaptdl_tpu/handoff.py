@@ -515,8 +515,15 @@ _manifest: dict | None = None  # guarded-by: _manifest_lock
 _manifest_url: str | None = None  # guarded-by: _manifest_lock
 _peer_topology: list | None = None  # guarded-by: _manifest_lock
 _unavailable = False  # guarded-by: _manifest_lock (sticky failure)
-_fetch_stats = {"bytes": 0, "seconds": 0.0}
+_fetch_stats = {"bytes": 0, "seconds": 0.0, "reused": 0}
 _states_applied: set[str] = set()
+# Speculative warm-up chunk cache: ``{state: {chunk_id: (sha, bytes)}}``
+# filled by ``warm_prefetch`` BEFORE the incumbent's final drain. The
+# restore path reuses a cached chunk only when its sha still matches
+# the (final) manifest — so the differential pull moves exactly the
+# chunks that changed between prefetch and drain, and a stale or
+# mispredicted cache degrades to the full pull bit-identically.
+_warm_cache: dict[str, dict[str, tuple[str, bytes]]] = {}  # guarded-by: _manifest_lock
 
 
 def _reset_client_state() -> None:
@@ -530,9 +537,29 @@ def _reset_client_state() -> None:
         _manifest_url = None
         _peer_topology = None
         _unavailable = False
+        _warm_cache.clear()
     _fetch_stats["bytes"] = 0
     _fetch_stats["seconds"] = 0.0
+    _fetch_stats["reused"] = 0
     _states_applied.clear()
+
+
+def _warm_chunks(name: str, sha_table: dict) -> dict[str, bytes]:
+    """The warm-cache chunks for ``name`` whose content hash still
+    matches the authoritative manifest's — exactly the chunks a
+    differential pull may skip. Empty when differential pulls are
+    disabled or nothing was prefetched."""
+    if not env.handoff_diff_enabled():
+        return {}
+    with _manifest_lock:
+        cached = _warm_cache.get(name)
+        if not cached:
+            return {}
+        return {
+            cid: data
+            for cid, (sha, data) in cached.items()
+            if sha is not None and sha == sha_table.get(cid)
+        }
 
 
 def peer_topology() -> list | None:
@@ -641,49 +668,64 @@ def _fetch_manifest(  # wire: consumes=handoff_manifest
 
 def _fetch_state_chunks(  # wire: consumes=handoff_manifest
     url: str, name: str, entry: dict, deadline: float
-) -> list[tuple[str, bytes]]:
+) -> tuple[list[tuple[str, bytes]], int, int]:
     """Pull one state's chunks, sha256-verifying each against the
-    manifest table. Tries the bulk ``/state`` form first (one
+    manifest table; returns ``(chunks, fetched_bytes, reused_bytes)``.
+    Chunks whose content hash already sits in the warm-up cache are
+    reused without touching the network (the differential pull); when
+    nothing is cached the bulk ``/state`` form is tried first (one
     round-trip for the whole container — the full-pull common case),
-    then falls back to per-chunk ``/chunk`` fetches. Raises on any
-    mismatch, timeout, or server error — the caller treats every
-    raise as "fall back to storage"."""
+    then per-chunk ``/chunk`` fetches. Raises on any mismatch,
+    timeout, or server error — the caller treats every raise as
+    "fall back to storage"."""
     client = rpc.default_client()
     sha_table = entry.get("sha") or {}
-    remaining = deadline - time.monotonic()
-    if remaining <= 0:
-        raise TimeoutError("handoff fetch deadline exceeded")
-    faults.maybe_fail("handoff.fetch")
-    try:
-        response = client.get(
-            f"{url}/state/{name}",
-            endpoint=f"handoff/state/{name}",
-            timeout=(2, max(remaining, 0.1)),
-            attempts=2,
-            deadline=remaining,
-            use_circuit=False,
-        )
-    except rpc.RpcError:
-        response = None  # try the per-chunk form below
-    if response is not None and response.status_code == 200:
-        container = pickle.loads(response.content)
-        chunks = container.get("chunks") or {}
-        assembled = []
-        for cid in entry["order"]:
-            data = chunks.get(cid)
-            if data is None:
-                raise RuntimeError(
-                    f"handoff bulk fetch of {name} is missing "
-                    f"chunk {cid!r}"
-                )
-            if checkpoint._chunk_sha(data) != sha_table.get(cid):
-                raise ValueError(
-                    f"handoff chunk {name}/{cid} failed sha256"
-                )
-            assembled.append((cid, data))
-        return assembled
+    cached = _warm_chunks(name, sha_table)
+    reused = 0
+    if not cached:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError("handoff fetch deadline exceeded")
+        faults.maybe_fail("handoff.fetch")
+        try:
+            response = client.get(
+                f"{url}/state/{name}",
+                endpoint=f"handoff/state/{name}",
+                timeout=(2, max(remaining, 0.1)),
+                attempts=2,
+                deadline=remaining,
+                use_circuit=False,
+            )
+        except rpc.RpcError:
+            response = None  # try the per-chunk form below
+        if response is not None and response.status_code == 200:
+            container = pickle.loads(response.content)
+            chunks = container.get("chunks") or {}
+            assembled = []
+            for cid in entry["order"]:
+                data = chunks.get(cid)
+                if data is None:
+                    raise RuntimeError(
+                        f"handoff bulk fetch of {name} is missing "
+                        f"chunk {cid!r}"
+                    )
+                if checkpoint._chunk_sha(data) != sha_table.get(cid):
+                    raise ValueError(
+                        f"handoff chunk {name}/{cid} failed sha256"
+                    )
+                assembled.append((cid, data))
+            nbytes = sum(len(data) for _, data in assembled)
+            return assembled, nbytes, 0
+    # Differential (or bulk-unavailable) path: verified cache hits
+    # cost zero wire bytes; only the changed chunks are fetched.
     assembled = []
+    nbytes = 0
     for cid in entry["order"]:
+        data = cached.get(cid)
+        if data is not None:
+            reused += len(data)
+            assembled.append((cid, data))
+            continue
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             raise TimeoutError("handoff fetch deadline exceeded")
@@ -706,8 +748,9 @@ def _fetch_state_chunks(  # wire: consumes=handoff_manifest
             raise ValueError(
                 f"handoff chunk {name}/{cid} failed sha256"
             )
+        nbytes += len(data)
         assembled.append((cid, data))
-    return assembled
+    return assembled, nbytes, reused
 
 
 def _fetch_chunk(
@@ -761,15 +804,17 @@ def _normalize_plan(  # wire: consumes=handoff_manifest
 
 def _fetch_state_ranges(  # wire: consumes=handoff_manifest
     url: str, name: str, entry: dict, plan: dict, deadline: float
-) -> tuple[list, list, int]:
+) -> tuple[list, list, int, int]:
     """The shard-map-keyed pull: chunks in ``plan`` are fetched as
     the row PARTS covering the requested span (each part
     sha256-verified against the manifest's per-part table, then
     concatenated); every other chunk is fetched whole. Returns
-    ``(whole_chunks, partial, nbytes)`` where ``partial`` entries are
-    ``(chunk_id, cover_lo, cover_hi, total_rows, ndarray)`` — the
-    covering range is part-aligned, so it may extend slightly past
-    the plan's span.
+    ``(whole_chunks, partial, nbytes, reused)`` where ``partial``
+    entries are ``(chunk_id, cover_lo, cover_hi, total_rows,
+    ndarray)`` — the covering range is part-aligned, so it may extend
+    slightly past the plan's span — and ``reused`` counts bytes
+    satisfied from the warm-up cache instead of the wire (a verified
+    cache hit beats even a range pull: zero round-trips).
     Raises on any mismatch/timeout/server error (caller falls back to
     storage)."""
     import numpy as np
@@ -777,10 +822,17 @@ def _fetch_state_ranges(  # wire: consumes=handoff_manifest
     client = rpc.default_client()
     sha_table = entry.get("sha") or {}
     parts_meta = entry.get("parts") or {}
+    cached = _warm_chunks(name, sha_table)
     whole: list[tuple[str, bytes]] = []
     partial: list[tuple[str, int, int, Any]] = []
     nbytes = 0
+    reused = 0
     for cid in entry["order"]:
+        data = cached.get(cid)
+        if data is not None:
+            reused += len(data)
+            whole.append((cid, data))
+            continue
         span = plan.get(cid)
         if span is None:
             data = _fetch_chunk(client, url, name, cid, deadline)
@@ -821,7 +873,7 @@ def _fetch_state_ranges(  # wire: consumes=handoff_manifest
                 np.concatenate(pieces, axis=0),
             )
         )
-    return whole, partial, nbytes
+    return whole, partial, nbytes, reused
 
 
 def _signal_done(url: str) -> None:
@@ -908,6 +960,52 @@ def prefetch() -> bool:
     return _ensure_manifest() is not None
 
 
+def warm_prefetch(  # wire: consumes=handoff_manifest
+    url: str | None = None,
+) -> int:
+    """Speculative CHUNK prefetch for a warm successor: pull the
+    peer's current manifest and every chunk it advertises into the
+    warm cache, so the post-cutover restore only re-fetches chunks
+    whose content changed between now and the incumbent's final drain
+    snapshot. Deliberately does NOT touch the restore path's manifest
+    or its sticky-unavailable verdict — the chunks cached here are
+    provisional (the authoritative manifest is fetched fresh at
+    restore time, and every reuse is gated on a sha match against
+    it), and a failed speculation must not poison the real restore.
+    Returns the number of bytes cached (0 when nothing was
+    prefetched); best-effort — any failure leaves whatever was cached
+    so far and falls through to the full pull."""
+    if url is None:
+        url = discover_url()
+    if url is None:
+        return 0
+    total = 0
+    try:
+        faults.maybe_fail("warmup.prefetch")
+        with trace.span("warmup.prefetch") as attrs:
+            fetched = _fetch_manifest(url, env.handoff_timeout_s())
+            if fetched is None:
+                return 0
+            manifest, _ = fetched
+            deadline = time.monotonic() + env.handoff_timeout_s()
+            for name, entry in manifest.items():
+                chunks, nbytes, reused = _fetch_state_chunks(
+                    url, name, entry, deadline
+                )
+                sha_table = entry.get("sha") or {}
+                with _manifest_lock:
+                    _warm_cache[name] = {
+                        cid: (sha_table.get(cid), data)
+                        for cid, data in chunks
+                    }
+                total += nbytes + reused
+            attrs["bytes"] = total
+            attrs["states"] = len(manifest)
+    except Exception:  # noqa: BLE001 - speculation is best-effort
+        LOG.debug("warm prefetch from %s failed", url, exc_info=True)
+    return total
+
+
 def mark_unavailable() -> None:
     """Stop serving further restores from the peer. Checkpoint's
     version-consistency healing calls this when a storage dir proves
@@ -965,6 +1063,7 @@ def try_restore(  # wire: consumes=handoff_manifest,handoff_fetch_stats
     deadline = time.monotonic() + env.handoff_timeout_s()
     t0 = time.monotonic()
     nbytes = 0
+    reused = 0
     fetched = False
     if plan:
         # The range pull is an OPTIMIZATION over the same peer: any
@@ -976,10 +1075,11 @@ def try_restore(  # wire: consumes=handoff_manifest,handoff_fetch_stats
             with trace.span(
                 "handoff.fetch", state=state.name, ranged=True
             ) as attrs:
-                whole, partial, nbytes = _fetch_state_ranges(
+                whole, partial, nbytes, reused = _fetch_state_ranges(
                     manifest_url, state.name, entry, plan, deadline
                 )
                 attrs["bytes"] = nbytes
+                attrs["reused"] = reused
                 with trace.span(
                     "handoff.restore", state=state.name
                 ):
@@ -997,11 +1097,11 @@ def try_restore(  # wire: consumes=handoff_manifest,handoff_fetch_stats
             with trace.span(
                 "handoff.fetch", state=state.name, ranged=False
             ) as attrs:
-                chunks = _fetch_state_chunks(
+                chunks, nbytes, reused = _fetch_state_chunks(
                     manifest_url, state.name, entry, deadline
                 )
-                nbytes = sum(len(data) for _, data in chunks)
                 attrs["bytes"] = nbytes
+                attrs["reused"] = reused
                 with trace.span(
                     "handoff.restore", state=state.name
                 ):
@@ -1022,6 +1122,7 @@ def try_restore(  # wire: consumes=handoff_manifest,handoff_fetch_stats
     elapsed = time.monotonic() - t0
     _fetch_stats["bytes"] += nbytes
     _fetch_stats["seconds"] += elapsed
+    _fetch_stats["reused"] += reused
     _states_applied.add(state.name)
     try:
         from adaptdl_tpu import metrics as metrics_mod

@@ -540,6 +540,20 @@ class Allocator:
                     job=key,
                     replicas=len(alloc),
                 )
+                # Speculative warm-up: publish the decision as a
+                # CANDIDATE first, so when the runner sees the launch
+                # config drift it finds a matching warm-up target and
+                # can bring the successor up before signalling the
+                # incumbent. The candidate commits nothing — the
+                # update below opens the real prepare epoch, and a
+                # later decision or rollback discards it.
+                self._state.publish_candidate(
+                    key,
+                    alloc,
+                    topology=topology,
+                    batch_config=batch_config,
+                    trace_parent=traceparent,
+                )
                 self._state.update(
                     key,
                     allocation=alloc,

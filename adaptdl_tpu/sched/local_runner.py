@@ -31,9 +31,11 @@ import sys
 import time
 
 from adaptdl_tpu import faults
+from adaptdl_tpu import env as env_mod
 from adaptdl_tpu._compat import pick_unused_port
 
 from adaptdl_tpu._signal import GRACEFUL_EXIT_CODE
+from adaptdl_tpu.sched import warmup
 from adaptdl_tpu.sched.allocator import Allocator
 from adaptdl_tpu.sched.policy import NodeInfo, PolluxPolicy
 from adaptdl_tpu.sched.state import (
@@ -112,6 +114,8 @@ class LocalElasticRunner:
             self.state.update(job_name, spec=spec)
             self.restarts = recovered.restarts + 1
         self.supervisor = Supervisor(self.state)
+        # Outstanding speculative successor (sched.warmup), if any.
+        self._warm: warmup.WarmSuccessor | None = None
         nodes = {"local": NodeInfo(resources={"tpu": num_chips})}
         self.allocator = Allocator(
             self.state,
@@ -121,7 +125,10 @@ class LocalElasticRunner:
         )
 
     def _job_env(
-        self, num_replicas: int, topology: dict | None
+        self,
+        num_replicas: int,
+        topology: dict | None,
+        restarts: int | None = None,
     ) -> dict:
         env = dict(os.environ)
         env.update(self.extra_env)
@@ -135,7 +142,12 @@ class LocalElasticRunner:
                 "ADAPTDL_NUM_REPLICAS": str(num_replicas),
                 "ADAPTDL_NUM_PROCESSES": "1",
                 "ADAPTDL_NUM_NODES": "1",
-                "ADAPTDL_NUM_RESTARTS": str(self.restarts),
+                # A warm successor is spawned for the NEXT incarnation
+                # while this one still runs, so its restart index is
+                # passed in rather than read off the runner.
+                "ADAPTDL_NUM_RESTARTS": str(
+                    self.restarts if restarts is None else restarts
+                ),
                 "ADAPTDL_SUPERVISOR_URL": self.supervisor.url,
             }
         )
@@ -193,24 +205,32 @@ class LocalElasticRunner:
                     # the counter instead of reusing version indices.
                     restarts=self.restarts,
                 )
-                try:
-                    # An injected fault here models a failed worker
-                    # launch (image pull error, node gone) — it rides
-                    # the same retry budget as a crashing worker.
-                    faults.maybe_fail("runner.launch.pre")
-                    proc = subprocess.Popen(
-                        [sys.executable, self.script],
-                        env=self._job_env(num_replicas, topology),
-                    )
-                except faults.InjectedFault:
-                    LOG.warning(
-                        "injected launch failure for %s", self.job_name
-                    )
-                    code, signalled = 1, False
-                else:
+                proc = self._adopt_warm(allocation, topology)
+                if proc is not None:
                     code, signalled = self._supervise(
                         proc, allocation, topology
                     )
+                else:
+                    try:
+                        # An injected fault here models a failed worker
+                        # launch (image pull error, node gone) — it
+                        # rides the same retry budget as a crashing
+                        # worker.
+                        faults.maybe_fail("runner.launch.pre")
+                        proc = subprocess.Popen(
+                            [sys.executable, self.script],
+                            env=self._job_env(num_replicas, topology),
+                        )
+                    except faults.InjectedFault:
+                        LOG.warning(
+                            "injected launch failure for %s",
+                            self.job_name,
+                        )
+                        code, signalled = 1, False
+                    else:
+                        code, signalled = self._supervise(
+                            proc, allocation, topology
+                        )
                 if code == 0:
                     self.state.update(self.job_name, status="Succeeded")
                     return 0
@@ -224,6 +244,11 @@ class LocalElasticRunner:
                     self.restarts += 1
                     continue
                 failures += 1
+                # The incumbent died before cutover: the warm
+                # successor (if any) was built against state the crash
+                # never drained — discard it and restore cold from the
+                # durable checkpoint.
+                self._discard_warm("incumbent crashed before cutover")
                 # A crash never ran the drain: withdraw any handoff
                 # descriptor an older incarnation left behind so the
                 # next launch goes straight to the durable checkpoint.
@@ -242,8 +267,84 @@ class LocalElasticRunner:
                     return code
                 self.restarts += 1
         finally:
+            self._discard_warm("runner shutting down")
             self.allocator.stop()
             self.supervisor.stop()
+
+    def _spawn_warm(self, allocation, topology) -> None:
+        """Speculatively bring up the successor for a drifted launch
+        config while the incumbent keeps training. Gated on the
+        allocator's published candidate matching the drift: a config
+        the allocator did not predict (or whose candidate a rollback
+        cleared) is never warmed — the cold path handles it exactly as
+        before. Blocks up to the warm-up deadline waiting for the
+        successor to finish its cold start; only then does the caller
+        signal the incumbent, so the overlap covers imports, jax init,
+        AOT compile, and the differential prefetch."""
+        candidate = self.state.get_candidate(self.job_name)
+        if not warmup.candidate_matches(candidate, allocation, topology):
+            LOG.info(
+                "no matching candidate for %s; rescaling cold",
+                self.job_name,
+            )
+            return
+        self._discard_warm("superseded by a newer drift")
+        warm = warmup.WarmSuccessor(
+            [sys.executable, self.script],
+            self._job_env(
+                max(len(allocation), 1),
+                topology,
+                restarts=self.restarts + 1,
+            ),
+            allocation,
+            topology,
+            restarts=self.restarts + 1,
+        )
+        try:
+            warm.spawn()
+        except faults.InjectedFault:
+            LOG.warning(
+                "injected warm-up spawn failure for %s", self.job_name
+            )
+            warm.discard()
+            return
+        if warm.wait_ready(env_mod.warmup_deadline_s()):
+            self._warm = warm
+        else:
+            warm.discard("never became ready")
+
+    def _adopt_warm(self, allocation, topology):
+        """The cutover: hand the pre-warmed successor the go signal
+        and return its process, or None when there is nothing warm (or
+        the speculation no longer matches what must launch — the
+        mispredict fallback)."""
+        warm, self._warm = self._warm, None
+        if warm is None:
+            return None
+        if not warm.alive():
+            warm.discard("died during warm-up")
+            return None
+        if not warm.matches(allocation, topology) or (
+            warm.restarts != self.restarts
+        ):
+            warm.discard("candidate mispredicted")
+            return None
+        try:
+            proc = warm.cutover()
+        except faults.InjectedFault:
+            warm.discard("injected cutover failure")
+            return None
+        LOG.info(
+            "cutover: adopting warm successor for %s (replicas=%d)",
+            self.job_name,
+            max(len(allocation), 1),
+        )
+        return proc
+
+    def _discard_warm(self, reason: str) -> None:
+        warm, self._warm = self._warm, None
+        if warm is not None:
+            warm.discard(reason)
 
     def _supervise(
         self, proc: subprocess.Popen, allocation, topology=None
@@ -300,6 +401,13 @@ class LocalElasticRunner:
                     current,
                     cur_topology,
                 )
+                if env_mod.warmup_enabled() and current:
+                    # Successor first, signal second: the incumbent
+                    # keeps taking steps for the whole warm-up window,
+                    # so the only stopped time left is its drain plus
+                    # the successor's differential pull. A withdrawal
+                    # (empty config) has no successor to warm.
+                    self._spawn_warm(current, cur_topology)
                 proc.send_signal(signal.SIGTERM)
                 signalled = True
                 term_deadline = time.monotonic() + self.term_grace_period

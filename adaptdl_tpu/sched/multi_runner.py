@@ -27,9 +27,11 @@ import time
 from dataclasses import dataclass, field
 
 from adaptdl_tpu import faults
+from adaptdl_tpu import env as env_mod
 from adaptdl_tpu._compat import pick_unused_port
 
 from adaptdl_tpu._signal import GRACEFUL_EXIT_CODE
+from adaptdl_tpu.sched import warmup
 from adaptdl_tpu.sched.allocator import Allocator
 from adaptdl_tpu.sched.policy import NodeInfo, PolluxPolicy
 from adaptdl_tpu.sched.state import (
@@ -132,6 +134,9 @@ class MultiJobRunner:
         # Live worker process per job (soak/fault-injection harnesses
         # SIGKILL through this; entries go stale after exit).
         self.procs: dict[str, subprocess.Popen] = {}
+        # Outstanding speculative successor per job (sched.warmup);
+        # touched only by the job's own supervising thread.
+        self._warms: dict[str, warmup.WarmSuccessor] = {}
 
     def stop_job(self, name: str) -> None:
         """Externally terminate a job (e.g. a tuning trial that lost
@@ -149,7 +154,11 @@ class MultiJobRunner:
     # -- per-job lifecycle (one thread each) --------------------------
 
     def _job_env(
-        self, job: JobSpec, num_replicas: int, topology: dict | None
+        self,
+        job: JobSpec,
+        num_replicas: int,
+        topology: dict | None,
+        restarts: int | None = None,
     ) -> dict:
         env = dict(os.environ)
         env.update(job.extra_env)
@@ -165,8 +174,13 @@ class MultiJobRunner:
                 "ADAPTDL_NUM_REPLICAS": str(num_replicas),
                 "ADAPTDL_NUM_PROCESSES": "1",
                 "ADAPTDL_NUM_NODES": "1",
+                # A warm successor is spawned for the NEXT incarnation
+                # while this one still runs, so its restart index is
+                # passed in rather than read off the counter.
                 "ADAPTDL_NUM_RESTARTS": str(
                     self.restart_counts[job.name]
+                    if restarts is None
+                    else restarts
                 ),
                 "ADAPTDL_SUPERVISOR_URL": self.supervisor.url,
             }
@@ -203,6 +217,7 @@ class MultiJobRunner:
         failures = 0
         while True:
             if job.name in self._stopped:
+                self._discard_warm(job.name, "job stopped")
                 self.state.update(job.name, status="Stopped")
                 self.exit_codes.setdefault(
                     job.name, GRACEFUL_EXIT_CODE
@@ -237,16 +252,22 @@ class MultiJobRunner:
                 status="Running",
                 restarts=self.restart_counts[job.name],
             )
-            try:
-                # Same injected-launch-failure path as the local
-                # runner: counted against the job's retry budget.
-                faults.maybe_fail("runner.launch.pre")
-                proc = subprocess.Popen(
-                    [sys.executable, job.script],
-                    env=self._job_env(job, num_replicas, topology),
-                )
-            except faults.InjectedFault:
-                LOG.warning("injected launch failure for %s", job.name)
+            proc = self._adopt_warm(job, allocation, topology)
+            if proc is None:
+                try:
+                    # Same injected-launch-failure path as the local
+                    # runner: counted against the job's retry budget.
+                    faults.maybe_fail("runner.launch.pre")
+                    proc = subprocess.Popen(
+                        [sys.executable, job.script],
+                        env=self._job_env(job, num_replicas, topology),
+                    )
+                except faults.InjectedFault:
+                    LOG.warning(
+                        "injected launch failure for %s", job.name
+                    )
+                    proc = None
+            if proc is None:
                 code, signalled = 1, False
             else:
                 self.procs[job.name] = proc
@@ -254,6 +275,7 @@ class MultiJobRunner:
                     proc, job, allocation, topology
                 )
             if code == 0:
+                self._discard_warm(job.name, "job succeeded")
                 self.state.update(job.name, status="Succeeded")
                 self.exit_codes[job.name] = 0
                 return
@@ -263,6 +285,11 @@ class MultiJobRunner:
                 self.restart_counts[job.name] += 1
                 continue
             failures += 1
+            # The incumbent died before cutover: any warm successor
+            # was built against state the crash never drained.
+            self._discard_warm(
+                job.name, "incumbent crashed before cutover"
+            )
             # A non-graceful death never ran the drain, so any handoff
             # descriptor in the checkpoint dir is from an older
             # incarnation — withdraw it rather than let a successor
@@ -283,6 +310,76 @@ class MultiJobRunner:
                 self.exit_codes[job.name] = code
                 return
             self.restart_counts[job.name] += 1
+
+    def _spawn_warm(self, job: JobSpec, allocation, topology) -> None:
+        """Same speculation as the single-job runner: bring the
+        successor all the way up while the incumbent keeps training,
+        gated on the allocator's published candidate matching the
+        drifted config. Runs on the job's supervising thread, so the
+        warm-up window of one job never delays another's."""
+        candidate = self.state.get_candidate(job.name)
+        if not warmup.candidate_matches(candidate, allocation, topology):
+            LOG.info(
+                "no matching candidate for %s; rescaling cold",
+                job.name,
+            )
+            return
+        self._discard_warm(job.name, "superseded by a newer drift")
+        warm = warmup.WarmSuccessor(
+            [sys.executable, job.script],
+            self._job_env(
+                job,
+                max(len(allocation), 1),
+                topology,
+                restarts=self.restart_counts[job.name] + 1,
+            ),
+            allocation,
+            topology,
+            restarts=self.restart_counts[job.name] + 1,
+        )
+        try:
+            warm.spawn()
+        except faults.InjectedFault:
+            LOG.warning(
+                "injected warm-up spawn failure for %s", job.name
+            )
+            warm.discard()
+            return
+        if warm.wait_ready(env_mod.warmup_deadline_s()):
+            self._warms[job.name] = warm
+        else:
+            warm.discard("never became ready")
+
+    def _adopt_warm(self, job: JobSpec, allocation, topology):
+        """Cutover (or mispredict fallback) for one job — see the
+        single-job runner's `_adopt_warm`."""
+        warm = self._warms.pop(job.name, None)
+        if warm is None:
+            return None
+        if not warm.alive():
+            warm.discard("died during warm-up")
+            return None
+        if not warm.matches(allocation, topology) or (
+            warm.restarts != self.restart_counts[job.name]
+        ):
+            warm.discard("candidate mispredicted")
+            return None
+        try:
+            proc = warm.cutover()
+        except faults.InjectedFault:
+            warm.discard("injected cutover failure")
+            return None
+        LOG.info(
+            "cutover: adopting warm successor for %s (replicas=%d)",
+            job.name,
+            max(len(allocation), 1),
+        )
+        return proc
+
+    def _discard_warm(self, name: str, reason: str) -> None:
+        warm = self._warms.pop(name, None)
+        if warm is not None:
+            warm.discard(reason)
 
     def _supervise(self, proc, job, allocation, topology=None):
         signalled = False
@@ -311,6 +408,10 @@ class MultiJobRunner:
                     topology,
                     cur_topology,
                 )
+                if env_mod.warmup_enabled() and current:
+                    # Successor first, signal second — the incumbent
+                    # keeps taking steps through the warm-up window.
+                    self._spawn_warm(job, current, cur_topology)
                 proc.send_signal(signal.SIGTERM)
                 signalled = True
                 term_deadline = (
@@ -344,5 +445,7 @@ class MultiJobRunner:
                 thread.join()
             return dict(self.exit_codes)
         finally:
+            for name in list(self._warms):
+                self._discard_warm(name, "runner shutting down")
             self.allocator.stop()
             self.supervisor.stop()

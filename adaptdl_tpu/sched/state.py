@@ -192,6 +192,21 @@ class JobRecord:
     # Monotonic end of the notice window (transient — re-armed with a
     # fresh clock on recovery).
     drain_deadline: float | None = None
+    # Speculative warm-up: the allocator's PREDICTED next launch
+    # config, published just before the decision so runners can
+    # pre-warm a successor (process up, AOT compiled, shards
+    # pre-pulled) while the incumbent still trains. Nothing commits
+    # through a candidate — the real allocation update (and its
+    # prepare epoch) follows, and a candidate is discarded when a
+    # different decision supersedes it, when the epoch rolls back, or
+    # when the successor group arrives.
+    candidate_allocation: list[str] = field(default_factory=list)
+    candidate_topology: dict | None = None
+    candidate_batch_config: dict | None = None
+    # alloc_epoch at publish time (-1 = no candidate outstanding):
+    # stamps which epoch the candidate predicted the successor of, so
+    # a runner can reject one that predates a rollback.
+    candidate_epoch: int = -1
 
 
 def _job_to_dict(record: JobRecord) -> dict:  # wire: produces=job_snapshot
@@ -228,6 +243,10 @@ def _job_to_dict(record: JobRecord) -> dict:  # wire: produces=job_snapshot
         "handoff_url": record.handoff_url,
         "handoff_group": record.handoff_group,
         "draining": record.draining,
+        "candidate_allocation": list(record.candidate_allocation),
+        "candidate_topology": record.candidate_topology,
+        "candidate_batch_config": record.candidate_batch_config,
+        "candidate_epoch": record.candidate_epoch,
     }
 
 
@@ -281,6 +300,14 @@ def _job_from_dict(payload: dict) -> JobRecord:  # replay-pure # wire: consumes=
     record.handoff_url = payload.get("handoff_url")
     record.handoff_group = int(payload.get("handoff_group", -1))
     record.draining = bool(payload.get("draining", False))
+    record.candidate_allocation = list(
+        payload.get("candidate_allocation") or []
+    )
+    record.candidate_topology = payload.get("candidate_topology")
+    record.candidate_batch_config = payload.get(
+        "candidate_batch_config"
+    )
+    record.candidate_epoch = int(payload.get("candidate_epoch", -1))
     return record
 
 
@@ -598,6 +625,8 @@ class ClusterState:
             return self._apply_preempt_locked(op, now)
         if kind == "handoff":
             return self._apply_handoff_locked(op, now)
+        if kind == "candidate":
+            return self._apply_candidate_locked(op, now)
         if kind == "recovered":
             self._recoveries += 1
             return None
@@ -735,6 +764,18 @@ class ClusterState:
                     # expiry that withdrew the allocation is served.
                     record.degraded = False
             setattr(record, name, value)
+        if launch_config_changed and record.candidate_epoch >= 0:
+            # The decision landed. A candidate that matches it stays
+            # visible — the runner mid-warm-up revalidates against it
+            # at cutover — while a superseding decision discards it:
+            # the warm successor was built for a config that will
+            # never launch.
+            if list(record.allocation) != list(
+                record.candidate_allocation
+            ) or normalize_topology(
+                record.topology
+            ) != normalize_topology(record.candidate_topology):
+                self._clear_candidate_locked(record)
         if self._commit_timeout <= 0 and "allocation" in fields:
             # Transactional rescale disabled: every published config
             # is immediately the rollback target.
@@ -779,9 +820,11 @@ class ClusterState:
             # never registers, so a stale multi-process quorum would
             # make its epochs forever uncommittable.
             record.expected_processes = 1
-            # The successor arrived: the preemption drain is served.
+            # The successor arrived: the preemption drain is served,
+            # and any outstanding warm-up candidate did its job.
             record.draining = False
             record.drain_deadline = None
+            self._clear_candidate_locked(record)
         accepted = group == record.group
         if accepted:
             record.workers[rank] = op["address"]
@@ -814,6 +857,7 @@ class ClusterState:
             record.expected_processes = 1
             record.draining = False
             record.drain_deadline = None
+            self._clear_candidate_locked(record)
         record.alive_ranks.add(rank)
         if float(op["ttl"]) > 0:
             # ttl 0 = lease enforcement disabled: the beat proves
@@ -908,6 +952,10 @@ class ClusterState:
                 epoch=record.alloc_epoch,
             )
         record.alloc_prepared_at = None
+        # A candidate published against the rolled-back epoch is
+        # stale: a runner must never warm (or cut over to) a
+        # successor for a config the epoch machinery just revoked.
+        self._clear_candidate_locked(record)
         self._rollbacks[op["key"]] = (
             self._rollbacks.get(op["key"], 0) + 1
         )
@@ -1092,6 +1140,96 @@ class ClusterState:
             return {
                 "url": record.handoff_url,
                 "group": record.handoff_group,
+            }
+
+    def publish_candidate(  # journaled # wire: produces=journal_op
+        self,
+        key: str,
+        allocation,
+        topology: dict | None = None,
+        batch_config: dict | None = None,
+        trace_parent: str | None = None,
+    ) -> bool:
+        """Publish the allocator's PREDICTED next launch config ahead
+        of the decision (speculative warm-up): a runner may pre-warm a
+        successor for it, but nothing commits through a candidate —
+        the real allocation update (and its prepare epoch) follows,
+        and a candidate the decision supersedes is simply discarded.
+        Journaled so a supervisor recovered mid-warm-up still knows
+        what the runner may be warming against."""
+        with self._cond:
+            if key not in self._jobs:
+                return False
+            op = {
+                "op": "candidate",
+                "key": key,
+                "allocation": list(allocation or []),
+                "topology": topology,
+                "batch_config": batch_config,
+            }
+            if trace_parent:
+                op["trace_parent"] = trace_parent
+            self._journal_append(op)
+            self._apply_candidate_locked(op, self._clock.monotonic())
+            self._cond.notify_all()
+            return True
+
+    def _apply_candidate_locked(  # holds-lock: _cond # replay-pure # wire: consumes=journal_op
+        self, op: dict, now: float
+    ) -> None:
+        record = self._jobs.get(op["key"])
+        if record is None:
+            return
+        record.candidate_allocation = list(op.get("allocation") or [])
+        record.candidate_topology = op.get("topology")
+        record.candidate_batch_config = op.get("batch_config")
+        # Stamped with the CURRENT epoch: the candidate predicts that
+        # epoch's successor, and a rollback of it clears the stamp.
+        record.candidate_epoch = record.alloc_epoch
+        if not self._replaying:
+            trace.event(
+                "candidate.publish",
+                traceparent=op.get("trace_parent")
+                or record.trace_parent,
+                job=record.key,
+                replicas=len(record.candidate_allocation),
+                epoch=record.candidate_epoch,
+            )
+
+    def _clear_candidate_locked(  # holds-lock: _cond # replay-pure
+        self, record: JobRecord
+    ) -> None:
+        record.candidate_allocation = []
+        record.candidate_topology = None
+        record.candidate_batch_config = None
+        record.candidate_epoch = -1
+
+    def get_candidate(  # wire: produces=candidate_alloc
+        self, key: str
+    ) -> dict | None:
+        """The job's outstanding candidate launch config (None when
+        no warm-up target is published): ``{"allocation", "topology",
+        "batchConfig", "epoch"}``. The epoch stamps which alloc_epoch
+        the candidate was published against — a consumer must treat a
+        vanished or re-stamped candidate as a misprediction and fall
+        back to the cold path."""
+        with self._cond:
+            record = self._jobs.get(key)
+            if record is None or record.candidate_epoch < 0:
+                return None
+            return {
+                "allocation": list(record.candidate_allocation),
+                "topology": (
+                    dict(record.candidate_topology)
+                    if record.candidate_topology
+                    else None
+                ),
+                "batchConfig": (
+                    dict(record.candidate_batch_config)
+                    if record.candidate_batch_config
+                    else None
+                ),
+                "epoch": record.candidate_epoch,
             }
 
     def publish_retune(  # journaled # wire: produces=journal_op

@@ -390,6 +390,27 @@ class Supervisor(ThreadedHttpServer):
         handoff = self._state.get_handoff(key)
         return web.json_response(handoff or {})
 
+    @_faultable("sup.candidate.pre")
+    async def _get_candidate(  # wire: produces=candidate_alloc,envelope
+        self, request: web.Request
+    ) -> web.Response:
+        """Speculative warm-up readback (``GET /candidate/{job}``):
+        the allocator's PREDICTED next launch config, published just
+        ahead of the decision. A runner (possibly on another host)
+        polls this to pre-warm a successor; 404 with no candidate
+        means nothing is predicted — warm nothing, rescale cold."""
+        key = "{namespace}/{name}".format(**request.match_info)
+        if self._state.get_job(key) is None:
+            return web.json_response(
+                {"error": "no such job"}, status=404
+            )
+        candidate = self._state.get_candidate(key)
+        if candidate is None:
+            return web.json_response(
+                {"error": "no candidate"}, status=404
+            )
+        return web.json_response(candidate)
+
     async def _healthz(self, request: web.Request) -> web.Response:
         return web.json_response({"ok": True})
 
@@ -1100,6 +1121,10 @@ class Supervisor(ThreadedHttpServer):
                 ),
                 web.get(
                     "/handoff/{namespace}/{name}", self._get_handoff
+                ),
+                web.get(
+                    "/candidate/{namespace}/{name}",
+                    self._get_candidate,
                 ),
                 web.get("/healthz", self._healthz),
                 web.get("/status", self._status),

@@ -22,6 +22,8 @@ without staging the full state on any single host.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import pickle
 import re
@@ -76,6 +78,84 @@ def _next_payload_dir(name: str) -> str:
     )
 
 
+def _hashable_ndarray(data) -> np.ndarray:
+    """Materialize a (shard of a) leaf for hashing. Extended dtypes
+    (typed PRNG keys) refuse ``np.asarray``; hash their underlying
+    integer representation instead."""
+    dtype = getattr(data, "dtype", None)
+    if dtype is not None and jax.dtypes.issubdtype(
+        dtype, jax.dtypes.extended
+    ):
+        data = jax.random.key_data(data)
+    return np.asarray(data)
+
+
+def shard_hash_table(state) -> dict[str, dict]:
+    """Per-shard content hashes of this process's addressable shards:
+    ``{"<leaf-path>@<shard-index>": {"sha": ..., "bytes": n}}``. The
+    differential-encoding unit for the orbax payload — two payloads'
+    tables diffed shard-by-shard tell a successor (and the metrics
+    layer) exactly which shards a save actually changed, at per-shard
+    rather than per-payload granularity. Keys are process-local by
+    construction (each process hashes only the shards it owns), which
+    matches orbax's per-process shard files."""
+    table: dict[str, dict] = {}
+    leaves, _ = jax.tree_util.tree_flatten_with_path(state)
+    for path, leaf in leaves:
+        key = jax.tree_util.keystr(path)
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            for shard in shards:
+                data = _hashable_ndarray(shard.data)
+                table[f"{key}@{shard.index}"] = {
+                    "sha": hashlib.sha256(data.tobytes()).hexdigest(),
+                    "bytes": int(data.nbytes),
+                }
+        else:
+            data = _hashable_ndarray(leaf)
+            table[f"{key}@full"] = {
+                "sha": hashlib.sha256(data.tobytes()).hexdigest(),
+                "bytes": int(data.nbytes),
+            }
+    return table
+
+
+def diff_shard_tables(
+    prev: dict | None, cur: dict
+) -> tuple[list[str], int]:
+    """Shard keys in ``cur`` whose content differs from (or is absent
+    in) ``prev``, plus their total byte volume — the bytes a
+    shard-granular transfer would actually have to move. ``prev``
+    None (no baseline) marks everything changed."""
+    prev = prev or {}
+    changed = [
+        key
+        for key, meta in cur.items()
+        if prev.get(key, {}).get("sha") != meta["sha"]
+    ]
+    return changed, sum(int(cur[key]["bytes"]) for key in changed)
+
+
+def hash_table_path(payload_dir: str) -> str:
+    """The sidecar hash-table file for one payload dir. A sibling
+    (not a file inside the dir): orbax owns the dir's contents and
+    finalizes it by rename, so the sidecar is written independently
+    and pruned alongside the dir in commit()."""
+    return f"{payload_dir}.hashes.json"
+
+
+def load_hash_table(payload_dir: str) -> dict | None:
+    """The payload's per-shard hash table, or None when it predates
+    shard hashing (or the sidecar is unreadable — hashing is an
+    accounting layer, never a restore dependency)."""
+    try:
+        with open(hash_table_path(payload_dir), encoding="utf-8") as f:
+            table = json.load(f)
+        return table if isinstance(table, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
 class ShardedTrainerCheckpoint(checkpoint.State):
     """Orbax-backed State for (possibly sharded) TrainStates.
 
@@ -95,11 +175,16 @@ class ShardedTrainerCheckpoint(checkpoint.State):
     per-process shard files with re-shard-on-restore (each process
     writes/reads only its shards, which is already the "pull exactly
     the chunks your new sharding needs" semantics at the storage
-    layer). Differential encoding inside the orbax payload would have
-    to live inside orbax's format and is deliberately out of scope;
-    the measured payload size (``payload_nbytes``, device bytes
-    summed at sync time) rides the pointer so the metrics layer can
-    report sharded save volume next to the registry's byte counts.
+    layer). Differential encoding rides alongside orbax's format
+    rather than inside it: every save hashes this process's
+    addressable shards (``shard_hash_table``) into a sidecar next to
+    the payload dir, and the diff against the previous save's table
+    (seeded from the restored payload's sidecar after a restart) is
+    recorded in the pointer as ``shard_delta`` — so the metrics layer
+    and a warm successor can see exactly which shards a save changed
+    and how many bytes a shard-granular pull would move, next to the
+    full measured payload size (``payload_nbytes``, device bytes
+    summed at sync time).
     """
 
     def __init__(
@@ -117,6 +202,13 @@ class ShardedTrainerCheckpoint(checkpoint.State):
         self._sharding_fn = sharding_fn
         self._last_payload_dir: str | None = None
         self._last_payload_nbytes: int = 0
+        # Previous save's per-shard hash table (differential-encoding
+        # baseline). Kept on the instance because commit() prunes old
+        # payload dirs; re-seeded from the restored payload's sidecar
+        # in load() so the first save after a restart diffs against
+        # the state it actually restored.
+        self._prev_hash_table: dict | None = None
+        self._last_shard_delta: dict = {}
         # Orbax checkpointer with its array write still in flight
         # (StandardCheckpointer is an AsyncCheckpointer: save()
         # returns once the on-device data is snapshotted and the
@@ -338,9 +430,39 @@ class ShardedTrainerCheckpoint(checkpoint.State):
         # fresh versioned dir no registry checkpoint references — the
         # previous complete (pointer, payload) pair stays restorable,
         # and the chaos suite proves it.
+        if env.sharded_hash_enabled():
+            # Differential encoding: hash this process's addressable
+            # shards (one host transfer per save — ADAPTDL_SHARDED_
+            # HASHES=off for jobs where that dominates) and diff
+            # against the previous save, so the pointer records which
+            # shards actually changed.
+            table = shard_hash_table(state)
+            changed, changed_bytes = diff_shard_tables(
+                self._prev_hash_table, table
+            )
+            self._last_shard_delta = {
+                "shards_total": len(table),
+                "shards_changed": len(changed),
+                "changed_bytes": int(changed_bytes),
+            }
+            self._prev_hash_table = table
+        else:
+            self._last_shard_delta = {}
         faults.maybe_fail("ckpt.sharded.payload")
         checkpointer = ocp.StandardCheckpointer()
         checkpointer.save(path, state)
+        if env.sharded_hash_enabled() and jax.process_index() == 0:
+            # Sidecar, not a file inside the payload dir: orbax owns
+            # that dir and finalizes it by rename. Best-effort — the
+            # table is accounting, never a restore dependency.
+            try:
+                os.makedirs(_sharded_root(), exist_ok=True)
+                with open(
+                    hash_table_path(path), "w", encoding="utf-8"
+                ) as f:
+                    json.dump(self._prev_hash_table, f)
+            except OSError:
+                pass
         if env.num_processes() > 1:
             # Multi-host: every process must finish its shards before
             # rank 0's registry rename can reference the payload — the
@@ -358,10 +480,13 @@ class ShardedTrainerCheckpoint(checkpoint.State):
         self._last_payload_dir = path
 
     def snapshot(self):
-        return {
+        snap = {
             "payload_dir": self._last_payload_dir,
             "payload_nbytes": self._last_payload_nbytes,
         }
+        if self._last_shard_delta:
+            snap["shard_delta"] = dict(self._last_shard_delta)
+        return snap
 
     def write_snapshot(self, snapshot, fileobj) -> None:
         self._finish_pending()
@@ -379,12 +504,20 @@ class ShardedTrainerCheckpoint(checkpoint.State):
         for _, _, path in _list_payload_dirs(self.name):
             if path != keep:
                 shutil.rmtree(path, ignore_errors=True)
+                try:
+                    os.remove(hash_table_path(path))
+                except OSError:
+                    pass
 
     def load(self, fileobj) -> None:
         import orbax.checkpoint as ocp
 
         meta = pickle.load(fileobj)
         path = meta["payload_dir"]
+        # Seed the differential baseline from the restored payload's
+        # sidecar: the first save of this incarnation then reports
+        # only what training actually changed since the restore.
+        self._prev_hash_table = load_hash_table(path)
         template = self._get_state()
         template = template._replace(
             rng=jax.random.key_data(template.rng)

@@ -1996,6 +1996,42 @@ class ElasticTrainer:
         )
 
 
+def gspmd_row_span(
+    mesh, spec, rows: int, devices
+) -> tuple[int, int] | None:
+    """The leading-axis row span the given devices read for a leaf
+    placed as ``NamedSharding(mesh, spec)`` — derived from GSPMD's own
+    device->index map on a 1-D view of the leading axis, so the span
+    is exactly what ``device_put`` will slice for those devices at
+    restore (or a contiguous superset when the devices' shards are
+    non-adjacent: over-coverage fetches extra rows, never misses
+    one). Returns None when the devices own no rows or the spec can't
+    be interpreted (caller falls back to a full pull)."""
+    rows = int(rows)
+    if rows <= 0:
+        return None
+    try:
+        dim0 = spec[0] if spec is not None and len(spec) > 0 else None
+        index_map = NamedSharding(mesh, P(dim0)).devices_indices_map(
+            (rows,)
+        )
+    except Exception:  # noqa: BLE001 - plan is an optimization
+        return None
+    wanted = set(devices)
+    lo = hi = None
+    for dev, idx in index_map.items():
+        if dev not in wanted:
+            continue
+        sl = idx[0]
+        start = 0 if sl.start is None else int(sl.start)
+        stop = rows if sl.stop is None else int(sl.stop)
+        lo = start if lo is None else min(lo, start)
+        hi = stop if hi is None else max(hi, stop)
+    if lo is None or hi <= lo:
+        return None
+    return lo, hi
+
+
 class TrainerCheckpoint(checkpoint.State):
     """Persists a TrainState device-agnostically.
 
@@ -2160,9 +2196,69 @@ class TrainerCheckpoint(checkpoint.State):
         )
 
     def handoff_shard_plan(self, chunk_rows):
-        if self._shard_plan_fn is None:
+        if self._shard_plan_fn is not None:
+            return self._shard_plan_fn(chunk_rows)
+        return self._default_shard_plan(chunk_rows)
+
+    def _default_shard_plan(self, chunk_rows, devices=None):
+        """GSPMD-derived default shard map: when no explicit
+        ``shard_plan_fn`` was passed, each range-addressable leaf's
+        row span is read off the SAME spec tree (and via GSPMD's own
+        device->index map) that ``_apply_host_state`` will restore
+        with, restricted to this process's mesh devices — so a
+        multi-process tensor-parallel restore range-pulls only its
+        own rows with zero launcher configuration. ``devices``
+        overrides the device subset (tests simulate a peer process's
+        view). Covers the dense path only: the zero family and
+        transform hooks store a canonical layout whose leaves don't
+        map positionally onto the run spec tree, and there the
+        conservative full pull stays. Single-process meshes derive
+        full spans, which ``handoff._normalize_plan`` drops — the
+        behavior is unchanged exactly where the plan couldn't help."""
+        trainer = self._trainer
+        if (
+            self._transform_save is not None
+            or self._transform_load is not None
+            or trainer.zero1
+            or trainer.zero3
+            or trainer.zero3_blocks is not None
+        ):
             return None
-        return self._shard_plan_fn(chunk_rows)
+        try:
+            state = self._get_state()
+            leaves, treedef = jax.tree_util.tree_flatten(state)
+            spec_leaves = treedef.flatten_up_to(
+                trainer.state_spec_tree(state)
+            )
+        except Exception:  # noqa: BLE001 - plan is an optimization
+            return None
+        if devices is None:
+            pidx = jax.process_index()
+            devices = [
+                d
+                for d in trainer.mesh.devices.flat
+                if d.process_index == pidx
+            ]
+        plan = {}
+        for cid, rows in chunk_rows.items():
+            if not cid.startswith("leaf/"):
+                continue
+            try:
+                i = int(cid[len("leaf/"):])
+            except ValueError:
+                continue
+            if i >= len(leaves):
+                continue
+            # A peer whose leaf shape disagrees with ours (mid-flight
+            # structure change) gets the safe full pull for that leaf.
+            if np.shape(leaves[i])[:1] != (int(rows),):
+                continue
+            span = gspmd_row_span(
+                trainer.mesh, spec_leaves[i], rows, devices
+            )
+            if span is not None:
+                plan[cid] = span
+        return plan or None
 
     def load_chunk_rows(self, chunks, partial):
         """Shard-plan restore: whole chunks deserialize as usual; a

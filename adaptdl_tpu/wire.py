@@ -175,6 +175,15 @@ WIRE_CONTRACTS = {
         "unchecked": ("ts",),
         "required": (),
     },
+    # ---- candidate allocation: the allocator's PREDICTED next
+    # launch config, published ahead of the decision so a runner can
+    # pre-warm a successor (GET /candidate body + get_candidate()).
+    "candidate_alloc": {
+        "doc": "GET /candidate body (speculative warm-up target)",
+        "persisted": False,
+        "keys": ("allocation", "topology", "batchConfig", "epoch"),
+        "required": (),
+    },
     # ---- write-ahead journal records (sched.journal): produced by
     # `# journaled` mutators, replayed by the `_apply_*` layer. A
     # consumer subscripting a non-required key breaks replay of
@@ -278,6 +287,10 @@ WIRE_CONTRACTS = {
             "handoff_url",
             "handoff_group",
             "draining",
+            "candidate_allocation",
+            "candidate_topology",
+            "candidate_batch_config",
+            "candidate_epoch",
         ),
         "required": ("key",),
     },
@@ -584,7 +597,9 @@ WIRE_CONTRACTS = {
         "persisted": False,
         "open_producers": True,
         "open_consumers": True,
-        "keys": ("bytes", "seconds"),
+        # `reused` counts bytes a differential pull satisfied from the
+        # warm-up cache instead of the network.
+        "keys": ("bytes", "seconds", "reused"),
         "required": (),
     },
 }
@@ -621,4 +636,5 @@ HEARTBEAT_KEYS = WIRE_CONTRACTS["heartbeat"]["keys"]
 REGISTER_KEYS = WIRE_CONTRACTS["register"]["keys"]
 PREEMPT_KEYS = WIRE_CONTRACTS["preempt"]["keys"]
 HANDOFF_AD_KEYS = WIRE_CONTRACTS["handoff_ad"]["keys"]
+CANDIDATE_ALLOC_KEYS = WIRE_CONTRACTS["candidate_alloc"]["keys"]
 JOURNAL_OP_KEYS = WIRE_CONTRACTS["journal_op"]["keys"]

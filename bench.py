@@ -830,6 +830,150 @@ def _rescale_trials(trainer_factory, dataset, init_bsz, trials=3):
     return p50, breakdown, trace_summary
 
 
+def _bench_warm_rescale(
+    trainer_factory, dataset, init_bsz, trials=2
+) -> dict | None:
+    """Speculative warm-up vs the cold planned rescale, in-process.
+
+    The warm arm stages everything the runner's warm successor does
+    while the incumbent is still training — successor construction,
+    step compile, differential chunk prefetch from the incumbent's
+    shard server — OUTSIDE the measured window, then measures only
+    the cutover: differential pull of the chunks that changed since
+    the prefetch, re-materialization, first step. The cold arm
+    measures the same rescale with everything inside the window (the
+    existing planned path). Both windows are also bracketed as
+    ``restart.first_step`` pending spans, so the trace view and the
+    wall-clock agree. Reports per-arm ``cutover_s`` and ``steps_lost``
+    (cutover over the measured steady step time) plus the
+    differential pull's wire bytes vs the full pull volume — the
+    changed-shard case by construction (the incumbent takes a step
+    between prefetch and drain, so params move but e.g. the treedef
+    chunk does not)."""
+    import tempfile
+
+    from adaptdl_tpu import checkpoint as ckpt_mod
+    from adaptdl_tpu import handoff as handoff_mod
+    from adaptdl_tpu import trace
+
+    import jax
+
+    warm_cutover: list[float] = []
+    cold_cutover: list[float] = []
+    warm_lost: list[int] = []
+    cold_lost: list[int] = []
+    diff_bytes: list[int] = []
+    full_bytes: list[int] = []
+    step_times: list[float] = []
+    rng = np.random.default_rng(7)
+    for trial in range(trials):
+        with tempfile.TemporaryDirectory() as tmp:
+            os.environ["ADAPTDL_CHECKPOINT_PATH"] = tmp
+            trainer = trainer_factory()
+            holder = {"state": trainer.init_state()}
+            ck = trainer.make_checkpoint_state(
+                lambda: holder["state"],
+                lambda s: holder.__setitem__("state", s),
+                name=f"bench-warm-{trial}",
+            )
+            atomic = init_bsz // trainer.num_replicas
+            step_fn = trainer.train_step(atomic, 0)
+            idx = rng.integers(0, len(dataset["label"]), size=init_bsz)
+            batch = trainer.shard_batch(
+                {k: v[idx] for k, v in dataset.items()}
+            )
+            holder["state"], step_s, m = _steady_state_time(
+                holder["state"], step_fn, batch, steps=4
+            )
+            step_times.append(step_s)
+            # Incumbent's latest save + shard server: the state the
+            # warm successor prefetches against.
+            ckpt_mod.save_all_states()
+            server_a = handoff_mod.serve_states()
+            # ---- warm-up (overlapped with the incumbent in
+            # production, so deliberately unmeasured).
+            trainer2 = trainer_factory()
+            holder2 = {"state": trainer2.init_state()}
+            step_fn2 = trainer2.train_step(atomic, 0)
+            _s, m2 = step_fn2(holder2["state"], batch)  # compile only
+            jax.block_until_ready(m2["loss"])
+            handoff_mod.warm_prefetch(url=server_a.url)
+            # ---- incumbent trains past the prefetched snapshot: the
+            # cutover pull is differential against a CHANGED state.
+            holder["state"], m = step_fn(holder["state"], batch)
+            jax.block_until_ready(m["loss"])
+            ckpt_mod.save_all_states()  # final drain snapshot
+            server_a.stop()
+            server_b = handoff_mod.serve_states()
+            ck.unregister()
+            ck2 = trainer2.make_checkpoint_state(
+                lambda: holder2["state"],
+                lambda s: holder2.__setitem__("state", s),
+                name=f"bench-warm-{trial}",
+            )
+            before = dict(handoff_mod._fetch_stats)
+            handoff_mod.set_source(server_b.url)
+            trace.begin_pending("restart.first_step", arm="warm")
+            t0 = time.monotonic()
+            if not ckpt_mod.load_state(ck2):
+                raise RuntimeError(
+                    "warm rescale trial: cutover restore failed"
+                )
+            holder2["state"], m2 = step_fn2(holder2["state"], batch)
+            jax.block_until_ready(m2["loss"])
+            cut = time.monotonic() - t0
+            trace.end_pending("restart.first_step", arm="warm")
+            warm_cutover.append(cut)
+            warm_lost.append(int(cut // max(step_s, 1e-9)))
+            stats = handoff_mod._fetch_stats
+            wire = int(stats["bytes"] - before["bytes"])
+            reused = int(stats["reused"] - before["reused"])
+            diff_bytes.append(wire)
+            full_bytes.append(wire + reused)
+            ck2.unregister()
+            handoff_mod._reset_client_state()
+            # ---- cold arm: the same rescale with successor build,
+            # compile, full pull, and first step all on the clock.
+            trace.begin_pending("restart.first_step", arm="cold")
+            t0 = time.monotonic()
+            trainer3 = trainer_factory()
+            holder3 = {"state": trainer3.init_state()}
+            ck3 = trainer3.make_checkpoint_state(
+                lambda: holder3["state"],
+                lambda s: holder3.__setitem__("state", s),
+                name=f"bench-warm-{trial}",
+            )
+            handoff_mod.set_source(server_b.url)
+            if not ckpt_mod.load_state(ck3):
+                raise RuntimeError(
+                    "warm rescale trial: cold restore failed"
+                )
+            step_fn3 = trainer3.train_step(atomic, 0)
+            holder3["state"], m3 = step_fn3(holder3["state"], batch)
+            jax.block_until_ready(m3["loss"])
+            cold = time.monotonic() - t0
+            trace.end_pending("restart.first_step", arm="cold")
+            cold_cutover.append(cold)
+            cold_lost.append(int(cold // max(step_s, 1e-9)))
+            server_b.stop()
+            ck3.unregister()
+            handoff_mod._reset_client_state()
+            os.environ.pop("ADAPTDL_CHECKPOINT_PATH", None)
+    out = {
+        "warm_rescale": {
+            "step_s": round(float(np.median(step_times)), 4),
+            "warm_cutover_s": round(float(np.median(warm_cutover)), 4),
+            "cold_cutover_s": round(float(np.median(cold_cutover)), 4),
+            "warm_steps_lost": int(np.median(warm_lost)),
+            "cold_steps_lost": int(np.median(cold_lost)),
+            "diff_pull_bytes": int(np.median(diff_bytes)),
+            "full_pull_bytes": int(np.median(full_bytes)),
+        }
+    }
+    _log(f"warm rescale: {out['warm_rescale']}")
+    return out
+
+
 def _bench_mesh_rescale(trials: int = 3) -> dict | None:
     """Mesh-shape elasticity's rescale cost: a PLANNED dp -> (dp, tp)
     reshape where the successor re-materializes the predecessor's
@@ -1209,6 +1353,19 @@ def main(quick: bool = False):
             )
     except Exception as exc:  # noqa: BLE001 - optional metric
         _log(f"rescale bench failed: {exc}")
+    # Speculative warm-up: cutover-only cost (and steps lost) of a
+    # planned rescale when the successor was pre-warmed, vs the same
+    # rescale cold, plus the differential pull's byte savings.
+    warm_stats = None
+    try:
+        if _remaining() > 50:
+            metrics._reset_state()
+            warm_stats = _bench_warm_rescale(
+                make_trainer, dataset, init_bsz,
+                trials=2 if _remaining() > 100 else 1,
+            )
+    except Exception as exc:  # noqa: BLE001 - optional metric
+        _log(f"warm rescale bench failed: {exc}")
     # Mesh-shape reshape: the planned dp -> (dp, tp) rescale path +
     # the shard-map range-pull bytes vs the full-leaf handoff.
     mesh_stats = None
@@ -1254,6 +1411,8 @@ def main(quick: bool = False):
         result["rescale_breakdown"] = rescale_breakdown
     if rescale_trace is not None:
         result["rescale_trace"] = rescale_trace
+    if warm_stats:
+        result.update(warm_stats)
     if mesh_stats:
         result.update(mesh_stats)
     if sched_stats:

@@ -1365,3 +1365,22 @@ def test_group_commit_crash_keeps_prefix_semantics(tmp_path, kill_at):
         applied = kill_at - 2  # updates acknowledged before the kill
         assert record is not None
         assert record.hints == {"initBatchSize": applied}
+
+
+def test_candidate_from_rolled_back_epoch_is_cleared(tmp_path):
+    """A speculative candidate published against a pending allocation
+    epoch dies with that epoch: after the commit-timeout rollback a
+    runner asking "should I keep my warm successor?" gets None — the
+    stale speculation is discarded instead of cut over to a config the
+    scheduler already revoked."""
+    state = _state(tmp_path)
+    state.create_job("ns/a")
+    state.update("ns/a", allocation=["good"], status="Running")
+    state.renew_lease("ns/a", 0, 30.0, group=0)  # commit baseline
+    state.update("ns/a", allocation=["bad", "bad"])  # pending epoch
+    state.publish_candidate("ns/a", ["bad", "bad"])
+    assert state.get_candidate("ns/a")["allocation"] == ["bad", "bad"]
+    state.expire_overdue_allocations(now=time.monotonic() + 1.0)
+    assert state.get_candidate("ns/a") is None
+    # ...and the rollback restored the committed allocation.
+    assert state.get_allocation("ns/a") == ["good"]

@@ -132,7 +132,12 @@ def test_second_save_never_clobbers_previous_payload(
     checkpoint.save_all_states()
     final_payload = ck2._last_payload_dir
     sharded_root = os.path.join(str(tmp_path), "sharded")
-    assert os.listdir(sharded_root) == [
-        os.path.basename(final_payload)
-    ]
+    # Only the live payload remains (plus its hash sidecar) —
+    # superseded payloads AND their sidecars are pruned together.
+    assert sorted(os.listdir(sharded_root)) == sorted(
+        [
+            os.path.basename(final_payload),
+            os.path.basename(final_payload) + ".hashes.json",
+        ]
+    )
     ck2.unregister()
