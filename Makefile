@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched chaos-preempt trace-gate rescale-fast meshgate simgate watchgate warmgate bench-sched probe
+.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched chaos-preempt trace-gate rescale-fast meshgate simgate watchgate warmgate shardgate bench-sched probe
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -126,6 +126,16 @@ watchgate:
 warmgate:
 	$(CPU_ENV) ADAPTDL_FAULT_SEED=1234 $(PY) -m pytest \
 	    tests/test_warm_rescale.py -q --durations=10
+
+# graftshard gate (docs/scheduler.md "Sharded control plane"): one
+# supervisor shard hard-killed mid-traffic (fixed seed) — zero job
+# restarts anywhere, sibling shards' endpoints never degrade, the
+# recovered shard replays its exact acknowledged journal prefix, and
+# the router's per-shard circuit isolates the dead shard without
+# touching siblings.
+shardgate:
+	$(CPU_ENV) ADAPTDL_FAULT_SEED=1234 $(PY) -m pytest \
+	    tests/test_chaos_shard.py -q --durations=10
 
 # Thousand-job control-plane bench standalone (bench.py also merges
 # these keys into the BENCH json): allocator decide p50/p99 at 1k

@@ -330,6 +330,27 @@ def _cmd_status(args) -> int:
             f"(last replay {recovery.get('lastRecoveryS') or 0:.3f}s, "
             f"{recovery.get('tornRecords', 0)} torn records dropped)"
         )
+    shards = payload.get("shards") or {}
+    if shards:
+        # Router-merged view (graftshard): one row per supervisor
+        # shard so a sick shard is visible next to healthy siblings.
+        print()
+        rows = [("SHARD", "JOBS", "RECOVERIES", "TORN", "STATE")]
+        for sid in sorted(shards, key=int):
+            info = shards[sid]
+            shard_recovery = info.get("recovery") or {}
+            rows.append(
+                (
+                    str(sid),
+                    str(info.get("jobs", 0)),
+                    str(shard_recovery.get("recoveries", 0)),
+                    str(shard_recovery.get("tornRecords", 0)),
+                    "DOWN: " + str(info["error"])[:40]
+                    if info.get("error")
+                    else "up",
+                )
+            )
+        _print_table(rows)
     return 0
 
 
@@ -348,6 +369,11 @@ def _render_top(payload: dict) -> None:  # wire: consumes=watch
         f"{latest.get('chipsTotal', 0)} chips allocated "
         f"(utilization {latest.get('utilization', 0.0):.2f}), "
         f"{payload.get('samples', 0)} watch sample(s)"
+        + (
+            f", {len(payload['shards'])} shard(s)"
+            if payload.get("shards")
+            else ""
+        )
     )
     tenants = payload.get("tenants") or {}
     if tenants:
@@ -435,6 +461,35 @@ def _cmd_top(args) -> int:
             print()
     except KeyboardInterrupt:
         return 0
+
+
+def _cmd_shardmap(args) -> int:  # wire: consumes=shard_map
+    """The sharded control plane's routing table: shard id → url from
+    the router's journaled rendezvous map, and (with ``--key``) where
+    one ``namespace/name`` lands — the first question an operator
+    asks when a tenant's traffic misbehaves."""
+    from adaptdl_tpu import rpc
+
+    payload = rpc.default_client().get(
+        f"{args.supervisor}/shardmap",
+        endpoint="cli/shardmap",
+        timeout=10,
+        attempts=3,
+        deadline=30.0,
+    ).json()
+    print(f"shard map version {payload['version']}")
+    rows = [("SHARD", "URL")]
+    for sid, url in sorted(
+        payload["shards"].items(), key=lambda kv: int(kv[0])
+    ):
+        rows.append((str(sid), url))
+    _print_table(rows)
+    if getattr(args, "key", None):
+        from adaptdl_tpu.sched.shard import ShardMap
+
+        shard_map = ShardMap.from_payload(payload)
+        print(f"\n{args.key} -> shard {shard_map.assign(args.key)}")
+    return 0
 
 
 def _cmd_explain(args) -> int:  # wire: consumes=explain,topology
@@ -1143,6 +1198,22 @@ def main(argv=None) -> int:
         "(default: one shot)",
     )
     p.set_defaults(fn=_cmd_top)
+
+    p = sub.add_parser(
+        "shardmap",
+        help="sharded control plane routing table: shard id → url "
+        "from the router's journaled rendezvous map",
+    )
+    p.add_argument(
+        "--supervisor",
+        required=True,
+        help="router (or shard-map-serving supervisor) base URL",
+    )
+    p.add_argument(
+        "--key",
+        help="a namespace/name job key to resolve to its owning shard",
+    )
+    p.set_defaults(fn=_cmd_shardmap)
 
     p = sub.add_parser(
         "explain",

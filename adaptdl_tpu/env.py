@@ -707,3 +707,24 @@ def default_job_resources_raw() -> str | None:
 def gke_node_pool_raw() -> str | None:
     """GKE autoscaling target as a raw JSON string."""
     return _get_str("ADAPTDL_GKE_NODE_POOL")
+
+
+def shard_count() -> int | None:
+    """Number of supervisor shards behind the router (raw; 1 or unset
+    means the classic single-supervisor deployment)."""
+    return _get_opt_int("ADAPTDL_SHARD_COUNT")
+
+
+def shard_id() -> int | None:
+    """This supervisor process's shard id in [0, shard_count) (raw)."""
+    return _get_opt_int("ADAPTDL_SHARD_ID")
+
+
+def shard_map_path() -> str | None:
+    """Path the router journals its rendezvous shard map to (raw)."""
+    return _get_str("ADAPTDL_SHARD_MAP_PATH")
+
+
+def router_port() -> int | None:
+    """Port the shard router's HTTP server binds (raw)."""
+    return _get_opt_int("ADAPTDL_ROUTER_PORT")

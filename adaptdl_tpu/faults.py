@@ -100,6 +100,13 @@ INJECTION_POINTS = {
     "warmup.spawn": "before a warm successor subprocess is spawned",
     "warmup.prefetch": "warm successor's differential chunk prefetch",
     "warmup.cutover": "before a warm successor adopts at cutover",
+    # sharded control plane (sched.router / sched.shard; router
+    # faults become 500s the worker-side rpc client retries through,
+    # a shard.map.write fault aborts the atomic map rewrite so the
+    # previous map version stays served)
+    "router.forward.pre": "router forwarding handler, before shard pick",
+    "sup.shard.inventory.pre": "per-shard inventory publication handler",
+    "shard.map.write": "before the shard map's atomic write+rename",
     # durable cluster state (sched.journal / sched.state)
     "sched.journal_write": "before a journal record is written+fsynced",
     "sched.snapshot_write": "before a state snapshot is written",

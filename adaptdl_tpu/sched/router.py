@@ -1,0 +1,470 @@
+"""graftshard — the thin stateless router in front of supervisor shards.
+
+The router owns NO cluster state: it holds a :class:`ShardMap`
+(journaled to disk by whoever operates the shard set), picks the
+owning shard for ``{namespace}/{name}`` by rendezvous hash, and
+forwards the worker-facing hot path verbatim — heartbeat, hints,
+config, trace, preempt, handoff, candidate, discover, register,
+explain all stay one proxy hop from the shard that journals them.
+Aggregation endpoints (``/status``, ``/watch``, ``/metrics``) fan out
+across every shard and merge, so ``adaptdl-tpu status``/``top`` and a
+Prometheus scrape see one logical cluster with a ``shard`` label.
+
+Failure semantics, deliberately boring:
+
+- Forwards ride the resilient rpc client with a **per-shard circuit
+  breaker** (``endpoint="router/shard{id}"``): a dead shard costs its
+  own workers a cheap 503 per circuit cadence and costs sibling
+  shards nothing.
+- On a failed forward the router reloads the shard map from disk
+  (the stale-map retry): if a newer map names a different owner, the
+  request is retried once against it; otherwise the worker gets 503
+  and ITS rpc client keeps retrying — exactly how workers already
+  ride out a single-supervisor restart, so a shard kill causes zero
+  job restarts.
+- The router itself is stateless and restartable at will: everything
+  it knows is the map file plus what shards serve.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import re
+import threading
+
+from aiohttp import web
+
+from adaptdl_tpu import rpc
+from adaptdl_tpu.sched.http_server import (
+    ThreadedHttpServer,
+    faultable as _faultable,
+)
+from adaptdl_tpu.sched.shard import ShardMap
+
+# Sample line of a Prometheus exposition: name, optional {labels},
+# then the value/timestamp tail that is passed through untouched.
+_SAMPLE_RE = re.compile(
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{.*\})?( .*)$"
+)
+
+
+def _label_sample(line: str, shard_id: int) -> str:
+    """Inject ``shard="N"`` as the first label of one sample line."""
+    m = _SAMPLE_RE.match(line)
+    if m is None:
+        return line
+    name, labels, tail = m.group(1), m.group(2), m.group(3)
+    if labels:
+        inner = labels[1:-1]
+        merged = (
+            f'shard="{shard_id}",{inner}' if inner else f'shard="{shard_id}"'
+        )
+        return f"{name}{{{merged}}}{tail}"
+    return f'{name}{{shard="{shard_id}"}}{tail}'
+
+
+def merge_metrics(per_shard: list[tuple[int, str]]) -> str:
+    """Merge per-shard Prometheus expositions into one, tagging every
+    sample with its ``shard`` label.
+
+    Families keep first-appearance order; each family's HELP/TYPE is
+    emitted exactly once, before any of its samples (the strict
+    exposition rules ``tests/promcheck.py`` enforces). Samples keep
+    their per-shard label sets disjoint via the injected label, so
+    histogram bucket invariants hold per shard series."""
+    order: list[str] = []
+    help_lines: dict[str, str] = {}
+    type_lines: dict[str, str] = {}
+    samples: dict[str, list[str]] = {}
+    for shard_id, text in per_shard:
+        family = None
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                family = line.split(None, 3)[2]
+                if family not in order:
+                    order.append(family)
+                target = (
+                    help_lines
+                    if line.startswith("# HELP ")
+                    else type_lines
+                )
+                target.setdefault(family, line)
+            elif line.startswith("#"):
+                continue
+            elif family is not None:
+                samples.setdefault(family, []).append(
+                    _label_sample(line, shard_id)
+                )
+    out: list[str] = []
+    for family in order:
+        if family in help_lines:
+            out.append(help_lines[family])
+        if family in type_lines:
+            out.append(type_lines[family])
+        out.extend(samples.get(family, ()))
+    return "\n".join(out) + "\n"
+
+
+def merge_status(per_shard: dict[int, dict]) -> dict:
+    """Merge per-shard ``/status`` payloads into the unsharded shape
+    plus a ``shards`` section. Tenants partition by shard, so the
+    job/slot tables union without collisions; numeric recovery
+    counters sum; per-kind hazard estimates merge by max (the
+    conservative bound an operator wants)."""
+    merged: dict = {
+        "jobs": {},
+        "slotStrikes": {},
+        "quarantinedSlots": {},
+        "rollbacks": {},
+        "drainingSlots": {},
+        "hazardRates": {},
+        "preemptionNotices": {},
+        "recovery": {
+            "recoveries": 0,
+            "lastRecoveryS": 0.0,
+            "tornRecords": 0,
+            "reconcileRemainingS": 0.0,
+        },
+        "shards": {},
+    }
+    for sid in sorted(per_shard):
+        payload = per_shard[sid]
+        summary = {"jobs": 0, "error": payload.get("error")}
+        if "error" not in payload:
+            merged["jobs"].update(payload.get("jobs", {}))
+            summary["jobs"] = len(payload.get("jobs", {}))
+            for table in (
+                "slotStrikes",
+                "quarantinedSlots",
+                "rollbacks",
+                "drainingSlots",
+            ):
+                merged[table].update(payload.get(table, {}))
+            for kind, rate in (payload.get("hazardRates") or {}).items():
+                merged["hazardRates"][kind] = max(
+                    merged["hazardRates"].get(kind, 0.0), rate
+                )
+            for kind, count in (
+                payload.get("preemptionNotices") or {}
+            ).items():
+                merged["preemptionNotices"][kind] = (
+                    merged["preemptionNotices"].get(kind, 0) + count
+                )
+            recovery = payload.get("recovery") or {}
+            merged["recovery"]["recoveries"] += recovery.get(
+                "recoveries", 0
+            )
+            merged["recovery"]["tornRecords"] += recovery.get(
+                "tornRecords", 0
+            )
+            for field in ("lastRecoveryS", "reconcileRemainingS"):
+                merged["recovery"][field] = max(
+                    merged["recovery"][field],
+                    recovery.get(field) or 0.0,
+                )
+            summary["recovery"] = recovery
+        merged["shards"][str(sid)] = summary
+    return merged
+
+
+def merge_watch(  # wire: consumes=watch,envelope
+    per_shard: dict[int, dict],
+) -> dict:
+    """Merge per-shard ``/watch`` payloads: tenant/job/suspect tables
+    union (tenants partition by shard), sample counters sum, and the
+    cluster line is re-synthesized by summing each shard's latest
+    utilization sample."""
+    merged: dict = {
+        "samples": 0,
+        "cluster": [],
+        "tenants": {},
+        "jobs": {},
+        "suspectSlots": {},
+        "cycles": [],
+        "overhead": {"sampleS": 0.0, "cycleS": 0.0},
+        "shards": sorted(per_shard),
+    }
+    latest = {"jobs": 0, "chipsAllocated": 0, "chipsTotal": 0}
+    saw_cluster = False
+    for sid in sorted(per_shard):
+        payload = per_shard[sid]
+        if "error" in payload:
+            continue
+        merged["samples"] += payload.get("samples", 0)
+        merged["tenants"].update(payload.get("tenants") or {})
+        merged["jobs"].update(payload.get("jobs") or {})
+        merged["suspectSlots"].update(payload.get("suspectSlots") or {})
+        merged["cycles"].extend(payload.get("cycles") or ())
+        overhead = payload.get("overhead") or {}
+        merged["overhead"]["sampleS"] += overhead.get("sampleS", 0.0)
+        merged["overhead"]["cycleS"] += overhead.get("cycleS", 0.0)
+        cluster = payload.get("cluster") or []
+        if cluster:
+            saw_cluster = True
+            last = cluster[-1]
+            latest["jobs"] += last.get("jobs", 0)
+            latest["chipsAllocated"] += last.get("chipsAllocated", 0)
+            latest["chipsTotal"] += last.get("chipsTotal", 0)
+    if saw_cluster:
+        latest["utilization"] = round(
+            latest["chipsAllocated"] / latest["chipsTotal"], 6
+        ) if latest["chipsTotal"] else 0.0
+        merged["cluster"] = [latest]
+    return merged
+
+
+class Router(ThreadedHttpServer):
+    """Thin stateless forwarder over a :class:`ShardMap`."""
+
+    def __init__(
+        self,
+        shard_map: ShardMap,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        map_path: str | None = None,
+        client: rpc.RpcClient | None = None,
+        forward_attempts: int = 2,
+        forward_deadline: float = 8.0,
+        circuit_cooldown: float = 5.0,
+    ):
+        super().__init__(host=host, port=port)
+        self._map_lock = threading.Lock()
+        self._map = shard_map  # guarded-by: _map_lock
+        self._map_path = map_path
+        self._client = (
+            client if client is not None else rpc.default_client()
+        )
+        self._forward_attempts = forward_attempts
+        self._forward_deadline = forward_deadline
+        # Per-shard circuit cadence: shorter than the client default —
+        # a recovered shard should see its first probe within seconds,
+        # not the worker-side 60s cadence (shard restarts are routine;
+        # the 503s the open circuit serves meanwhile are exactly what
+        # worker clients already retry through).
+        self._circuit_cooldown = circuit_cooldown
+
+    @staticmethod
+    async def _offload(fn, *args, **kwargs):
+        """Forwarding blocks on the downstream shard (and the rpc
+        client's retry backoff); run it off the router's event loop
+        so slow shards never serialize unrelated tenants."""
+        return await asyncio.get_event_loop().run_in_executor(
+            None, functools.partial(fn, *args, **kwargs)
+        )
+
+    # -- shard map ----------------------------------------------------
+
+    def current_map(self) -> ShardMap:
+        with self._map_lock:
+            return self._map
+
+    def set_map(self, shard_map: ShardMap) -> None:
+        with self._map_lock:
+            self._map = shard_map
+
+    def _reload_map(self) -> bool:
+        """Reload the journaled map from disk; True when a NEWER
+        version replaced the in-memory one (the stale-map signal)."""
+        if not self._map_path:
+            return False
+        try:
+            fresh = ShardMap.load(self._map_path)
+        except (OSError, ValueError, KeyError):
+            return False
+        with self._map_lock:
+            if fresh.version > self._map.version:
+                self._map = fresh
+                return True
+        return False
+
+    # -- forwarding ---------------------------------------------------
+
+    def _forward_sync(
+        self, method: str, key: str, path_qs: str, body
+    ) -> tuple[int, str]:
+        shard_map = self.current_map()
+        sid = shard_map.assign(key)
+        try:
+            resp = self._request_shard(
+                method, shard_map.shards[sid], sid, path_qs, body
+            )
+            return resp.status_code, resp.text
+        except (rpc.CircuitOpenError, rpc.RpcError):
+            # Stale-map retry: the shard set may have changed under
+            # us. Only a NEWER map that names a DIFFERENT owner earns
+            # one retry; otherwise the worker's own client retries
+            # through the shard's recovery window.
+            if self._reload_map():
+                fresh = self.current_map()
+                new_sid = fresh.assign(key)
+                if new_sid != sid:
+                    try:
+                        resp = self._request_shard(
+                            method,
+                            fresh.shards[new_sid],
+                            new_sid,
+                            path_qs,
+                            body,
+                        )
+                        return resp.status_code, resp.text
+                    except (rpc.CircuitOpenError, rpc.RpcError):
+                        pass
+            return 503, (
+                '{"error": "shard unavailable", '
+                f'"shard": {sid}}}'
+            )
+
+    def _request_shard(
+        self, method: str, base_url: str, sid: int, path_qs: str, body
+    ):
+        return self._client.request(
+            method,
+            f"{base_url}{path_qs}",
+            json=body,
+            endpoint=f"router/shard{sid}",
+            timeout=(2, 10),
+            attempts=self._forward_attempts,
+            deadline=self._forward_deadline,
+            circuit_cooldown=self._circuit_cooldown,
+        )
+
+    @_faultable("router.forward.pre")
+    async def _forward(  # idempotent: keyed-by=downstream (router adds no state; shard handlers fold retries)
+        self, request: web.Request
+    ) -> web.Response:
+        """The generic hot-path proxy: every ``{namespace}/{name}``
+        route lands here, is rendezvous-routed, and is replayed
+        verbatim against the owning shard. Idempotency is the
+        downstream handler's (every shard PUT/POST folds retries),
+        so replaying a forward is as safe as replaying the original
+        worker request."""
+        key = "{namespace}/{name}".format(**request.match_info)
+        body = None
+        if request.can_read_body:
+            body = await request.json()
+        status, text = await self._offload(
+            self._forward_sync,
+            request.method,
+            key,
+            request.path_qs,
+            body,
+        )
+        return web.Response(
+            text=text, status=status, content_type="application/json"
+        )
+
+    # -- aggregation --------------------------------------------------
+
+    def _fanout_sync(self, path: str) -> dict[int, dict]:
+        """GET ``path`` on every shard; a dead shard contributes an
+        ``{"error": ...}`` marker instead of failing the merge —
+        sibling shards' visibility must not depend on the sick one."""
+        shard_map = self.current_map()
+        out: dict[int, dict] = {}
+        for sid in shard_map.shard_ids():
+            try:
+                out[sid] = self._client.get(
+                    f"{shard_map.shards[sid]}{path}",
+                    endpoint=f"router/shard{sid}",
+                    timeout=(2, 10),
+                    attempts=self._forward_attempts,
+                    deadline=self._forward_deadline,
+                    circuit_cooldown=self._circuit_cooldown,
+                ).json()
+            except (rpc.CircuitOpenError, rpc.RpcError) as exc:
+                out[sid] = {"error": str(exc)}
+        return out
+
+    def _fanout_text_sync(self, path: str) -> list[tuple[int, str]]:
+        shard_map = self.current_map()
+        out: list[tuple[int, str]] = []
+        for sid in shard_map.shard_ids():
+            try:
+                out.append(
+                    (
+                        sid,
+                        self._client.get(
+                            f"{shard_map.shards[sid]}{path}",
+                            endpoint=f"router/shard{sid}",
+                            timeout=(2, 10),
+                            attempts=self._forward_attempts,
+                            deadline=self._forward_deadline,
+                            circuit_cooldown=self._circuit_cooldown,
+                        ).text,
+                    )
+                )
+            except (rpc.CircuitOpenError, rpc.RpcError):
+                continue
+        return out
+
+    @_faultable("router.forward.pre")
+    async def _status(self, request: web.Request) -> web.Response:
+        per_shard = await self._offload(self._fanout_sync, "/status")
+        return web.json_response(merge_status(per_shard))
+
+    @_faultable("router.forward.pre")
+    async def _watch(self, request: web.Request) -> web.Response:
+        per_shard = await self._offload(self._fanout_sync, "/watch")
+        return web.json_response(merge_watch(per_shard))
+
+    @_faultable("router.forward.pre")
+    async def _metrics(self, request: web.Request) -> web.Response:
+        per_shard = await self._offload(
+            self._fanout_text_sync, "/metrics"
+        )
+        return web.Response(
+            text=merge_metrics(per_shard),
+            content_type="text/plain",
+        )
+
+    @_faultable("router.forward.pre")
+    async def _shardmap(self, request: web.Request) -> web.Response:
+        return web.json_response(self.current_map().to_payload())
+
+    async def _healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.add_routes(
+            [
+                # Worker-facing hot path: one proxy hop to the shard
+                # that owns the tenant. Path templates mirror the
+                # supervisor's exactly — the router is transparent.
+                web.get(
+                    "/discover/{namespace}/{name}/{group}",
+                    self._forward,
+                ),
+                web.put(
+                    "/register/{namespace}/{name}/{group}/{rank}",
+                    self._forward,
+                ),
+                web.put(
+                    "/heartbeat/{namespace}/{name}/{rank}",
+                    self._forward,
+                ),
+                web.put("/hints/{namespace}/{name}", self._forward),
+                web.get("/hints/{namespace}/{name}", self._forward),
+                web.get("/config/{namespace}/{name}", self._forward),
+                web.put("/trace/{namespace}/{name}", self._forward),
+                web.get("/trace/{namespace}/{name}", self._forward),
+                web.post("/preempt/{namespace}/{name}", self._forward),
+                web.put("/handoff/{namespace}/{name}", self._forward),
+                web.get("/handoff/{namespace}/{name}", self._forward),
+                web.get(
+                    "/candidate/{namespace}/{name}", self._forward
+                ),
+                web.get("/explain/{namespace}/{name}", self._forward),
+                # Aggregation: fan out + merge.
+                web.get("/status", self._status),
+                web.get("/watch", self._watch),
+                web.get("/metrics", self._metrics),
+                # Router-local.
+                web.get("/shardmap", self._shardmap),
+                web.get("/healthz", self._healthz),
+            ]
+        )
+        return app

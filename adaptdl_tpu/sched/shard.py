@@ -1,0 +1,421 @@
+"""graftshard — partitioned supervisor shards for the control plane.
+
+One supervisor process is the throughput *and* availability ceiling of
+the whole control plane: every heartbeat, hint post, trace flush, and
+allocator cycle funnels through its one event loop, one journal, and
+one lease sweeper. This module partitions :class:`ClusterState` by
+**tenant** (the ``namespace`` half of a ``namespace/name`` job key)
+across N full supervisor instances — each shard owns its own journal,
+snapshot cycle, lease sweeper, and watch store, so a shard crash is
+exactly the single-supervisor crash the durability layer already
+survives: the shard replays its acknowledged journal prefix while its
+workers ride out the restart on the retrying rpc client, zero job
+restarts, and sibling shards never notice.
+
+The pieces:
+
+- :func:`rendezvous_shard` — highest-random-weight (rendezvous)
+  hashing of a partition key over the shard-id set. Deterministic
+  across processes (sha256, no process-seeded ``hash()``), and
+  minimal-remap by construction: adding or removing a shard only
+  moves the tenants whose winning shard changed.
+- :class:`ShardMap` — the journaled ``{version, shards}`` record the
+  router serves and reloads; written atomically (tmp + fsync +
+  rename) through the ``shard.map.write`` fault point so a torn write
+  can never be observed.
+- :class:`SupervisorShard` — one shard: its own ``ClusterState``
+  (own ``state_dir`` → own journal) behind its own
+  :class:`Supervisor` on a **stable port**, so a killed shard
+  recovers at the same address the shard map already names.
+- :class:`ShardedCluster` — N shards plus the map: partitions the
+  slice inventory, routes job creation, and exposes
+  ``kill_shard``/``restart_shard`` for the chaos suite.
+- :func:`merged_inventory` / :func:`plan_inventory_rebalance` — the
+  allocator-facing merged view: each shard publishes its slice
+  inventory + dirty-job set over the ``shard_inventory`` wire family
+  (``GET /shard/inventory``); per-shard incremental cycles stay
+  local, and only full cycles consult the merged view — the
+  partitioned-full-cycle machinery maps 1:1 onto shard boundaries.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from adaptdl_tpu import env, faults, rpc
+from adaptdl_tpu._compat import pick_unused_port
+from adaptdl_tpu.sched.state import ClusterState
+from adaptdl_tpu.sched.supervisor import Supervisor
+
+
+def shard_key(job_key: str) -> str:
+    """The partition key: the tenant (namespace) half of
+    ``namespace/name``. Whole tenants live on one shard, so tenant
+    fairness, per-tenant SLO burn, and the watch store's tenant
+    series never need cross-shard reconstruction."""
+    return job_key.split("/", 1)[0]
+
+
+def rendezvous_shard(partition_key: str, shard_ids) -> int:
+    """Highest-random-weight shard for ``partition_key``.
+
+    sha256 over ``"{sid}|{key}"`` — stable across processes and
+    Python versions (never the process-seeded builtin ``hash``), and
+    the HRW property gives minimal remap: a shard joining or leaving
+    only moves the keys it wins or held."""
+    best_id: int | None = None
+    best_score: int | None = None
+    for sid in shard_ids:
+        digest = hashlib.sha256(
+            f"{sid}|{partition_key}".encode()
+        ).digest()
+        score = int.from_bytes(digest[:16], "big")
+        if (
+            best_score is None
+            or score > best_score
+            # Ties (astronomically unlikely) break toward the lowest
+            # id so the assignment stays a pure function of the set.
+            or (score == best_score and sid < best_id)
+        ):
+            best_id, best_score = sid, score
+    if best_id is None:
+        raise ValueError("rendezvous over an empty shard set")
+    return best_id
+
+
+def partition_slices(slice_names, shard_ids) -> dict[int, list[str]]:
+    """Deterministic slice → shard partition, rendezvous-hashed like
+    tenants so a shard-set change moves the minimal slice set."""
+    out: dict[int, list[str]] = {sid: [] for sid in shard_ids}
+    for name in sorted(slice_names):
+        out[rendezvous_shard(name, shard_ids)].append(name)
+    return out
+
+
+class ShardMap:
+    """The journaled tenant → shard routing record.
+
+    A plain ``{version, shards: {id: url}}`` payload (wire family
+    ``shard_map``): routers hold it in memory, journal it to disk on
+    every change, and reload it when a forward fails — the stale-map
+    retry path. ``version`` increases monotonically so a reload can
+    tell "newer map" from "same map, shard actually down"."""
+
+    def __init__(self, shards: dict[int, str], version: int = 1):
+        self.version = int(version)
+        self.shards = {int(sid): url for sid, url in shards.items()}
+
+    def shard_ids(self) -> list[int]:
+        return sorted(self.shards)
+
+    def assign(self, job_key: str) -> int:
+        """Owning shard id for a job key (rendezvous over the map's
+        current shard set)."""
+        return rendezvous_shard(shard_key(job_key), self.shard_ids())
+
+    def url_for(self, job_key: str) -> str:
+        return self.shards[self.assign(job_key)]
+
+    def to_payload(self) -> dict:  # wire: produces=shard_map
+        # JSON object keys are strings; ``from_payload`` restores the
+        # int ids.
+        return {
+            "version": self.version,
+            "shards": {
+                str(sid): self.shards[sid]
+                for sid in sorted(self.shards)
+            },
+        }
+
+    @classmethod
+    def from_payload(cls, payload: dict) -> "ShardMap":  # wire: consumes=shard_map
+        return cls(
+            {
+                int(sid): url
+                for sid, url in payload["shards"].items()
+            },
+            version=payload["version"],
+        )
+
+    def save(self, path: str) -> None:
+        """Atomic write+fsync+rename — a crashed writer leaves either
+        the old complete map or the new complete map, never a torn
+        one. The ``shard.map.write`` fault point aborts BEFORE the
+        rename, so an injected fault keeps the previous version
+        served."""
+        faults.maybe_fail("shard.map.write")
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_payload(), f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "ShardMap":
+        with open(path) as f:
+            return cls.from_payload(json.load(f))
+
+
+class SupervisorShard:
+    """One shard of the partitioned control plane: a full supervisor
+    (own journal, snapshot cycle, lease sweeper, watch store) bound
+    to a **stable port**, so the shard map entry survives a
+    kill/recover cycle.
+
+    ``state_dir=None`` runs in-memory (bench arms); a real directory
+    makes the shard durable — ``kill()`` then ``start()`` replays the
+    acknowledged journal prefix exactly like a supervisor restart."""
+
+    def __init__(
+        self,
+        shard_id: int,
+        state_dir: str | None = None,
+        host: str = "127.0.0.1",
+        port: int | None = None,
+        slices=(),
+        lease_ttl: float | None = None,
+        sweep_interval: float | None = None,
+        state_kwargs: dict | None = None,
+    ):
+        self.shard_id = int(shard_id)
+        self._state_dir = state_dir
+        self._host = host
+        self._port = port if port is not None else pick_unused_port()
+        self.slices = list(slices)
+        self._lease_ttl = lease_ttl
+        self._sweep_interval = sweep_interval
+        self._state_kwargs = dict(state_kwargs or {})
+        self.state: ClusterState | None = None
+        self.supervisor: Supervisor | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self._port}"
+
+    @property
+    def alive(self) -> bool:
+        return self.supervisor is not None
+
+    def start(self) -> str:
+        """(Re)start the shard. With a ``state_dir``, construction IS
+        recovery: ``ClusterState`` replays snapshot+journal before
+        the supervisor serves its first request."""
+        if self.supervisor is not None:
+            return self.url
+        self.state = ClusterState(
+            state_dir=self._state_dir, **self._state_kwargs
+        )
+        self.supervisor = Supervisor(
+            self.state,
+            host=self._host,
+            port=self._port,
+            lease_ttl=self._lease_ttl,
+            sweep_interval=self._sweep_interval,
+            shard_id=self.shard_id,
+            slices_fn=lambda: list(self.slices),
+        )
+        return self.supervisor.start()
+
+    def stop(self) -> None:
+        if self.supervisor is not None:
+            self.supervisor.stop()
+            self.supervisor = None
+
+    def kill(self) -> None:
+        """Hard-kill: stop serving and DROP the in-memory state, as a
+        crashed process would. Durable shards recover everything the
+        journal acknowledged on the next ``start()``; in-memory
+        shards come back empty (a deliberate data loss the caller
+        opted into by passing no ``state_dir``)."""
+        self.stop()
+        self.state = None
+
+
+class ShardedCluster:
+    """N supervisor shards plus their shard map — the process-level
+    partition of one logical cluster.
+
+    ``shard_count=1`` is the provably-unchanged special case: one
+    shard owns every tenant and every slice, and the deployment is
+    bit-identical to the classic unsharded supervisor (the
+    equivalence test pins this)."""
+
+    def __init__(
+        self,
+        shard_count: int | None = None,
+        state_root: str | None = None,
+        host: str = "127.0.0.1",
+        slices=(),
+        lease_ttl: float | None = None,
+        sweep_interval: float | None = None,
+        state_kwargs: dict | None = None,
+        map_path: str | None = None,
+    ):
+        count = (
+            shard_count
+            if shard_count is not None
+            else (env.shard_count() or 1)
+        )
+        if count < 1:
+            raise ValueError(f"shard_count must be >= 1: {count}")
+        shard_ids = list(range(count))
+        by_shard = partition_slices(slices, shard_ids)
+        self.shards: dict[int, SupervisorShard] = {}
+        for sid in shard_ids:
+            state_dir = (
+                os.path.join(state_root, f"shard-{sid}")
+                if state_root is not None
+                else None
+            )
+            self.shards[sid] = SupervisorShard(
+                sid,
+                state_dir=state_dir,
+                host=host,
+                slices=by_shard[sid],
+                lease_ttl=lease_ttl,
+                sweep_interval=sweep_interval,
+                state_kwargs=state_kwargs,
+            )
+        self._map_path = (
+            map_path if map_path is not None else env.shard_map_path()
+        )
+        self.map: ShardMap | None = None
+
+    def start(self) -> ShardMap:
+        for shard in self.shards.values():
+            shard.start()
+        self.map = ShardMap(
+            {sid: shard.url for sid, shard in self.shards.items()}
+        )
+        if self._map_path:
+            self.map.save(self._map_path)
+        return self.map
+
+    def stop(self) -> None:
+        for shard in self.shards.values():
+            shard.stop()
+
+    def shard_for(self, job_key: str) -> SupervisorShard:
+        if self.map is None:
+            raise RuntimeError("cluster not started")
+        return self.shards[self.map.assign(job_key)]
+
+    def create_job(self, key: str, spec: dict | None = None):
+        """Create a job on its owning shard (control-plane-local: job
+        admission happens beside the journal that owns the key)."""
+        shard = self.shard_for(key)
+        if shard.state is None:
+            raise RuntimeError(f"shard {shard.shard_id} is down")
+        return shard.state.create_job(key, spec)
+
+    def kill_shard(self, shard_id: int) -> None:
+        self.shards[shard_id].kill()
+
+    def restart_shard(self, shard_id: int) -> str:
+        return self.shards[shard_id].start()
+
+
+def merged_inventory(  # wire: consumes=shard_inventory
+    shard_map: ShardMap, client: rpc.RpcClient | None = None
+) -> dict:
+    """The allocator's cross-shard view: every shard's
+    ``GET /shard/inventory`` slice, merged. Jobs and slices map to
+    their owning shard id; the dirty-job union is what a merged full
+    cycle would re-optimize. Per-shard incremental cycles never need
+    this — only full cycles (and the rebalance planner below) do."""
+    client = client if client is not None else rpc.default_client()
+    shards_seen: list[int] = []
+    jobs: dict[str, int] = {}
+    dirty: list[str] = []
+    slices: dict[str, int] = {}
+    for sid in shard_map.shard_ids():
+        url = shard_map.shards[sid]
+        inv = client.get(
+            f"{url}/shard/inventory",
+            endpoint=f"shard{sid}/inventory",
+            timeout=5,
+            attempts=3,
+            deadline=15.0,
+        ).json()
+        shard = inv["shard"]
+        shards_seen.append(shard)
+        for key in inv["jobs"]:
+            jobs[key] = shard
+        dirty.extend(inv["dirtyJobs"])
+        for name in inv["slices"]:
+            slices[name] = shard
+    return {
+        "shards": shards_seen,
+        "jobs": jobs,
+        "dirtyJobs": sorted(set(dirty)),
+        "slices": slices,
+    }
+
+
+def plan_inventory_rebalance(merged: dict) -> list[dict]:
+    """Pure full-cycle planning over a merged inventory: propose
+    slice moves so each shard's slice share tracks its job share.
+
+    Deterministic (sorted iteration, largest-deficit-first) so the
+    same merged view always yields the same plan; returns
+    ``[{"slice", "from", "to"}]`` moves, empty when balanced. The
+    caller (an operator, or a future expander hook) applies moves by
+    editing shard slice sets — this function never mutates."""
+    shard_ids = sorted(merged["shards"])
+    if not shard_ids:
+        return []
+    jobs_per = {sid: 0 for sid in shard_ids}
+    for owner in merged["jobs"].values():
+        if owner in jobs_per:
+            jobs_per[owner] += 1
+    slices_per: dict[int, list[str]] = {sid: [] for sid in shard_ids}
+    for name, owner in sorted(merged["slices"].items()):
+        if owner in slices_per:
+            slices_per[owner].append(name)
+    total_slices = sum(len(v) for v in slices_per.values())
+    total_jobs = sum(jobs_per.values())
+    if total_slices == 0:
+        return []
+    # Target: proportional to job count; an idle shard keeps zero
+    # target but never gives up its LAST slice unless another shard
+    # has jobs and none (largest-remainder rounding keeps the sum
+    # exact).
+    if total_jobs == 0:
+        return []
+    quotas = {
+        sid: total_slices * jobs_per[sid] / total_jobs
+        for sid in shard_ids
+    }
+    targets = {sid: int(quotas[sid]) for sid in shard_ids}
+    remainder = total_slices - sum(targets.values())
+    for sid in sorted(
+        shard_ids,
+        key=lambda s: (-(quotas[s] - targets[s]), s),
+    )[:remainder]:
+        targets[sid] += 1
+    surplus: list[tuple[int, str]] = []
+    for sid in shard_ids:
+        extra = len(slices_per[sid]) - targets[sid]
+        # Give up the lexicographically-last slices so the kept
+        # prefix is stable run over run.
+        for name in slices_per[sid][len(slices_per[sid]) - extra:]:
+            surplus.append((sid, name))
+    moves: list[dict] = []
+    deficits = [
+        sid
+        for sid in sorted(
+            shard_ids,
+            key=lambda s: (len(slices_per[s]) - targets[s], s),
+        )
+        if len(slices_per[sid]) < targets[sid]
+    ]
+    for sid in deficits:
+        need = targets[sid] - len(slices_per[sid])
+        while need > 0 and surplus:
+            src, name = surplus.pop(0)
+            moves.append({"slice": name, "from": src, "to": sid})
+            need -= 1
+    return moves

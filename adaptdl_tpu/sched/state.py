@@ -1371,6 +1371,9 @@ class ClusterState:
                 self._journal_append(op)
                 self._apply_lease_expiry_locked(op, now)
                 expired.extend((key, rank) for rank in stale)
+                # Countable sweep signal (the Grafana per-shard lease
+                # panel rates this; per-expiry, not per-sweep-pass).
+                trace.event("lease.expired", job=key)
             if expired:
                 self._cond.notify_all()
         return expired
@@ -1633,6 +1636,12 @@ class ClusterState:
     def dirty_job_count(self) -> int:
         with self._cond:
             return len(self._dirty)
+
+    def dirty_jobs(self) -> list[str]:
+        """Non-consuming peek at the dirty set (the shard inventory
+        publisher reads it without stealing the allocator's cycle)."""
+        with self._cond:
+            return sorted(self._dirty)
 
     def consume_dirty_jobs(self) -> set[str]:
         """Snapshot-and-clear the dirty set (the allocator calls this

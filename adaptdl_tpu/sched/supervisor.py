@@ -63,6 +63,7 @@ import functools
 import logging
 import os
 import threading
+import time
 from collections import deque
 
 from aiohttp import web
@@ -94,9 +95,23 @@ class Supervisor(ThreadedHttpServer):
         port=0,
         lease_ttl: float | None = None,
         sweep_interval: float | None = None,
+        shard_id: int | None = None,
+        slices_fn=None,
     ):
         super().__init__(host=host, port=port)
         self._state = state
+        # Sharded control plane (sched/shard.py): which shard this
+        # supervisor is, and a callable yielding the slice names this
+        # shard owns — published over GET /shard/inventory so the
+        # merged-inventory view can run full allocation cycles across
+        # shard boundaries. Both stay inert in the classic unsharded
+        # deployment (shard 0, no slices published).
+        self._shard_id = (
+            shard_id
+            if shard_id is not None
+            else (env.shard_id() or 0)
+        )
+        self._slices_fn = slices_fn
         self._lease_ttl = (
             env.lease_ttl() if lease_ttl is None else lease_ttl
         )
@@ -476,6 +491,31 @@ class Supervisor(ThreadedHttpServer):
         return web.json_response(
             await self._offload(self._state.watch.snapshot)
         )
+
+    @_faultable("sup.shard.inventory.pre")
+    async def _shard_inventory(  # wire: produces=shard_inventory
+        self, request: web.Request
+    ) -> web.Response:
+        """This shard's slice of the merged inventory view: the jobs
+        it owns, the dirty subset awaiting an allocator cycle (a
+        non-consuming peek — publication must not steal the local
+        allocator's work), and the slice names partitioned to it.
+        The router/allocator merges these across shards; PR 11's
+        partitioned full cycle maps 1:1 onto the boundaries."""
+
+        def build() -> dict:
+            return {
+                "shard": self._shard_id,
+                "jobs": sorted(self._state.jobs()),
+                "dirtyJobs": self._state.dirty_jobs(),
+                "slices": (
+                    sorted(self._slices_fn())
+                    if self._slices_fn is not None
+                    else []
+                ),
+            }
+
+        return web.json_response(await self._offload(build))
 
     @_faultable("sup.explain.pre")
     async def _explain(self, request: web.Request) -> web.Response:
@@ -1093,8 +1133,26 @@ class Supervisor(ThreadedHttpServer):
             except asyncio.CancelledError:
                 pass
 
+    @web.middleware
+    async def _time_endpoint(self, request, handler):
+        """Server-side per-endpoint latency histogram
+        (``adaptdl_trace_phase_seconds{phase="sup.endpoint.<seg>"}``)
+        — the signal the per-shard Grafana endpoint-p99 panel rates
+        once the router relabels it with ``shard``. Keyed by the
+        first path segment so cardinality stays at the route count."""
+        start = time.monotonic()
+        try:
+            return await handler(request)
+        finally:
+            parts = request.path.split("/", 2)
+            segment = parts[1] if len(parts) > 1 and parts[1] else "root"
+            trace.record_span(
+                f"sup.endpoint.{segment}",
+                time.monotonic() - start,
+            )
+
     def build_app(self) -> web.Application:
-        app = web.Application()
+        app = web.Application(middlewares=[self._time_endpoint])
         app.add_routes(
             [
                 web.get(
@@ -1129,6 +1187,7 @@ class Supervisor(ThreadedHttpServer):
                 web.get("/healthz", self._healthz),
                 web.get("/status", self._status),
                 web.get("/watch", self._watch),
+                web.get("/shard/inventory", self._shard_inventory),
                 web.get(
                     "/explain/{namespace}/{name}", self._explain
                 ),

@@ -429,7 +429,12 @@ WIRE_CONTRACTS = {
             "job",
             "rank",
             "ratio",
+            # Router-merged payloads only (graftshard): the shard-id
+            # list the fan-out covered. Written by the router's merge
+            # (outside the annotated producer), so unchecked.
+            "shards",
         ),
+        "unchecked": ("shards",),
         "required": (),
     },
     # ---- GET /explain payload (decision provenance). The policy's
@@ -591,6 +596,23 @@ WIRE_CONTRACTS = {
         ),
         "required": (),
     },
+    # ---- the router's journaled rendezvous shard map (persisted:
+    # written atomically to disk, reloaded by routers on stale-map
+    # retries, so both keys are required in every version).
+    "shard_map": {
+        "doc": "sched.router / sched.shard rendezvous shard map",
+        "persisted": True,
+        "keys": ("version", "shards"),
+        "required": ("version", "shards"),
+    },
+    # ---- per-shard inventory slice (shard supervisor -> merged
+    # allocator view; the full-cycle partition boundary).
+    "shard_inventory": {
+        "doc": "GET /shard/inventory per-shard slice+dirty-job view",
+        "persisted": False,
+        "keys": ("shard", "jobs", "dirtyJobs", "slices"),
+        "required": ("shard", "jobs", "dirtyJobs", "slices"),
+    },
     # ---- handoff fetch accounting (handoff -> metrics).
     "handoff_fetch_stats": {
         "doc": "handoff._fetch_stats counters",
@@ -623,6 +645,7 @@ FAULT_EXEMPT_ROUTES = ("/healthz",)
 # deliberately not listed.
 DOCUMENTED_SERVERS = (
     "adaptdl_tpu/sched/supervisor.py",
+    "adaptdl_tpu/sched/router.py",
     "adaptdl_tpu/handoff.py",
     "adaptdl_tpu/sched/validator.py",
 )
@@ -638,3 +661,5 @@ PREEMPT_KEYS = WIRE_CONTRACTS["preempt"]["keys"]
 HANDOFF_AD_KEYS = WIRE_CONTRACTS["handoff_ad"]["keys"]
 CANDIDATE_ALLOC_KEYS = WIRE_CONTRACTS["candidate_alloc"]["keys"]
 JOURNAL_OP_KEYS = WIRE_CONTRACTS["journal_op"]["keys"]
+SHARD_MAP_KEYS = WIRE_CONTRACTS["shard_map"]["keys"]
+SHARD_INVENTORY_KEYS = WIRE_CONTRACTS["shard_inventory"]["keys"]

@@ -205,6 +205,138 @@ def bench_supervisor(
     return out
 
 
+def _sharded_worker_main(url, job_keys, seconds, out_queue):
+    """One simulated worker process hammering the ROUTER: heartbeat +
+    hints + config + discover, per-request latency recorded."""
+    import requests
+
+    session = requests.Session()
+    lat = {"heartbeat": [], "hints": [], "config": [], "discover": []}
+    deadline = time.monotonic() + seconds
+    i = 0
+    hints = {
+        "perfParams": None,
+        "gradParams": None,
+        "initBatchSize": 128,
+    }
+    while time.monotonic() < deadline:
+        key = job_keys[i % len(job_keys)]
+        i += 1
+        t0 = time.monotonic()
+        session.put(f"{url}/heartbeat/{key}/0?group=0", timeout=10)
+        lat["heartbeat"].append(time.monotonic() - t0)
+        t0 = time.monotonic()
+        session.put(f"{url}/hints/{key}", json=hints, timeout=10)
+        lat["hints"].append(time.monotonic() - t0)
+        t0 = time.monotonic()
+        session.get(f"{url}/config/{key}", timeout=10)
+        lat["config"].append(time.monotonic() - t0)
+        t0 = time.monotonic()
+        session.get(
+            f"{url}/discover/{key}/0?replicas=1", timeout=10
+        )
+        lat["discover"].append(time.monotonic() - t0)
+    out_queue.put(lat)
+
+
+def bench_sharded(
+    shard_counts: tuple = (1, 2, 4),
+    jobs_per_shard: int = 25,
+    workers: int = 8,
+    seconds: float = 4.0,
+) -> dict:
+    """The graftshard scaling arm: per-endpoint p50/p99 through the
+    router at 1, 2, and 4 supervisor shards, with TOTAL job count
+    scaling with the shard count — the single-process ceiling is what
+    sharding removes, so the signal is the per-endpoint p99 staying
+    flat (<= 1.2x the single-shard p99) while the job count scales
+    past it."""
+    from adaptdl_tpu.sched.router import Router
+    from adaptdl_tpu.sched.shard import ShardedCluster
+
+    out: dict = {"sched_shard_counts": list(shard_counts)}
+    p99s: dict[int, dict[str, float]] = {}
+    for count in shard_counts:
+        cluster = ShardedCluster(
+            count,
+            lease_ttl=60.0,
+            sweep_interval=3600.0,
+            state_kwargs={"alloc_commit_timeout": 0.0},
+        )
+        shard_map = cluster.start()
+        router = Router(shard_map)
+        url = router.start()
+        job_keys = []
+        for i in range(jobs_per_shard * count):
+            key = f"t{i:04d}/j0"
+            shard = cluster.shard_for(key)
+            shard.state.create_job(key, spec={"max_replicas": 4})
+            shard.state.update(
+                key, status="Running", allocation=["local"]
+            )
+            shard.state.register_worker(key, 0, 0, "127.0.0.1:0")
+            job_keys.append(key)
+        ctx = multiprocessing.get_context("fork")
+        queue = ctx.Queue()
+        procs = [
+            ctx.Process(
+                target=_sharded_worker_main,
+                args=(
+                    url,
+                    job_keys[w::workers] or job_keys,
+                    seconds,
+                    queue,
+                ),
+                daemon=True,
+            )
+            for w in range(workers)
+        ]
+        for proc in procs:
+            proc.start()
+        merged = {
+            "heartbeat": [],
+            "hints": [],
+            "config": [],
+            "discover": [],
+        }
+        for _ in procs:
+            lat = queue.get(timeout=seconds * 5 + 60)
+            for endpoint, values in lat.items():
+                merged[endpoint].extend(values)
+        for proc in procs:
+            proc.join(timeout=30)
+        router.stop()
+        cluster.stop()
+        p99s[count] = {}
+        for endpoint, values in merged.items():
+            p99 = _pct(values, 0.99)
+            p99s[count][endpoint] = p99
+            out[f"sched_shard{count}_{endpoint}_p50_s"] = round(
+                _pct(values, 0.5), 5
+            )
+            out[f"sched_shard{count}_{endpoint}_p99_s"] = round(
+                p99, 5
+            )
+            out[f"sched_shard{count}_{endpoint}_rps"] = round(
+                len(values) / max(seconds, 1e-9), 1
+            )
+    # The acceptance bar: at the highest shard count (job count
+    # scaled by the same factor), every endpoint's p99 stays within
+    # 1.2x of the single-shard p99.  Sub-SLO tails are exempt from
+    # the relative bound — with ~10^2 samples a p99 is nearly a max,
+    # so a few-ms GC blip would flap the gate without the absolute
+    # floor; a real serialization blowup still trips it.
+    base = p99s.get(min(shard_counts), {})
+    top = p99s.get(max(shard_counts), {})
+    flat_ok = all(
+        top[endpoint]
+        <= max(1.2 * base[endpoint], SLOS.get(endpoint, 0.25))
+        for endpoint in top
+    )
+    out["sched_shard_p99_flat_ok"] = flat_ok
+    return out
+
+
 def collect(quick: bool = False) -> dict:
     """Everything on one dict (bench.py merges this into BENCH)."""
     out = {}
@@ -217,6 +349,14 @@ def collect(quick: bool = False) -> dict:
         bench_supervisor(jobs=20, workers=4, seconds=3.0)
         if quick
         else bench_supervisor()
+    )
+    out.update(
+        bench_sharded(
+            shard_counts=(1, 2), jobs_per_shard=10, workers=4,
+            seconds=2.0,
+        )
+        if quick
+        else bench_sharded()
     )
     return out
 

@@ -1,0 +1,624 @@
+"""graftshard tests — partitioned supervisor shards + thin router.
+
+What must hold for the sharded control plane to be deployable:
+
+- the rendezvous shard map is deterministic across processes and
+  minimal-remap under shard add/remove (only moved tenants remap, and
+  only to/from the changed shard);
+- the journaled map file is atomic — an injected ``shard.map.write``
+  fault leaves the previous complete version served;
+- the router's forward is idempotent (replaying any worker request
+  through it is as safe as replaying against the shard directly) and
+  retries through a stale shard map by reloading the journaled file;
+- aggregation endpoints fan out and merge: a dead shard degrades to
+  an error marker, never a failed merge, and the merged ``/metrics``
+  stays a strictly valid Prometheus exposition with a ``shard`` label;
+- the 1-shard sharded deployment is BYTE-identical to the unsharded
+  supervisor — the provably-unchanged special case that makes the
+  subsystem safe to roll out.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from adaptdl_tpu import faults, rpc
+from adaptdl_tpu.sched.router import (
+    Router,
+    merge_metrics,
+    merge_status,
+    merge_watch,
+)
+from adaptdl_tpu.sched.shard import (
+    ShardMap,
+    ShardedCluster,
+    merged_inventory,
+    partition_slices,
+    plan_inventory_rebalance,
+    rendezvous_shard,
+    shard_key,
+)
+from adaptdl_tpu.sched.state import ClusterState
+from adaptdl_tpu.sched.supervisor import Supervisor
+
+from promcheck import validate_exposition
+
+HINTS = {"initBatchSize": 128, "maxBatchSize": 1280}
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    faults.reset()
+    rpc.reset_default_client()
+    yield
+    faults.reset()
+    rpc.reset_default_client()
+
+
+class _FrozenClock:
+    """Constant clock: every monotonic()/time() read returns the same
+    instant, so two runs making different NUMBERS of clock calls still
+    produce byte-identical payloads (the bit-equivalence harness)."""
+
+    @staticmethod
+    def monotonic() -> float:
+        return 1000.0
+
+    @staticmethod
+    def time() -> float:
+        return 1_700_000_000.0
+
+
+# ---- rendezvous hashing ----------------------------------------------
+
+
+def test_rendezvous_deterministic():
+    ids = [0, 1, 2, 3]
+    for key in ("tenant-a", "tenant-b", "x/y", ""):
+        first = rendezvous_shard(key, ids)
+        assert rendezvous_shard(key, list(reversed(ids))) == first
+        assert rendezvous_shard(key, ids) == first
+
+
+def test_rendezvous_minimal_remap_on_add():
+    keys = [f"tenant-{i}" for i in range(300)]
+    before = {k: rendezvous_shard(k, [0, 1, 2]) for k in keys}
+    after = {k: rendezvous_shard(k, [0, 1, 2, 3]) for k in keys}
+    moved = [k for k in keys if before[k] != after[k]]
+    # Every moved key lands on the NEW shard — no churn between
+    # surviving shards (the HRW property).
+    assert moved and all(after[k] == 3 for k in moved)
+    # The expected move fraction is 1/4; allow generous slack.
+    assert len(moved) / len(keys) < 0.45
+
+
+def test_rendezvous_minimal_remap_on_remove():
+    keys = [f"tenant-{i}" for i in range(300)]
+    before = {k: rendezvous_shard(k, [0, 1, 2]) for k in keys}
+    after = {k: rendezvous_shard(k, [0, 2]) for k in keys}
+    for k in keys:
+        if before[k] != 1:
+            # Keys not on the removed shard NEVER move.
+            assert after[k] == before[k]
+        else:
+            assert after[k] in (0, 2)
+
+
+def test_shard_key_is_tenant():
+    assert shard_key("ns-a/job-1") == "ns-a"
+    assert shard_key("bare") == "bare"
+
+
+def test_partition_slices_minimal_remap():
+    names = [f"slice-{i}" for i in range(64)]
+    before = partition_slices(names, [0, 1])
+    after = partition_slices(names, [0, 1, 2])
+    assert sorted(sum(after.values(), [])) == sorted(names)
+    for sid in (0, 1):
+        # Surviving shards only SHED slices (to the new shard).
+        assert set(after[sid]) <= set(before[sid])
+
+
+# ---- shard map (journaled, atomic) -----------------------------------
+
+
+def test_shard_map_roundtrip(tmp_path):
+    m = ShardMap({0: "http://h:1", 1: "http://h:2"}, version=7)
+    path = str(tmp_path / "map.json")
+    m.save(path)
+    loaded = ShardMap.load(path)
+    assert loaded.version == 7
+    assert loaded.shards == {0: "http://h:1", 1: "http://h:2"}
+    key = "tenant-x/job"
+    assert loaded.assign(key) == m.assign(key)
+    assert loaded.url_for(key) == m.shards[m.assign(key)]
+
+
+def test_shard_map_write_fault_preserves_previous(tmp_path):
+    path = str(tmp_path / "map.json")
+    ShardMap({0: "http://old:1"}, version=1).save(path)
+    faults.configure("shard.map.write=fail", seed=1234)
+    with pytest.raises(faults.InjectedFault):
+        ShardMap({0: "http://new:1"}, version=2).save(path)
+    faults.configure(None)
+    # The previous complete version is still what readers see.
+    loaded = ShardMap.load(path)
+    assert loaded.version == 1
+    assert loaded.shards == {0: "http://old:1"}
+
+
+# ---- router forwarding -----------------------------------------------
+
+
+@pytest.fixture()
+def two_shards():
+    cluster = ShardedCluster(
+        2, lease_ttl=30.0, sweep_interval=3600.0
+    )
+    shard_map = cluster.start()
+    router = Router(shard_map, circuit_cooldown=0.2)
+    router.start()
+    try:
+        yield cluster, router
+    finally:
+        router.stop()
+        cluster.stop()
+
+
+def _tenant_for(cluster, sid):
+    """A tenant name the cluster's map routes to shard ``sid``."""
+    for i in range(1000):
+        tenant = f"tenant-{i}"
+        if cluster.map.assign(f"{tenant}/j") == sid:
+            return tenant
+    raise AssertionError("no tenant found")
+
+
+def test_router_forwards_to_owning_shard(two_shards):
+    cluster, router = two_shards
+    client = rpc.default_client()
+    for sid in (0, 1):
+        key = f"{_tenant_for(cluster, sid)}/job-{sid}"
+        cluster.create_job(key, {})
+        resp = client.put(
+            f"{router.url}/register/{key}/0/0",
+            json={"address": "10.0.0.1:1234"},
+            endpoint="test/register",
+        )
+        assert resp.status_code == 200
+        resp = client.put(
+            f"{router.url}/hints/{key}",
+            json=HINTS,
+            endpoint="test/hints",
+        )
+        assert resp.status_code == 200
+        # The mutation landed on the owning shard and ONLY there.
+        owner = cluster.shards[sid].state
+        other = cluster.shards[1 - sid].state
+        assert owner.get_job(key) is not None
+        assert other.get_job(key) is None
+
+
+def test_router_forward_is_idempotent(two_shards):
+    cluster, router = two_shards
+    client = rpc.default_client()
+    key = f"{_tenant_for(cluster, 0)}/job"
+    cluster.create_job(key, {})
+    for _ in range(3):
+        resp = client.put(
+            f"{router.url}/register/{key}/0/0",
+            json={"address": "10.0.0.1:1234"},
+            endpoint="test/register",
+        )
+        assert resp.status_code == 200
+    workers = cluster.shards[0].state.get_workers(key)
+    assert workers == {0: "10.0.0.1:1234"}
+    for _ in range(2):
+        resp = client.put(
+            f"{router.url}/heartbeat/{key}/0",
+            json={"stepTimeEwma": 0.25},
+            endpoint="test/heartbeat",
+        )
+        assert resp.status_code == 200
+
+
+def test_router_passes_through_downstream_status(two_shards):
+    cluster, router = two_shards
+    client = rpc.default_client()
+    resp = client.get(
+        f"{router.url}/hints/{_tenant_for(cluster, 0)}/missing",
+        endpoint="test/hints",
+    )
+    assert resp.status_code == 404
+
+
+def test_router_fault_point_yields_500(two_shards):
+    cluster, router = two_shards
+    key = f"{_tenant_for(cluster, 0)}/job"
+    cluster.create_job(key, {})
+    faults.configure("router.forward.pre=fail@1", seed=1234)
+    # The worker-side client retries straight through the injected
+    # router blip — the same contract supervisor blips already have.
+    resp = rpc.default_client().put(
+        f"{router.url}/heartbeat/{key}/0",
+        json={},
+        endpoint="test/heartbeat",
+        attempts=3,
+    )
+    assert resp.status_code in (200, 404)
+    assert faults.hit_count("router.forward.pre") >= 1
+
+
+def test_router_stale_map_retry(tmp_path):
+    cluster = ShardedCluster(1, lease_ttl=30.0, sweep_interval=3600.0)
+    fresh_map = cluster.start()
+    key = "tenant-x/job"
+    cluster.create_job(key, {})
+    map_path = str(tmp_path / "map.json")
+    # Disk has the CURRENT map at a newer version; the router boots
+    # from a stale one naming a dead shard replica.
+    ShardMap(dict(fresh_map.shards), version=2).save(map_path)
+    stale = ShardMap({0: "http://127.0.0.1:9"}, version=1)
+    router = Router(stale, map_path=map_path, circuit_cooldown=0.2)
+    router.start()
+    try:
+        resp = rpc.default_client().put(
+            f"{router.url}/heartbeat/{key}/0",
+            json={},
+            endpoint="test/heartbeat",
+            attempts=4,
+            deadline=20.0,
+        )
+        assert resp.status_code in (200, 404)
+        assert router.current_map().version == 2
+    finally:
+        router.stop()
+        cluster.stop()
+
+
+def test_router_without_newer_map_returns_503(tmp_path):
+    stale = ShardMap({0: "http://127.0.0.1:9"}, version=1)
+    router = Router(stale, circuit_cooldown=0.2, forward_deadline=1.0)
+    router.start()
+    try:
+        resp = rpc.default_client().put(
+            f"{router.url}/heartbeat/tenant-x/job/0",
+            json={},
+            endpoint="test/heartbeat",
+            attempts=1,
+            retry_statuses=(),
+        )
+        assert resp.status_code == 503
+    finally:
+        router.stop()
+
+
+# ---- aggregation -----------------------------------------------------
+
+
+def test_router_aggregates_status_and_watch(two_shards):
+    cluster, router = two_shards
+    client = rpc.default_client()
+    keys = [
+        f"{_tenant_for(cluster, sid)}/job-{sid}" for sid in (0, 1)
+    ]
+    for key in keys:
+        cluster.create_job(key, {})
+    status = client.get(
+        f"{router.url}/status", endpoint="cli/status"
+    ).json()
+    assert sorted(status["jobs"]) == sorted(keys)
+    assert set(status["shards"]) == {"0", "1"}
+    assert all(
+        not info["error"] for info in status["shards"].values()
+    )
+    watch = client.get(
+        f"{router.url}/watch", endpoint="cli/watch"
+    ).json()
+    assert watch["shards"] == [0, 1]
+
+
+def test_router_merged_metrics_are_valid_prometheus(two_shards):
+    cluster, router = two_shards
+    client = rpc.default_client()
+    cluster.create_job(f"{_tenant_for(cluster, 0)}/job", {})
+    text = client.get(
+        f"{router.url}/metrics", endpoint="cli/metrics"
+    ).text
+    validate_exposition(text)
+    sample_lines = [
+        line
+        for line in text.splitlines()
+        if line and not line.startswith("#")
+    ]
+    assert sample_lines
+    assert all('shard="' in line for line in sample_lines)
+
+
+def test_dead_shard_degrades_to_error_marker(two_shards):
+    cluster, router = two_shards
+    client = rpc.default_client()
+    live_key = f"{_tenant_for(cluster, 0)}/job"
+    cluster.create_job(live_key, {})
+    cluster.kill_shard(1)
+    status = client.get(
+        f"{router.url}/status", endpoint="cli/status"
+    ).json()
+    assert live_key in status["jobs"]
+    assert status["shards"]["1"]["error"]
+    assert not status["shards"]["0"]["error"]
+    # The merged exposition simply omits the dead shard.
+    text = client.get(
+        f"{router.url}/metrics", endpoint="cli/metrics"
+    ).text
+    validate_exposition(text)
+    assert 'shard="0"' in text and 'shard="1"' not in text
+
+
+# ---- merge units -----------------------------------------------------
+
+
+def test_merge_metrics_single_help_type_per_family():
+    shard0 = (
+        "# HELP adaptdl_jobs jobs\n"
+        "# TYPE adaptdl_jobs gauge\n"
+        "adaptdl_jobs 3\n"
+        '# HELP adaptdl_lat seconds\n'
+        "# TYPE adaptdl_lat histogram\n"
+        'adaptdl_lat_bucket{le="1"} 2\n'
+        'adaptdl_lat_bucket{le="+Inf"} 2\n'
+        "adaptdl_lat_sum 0.5\n"
+        "adaptdl_lat_count 2\n"
+    )
+    shard1 = shard0.replace(" 3\n", " 5\n")
+    merged = merge_metrics([(0, shard0), (1, shard1)])
+    validate_exposition(merged)
+    assert merged.count("# HELP adaptdl_jobs") == 1
+    assert merged.count("# TYPE adaptdl_jobs") == 1
+    assert 'adaptdl_jobs{shard="0"} 3' in merged
+    assert 'adaptdl_jobs{shard="1"} 5' in merged
+    assert 'adaptdl_lat_bucket{shard="0",le="1"} 2' in merged
+
+
+def test_merge_status_counters_and_tables():
+    merged = merge_status(
+        {
+            0: {
+                "jobs": {"a/j": {"status": "Running"}},
+                "slotStrikes": {"s0": 1},
+                "recovery": {"recoveries": 1, "tornRecords": 2},
+                "hazardRates": {"spot": 0.5},
+                "preemptionNotices": {"spot": 2},
+            },
+            1: {
+                "jobs": {"b/j": {"status": "Running"}},
+                "slotStrikes": {"s1": 2},
+                "recovery": {"recoveries": 3, "tornRecords": 0},
+                "hazardRates": {"spot": 0.25},
+                "preemptionNotices": {"spot": 1},
+            },
+            2: {"error": "down"},
+        }
+    )
+    assert sorted(merged["jobs"]) == ["a/j", "b/j"]
+    assert merged["slotStrikes"] == {"s0": 1, "s1": 2}
+    assert merged["recovery"]["recoveries"] == 4
+    assert merged["recovery"]["tornRecords"] == 2
+    assert merged["hazardRates"] == {"spot": 0.5}
+    assert merged["preemptionNotices"] == {"spot": 3}
+    assert merged["shards"]["2"]["error"] == "down"
+
+
+def test_merge_watch_synthesizes_cluster_line():
+    merged = merge_watch(
+        {
+            0: {
+                "samples": 2,
+                "cluster": [
+                    {"jobs": 1, "chipsAllocated": 4, "chipsTotal": 8}
+                ],
+                "tenants": {"a": {"series": [], "burn": 0}},
+                "jobs": {},
+                "suspectSlots": {},
+                "cycles": [],
+                "overhead": {"sampleS": 0.1, "cycleS": 0.2},
+            },
+            1: {
+                "samples": 3,
+                "cluster": [
+                    {"jobs": 2, "chipsAllocated": 8, "chipsTotal": 8}
+                ],
+                "tenants": {"b": {"series": [], "burn": 1}},
+                "jobs": {},
+                "suspectSlots": {},
+                "cycles": [],
+                "overhead": {"sampleS": 0.3, "cycleS": 0.1},
+            },
+        }
+    )
+    assert merged["samples"] == 5
+    latest = merged["cluster"][-1]
+    assert latest["jobs"] == 3
+    assert latest["chipsAllocated"] == 12
+    assert latest["chipsTotal"] == 16
+    assert latest["utilization"] == 0.75
+    assert sorted(merged["tenants"]) == ["a", "b"]
+
+
+# ---- merged inventory + rebalance planning ---------------------------
+
+
+def test_merged_inventory_view(two_shards):
+    cluster, router = two_shards
+    keys = [
+        f"{_tenant_for(cluster, sid)}/job-{sid}" for sid in (0, 1)
+    ]
+    for key in keys:
+        cluster.create_job(key, {})
+    view = merged_inventory(cluster.map)
+    assert sorted(view["shards"]) == [0, 1]
+    assert sorted(view["jobs"]) == sorted(keys)
+    for key in keys:
+        assert view["jobs"][key] == cluster.map.assign(key)
+    # A fresh create marks the job dirty on its own shard; the merged
+    # dirty set is the union.
+    assert sorted(view["dirtyJobs"]) == sorted(keys)
+
+
+def test_merged_inventory_slices_follow_partition(tmp_path):
+    slices = [f"slice-{i}" for i in range(8)]
+    cluster = ShardedCluster(
+        2, slices=slices, lease_ttl=30.0, sweep_interval=3600.0
+    )
+    shard_map = cluster.start()
+    try:
+        view = merged_inventory(shard_map)
+        assert sorted(view["slices"]) == slices
+        expected = partition_slices(slices, [0, 1])
+        for sid, names in expected.items():
+            for name in names:
+                assert view["slices"][name] == sid
+    finally:
+        cluster.stop()
+
+
+def test_plan_inventory_rebalance_deterministic_and_balanced():
+    merged = {
+        "shards": [0, 1],
+        "jobs": {"a/1": 0, "a/2": 0, "b/1": 1, "b/2": 1},
+        "dirtyJobs": [],
+        "slices": {f"s{i}": 0 for i in range(6)},
+    }
+    plan = plan_inventory_rebalance(merged)
+    assert plan == plan_inventory_rebalance(merged)
+    # Equal job shares -> half the slices move to the empty shard.
+    assert len(plan) == 3
+    assert all(m["from"] == 0 and m["to"] == 1 for m in plan)
+    # Balanced input -> empty plan.
+    balanced = dict(merged)
+    balanced["slices"] = {
+        f"s{i}": (0 if i < 3 else 1) for i in range(6)
+    }
+    assert plan_inventory_rebalance(balanced) == []
+    # No jobs -> nothing to optimize for.
+    idle = dict(merged)
+    idle["jobs"] = {}
+    assert plan_inventory_rebalance(idle) == []
+
+
+# ---- 1-shard bit-equivalence -----------------------------------------
+
+
+def _drive(base_url: str) -> list[str]:
+    """One deterministic op sequence against a control plane at
+    ``base_url``; returns the raw response bodies, in order."""
+    client = rpc.default_client()
+    out = []
+
+    def record(resp):
+        assert resp.status_code == 200, resp.text
+        out.append(resp.text)
+
+    for key in ("tenant-a/j0", "tenant-b/j1"):
+        record(
+            client.put(
+                f"{base_url}/register/{key}/0/0",
+                json={"address": "10.0.0.1:1", "processes": 1},
+                endpoint="test/register",
+            )
+        )
+        record(
+            client.put(
+                f"{base_url}/hints/{key}",
+                json=HINTS,
+                endpoint="test/hints",
+            )
+        )
+        record(
+            client.put(
+                f"{base_url}/heartbeat/{key}/0",
+                json={"stepTimeEwma": 0.5},
+                endpoint="test/heartbeat",
+            )
+        )
+        record(
+            client.get(
+                f"{base_url}/hints/{key}", endpoint="test/hints"
+            )
+        )
+        record(
+            client.get(
+                f"{base_url}/config/{key}", endpoint="test/config"
+            )
+        )
+    return out
+
+
+def test_one_shard_bit_identical_to_unsharded(tmp_path):
+    """The provably-unchanged special case: every worker-visible
+    response from a 1-shard sharded deployment (through the router)
+    is BYTE-identical to the classic unsharded supervisor's, given a
+    frozen clock and the same op sequence."""
+    keys = ("tenant-a/j0", "tenant-b/j1")
+
+    # Classic unsharded supervisor.
+    plain_state = ClusterState(clock=_FrozenClock())
+    for key in keys:
+        plain_state.create_job(key, {})
+    plain_sup = Supervisor(
+        plain_state, lease_ttl=30.0, sweep_interval=3600.0
+    )
+    plain_url = plain_sup.start()
+
+    # 1-shard sharded deployment behind the router.
+    cluster = ShardedCluster(
+        1,
+        lease_ttl=30.0,
+        sweep_interval=3600.0,
+        state_kwargs={"clock": _FrozenClock()},
+    )
+    shard_map = cluster.start()
+    for key in keys:
+        cluster.create_job(key, {})
+    router = Router(shard_map)
+    router_url = router.start()
+
+    try:
+        plain = _drive(plain_url)
+        sharded = _drive(router_url)
+        assert plain == sharded
+        # The shard's own /status (what failover preserves) matches
+        # the unsharded one byte-for-byte too.
+        client = rpc.default_client()
+        plain_status = client.get(
+            f"{plain_url}/status", endpoint="cli/status"
+        ).text
+        shard_status = client.get(
+            f"{cluster.shards[0].url}/status", endpoint="cli/status"
+        ).text
+        assert plain_status == shard_status
+        # And the router's merged views carry the same tables — the
+        # only delta is the ``shards`` section the merge adds.
+        merged = client.get(
+            f"{router_url}/status", endpoint="cli/status"
+        ).json()
+        assert json.dumps(merged["jobs"], sort_keys=True) == json.dumps(
+            json.loads(plain_status)["jobs"], sort_keys=True
+        )
+        plain_watch = client.get(
+            f"{plain_url}/watch", endpoint="cli/watch"
+        ).json()
+        merged_watch = client.get(
+            f"{router_url}/watch", endpoint="cli/watch"
+        ).json()
+        assert merged_watch.pop("shards") == [0]
+        assert json.dumps(merged_watch, sort_keys=True) == json.dumps(
+            plain_watch, sort_keys=True
+        )
+    finally:
+        router.stop()
+        plain_sup.stop()
+        cluster.stop()
