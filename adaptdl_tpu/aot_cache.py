@@ -205,7 +205,7 @@ def load(fp: str) -> Any | None:
 # Mutated by every save_async caller AND drained by wait_for_writes
 # from tests/atexit; graftcheck enforces the lock (GC101).
 _writers: list[threading.Thread] = []  # guarded-by: _writers_lock
-_writers_lock = threading.Lock()
+_writers_lock = threading.Lock()  # lock-order: 41
 _atexit_registered = False
 
 

@@ -82,6 +82,10 @@ def _discover_peers() -> dict[int, str] | None:  # wire: produces=register
 
 
 _heartbeat_stop: threading.Event | None = None
+_heartbeat_thread: threading.Thread | None = None
+# The handoff-manifest prefetch rides a side thread during bootstrap;
+# the handle is kept so teardown can prove it drained.
+_prefetch_thread: threading.Thread | None = None
 # The restart->first-step span opens at most once per incarnation:
 # initialize_job is documented idempotent, and a repeat call must not
 # re-arm a span that would then "measure" an arbitrary mid-training
@@ -98,7 +102,7 @@ def start_heartbeat() -> threading.Event | None:
     this thread only matters when a worker is alive but not talking —
     e.g. rank > 0, or a long compile. Returns the stop event, or None
     when heartbeating is not applicable (no supervisor, disabled)."""
-    global _heartbeat_stop
+    global _heartbeat_stop, _heartbeat_thread
     interval = env.heartbeat_interval()
     if not env.supervisor_url() or not env.job_id() or interval <= 0:
         return None
@@ -127,18 +131,30 @@ def start_heartbeat() -> threading.Event | None:
             # exactly what a rescale trace must be able to show.
             trace.flush_to_supervisor()
 
-    thread = threading.Thread(
+    _heartbeat_thread = threading.Thread(
         target=loop, name="adaptdl-heartbeat", daemon=True
     )
-    thread.start()
+    _heartbeat_thread.start()
     _heartbeat_stop = stop
     return stop
+
+
+def stop_heartbeat(timeout: float | None = 5.0) -> None:
+    """Stop the heartbeat daemon and join it (tests, clean worker
+    shutdown). Safe when no heartbeat is running; a later
+    :func:`start_heartbeat` starts a fresh one."""
+    if _heartbeat_stop is not None:
+        _heartbeat_stop.set()
+    if _heartbeat_thread is not None:
+        _heartbeat_thread.join(timeout)
+    if _prefetch_thread is not None:
+        _prefetch_thread.join(timeout)
 
 
 def initialize_job(distributed: bool | None = None) -> None:
     """Initialize this process for (possibly multi-host) elastic
     training. Idempotent; safe to call in single-process jobs."""
-    global _restart_span_armed
+    global _restart_span_armed, _prefetch_thread
     # Adopt the rescale trace context the launcher exported
     # (ADAPTDL_TRACEPARENT) BEFORE anything records a span: the
     # restore/first-step spans of this incarnation must land in the
@@ -185,11 +201,12 @@ def initialize_job(distributed: bool | None = None) -> None:
             # checkpoint.
             from adaptdl_tpu import handoff
 
-            threading.Thread(
+            _prefetch_thread = threading.Thread(
                 target=handoff.prefetch,
                 name="adaptdl-handoff-prefetch",
                 daemon=True,
-            ).start()
+            )
+            _prefetch_thread.start()
         if not collective.initialized():
             master = peers.get(0) if peers else None
             collective.initialize(

@@ -298,7 +298,7 @@ class AsyncSaveHandle:
         # phase's thread pool (one entry per state, but one shared
         # dict) and may be read by the trainer thread while the
         # background write is still in flight.
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: 40
         # name -> {"snapshot_s": ..., "write_s": ...}
         self.per_state: dict[str, dict[str, float]] = {}  # guarded-by: _lock
 

@@ -1,0 +1,35 @@
+"""Concurrency policy registry for the control plane.
+
+``DETACHED_SPAWNS`` is the single source of truth for deliberately
+unsupervised spawns — threads or processes that are *meant* to
+outlive the function (or the process) that started them. graftcheck's
+lifecycle pass (GC1401/GC1402, ``docs/static-analysis.md``) requires
+every ``threading.Thread`` / ``subprocess.Popen`` / executor spawn to
+either have reachable cleanup or carry a ``# detached: <name>``
+annotation whose name is registered here; an unregistered name is a
+finding, so a leak cannot be sanctioned by a typo.
+
+Keep this a plain literal dict — it is parsed statically (ast), the
+same way the fault-injection catalog in :mod:`adaptdl_tpu.faults` is.
+
+The value documents WHY the spawn may leak and WHO eventually reaps
+it — every entry must name a terminator.
+"""
+
+from __future__ import annotations
+
+DETACHED_SPAWNS = {
+    "handoff-child-server": (
+        "The doomed incarnation's handoff shard server: forked with "
+        "start_new_session so it survives the parent's exit and "
+        "keeps serving checkpoint chunks to the successor; it "
+        "self-terminates on its --ttl deadline and the successor "
+        "kills it early on pull completion."
+    ),
+    "warm-successor": (
+        "The speculatively pre-warmed successor process published "
+        "ahead of an allocation commit: it must outlive the "
+        "launcher's decision window; WarmupManager.discard() or the "
+        "commit cutover reaps it, and its --ttl is the backstop."
+    ),
+}

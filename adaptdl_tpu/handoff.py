@@ -441,7 +441,7 @@ def spawn_server(  # wire: produces=handoff_payload
     if not payload:
         return None
     try:
-        proc = subprocess.Popen(
+        proc = subprocess.Popen(  # detached: handoff-child-server
             [sys.executable, "-m", "adaptdl_tpu.handoff"],
             stdin=subprocess.PIPE,
             stdout=subprocess.DEVNULL,

@@ -144,7 +144,7 @@ class MetricsState:
 
 _state = MetricsState()
 _last_fit_time: float | None = None
-_profile_lock = threading.Lock()
+_profile_lock = threading.Lock()  # lock-order: 30
 _fit_thread: threading.Thread | None = None
 _active_topology: tuple[int, int, int, int, int] | None = None
 

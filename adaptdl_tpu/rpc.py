@@ -90,7 +90,7 @@ class RpcClient:
 
     def __init__(self, sleep=time.sleep):
         self._sleep = sleep
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: 20
         self._circuits: dict[str, _Circuit] = {}  # guarded-by: _lock
         # Jitter is cosmetic (thundering-herd smearing), not part of
         # the deterministic fault schedule, so a plain PRNG is fine.
@@ -320,7 +320,7 @@ class RpcClient:
 # Process-wide shared client, created on first use. A lock (not a
 # fast-path read) is fine here: callers cache the result or are
 # already off the hot path.
-_default_lock = threading.Lock()
+_default_lock = threading.Lock()  # lock-order: 21
 _default: RpcClient | None = None  # guarded-by: _default_lock
 
 

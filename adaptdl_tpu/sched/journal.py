@@ -107,7 +107,7 @@ class StateJournal:
             if group_commit_s is None
             else max(float(group_commit_s), 0.0)
         )
-        self._io_lock = threading.Lock()
+        self._io_lock = threading.Lock()  # lock-order: 60
         self._fsync_cv = threading.Condition(self._io_lock)
         self._fh = None  # guarded-by: _io_lock
         self._fsync_pending = False  # guarded-by: _io_lock
@@ -244,6 +244,13 @@ class StateJournal:
             if self._fh is not None:
                 self._fh.close()
                 self._fh = None
+            flusher = self._fsync_thread
+        # Join OUTSIDE _io_lock: the flusher must reacquire it to
+        # observe _closed and exit, so joining under the lock would
+        # deadlock. After this returns no background fsync can race a
+        # caller that deletes or reopens the journal files.
+        if flusher is not None:
+            flusher.join()
 
     # -- recovery ------------------------------------------------------
 

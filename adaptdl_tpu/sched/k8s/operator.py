@@ -31,6 +31,7 @@ without a cluster.
 from __future__ import annotations
 
 import asyncio
+import functools
 import logging
 import sys
 
@@ -117,6 +118,16 @@ class Operator:
         self._slice_inventory: dict[str, NodeInfo] = {}
         self._published_status: dict[str, dict] = {}
 
+    @staticmethod
+    async def _offload(fn, *args, **kwargs):
+        """Run a journaled ClusterState mutation (or any fsync-backed
+        read) off the event loop: the watch stream and reconcile loop
+        share one loop with the supervisor's handlers, and a journal
+        append stalls it behind disk latency otherwise."""
+        return await asyncio.get_event_loop().run_in_executor(
+            None, functools.partial(fn, *args, **kwargs)
+        )
+
     async def run(self):
         client, config, watch = _require_k8s()
         await config.load_incluster_config()
@@ -165,7 +176,9 @@ class Operator:
             expander=self.expander,
             interval=sched_config.allocator_interval(),
         )
-        self.allocator.start()
+        # Allocator.start runs its first cycle synchronously (journal
+        # appends included) — off the loop with it.
+        await self._offload(self.allocator.start)
         self.expander.start()
         await asyncio.gather(
             self._watch_jobs(api, watch),
@@ -232,7 +245,9 @@ class Operator:
             self.namespace,
             PLURAL,
         ):
-            self.handle_job_event(event)
+            # create/update/remove all journal (fsync) — keep the
+            # watch stream's loop responsive while they land.
+            await self._offload(self.handle_job_event, event)
 
     def handle_job_event(self, event: dict) -> None:
         """Apply one AdaptDLJob watch event to the cluster state
@@ -274,7 +289,8 @@ class Operator:
                 self._slice_inventory = await self._discover_slices(core)
             except Exception:  # noqa: BLE001
                 LOG.exception("slice discovery failed; keeping last")
-            for key, record in self.state.jobs().items():
+            records = await self._offload(self.state.jobs)
+            for key, record in records.items():
                 try:
                     await self._reconcile_job(api, core, key, record)
                 except Exception:  # noqa: BLE001
@@ -356,7 +372,9 @@ class Operator:
             # (without this a zero-allocation job reports Stopping
             # forever — no later branch fires at live == desired == []).
             if record.status != "Pending":
-                self.state.update(key, status="Pending")
+                await self._offload(
+                    self.state.update, key, status="Pending"
+                )
             return
 
         def pod_group(pod):
@@ -405,7 +423,9 @@ class Operator:
             and len(succeeded) == len(live) == len(desired)
         ):
             LOG.info("%s: all %d workers succeeded", key, len(live))
-            self.state.update(key, status="Succeeded")
+            await self._offload(
+                self.state.update, key, status="Succeeded"
+            )
             for pod in live:
                 await core.delete_namespaced_pod(
                     pod.metadata.name, namespace
@@ -425,7 +445,8 @@ class Operator:
             failures = record.failures + len(fresh)
             if fresh:
                 LOG.warning("%s worker failures: %s", key, fresh)
-                self.state.update(
+                await self._offload(
+                    self.state.update,
                     key,
                     failures=failures,
                     counted_failures=record.counted_failures
@@ -438,7 +459,9 @@ class Operator:
                     failures,
                     self.max_failures,
                 )
-                self.state.update(key, status="Failed")
+                await self._offload(
+                    self.state.update, key, status="Failed"
+                )
                 for pod in live:
                     await core.delete_namespaced_pod(
                         pod.metadata.name, namespace
@@ -454,26 +477,34 @@ class Operator:
         ):
             # Stop everything; next pass recreates at the new group.
             if live:
-                self.state.update(key, status="Stopping")
+                await self._offload(
+                    self.state.update, key, status="Stopping"
+                )
                 for pod in live:
                     await core.delete_namespaced_pod(
                         pod.metadata.name, namespace
                     )
                 return
-            self.state.update(key, group=record.group + 1)
-            record = self.state.get_job(key)
+            await self._offload(
+                self.state.update, key, group=record.group + 1
+            )
+            record = await self._offload(self.state.get_job, key)
             for rank, node in enumerate(desired):
                 await core.create_namespaced_pod(
                     namespace,
                     self._worker_pod(name, record, rank, node),
                 )
-            self.state.update(
-                key, status="Starting" if desired else "Pending"
+            await self._offload(
+                self.state.update,
+                key,
+                status="Starting" if desired else "Pending",
             )
         elif record.status == "Starting" and live:
             # Full complement at the right config and nothing
             # terminated: the group is running.
-            self.state.update(key, status="Running")
+            await self._offload(
+                self.state.update, key, status="Running"
+            )
 
     def _worker_pod(self, name, record, rank, node_pool):
         from adaptdl_tpu.sched import config as sched_config

@@ -57,6 +57,10 @@ _notice_lock = threading.Lock()
 # read by the drain/notify paths and tests. None = no notice yet.
 _notice: dict | None = None  # guarded-by: _notice_lock
 _listener_stop: threading.Event | None = None  # guarded-by: _notice_lock
+# Handles of the background threads this module starts, kept so
+# stop_listener() can prove them drained (tests, explicit teardown).
+_listener_thread: threading.Thread | None = None
+_notify_thread: threading.Thread | None = None
 
 
 def poll_status(
@@ -153,7 +157,7 @@ def deliver_notice(  # wire: produces=preempt_notice
     loop checkpoints and exits 143 at the next step boundary, and —
     with ``notify`` — reports the notice to the supervisor in the
     background so the successor's re-placement overlaps the drain."""
-    global _notice
+    global _notice, _notify_thread
     if notice_s is None:
         notice_s = env.preempt_notice_s()
     budget = max(float(notice_s) - env.preempt_margin_s(), 1.0)
@@ -187,11 +191,12 @@ def deliver_notice(  # wire: produces=preempt_notice
     )
     _signal.set_exit_flag(True)
     if notify:
-        threading.Thread(
+        _notify_thread = threading.Thread(
             target=notify_supervisor,
             name="adaptdl-preempt-notify",
             daemon=True,
-        ).start()
+        )
+        _notify_thread.start()
     return True
 
 
@@ -395,10 +400,16 @@ def start_listener(
             if stop.wait(wait):
                 return
 
-    thread = threading.Thread(
+    global _listener_thread, _listener_stop
+    with _notice_lock:
+        # Record the stop event for stop_listener() even when the
+        # caller bypassed ensure_listener(): every started poller must
+        # be stoppable through the module-level teardown path.
+        _listener_stop = stop
+    _listener_thread = threading.Thread(
         target=loop, name="adaptdl-preemption", daemon=True
     )
-    thread.start()
+    _listener_thread.start()
     return stop
 
 
@@ -417,3 +428,18 @@ def ensure_listener() -> threading.Event | None:
     with _notice_lock:
         _listener_stop = stop
     return stop
+
+
+def stop_listener(timeout: float | None = 5.0) -> None:
+    """Stop the notice listener and join the background threads this
+    module started — the poll loop and any in-flight notify post.
+    Safe when nothing is running; tests and explicit worker teardown
+    call this so no poller outlives its process's useful life."""
+    with _notice_lock:
+        stop = _listener_stop
+    if stop is not None:
+        stop.set()
+    if _listener_thread is not None:
+        _listener_thread.join(timeout)
+    if _notify_thread is not None:
+        _notify_thread.join(timeout)

@@ -326,7 +326,7 @@ class ClusterState:
         hazard_tau_s: float | None = None,
         clock=None,
     ):
-        self._cond = threading.Condition()
+        self._cond = threading.Condition()  # lock-order: 10
         # Injectable clock (``monotonic()`` + ``time()``): defaults to
         # the real ``time`` module; the discrete-event simulator
         # (adaptdl_tpu/sim) passes a virtual clock so this exact state

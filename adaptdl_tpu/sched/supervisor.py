@@ -118,7 +118,7 @@ class Supervisor(ThreadedHttpServer):
         # Per-job store of worker-posted trace spans (graftscope).
         # Bounded like the in-process ring buffer; written by the
         # trace-intake executor thread, read by GET /trace.
-        self._trace_lock = threading.Lock()
+        self._trace_lock = threading.Lock()  # lock-order: 50
         self._trace_store: dict[str, deque] = {}  # guarded-by: _trace_lock
         # Default cadence: a quarter of whichever expiry clock is
         # active (lease TTL, else the allocation-commit timeout).
@@ -163,16 +163,24 @@ class Supervisor(ThreadedHttpServer):
         deadline = (
             asyncio.get_event_loop().time() + _DISCOVER_TIMEOUT
         )
-        while True:
+
+        def probe():
+            # State reads take _cond, which journal appends hold
+            # across fsync — poll from the executor, not the loop.
             record = self._state.get_job(key)
-            if record is not None and record.group == group:
-                workers = self._state.get_workers(key) or {}
-                if (want and len(workers) >= want) or (
-                    not want and workers
-                ):
-                    return web.json_response(
-                        {str(rank): addr for rank, addr in workers.items()}
-                    )
+            if record is None or record.group != group:
+                return None
+            return self._state.get_workers(key) or {}
+
+        while True:
+            workers = await self._offload(probe)
+            if workers is not None and (
+                (want and len(workers) >= want)
+                or (not want and workers)
+            ):
+                return web.json_response(
+                    {str(rank): addr for rank, addr in workers.items()}
+                )
             if asyncio.get_event_loop().time() > deadline:
                 return web.json_response(
                     {"error": "discover timeout"}, status=408
@@ -187,10 +195,10 @@ class Supervisor(ThreadedHttpServer):
         group = int(request.match_info["group"])
         rank = int(request.match_info["rank"])
         body = await request.json()
-        if self._state.get_job(key) is None:
-            return web.json_response({"error": "no such job"}, status=404)
 
-        def mutate() -> None:
+        def mutate() -> bool:
+            if self._state.get_job(key) is None:
+                return False
             if self._state.register_worker(
                 key,
                 group,
@@ -206,8 +214,10 @@ class Supervisor(ThreadedHttpServer):
                 # a rank the current incarnation doesn't run (its
                 # expiry would degrade a healthy job).
                 self._renew(key, rank)
+            return True
 
-        await self._offload(mutate)
+        if not await self._offload(mutate):
+            return web.json_response({"error": "no such job"}, status=404)
         return web.json_response({"ok": True})
 
     @_faultable("sup.heartbeat.pre")
@@ -261,11 +271,11 @@ class Supervisor(ThreadedHttpServer):
             sched_hints.validate_hints(hints)
         except ValueError as exc:
             return web.json_response({"error": str(exc)}, status=400)
-        if self._state.get_job(key) is None:
-            return web.json_response({"error": "no such job"}, status=404)
         group = _group_param(request)
 
-        def mutate() -> None:
+        def mutate() -> bool:
+            if self._state.get_job(key) is None:
+                return False
             self._state.update(key, hints=hints)
             # graftwatch: the trainer-measured goodput rides the hint
             # post; the watch store pairs it with the model's
@@ -277,14 +287,16 @@ class Supervisor(ThreadedHttpServer):
             # a liveness beat so chatty jobs never need a dedicated
             # beat.
             self._renew(key, 0, group=group)
+            return True
 
-        await self._offload(mutate)
+        if not await self._offload(mutate):
+            return web.json_response({"error": "no such job"}, status=404)
         return web.json_response({"ok": True})
 
     @_faultable("sup.hints.get.pre")
     async def _get_hints(self, request: web.Request) -> web.Response:
         key = "{namespace}/{name}".format(**request.match_info)
-        record = self._state.get_job(key)
+        record = await self._offload(self._state.get_job, key)
         if record is None:
             return web.json_response({"error": "no such job"}, status=404)
         return web.json_response(record.hints or {})
@@ -330,12 +342,10 @@ class Supervisor(ThreadedHttpServer):
             body = {}
         if not isinstance(body, dict):
             body = {}
-        if self._state.get_job(key) is None:
-            return web.json_response(
-                {"error": "no such job"}, status=404
-            )
 
-        def mutate() -> bool:
+        def mutate() -> bool | None:
+            if self._state.get_job(key) is None:
+                return None
             accepted = self._state.report_preemption(
                 key,
                 group=body.get("group"),
@@ -354,6 +364,10 @@ class Supervisor(ThreadedHttpServer):
             return accepted
 
         accepted = await self._offload(mutate)
+        if accepted is None:
+            return web.json_response(
+                {"error": "no such job"}, status=404
+            )
         return web.json_response(
             {"ok": True, "draining": bool(accepted)}
         )
@@ -398,12 +412,18 @@ class Supervisor(ThreadedHttpServer):
     @_faultable("sup.handoff.get.pre")
     async def _get_handoff(self, request: web.Request) -> web.Response:
         key = "{namespace}/{name}".format(**request.match_info)
-        if self._state.get_job(key) is None:
+
+        def fetch():
+            if self._state.get_job(key) is None:
+                return None
+            return self._state.get_handoff(key) or {}
+
+        handoff = await self._offload(fetch)
+        if handoff is None:
             return web.json_response(
                 {"error": "no such job"}, status=404
             )
-        handoff = self._state.get_handoff(key)
-        return web.json_response(handoff or {})
+        return web.json_response(handoff)
 
     @_faultable("sup.candidate.pre")
     async def _get_candidate(  # wire: produces=candidate_alloc,envelope
@@ -415,11 +435,18 @@ class Supervisor(ThreadedHttpServer):
         polls this to pre-warm a successor; 404 with no candidate
         means nothing is predicted — warm nothing, rescale cold."""
         key = "{namespace}/{name}".format(**request.match_info)
-        if self._state.get_job(key) is None:
+
+        def fetch():
+            if self._state.get_job(key) is None:
+                return None
+            return (self._state.get_candidate(key),)
+
+        found = await self._offload(fetch)
+        if found is None:
             return web.json_response(
                 {"error": "no such job"}, status=404
             )
-        candidate = self._state.get_candidate(key)
+        candidate = found[0]
         if candidate is None:
             return web.json_response(
                 {"error": "no candidate"}, status=404
@@ -435,7 +462,14 @@ class Supervisor(ThreadedHttpServer):
         + allocation epoch/state + lease ages, slot strikes and
         quarantine, and durable-state recovery info — what
         ``adaptdl-tpu status`` renders so an operator can see WHY an
-        allocation was withdrawn or rolled back."""
+        allocation was withdrawn or rolled back. Assembled entirely on
+        the executor: every section takes _cond (or the watch lock),
+        and a mid-append fsync must not stall heartbeats behind it."""
+        return web.json_response(
+            await self._offload(self._status_payload)
+        )
+
+    def _status_payload(self) -> dict:
         payload = self._state.status_snapshot()
         for job in payload["jobs"].values():
             # Remaining seconds -> age since last renewal (operators
@@ -470,15 +504,11 @@ class Supervisor(ThreadedHttpServer):
         payload["preemptionNotices"] = preempt["noticesByKind"]
         # graftwatch: measured vs predicted goodput, drift, and the
         # re-profiling flag per job — "is this job healthy" answered
-        # from /status alone, no Prometheus scrape needed. Offloaded:
-        # the watch lock may be contended by a mid-sample allocator
-        # cycle, and the event loop must not wait on it.
-        watch_fields = await self._offload(
-            self._state.watch.status_fields
-        )
+        # from /status alone, no Prometheus scrape needed.
+        watch_fields = self._state.watch.status_fields()
         for key, job in payload["jobs"].items():
             job.update(watch_fields.get(key, {}))
-        return web.json_response(payload)
+        return payload
 
     # -- graftwatch: goodput accounting + decision provenance ---------
 
@@ -523,7 +553,7 @@ class Supervisor(ThreadedHttpServer):
         explain record (winning allocation, mesh shape, objective
         terms) plus retained history and the cycle's top-k losers."""
         key = "{namespace}/{name}".format(**request.match_info)
-        if self._state.get_job(key) is None:
+        if await self._offload(self._state.get_job, key) is None:
             return web.json_response(
                 {"error": "no such job"}, status=404
             )
@@ -576,7 +606,7 @@ class Supervisor(ThreadedHttpServer):
                 {"error": "body must be {\"spans\": [{...}, ...]}"},
                 status=400,
             )
-        if self._state.get_job(key) is None:
+        if await self._offload(self._state.get_job, key) is None:
             return web.json_response(
                 {"error": "no such job"}, status=404
             )
@@ -657,7 +687,7 @@ class Supervisor(ThreadedHttpServer):
         self, request: web.Request
     ) -> web.Response:
         key = "{namespace}/{name}".format(**request.match_info)
-        record = self._state.get_job(key)
+        record = await self._offload(self._state.get_job, key)
         if record is None:
             return web.json_response(
                 {"error": "no such job"}, status=404
@@ -677,7 +707,16 @@ class Supervisor(ThreadedHttpServer):
         from the controller on :9091, controller.py:35-41; here the
         supervisor serves cluster-visible gauges directly). Built with
         :class:`trace.PromBuilder` so HELP/TYPE coverage and label
-        escaping hold for every series by construction."""
+        escaping hold for every series by construction. Rendered on
+        the executor: the assembly walks every state section under
+        _cond and the trace registry locks, and a scrape must not
+        stall the loop's heartbeats behind them."""
+        return web.Response(
+            text=await self._offload(self._metrics_text),
+            content_type="text/plain",
+        )
+
+    def _metrics_text(self) -> str:
         b = trace.PromBuilder()
         b.family(
             "adaptdl_jobs", "gauge", "Known jobs by lifecycle status."
@@ -1073,10 +1112,7 @@ class Supervisor(ThreadedHttpServer):
         # (supervisor-side spans recorded locally, worker-side spans
         # absorbed on PUT /trace).
         trace.render_into(b)
-        return web.Response(
-            text=b.render(),
-            content_type="text/plain",
-        )
+        return b.render()
 
     # -- lifecycle ----------------------------------------------------
 
@@ -1089,16 +1125,24 @@ class Supervisor(ThreadedHttpServer):
         )
         if self._lease_ttl <= 0 and commit_timeout <= 0:
             return
+
+        def sweep():
+            # Both expirers are journaled mutators (fsync per append)
+            # — sweep from the executor so the cadence timer never
+            # blocks the loop serving heartbeats.
+            expired = (
+                self._state.expire_stale_leases()
+                if self._lease_ttl > 0
+                else []
+            )
+            rolled = self._state.expire_overdue_allocations()
+            return expired, rolled
+
         try:
             while True:
                 await asyncio.sleep(self._sweep_interval)
                 try:
-                    expired = (
-                        self._state.expire_stale_leases()
-                        if self._lease_ttl > 0
-                        else []
-                    )
-                    rolled = self._state.expire_overdue_allocations()
+                    expired, rolled = await self._offload(sweep)
                 except Exception:  # noqa: BLE001 - sweeper must survive
                     LOG.exception("lease/epoch sweep failed")
                     continue
@@ -1146,7 +1190,11 @@ class Supervisor(ThreadedHttpServer):
         finally:
             parts = request.path.split("/", 2)
             segment = parts[1] if len(parts) > 1 and parts[1] else "root"
-            trace.record_span(
+            # record_span journals the span (file IO under the trace
+            # journal lock) when ADAPTDL_TRACE_JOURNAL is set — off
+            # the loop, like every other blocking call here.
+            await self._offload(
+                trace.record_span,
                 f"sup.endpoint.{segment}",
                 time.monotonic() - start,
             )

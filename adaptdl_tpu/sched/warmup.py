@@ -141,7 +141,9 @@ class WarmSuccessor:
         under a ``warmup.spawn`` schedule — the caller falls back to
         the cold path)."""
         faults.maybe_fail("warmup.spawn")
-        self.proc = subprocess.Popen(self.argv, env=self.env)
+        self.proc = subprocess.Popen(  # detached: warm-successor
+            self.argv, env=self.env
+        )
 
     def alive(self) -> bool:
         return self.proc is not None and self.proc.poll() is None
@@ -244,6 +246,15 @@ def maybe_hold() -> bool:
         # reaches a clean interpreter shutdown, and atexit hooks must
         # not write anything durable from a discarded speculation.
         os._exit(GRACEFUL_EXIT_CODE)
+    # The GO verdict is consumed and this process is the channel dir's
+    # last reader (the incumbent is deep in its final drain, possibly
+    # already gone — discard() covers the abort side), so the adopted
+    # successor removes the dir.
+    cutover_path = env.warmup_cutover_file()
+    if cutover_path:
+        shutil.rmtree(
+            os.path.dirname(cutover_path), ignore_errors=True
+        )
     return True
 
 

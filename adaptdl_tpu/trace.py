@@ -123,7 +123,7 @@ def new_traceparent() -> str:
 # traceparent (and without an enclosing span on its thread) parents
 # here. Lazily initialized from ADAPTDL_TRACEPARENT so a restarted
 # incarnation lands in the trace of the decision that restarted it.
-_ctx_lock = threading.Lock()
+_ctx_lock = threading.Lock()  # lock-order: 70
 _trace_id: str | None = None  # guarded-by: _ctx_lock
 _root_span_id: str | None = None  # guarded-by: _ctx_lock
 
@@ -202,7 +202,7 @@ def _inc() -> int:
 # Per-thread stack of (trace_id, span_id) for parent/child nesting.
 _tls = threading.local()
 
-_buffer_lock = threading.Lock()
+_buffer_lock = threading.Lock()  # lock-order: 72
 _buffer: deque | None = None  # guarded-by: _buffer_lock
 _seq = 0  # guarded-by: _buffer_lock
 _flushed_seq = 0  # guarded-by: _buffer_lock
@@ -384,7 +384,7 @@ def event(  # wire: produces=trace_span
 
 # ---- pending spans (cross-callsite: restart -> first step) -----------
 
-_pending_lock = threading.Lock()
+_pending_lock = threading.Lock()  # lock-order: 71
 # name -> (wall_start, monotonic_start, attrs)
 _pending: dict[str, tuple[float, float, dict]] = {}  # guarded-by: _pending_lock
 
@@ -427,7 +427,7 @@ def end_pending(name: str, **attrs) -> bool:
 
 # ---- exporter 1: per-job JSONL structured event journal --------------
 
-_journal_lock = threading.Lock()
+_journal_lock = threading.Lock()  # lock-order: 74
 _journal_fh = None  # guarded-by: _journal_lock
 _journal_target: str | None = None  # guarded-by: _journal_lock
 # Lock-free latch: once the journal is known to be unconfigured, every
@@ -616,7 +616,7 @@ class Histogram:
         self.count += 1
 
 
-_metrics_lock = threading.Lock()
+_metrics_lock = threading.Lock()  # lock-order: 73
 _histograms: dict[str, Histogram] = {}  # guarded-by: _metrics_lock
 _counters: dict[str, int] = {}  # guarded-by: _metrics_lock
 

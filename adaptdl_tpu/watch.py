@@ -162,7 +162,7 @@ class WatchStore:
             env.watch_slo_rho() if slo_rho is None
             else max(float(slo_rho), 0.1)
         )
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: 31
         # Latest trainer-reported measured goodput per job as
         # (value, intake seq) — the seq lets the drift monitor pair
         # each observation with a prediction exactly ONCE, however

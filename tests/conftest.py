@@ -22,6 +22,10 @@ import jax  # noqa: E402
 # of JAX_PLATFORMS; override it before any backend is initialised.
 jax.config.update("jax_platforms", "cpu")
 
+import tempfile  # noqa: E402
+import threading  # noqa: E402
+import time  # noqa: E402
+
 import pytest  # noqa: E402
 
 from adaptdl_tpu import checkpoint, trace  # noqa: E402
@@ -39,3 +43,115 @@ def _clean_state_registry():
     yield
     checkpoint._reset_registry()
     trace._reset_state()
+
+
+# ---- per-test resource-leak canary ----------------------------------
+#
+# The GC14xx lifecycle passes prove every spawn in adaptdl_tpu/ has a
+# custodian *statically*; this fixture is the dynamic counterpart. A
+# test that leaves a non-daemon thread running, a live child process,
+# or a stray adaptdl temp dir behind fails HERE — at the leaking test
+# — instead of hanging the pytest process at exit or poisoning an
+# unrelated test later in the session. E2e tests that deliberately
+# detach (sanctioned via ``# detached:`` in the code under test) opt
+# out with ``@pytest.mark.leaks_ok``.
+
+_LEAK_GRACE_S = 2.0
+# Temp-dir prefixes owned by the package (checkpoint staging dirs are
+# created inside the checkpoint root, not the global tmpdir, so only
+# the warmup workdir prefix matters here — keep the tuple extensible).
+_ADAPTDL_TMP_PREFIXES = ("adaptdl-warmup-", "adaptdl-tpu-")
+
+
+def _live_child_pids() -> set:
+    """Direct live (non-zombie) children of this process, minus the
+    multiprocessing bookkeeping daemons that legitimately persist for
+    the whole session (resource_tracker, forkserver)."""
+    pids = set()
+    task_dir = "/proc/self/task"
+    if not os.path.isdir(task_dir):  # non-Linux: canary skips pids
+        return pids
+    for tid in os.listdir(task_dir):
+        try:
+            with open(os.path.join(task_dir, tid, "children")) as f:
+                pids.update(int(p) for p in f.read().split())
+        except (OSError, ValueError):
+            continue
+    live = set()
+    for pid in pids:
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                state = f.read().rpartition(")")[2].split()[0]
+            if state == "Z":  # finished, awaiting reap: not a leak
+                continue
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmdline = f.read().replace(b"\0", b" ")
+            if (b"resource_tracker" in cmdline
+                    or b"forkserver" in cmdline):
+                continue
+        except OSError:
+            continue  # raced with exit
+        live.add(pid)
+    return live
+
+
+def _stray_tmp_entries() -> set:
+    tmp = tempfile.gettempdir()
+    try:
+        entries = os.listdir(tmp)
+    except OSError:
+        return set()
+    return {
+        e for e in entries if e.startswith(_ADAPTDL_TMP_PREFIXES)
+    }
+
+
+def _leaked_threads(before: set) -> list:
+    return [
+        t for t in threading.enumerate()
+        if t.is_alive()
+        and not t.daemon
+        and t is not threading.main_thread()
+        and t.ident not in before
+        # The asyncio default executor's workers belong to the event
+        # loop; aiohttp test harnesses tear the loop (and them) down
+        # after this fixture runs.
+        and not t.name.startswith("asyncio_")
+    ]
+
+
+@pytest.fixture(autouse=True)
+def _resource_leak_canary(request):
+    if request.node.get_closest_marker("leaks_ok"):
+        yield
+        return
+    before_threads = {t.ident for t in threading.enumerate()}
+    before_children = _live_child_pids()
+    before_tmp = _stray_tmp_entries()
+    yield
+    deadline = time.monotonic() + _LEAK_GRACE_S
+    while _leaked_threads(before_threads) and (
+        time.monotonic() < deadline
+    ):
+        time.sleep(0.05)
+    leaked = _leaked_threads(before_threads)
+    assert not leaked, (
+        f"test leaked non-daemon thread(s): "
+        f"{[t.name for t in leaked]} — join them in teardown or mark "
+        f"the test @pytest.mark.leaks_ok"
+    )
+    while (_live_child_pids() - before_children) and (
+        time.monotonic() < deadline
+    ):
+        time.sleep(0.05)
+    children = _live_child_pids() - before_children
+    assert not children, (
+        f"test leaked live child process(es): {sorted(children)} — "
+        f"wait()/terminate them or mark the test "
+        f"@pytest.mark.leaks_ok"
+    )
+    tmp_dirs = _stray_tmp_entries() - before_tmp
+    assert not tmp_dirs, (
+        f"test leaked temp dir(s) under {tempfile.gettempdir()}: "
+        f"{sorted(tmp_dirs)} — clean them up in teardown"
+    )

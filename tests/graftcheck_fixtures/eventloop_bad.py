@@ -1,0 +1,35 @@
+"""Known-bad: event-loop discipline violations (GC1301/02/03)."""
+
+import asyncio
+import os
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def _sync_flush(path):
+    with open(path, "w") as f:
+        f.write("x")
+        os.fsync(f.fileno())
+
+
+async def handler_sleeps():
+    time.sleep(0.1)  # blocks the loop directly
+
+
+async def handler_flushes(path):
+    _sync_flush(path)  # blocks through a sync callee
+
+
+async def holds_lock_across_await():
+    with _lock:
+        await asyncio.sleep(0)  # every other holder now stalls the loop
+
+
+async def _notify():
+    return 1
+
+
+async def forgets_await():
+    _notify()  # coroutine created, never scheduled

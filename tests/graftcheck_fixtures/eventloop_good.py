@@ -1,0 +1,47 @@
+"""Known-good: blocking work offloaded by reference, locks released
+before awaiting, coroutines awaited or scheduled."""
+
+import asyncio
+import functools
+import os
+import threading
+
+_lock = threading.Lock()
+
+
+def _sync_flush(path):
+    with open(path, "w") as f:
+        f.write("x")
+        os.fsync(f.fileno())
+
+
+async def _offload(fn, *args):
+    return await asyncio.get_event_loop().run_in_executor(
+        None, functools.partial(fn, *args)
+    )
+
+
+async def handler(path):
+    # The blocking callee is passed by reference: it runs on the
+    # executor, never on the loop.
+    return await _offload(_sync_flush, path)
+
+
+async def snapshot_then_await():
+    with _lock:
+        value = 1
+    await asyncio.sleep(0)
+    return value
+
+
+async def _notify():
+    return 1
+
+
+async def awaits_properly():
+    return await _notify()
+
+
+async def schedules_task():
+    task = asyncio.ensure_future(_notify())
+    return await task

@@ -1,0 +1,44 @@
+"""Known-bad: spawn/cleanup lifecycle violations (GC1401/02/03/04)."""
+
+import subprocess
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+_worker = None
+
+
+def fire_and_forget():
+    threading.Thread(target=print, daemon=True).start()  # nobody can join
+
+
+def leaked_popen():
+    subprocess.Popen(["true"])  # child never waited
+
+
+def leaked_executor():
+    pool = ThreadPoolExecutor(max_workers=1)  # never shut down
+    pool.submit(print)
+
+
+def typo_detached():
+    threading.Thread(  # detached: no-such-entry
+        target=print, daemon=True
+    ).start()
+
+
+def daemon_unset():
+    t = threading.Thread(target=print)  # daemonhood left implicit
+    t.start()
+    t.join()
+
+
+def respawn_forever():
+    global _worker
+    while True:
+        _worker = threading.Thread(target=print, daemon=True)
+        _worker.start()
+
+
+def shutdown():
+    if _worker is not None:
+        _worker.join()

@@ -1,0 +1,59 @@
+"""Known-good: every spawn has custody — joined, context-managed,
+handed to a supervising call, or registered as detached."""
+
+import subprocess
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from tempfile import TemporaryDirectory
+
+
+class Owner:
+    def __init__(self):
+        self._thread = threading.Thread(target=print, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._thread.join()
+
+
+def scoped_pool():
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        pool.submit(print)
+    with TemporaryDirectory() as tmp:
+        return tmp
+
+
+def waited_popen():
+    proc = subprocess.Popen(["true"])
+    proc.wait()
+    return proc.returncode
+
+
+def sanctioned_detach():
+    threading.Thread(  # detached: warm-successor
+        target=print, daemon=True
+    ).start()
+
+
+def handed_onward():
+    return threading.Thread(target=print, daemon=True)
+
+
+def supervised_respawn(supervise):
+    while True:
+        proc = subprocess.Popen(["true"])
+        code = supervise(proc)  # supervisor owns the wait
+        if code == 0:
+            return code
+
+
+def guarded_respawn():
+    t = threading.Thread(target=print, daemon=True)
+    t.start()
+    while True:
+        if not t.is_alive():
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+        t.join(0.1)
+        if not t.is_alive():
+            return

@@ -1,0 +1,48 @@
+"""Known-bad: lock-order cycles, hierarchy violations, dishonest
+ranks (GC1201/GC1202/GC1203)."""
+
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+ranked_outer = threading.Lock()  # lock-order: 10
+ranked_inner = threading.Lock()  # lock-order: 20
+
+half_ranked = threading.Lock()  # lock-order: 30
+unranked = threading.Lock()
+
+bad_rank = threading.Lock()  # lock-order: high
+dup_a = threading.Lock()  # lock-order: 40
+dup_b = threading.Lock()  # lock-order: 40
+
+base_lock = threading.Lock()
+base_cv = threading.Condition(base_lock)  # lock-order: 60
+
+
+def ab():
+    with lock_a:
+        with lock_b:  # one direction of the ABBA
+            pass
+
+
+def ba():
+    with lock_b:
+        with lock_a:  # the other direction closes the cycle
+            pass
+
+
+def wrong_rank_order():
+    with ranked_inner:
+        with ranked_outer:  # rank 20 held, rank 10 acquired
+            pass
+
+
+def ranked_meets_unranked():
+    with half_ranked:
+        with unranked:  # unranked lock nests with a ranked one
+            pass
+
+
+# An annotation attached to nothing the lock table recognizes:
+# lock-order: 50

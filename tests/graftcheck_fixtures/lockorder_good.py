@@ -1,0 +1,44 @@
+"""Known-good: consistent acquisition order under a declared
+hierarchy, RLock/Condition re-entry, release-before-acquire."""
+
+import threading
+
+outer = threading.Lock()  # lock-order: 10
+inner = threading.Lock()  # lock-order: 20
+aside = threading.Lock()
+
+reentrant = threading.RLock()
+cv = threading.Condition(reentrant)
+
+
+def ordered():
+    with outer:
+        with inner:  # 10 -> 20: strictly increasing
+            pass
+
+
+def also_ordered():
+    with outer:
+        with inner:
+            pass
+
+
+def sequential_not_nested():
+    with inner:
+        pass
+    with outer:  # released first: no edge, order free
+        pass
+
+
+def reenter():
+    with reentrant:
+        with cv:  # Condition wraps the same RLock: legal re-entry
+            with reentrant:
+                pass
+
+
+def snapshot_then_act():
+    with aside:
+        value = 1
+    with outer:  # aside released before outer: no aside->outer edge
+        return value

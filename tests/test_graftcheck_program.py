@@ -670,6 +670,39 @@ def test_sarif_output_is_valid_and_locates_findings():
         )
 
 
+def test_sarif_carries_concurrency_family_rule_metadata():
+    """The GC12xx/13xx/14xx families ship SARIF rule metadata like
+    every older family — a lockorder finding uploaded to code scanning
+    must resolve to a named, described rule."""
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tools.graftcheck",
+            os.path.join(
+                "tests", "graftcheck_fixtures", "lockorder_bad.py"
+            ),
+            "--format", "sarif", "-q",
+            "--baseline", "does-not-exist.json",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1
+    run = json.loads(proc.stdout)["runs"][0]
+    assert {r["ruleId"] for r in run["results"]} == {
+        "GC1201", "GC1202", "GC1203",
+    }
+    rules = {r["id"]: r for r in run["tool"]["driver"]["rules"]}
+    for rule_id in (
+        "GC1201", "GC1202", "GC1203",
+        "GC1301", "GC1302", "GC1303",
+        "GC1401", "GC1402", "GC1403", "GC1404",
+    ):
+        assert rule_id in rules
+        assert rules[rule_id]["shortDescription"]["text"]
+
+
 # ---- GC304: stale env docs ------------------------------------------
 
 

@@ -1,0 +1,115 @@
+"""Regression tests for the GC14xx lifecycle fixes: every background
+thread the control plane spawns now has a join path, and the joins
+cannot deadlock against the locks the threads use.
+
+Each test here pins a shutdown contract that graftcheck's lifecycle
+pass proves statically (see docs/static-analysis.md): the journal
+group-commit flusher, the worker heartbeat + handoff-prefetch
+threads, and the preemption listener + notify threads.
+"""
+
+import threading
+import time
+
+import pytest
+
+from adaptdl_tpu import bootstrap
+from adaptdl_tpu.sched import preemption
+from adaptdl_tpu.sched.journal import StateJournal
+
+
+def _join_with_watchdog(fn, timeout=10.0):
+    """Run ``fn`` in a helper thread: a deadlocked shutdown becomes a
+    test failure instead of a hung pytest process."""
+    done = threading.Event()
+
+    def run():
+        fn()
+        done.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout)
+    assert done.is_set(), f"{fn} did not return within {timeout}s"
+
+
+def test_journal_close_joins_group_commit_flusher(tmp_path):
+    """close() must leave no flusher thread behind — and must not
+    deadlock doing it (the flusher reacquires _io_lock to observe
+    _closed, so close() joins OUTSIDE the lock)."""
+    journal = StateJournal(str(tmp_path / "j"), group_commit_s=5.0)
+    journal.append({"op": "update"})  # arms the deferred fsync
+    flusher = journal._fsync_thread
+    assert flusher is not None and flusher.is_alive()
+    _join_with_watchdog(journal.close)
+    flusher.join(5.0)
+    assert not flusher.is_alive(), (
+        "group-commit flusher survived close()"
+    )
+
+
+def test_journal_close_without_flusher_is_safe(tmp_path):
+    """Strict mode never starts a flusher; close() still works."""
+    journal = StateJournal(str(tmp_path / "j"), group_commit_s=0.0)
+    journal.append({"op": "update"})
+    assert journal._fsync_thread is None
+    _join_with_watchdog(journal.close)
+
+
+def test_stop_heartbeat_joins_thread(monkeypatch):
+    """The heartbeat daemon is joinable: stop_heartbeat() leaves no
+    live thread, and a later start_heartbeat() begins a fresh one."""
+    beats = []
+    monkeypatch.setenv("ADAPTDL_SUPERVISOR_URL", "http://sup.invalid")
+    monkeypatch.setenv("ADAPTDL_JOB_ID", "ns/job")
+    monkeypatch.setenv("ADAPTDL_HEARTBEAT_INTERVAL", "0.05")
+    monkeypatch.setattr(
+        bootstrap.sched_hints,
+        "send_heartbeat",
+        lambda **kw: beats.append(kw),
+    )
+    stop = bootstrap.start_heartbeat()
+    assert stop is not None
+    thread = bootstrap._heartbeat_thread
+    assert thread is not None and thread.is_alive()
+    deadline = time.monotonic() + 5.0
+    while not beats and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert beats, "heartbeat thread never beat"
+    _join_with_watchdog(bootstrap.stop_heartbeat)
+    assert not thread.is_alive(), (
+        "heartbeat thread survived stop_heartbeat()"
+    )
+    # Idempotent when nothing is running.
+    bootstrap.stop_heartbeat()
+
+
+def test_stop_listener_joins_poller(monkeypatch):
+    """stop_listener() joins the poll thread — no poller outlives the
+    test that started it."""
+    monkeypatch.setattr(
+        preemption, "_poll_for_notice", lambda url: preemption.POLL_OK
+    )
+    stop = preemption.start_listener(
+        "http://metadata.invalid/preempted", interval=0.05
+    )
+    thread = preemption._listener_thread
+    assert thread is not None and thread.is_alive()
+    assert not stop.is_set()
+    _join_with_watchdog(preemption.stop_listener)
+    assert stop.is_set()
+    assert not thread.is_alive(), (
+        "listener thread survived stop_listener()"
+    )
+    # Safe to call again with nothing running.
+    preemption.stop_listener()
+
+
+@pytest.mark.leaks_ok
+def test_leaks_ok_marker_opts_out_of_canary():
+    """The canary's escape hatch works: a deliberately-detached
+    non-daemon thread does not fail a marked test. The thread is
+    short-lived so it cannot poison later tests."""
+    t = threading.Thread(target=time.sleep, args=(0.2,))
+    t.start()
+    assert t.is_alive()

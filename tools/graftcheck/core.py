@@ -17,7 +17,7 @@ import tokenize
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable
 
-TOOL_VERSION = "3"
+TOOL_VERSION = "4"
 
 
 def tool_fingerprint(
@@ -98,6 +98,14 @@ DEFAULT_BASELINE = "graftcheck_baseline.json"
 #   async def _put():   # idempotent: keyed-by=group
 #                       retried (PUT/POST) handlers declare how a
 #                       retry folds into the first attempt (GC1103)
+#   _lock = Lock()      # lock-order: 40
+#                       the lock's rank in the declared acquisition
+#                       hierarchy — nested acquisition must go from
+#                       lower to strictly higher rank (GC12xx)
+#   Thread(...).start() # detached: handoff-child-server
+#                       a deliberately unjoined spawn, sanctioned by
+#                       the DETACHED_SPAWNS registry in
+#                       adaptdl_tpu/concurrency.py (GC14xx)
 
 GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
 HOLDS_LOCK_RE = re.compile(r"#\s*holds-lock:\s*([A-Za-z_][\w.]*)")
@@ -115,6 +123,8 @@ WIRE_RE = re.compile(r"#\s*wire:\s*(produces|consumes)=([\w,-]+)")
 IDEMPOTENT_RE = re.compile(
     r"#\s*idempotent\b(?::\s*keyed-by=([\w-]+))?"
 )
+LOCK_ORDER_RE = re.compile(r"#\s*lock-order:\s*(\S+)")
+DETACHED_RE = re.compile(r"#\s*detached:\s*([\w.-]+)")
 
 
 @dataclass(frozen=True, order=True)

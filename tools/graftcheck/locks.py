@@ -1,0 +1,475 @@
+"""Shared lock model for the concurrency passes (GC12xx / GC13xx).
+
+The per-field lock pass (GC1xx) only needs lock *names*; ordering and
+event-loop analysis need lock *identities* — two classes each naming a
+field ``_lock`` are two different locks, and ``threading.Condition(
+self._io_lock)`` is the SAME lock wearing a condition interface. This
+module builds, once per whole-program run:
+
+- a **definition table**: module-global locks (``_lock =
+  threading.Lock()``) keyed ``<module>::<name>`` and instance locks
+  (``self._lock = threading.Lock()``) keyed
+  ``<module>::<Class>.<attr>``, with reentrancy kind and the optional
+  ``# lock-order: <rank>`` annotation from the defining statement;
+- **aliases**: a ``Condition(existing_lock)`` canonicalizes to the
+  wrapped lock (waiting on the condition and holding the lock are the
+  same acquisition);
+- an **acquisition table**: every ``with <lock>:`` item and
+  ``<lock>.acquire()`` call, resolved to a definition, with the set
+  of lock identities *provably held* at that point (enclosing
+  ``with`` items, ``# holds-lock:`` annotations, and the
+  interprocedural lock-set fixpoint's entry locks);
+- the **acquisition-order edge set**: ``A -> B`` whenever B is
+  acquired while A is provably held — both lexically and through
+  resolved call edges (caller holds A at a call site whose callee
+  transitively acquires B). Re-entry on RLocks and Conditions
+  (reentrant by construction) is excluded.
+
+Resolution is deliberately conservative: a ``with`` expression whose
+name cannot be matched to exactly one known lock definition in
+context contributes no acquisition and no edge — unresolved means
+unknown, never an invented deadlock.
+"""
+
+from __future__ import annotations
+
+import ast
+import weakref
+from dataclasses import dataclass
+
+from tools.graftcheck.core import (
+    LOCK_ORDER_RE,
+    SourceFile,
+    dotted_name,
+    walk_own,
+)
+from tools.graftcheck.program import (
+    FunctionInfo,
+    Program,
+    _module_key,
+)
+
+# threading constructor name -> reentrancy kind. asyncio's same-named
+# constructors are excluded at collection time (an asyncio.Lock never
+# blocks a thread; it is not part of this hierarchy).
+_LOCK_CTORS = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+    "Semaphore": "semaphore",
+    "BoundedSemaphore": "semaphore",
+}
+
+# Conditions wrap an RLock unless given a plain Lock explicitly, and
+# RLocks re-enter: a self-edge on these kinds is not a deadlock.
+_REENTRANT_KINDS = {"rlock", "condition"}
+
+
+@dataclass
+class LockDef:
+    """One lock definition statement."""
+
+    ident: str  # "<module>::<name>" or "<module>::<Class>.<attr>"
+    short: str  # last name component ("_io_lock")
+    kind: str  # lock | rlock | condition | semaphore
+    module: str
+    cls: str | None
+    sf: SourceFile
+    line: int
+    rank: int | None = None
+    rank_raw: str | None = None  # annotation text when unparsable
+    alias_arg: str | None = None  # dotted ctor arg of Condition(x)
+    alias_of: str | None = None  # canonical ident after linking
+
+
+@dataclass
+class Acquisition:
+    """One resolved lock acquisition site."""
+
+    lock: LockDef  # canonical definition
+    fn: FunctionInfo
+    line: int
+    col: int
+    held: frozenset[str] = frozenset()  # canonical idents held here
+
+
+@dataclass
+class OrderEdge:
+    """Witness that ``acquired`` was taken while ``held`` was held."""
+
+    held: str  # canonical ident
+    acquired: str  # canonical ident
+    sf_rel: str
+    line: int
+    col: int
+    via: str  # human-readable witness ("in StateJournal.append")
+
+
+class LockModel:
+    def __init__(self, program: Program):
+        self.program = program
+        self.defs: dict[str, LockDef] = {}
+        self.by_short: dict[str, list[LockDef]] = {}
+        self.acquisitions: list[Acquisition] = []
+        # (held, acquired) -> first witness edge
+        self.edges: dict[tuple[str, str], OrderEdge] = {}
+        # Transitively acquired locks per function qualname, each with
+        # its first witness acquisition.
+        self._acquired_trans: dict[str, dict[str, Acquisition]] = {}
+        self._collect_defs()
+        self._link_aliases()
+        self._collect_acquisitions()
+        self._build_edges()
+
+    # -- definitions ---------------------------------------------------
+
+    def _ctor_kind(self, value: ast.expr) -> str | None:
+        if not isinstance(value, ast.Call):
+            return None
+        name = dotted_name(value.func)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if parts[0] == "asyncio":
+            return None
+        return _LOCK_CTORS.get(parts[-1])
+
+    def _add_def(
+        self,
+        sf: SourceFile,
+        stmt: ast.stmt,
+        value: ast.Call,
+        kind: str,
+        module: str,
+        cls: str | None,
+        short: str,
+    ) -> None:
+        ident = (
+            f"{module}::{cls}.{short}"
+            if cls is not None
+            else f"{module}::{short}"
+        )
+        if ident in self.defs:
+            return
+        ldef = LockDef(
+            ident=ident,
+            short=short,
+            kind=kind,
+            module=module,
+            cls=cls,
+            sf=sf,
+            line=stmt.lineno,
+        )
+        m = LOCK_ORDER_RE.search(sf.statement_comment(stmt))
+        if m:
+            try:
+                ldef.rank = int(m.group(1))
+            except ValueError:
+                ldef.rank_raw = m.group(1)
+        if kind == "condition" and value.args:
+            ldef.alias_arg = dotted_name(value.args[0])
+        self.defs[ident] = ldef
+        self.by_short.setdefault(short, []).append(ldef)
+
+    def _collect_defs(self) -> None:
+        for sf in self.program.files:
+            module = _module_key(sf)
+            for stmt in sf.tree.body:
+                if isinstance(stmt, ast.Assign) and len(
+                    stmt.targets
+                ) == 1 and isinstance(stmt.targets[0], ast.Name):
+                    kind = self._ctor_kind(stmt.value)
+                    if kind:
+                        self._add_def(
+                            sf,
+                            stmt,
+                            stmt.value,
+                            kind,
+                            module,
+                            None,
+                            stmt.targets[0].id,
+                        )
+                elif isinstance(stmt, ast.ClassDef):
+                    for node in ast.walk(stmt):
+                        if not (
+                            isinstance(node, ast.Assign)
+                            and len(node.targets) == 1
+                        ):
+                            continue
+                        target = node.targets[0]
+                        if not (
+                            isinstance(target, ast.Attribute)
+                            and isinstance(target.value, ast.Name)
+                            and target.value.id == "self"
+                        ):
+                            continue
+                        kind = self._ctor_kind(node.value)
+                        if kind:
+                            self._add_def(
+                                sf,
+                                node,
+                                node.value,
+                                kind,
+                                module,
+                                stmt.name,
+                                target.attr,
+                            )
+
+    def _link_aliases(self) -> None:
+        for ldef in self.defs.values():
+            if ldef.alias_arg is None:
+                continue
+            parts = ldef.alias_arg.split(".")
+            if parts[0] == "self" and len(parts) == 2:
+                target = f"{ldef.module}::{ldef.cls}.{parts[1]}"
+            elif len(parts) == 1:
+                target = f"{ldef.module}::{parts[0]}"
+            else:
+                continue
+            if target in self.defs and target != ldef.ident:
+                ldef.alias_of = target
+
+    def canonical(self, ldef: LockDef) -> LockDef:
+        seen = set()
+        while ldef.alias_of is not None and ldef.ident not in seen:
+            seen.add(ldef.ident)
+            ldef = self.defs[ldef.alias_of]
+        return ldef
+
+    # -- resolution ----------------------------------------------------
+
+    def resolve(
+        self, short: str, module: str, cls: str | None
+    ) -> LockDef | None:
+        """Match a short lock name to its definition, preferring the
+        context class, then the context module, then a program-unique
+        short name; ambiguity resolves to None (no edge)."""
+        if cls is not None:
+            ldef = self.defs.get(f"{module}::{cls}.{short}")
+            if ldef is not None:
+                return self.canonical(ldef)
+        ldef = self.defs.get(f"{module}::{short}")
+        if ldef is not None:
+            return self.canonical(ldef)
+        candidates = self.by_short.get(short, [])
+        in_module = [d for d in candidates if d.module == module]
+        for pool in (in_module, candidates):
+            if len(pool) == 1:
+                return self.canonical(pool[0])
+        return None
+
+    def resolve_held(
+        self, shorts: "frozenset[str] | set[str]", fn: FunctionInfo
+    ) -> frozenset[str]:
+        module = _module_key(fn.sf)
+        out = set()
+        for short in shorts:
+            ldef = self.resolve(short, module, fn.cls)
+            if ldef is not None:
+                out.add(ldef.ident)
+        return frozenset(out)
+
+    # -- acquisitions --------------------------------------------------
+
+    def _lexical_held(
+        self, fn: FunctionInfo, node: ast.AST, skip: ast.withitem
+    ) -> set[str]:
+        """Canonical idents of locks lexically held at ``node`` inside
+        ``fn`` — enclosing ``with`` items (earlier items only for the
+        With being entered: the item under evaluation must not vouch
+        for itself) — plus annotated and fixpoint entry locks."""
+        sf = fn.sf
+        module = _module_key(sf)
+        held: set[str] = set()
+
+        def add(expr: ast.expr) -> None:
+            if isinstance(expr, ast.Call):
+                expr = expr.func
+            name = dotted_name(expr)
+            if name is None:
+                return
+            ldef = self.resolve(
+                name.rsplit(".", 1)[-1], module, fn.cls
+            )
+            if ldef is not None:
+                held.add(ldef.ident)
+
+        anc: ast.AST = node
+        for anc in sf.ancestors(node):
+            if anc is fn.node:
+                break
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    if item is skip:
+                        break
+                    add(item.context_expr)
+        for short in fn.annotated_locks | fn.entry_locks:
+            ldef = self.resolve(short, module, fn.cls)
+            if ldef is not None:
+                held.add(ldef.ident)
+        return held
+
+    def _collect_acquisitions(self) -> None:
+        for fn in self.program.functions.values():
+            module = _module_key(fn.sf)
+            for node in walk_own(fn.node):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        expr = item.context_expr
+                        probe = (
+                            expr.func
+                            if isinstance(expr, ast.Call)
+                            else expr
+                        )
+                        name = dotted_name(probe)
+                        if name is None:
+                            continue
+                        ldef = self.resolve(
+                            name.rsplit(".", 1)[-1], module, fn.cls
+                        )
+                        if ldef is None:
+                            continue
+                        self.acquisitions.append(
+                            Acquisition(
+                                lock=ldef,
+                                fn=fn,
+                                line=expr.lineno,
+                                col=expr.col_offset,
+                                held=frozenset(
+                                    self._lexical_held(
+                                        fn, expr, item
+                                    )
+                                ),
+                            )
+                        )
+                elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    if node.func.attr != "acquire":
+                        continue
+                    name = dotted_name(node.func.value)
+                    if name is None:
+                        continue
+                    ldef = self.resolve(
+                        name.rsplit(".", 1)[-1], module, fn.cls
+                    )
+                    if ldef is None:
+                        continue
+                    self.acquisitions.append(
+                        Acquisition(
+                            lock=ldef,
+                            fn=fn,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            held=frozenset(
+                                self._lexical_held(fn, node, None)
+                            ),
+                        )
+                    )
+
+    # -- order edges ---------------------------------------------------
+
+    def _add_edge(
+        self, held: str, acq: Acquisition, via: str
+    ) -> None:
+        acquired = acq.lock.ident
+        if held == acquired:
+            if acq.lock.kind in _REENTRANT_KINDS:
+                return  # RLock/Condition re-entry is legal
+        key = (held, acquired)
+        if key not in self.edges:
+            self.edges[key] = OrderEdge(
+                held=held,
+                acquired=acquired,
+                sf_rel=acq.fn.sf.rel,
+                line=acq.line,
+                col=acq.col,
+                via=via,
+            )
+
+    def _build_edges(self) -> None:
+        # Direct edges: lock-set at the acquisition site itself.
+        direct: dict[str, dict[str, Acquisition]] = {}
+        for acq in self.acquisitions:
+            fn_acquired = direct.setdefault(acq.fn.qualname, {})
+            fn_acquired.setdefault(acq.lock.ident, acq)
+            for held in acq.held:
+                self._add_edge(
+                    held, acq, f"in {_fn_label(acq.fn)}"
+                )
+        # Transitive acquisition sets: what each function's resolved
+        # call closure acquires (union fixpoint, witness-preserving).
+        trans: dict[str, dict[str, Acquisition]] = {
+            q: dict(direct.get(q, {}))
+            for q in self.program.functions
+        }
+        changed = True
+        iterations = 0
+        while changed and iterations < 50:
+            changed = False
+            iterations += 1
+            for fn in self.program.functions.values():
+                mine = trans[fn.qualname]
+                before = len(mine)
+                for site in fn.call_sites:
+                    if site.callee is None:
+                        continue
+                    for ident, acq in trans[
+                        site.callee.qualname
+                    ].items():
+                        mine.setdefault(ident, acq)
+                if len(mine) != before:
+                    changed = True
+        self._acquired_trans = trans
+        # Interprocedural edges: caller provably holds A at a call
+        # site whose callee closure acquires B. Site-held is the same
+        # evidence the lock-set fixpoint admits (lexical + annotated
+        # + entry), so these are proofs, not guesses.
+        for fn in self.program.functions.values():
+            for site in fn.call_sites:
+                if site.callee is None or site.is_reference:
+                    continue
+                callee_acquired = trans.get(
+                    site.callee.qualname
+                )
+                if not callee_acquired:
+                    continue
+                shorts = set(site.held_locks) | fn.annotated_locks
+                held = set(
+                    self.resolve_held(shorts, fn)
+                ) | set(
+                    self.resolve_held(fn.entry_locks, fn)
+                )
+                if not held:
+                    continue
+                for ident, acq in callee_acquired.items():
+                    for h in held:
+                        self._add_edge(
+                            h,
+                            acq,
+                            f"in {_fn_label(acq.fn)} via "
+                            f"{_fn_label(site.callee)}",
+                        )
+
+    def acquired_transitively(
+        self, fn: FunctionInfo
+    ) -> dict[str, Acquisition]:
+        return self._acquired_trans.get(fn.qualname, {})
+
+
+def _fn_label(fn: FunctionInfo) -> str:
+    return fn.qualname.split("::", 1)[-1]
+
+
+_models: "weakref.WeakKeyDictionary[Program, LockModel]" = (
+    weakref.WeakKeyDictionary()
+)
+
+
+def lock_model(program: Program) -> LockModel:
+    """One LockModel per Program — GC12xx and GC13xx share it."""
+    model = _models.get(program)
+    if model is None:
+        model = LockModel(program)
+        _models[program] = model
+    return model

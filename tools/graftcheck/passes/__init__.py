@@ -8,12 +8,15 @@ from tools.graftcheck.passes.endpoints import (
     EndpointConformancePass,
 )
 from tools.graftcheck.passes.env_registry import EnvRegistryPass
+from tools.graftcheck.passes.event_loop import EventLoopPass
 from tools.graftcheck.passes.fault_rpc import FaultRpcPass
 from tools.graftcheck.passes.host_sync import HostSyncPass
 from tools.graftcheck.passes.journal_discipline import (
     JournalDisciplinePass,
 )
+from tools.graftcheck.passes.lifecycle import LifecyclePass
 from tools.graftcheck.passes.lock_discipline import LockDisciplinePass
+from tools.graftcheck.passes.lock_order import LockOrderPass
 from tools.graftcheck.passes.replay_purity import ReplayPurityPass
 from tools.graftcheck.passes.spmd import SpmdDisciplinePass
 from tools.graftcheck.passes.timing_discipline import (
@@ -34,6 +37,9 @@ ALL_PASSES = [
     ReplayPurityPass(),
     WireContractPass(),
     EndpointConformancePass(),
+    LockOrderPass(),
+    EventLoopPass(),
+    LifecyclePass(),
 ]
 
 RULE_CATALOG = {

@@ -1,0 +1,446 @@
+"""GC13xx — asyncio event-loop discipline for the control plane.
+
+PR 5 found the supervisor's event loop frozen by an fsync inside a
+handler and fixed it by hand; PR 17's per-shard servers multiplied
+the handlers that can silently regress. This pass makes the fix a
+machine invariant: nothing *blocking* may be transitively reachable
+from an ``async def`` without an executor hop.
+
+**What counts as blocking** (syntactic catalog + two derived facts):
+
+- primitives: ``time.sleep``, ``os.fsync/fdatasync/replace/rename/
+  makedirs``, builtin ``open(...)``, ``subprocess.run/call/
+  check_call/check_output``, and non-awaited ``.wait()`` /
+  ``.communicate()`` / ``.result()``;
+- resolved calls into the rpc client (``RpcClient.request/get/put/
+  post`` — retries, backoff sleeps, network waits);
+- resolved calls into ``# journaled`` mutators (they fsync on
+  commit);
+- acquiring a **slow lock**: a lock the whole-program model proves is
+  held across a blocking operation somewhere (so `Lock.acquire` on it
+  can stall for that operation's duration). Slowness propagates
+  backwards along the acquisition-order graph — if A is held while
+  acquiring slow B, waiting for A can transitively wait for B.
+  Fast, compute-only locks (a metrics counter bump) stay acquirable
+  from handlers; that distinction is what keeps this rule quiet on
+  the ``faultable`` decorator and loud on the journal condition.
+
+**The executor hop** is detected structurally: functions handed to
+``run_in_executor`` / ``asyncio.to_thread`` are by-name references,
+not calls — the call graph has no edge through them, so offloaded
+work is unreachable by construction and anything still reachable is a
+finding.
+
+Rules:
+
+- **GC1301** — blocking work reachable from an ``async def``:
+  reported at the blocking line itself when lexically inside the
+  coroutine, else at the call site in the coroutine that enters the
+  blocking path (with the witness chain in the message).
+- **GC1302** — ``await`` while holding a threading lock: the
+  coroutine parks with the lock held and every thread touching it
+  stalls until the task resumes.
+- **GC1303** — a bare-statement call to a coroutine function: the
+  coroutine is created and dropped, never awaited — the work
+  silently does not happen.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftcheck.core import (
+    Context,
+    Finding,
+    Pass,
+    dotted_name,
+    walk_own,
+)
+from tools.graftcheck.locks import LockModel, lock_model
+from tools.graftcheck.passes.journal_discipline import JOURNALED_RE
+from tools.graftcheck.program import FunctionInfo, _module_key
+
+_OS_BLOCKING = {
+    "fsync",
+    "fdatasync",
+    "replace",
+    "rename",
+    "makedirs",
+}
+_SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output"}
+_METHOD_BLOCKING = {"wait", "communicate", "result"}
+_RPC_BLOCKING_METHODS = {"request", "get", "put", "post"}
+
+
+def _is_awaited(sf, node: ast.AST) -> bool:
+    return isinstance(sf.parents.get(node), ast.Await)
+
+
+def _under_lambda(sf, node: ast.AST, fn_node: ast.AST) -> bool:
+    """A call lexically inside a ``lambda`` belongs to the lambda's
+    eventual caller, not to the enclosing def's control flow."""
+    for anc in sf.ancestors(node):
+        if anc is fn_node:
+            return False
+        if isinstance(anc, ast.Lambda):
+            return True
+    return False
+
+
+class EventLoopPass(Pass):
+    name = "event-loop"
+    whole_program = True
+    rules = {
+        "GC1301": (
+            "blocking call reachable from async def without an "
+            "executor hop"
+        ),
+        "GC1302": "await while holding a threading lock",
+        "GC1303": "coroutine called but never awaited",
+    }
+
+    def check_program(self, program, ctx: Context) -> list[Finding]:
+        model = lock_model(program)
+        slow = self._slow_locks(program, model)
+        findings: list[Finding] = []
+        findings.extend(self._check_blocking(program, model, slow))
+        findings.extend(self._check_await_under_lock(program, model))
+        findings.extend(self._check_dropped_coroutines(program))
+        return findings
+
+    # -- blocking-site catalog -----------------------------------------
+
+    def _primitive_reason(self, site) -> str | None:
+        """Blocking by name alone — no resolution needed."""
+        sf = site._sf
+        if _is_awaited(sf, site.node):
+            return None
+        name = site.name
+        parts = name.split(".")
+        last = parts[-1]
+        if last == "sleep" and len(parts) > 1 and parts[-2] == "time":
+            return "time.sleep"
+        if name == "open" and site.callee is None:
+            return "file open"
+        if parts[0] == "os" and last in _OS_BLOCKING:
+            return f"os.{last} (file IO)"
+        if (
+            parts[0] == "subprocess"
+            and last in _SUBPROCESS_BLOCKING
+        ):
+            return f"subprocess.{last}"
+        if (
+            len(parts) >= 2
+            and last in _METHOD_BLOCKING
+            and parts[0] not in ("asyncio",)
+        ):
+            return f".{last}() wait"
+        return None
+
+    def _callee_reason(self, site) -> str | None:
+        """Blocking because of what the resolved callee IS."""
+        callee = site.callee
+        if callee is None:
+            return None
+        rel = callee.sf.rel.replace("\\", "/")
+        if (
+            rel.endswith("/rpc.py") or rel == "rpc.py"
+        ) and callee.cls == "RpcClient" and (
+            callee.name in _RPC_BLOCKING_METHODS
+        ):
+            return f"rpc client call {site.name}"
+        if JOURNALED_RE.search(
+            callee.sf.def_header_comment(callee.node)
+        ):
+            return f"journaled mutator {site.name} (fsync on commit)"
+        return None
+
+    def _own_blocking_sites(
+        self,
+        fn: FunctionInfo,
+        model: LockModel,
+        slow: frozenset,
+    ) -> list[tuple[int, int, str]]:
+        """(line, col, reason) for blocking work lexically in ``fn``
+        (its own statements; nested defs are their own functions)."""
+        out: list[tuple[int, int, str]] = []
+        own_nodes = None
+        for site in fn.call_sites:
+            if site.is_reference:
+                continue
+            if _under_lambda(fn.sf, site.node, fn.node):
+                continue
+            if own_nodes is None:
+                own_nodes = set(
+                    id(n) for n in walk_own(fn.node)
+                )
+            if id(site.node) not in own_nodes:
+                continue  # attributed here but nested lexically
+            reason = self._primitive_reason(
+                site
+            ) or self._callee_reason(site)
+            if reason is not None:
+                out.append(
+                    (site.node.lineno, site.node.col_offset, reason)
+                )
+        for acq in model.acquisitions:
+            if acq.fn is not fn:
+                continue
+            if acq.lock.ident in slow:
+                out.append(
+                    (
+                        acq.line,
+                        acq.col,
+                        f"acquires {acq.lock.short}, a lock held "
+                        "across blocking work",
+                    )
+                )
+        return sorted(out)
+
+    # -- slow locks ----------------------------------------------------
+
+    def _slow_locks(
+        self, program, model: LockModel
+    ) -> frozenset:
+        """Locks provably held across a primitive-blocking operation
+        anywhere in the program, closed backwards over the
+        acquisition-order graph."""
+        slow: set[str] = set()
+        for fn in program.functions.values():
+            fn_held = None
+            for site in fn.call_sites:
+                if site.is_reference:
+                    continue
+                if self._primitive_reason(site) is None and (
+                    self._callee_reason(site) is None
+                ):
+                    continue
+                if fn_held is None:
+                    fn_held = model.resolve_held(
+                        fn.annotated_locks | fn.entry_locks, fn
+                    )
+                slow |= model.resolve_held(
+                    site.held_locks, fn
+                )
+                slow |= fn_held
+        changed = True
+        while changed:
+            changed = False
+            for (held, acquired) in model.edges:
+                if acquired in slow and held not in slow:
+                    slow.add(held)
+                    changed = True
+        return frozenset(slow)
+
+    # -- GC1301 --------------------------------------------------------
+
+    def _check_blocking(
+        self, program, model: LockModel, slow: frozenset
+    ) -> list[Finding]:
+        own: dict[str, list[tuple[int, int, str]]] = {}
+        for fn in program.functions.values():
+            own[fn.qualname] = self._own_blocking_sites(
+                fn, model, slow
+            )
+
+        # Transitive "does this sync function block" with a witness.
+        memo: dict[str, str | None] = {}
+
+        def blocks(fn: FunctionInfo) -> str | None:
+            q = fn.qualname
+            if q in memo:
+                return memo[q]
+            memo[q] = None  # cycle guard
+            sites = own[q]
+            if sites:
+                memo[q] = sites[0][2]
+                return memo[q]
+            for site in fn.call_sites:
+                callee = site.callee
+                if (
+                    callee is None
+                    or site.is_reference
+                    or isinstance(
+                        callee.node, ast.AsyncFunctionDef
+                    )
+                ):
+                    continue
+                if _under_lambda(fn.sf, site.node, fn.node):
+                    continue
+                inner = blocks(callee)
+                if inner is not None:
+                    memo[q] = (
+                        f"{_short(callee)}: {inner}"
+                    )
+                    return memo[q]
+            return memo[q]
+
+        findings: list[Finding] = []
+        for fn in sorted(
+            program.functions.values(), key=lambda f: f.qualname
+        ):
+            if not isinstance(fn.node, ast.AsyncFunctionDef):
+                continue
+            seen_lines: set[int] = set()
+            for line, col, reason in own[fn.qualname]:
+                if line in seen_lines:
+                    continue
+                seen_lines.add(line)
+                findings.append(
+                    Finding(
+                        file=fn.sf.rel,
+                        line=line,
+                        col=col,
+                        rule="GC1301",
+                        message=(
+                            f"{reason} on the event loop in "
+                            f"async {_short(fn)}"
+                        ),
+                        hint=(
+                            "offload with `await loop."
+                            "run_in_executor(None, fn)` (bundle "
+                            "the sync work into one function)"
+                        ),
+                    )
+                )
+            own_nodes = set(id(n) for n in walk_own(fn.node))
+            for site in fn.call_sites:
+                callee = site.callee
+                if (
+                    callee is None
+                    or site.is_reference
+                    or isinstance(
+                        callee.node, ast.AsyncFunctionDef
+                    )
+                ):
+                    continue
+                if id(site.node) not in own_nodes:
+                    continue
+                if _under_lambda(fn.sf, site.node, fn.node):
+                    continue
+                witness = blocks(callee)
+                if witness is None:
+                    continue
+                if site.node.lineno in seen_lines:
+                    continue
+                seen_lines.add(site.node.lineno)
+                findings.append(
+                    Finding(
+                        file=fn.sf.rel,
+                        line=site.node.lineno,
+                        col=site.node.col_offset,
+                        rule="GC1301",
+                        message=(
+                            f"call into {site.name} from async "
+                            f"{_short(fn)} reaches blocking work "
+                            f"({witness}) without an executor hop"
+                        ),
+                        hint=(
+                            "move the call into the offloaded "
+                            "sync bundle (`await loop."
+                            "run_in_executor(None, fn)`)"
+                        ),
+                    )
+                )
+        return findings
+
+    # -- GC1302 --------------------------------------------------------
+
+    def _check_await_under_lock(
+        self, program, model: LockModel
+    ) -> list[Finding]:
+        findings: list[Finding] = []
+        for fn in sorted(
+            program.functions.values(), key=lambda f: f.qualname
+        ):
+            if not isinstance(fn.node, ast.AsyncFunctionDef):
+                continue
+            sf = fn.sf
+            module = _module_key(sf)
+            for node in walk_own(fn.node):
+                if not isinstance(node, ast.Await):
+                    continue
+                for anc in sf.ancestors(node):
+                    if anc is fn.node:
+                        break
+                    if not isinstance(anc, ast.With):
+                        continue
+                    for item in anc.items:
+                        expr = item.context_expr
+                        if isinstance(expr, ast.Call):
+                            expr = expr.func
+                        name = dotted_name(expr)
+                        if name is None:
+                            continue
+                        ldef = model.resolve(
+                            name.rsplit(".", 1)[-1],
+                            module,
+                            fn.cls,
+                        )
+                        if ldef is None:
+                            continue
+                        findings.append(
+                            Finding(
+                                file=sf.rel,
+                                line=node.lineno,
+                                col=node.col_offset,
+                                rule="GC1302",
+                                message=(
+                                    "await while holding threading "
+                                    f"lock {ldef.short} in async "
+                                    f"{_short(fn)} — threads "
+                                    "touching it stall until the "
+                                    "task resumes"
+                                ),
+                                hint=(
+                                    "copy what you need under the "
+                                    "lock, release, then await"
+                                ),
+                            )
+                        )
+        return findings
+
+    # -- GC1303 --------------------------------------------------------
+
+    def _check_dropped_coroutines(self, program) -> list[Finding]:
+        findings: list[Finding] = []
+        for fn in sorted(
+            program.functions.values(), key=lambda f: f.qualname
+        ):
+            for site in fn.call_sites:
+                callee = site.callee
+                if (
+                    callee is None
+                    or site.is_reference
+                    or not isinstance(
+                        callee.node, ast.AsyncFunctionDef
+                    )
+                ):
+                    continue
+                if not isinstance(
+                    fn.sf.parents.get(site.node), ast.Expr
+                ):
+                    continue
+                findings.append(
+                    Finding(
+                        file=fn.sf.rel,
+                        line=site.node.lineno,
+                        col=site.node.col_offset,
+                        rule="GC1303",
+                        message=(
+                            f"coroutine {site.name} is called but "
+                            "never awaited — the work silently "
+                            "does not happen"
+                        ),
+                        hint=(
+                            "await it, or wrap in "
+                            "asyncio.create_task(...) and keep the "
+                            "handle"
+                        ),
+                    )
+                )
+        return findings
+
+
+def _short(fn: FunctionInfo) -> str:
+    return fn.qualname.split("::", 1)[-1]

@@ -1,0 +1,511 @@
+"""GC14xx — thread / process / resource lifecycle discipline.
+
+Fourteen modules spawn threads, the launchers spawn processes, and
+the rescale path deliberately leaves a detached handoff server
+behind. The line between "supervised" and "leaked" is invisible in
+review; this pass draws it:
+
+- **GC1401** — every ``threading.Thread`` / ``subprocess.Popen`` /
+  ``ThreadPoolExecutor`` / ``ProcessPoolExecutor`` /
+  ``TemporaryDirectory`` spawn must have a cleanup call
+  (``join/terminate/kill/wait/communicate/shutdown/cleanup/close/
+  stop``) reachable for whatever the spawn is stored in — or carry
+  an explicit ``# detached: <name>`` annotation. Recognized
+  custodies: a ``with`` statement, a local whose cleanup happens in
+  the same function, a local handed onward (argument / return /
+  stored into an attribute — custody transferred), an attribute or
+  module global cleaned up anywhere in the module (including loops
+  over container attributes: ``for t in self._writers: t.join()``).
+- **GC1402** — a ``# detached:`` name must be registered in the
+  ``DETACHED_SPAWNS`` catalog in ``adaptdl_tpu/concurrency.py``
+  (mirroring GC602's fault-point registry): the sanctioned leaks are
+  enumerable in one place, and a typo'd annotation cannot silently
+  sanction a new one.
+- **GC1403** — thread spawns state ``daemon=`` explicitly (in the
+  constructor or an immediate attribute assignment). The default is
+  load-bearing at interpreter shutdown; it must be a decision, not
+  an accident.
+- **GC1404** — a spawn inside a ``while True:`` respawn loop needs a
+  liveness guard (``is_alive()``), a same-function ``join``/``wait``,
+  or the handle handed to a call inside the loop body (the callee
+  owns the wait) — an unconditional respawn multiplies threads until
+  the process dies.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.graftcheck.core import (
+    DETACHED_RE,
+    Context,
+    Finding,
+    Pass,
+    SourceFile,
+    dotted_name,
+)
+
+_THREAD_CTORS = {"Thread"}
+_PROCESS_CTORS = {"Popen"}
+_EXECUTOR_CTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+_TMP_CTORS = {"TemporaryDirectory"}
+_SPAWN_CTORS = (
+    _THREAD_CTORS | _PROCESS_CTORS | _EXECUTOR_CTORS | _TMP_CTORS
+)
+
+_CLEANUP_METHODS = {
+    "join",
+    "terminate",
+    "kill",
+    "wait",
+    "communicate",
+    "shutdown",
+    "cleanup",
+    "close",
+    "stop",
+}
+
+
+def _load_registry(path: str) -> set[str] | None:
+    """DETACHED_SPAWNS keys from the concurrency module, or None when
+    the module (or the literal) cannot be found."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "DETACHED_SPAWNS"
+            for t in node.targets
+        ):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return None
+        return {
+            key.value
+            for key in node.value.keys
+            if isinstance(key, ast.Constant)
+            and isinstance(key.value, str)
+        }
+    return None
+
+
+def _enclosing_stmt(sf: SourceFile, node: ast.AST) -> ast.stmt:
+    stmt = node
+    for anc in sf.ancestors(node):
+        if isinstance(
+            anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)
+        ):
+            break
+        if isinstance(anc, ast.stmt):
+            stmt = anc
+    return stmt if isinstance(stmt, ast.stmt) else node
+
+
+def _attr_cleaned_in_module(sf: SourceFile, attr: str) -> bool:
+    """Any ``<...>.attr.<cleanup>()`` call, a local alias of the
+    attribute cleaned up (``t = self.attr`` ... ``t.join()`` — the
+    snapshot-under-lock, join-outside-lock shape), or a loop over
+    ``<...>.attr`` whose body cleans the loop variable."""
+    for node in sf.walk(ast.Call):
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        if func.attr not in _CLEANUP_METHODS:
+            continue
+        recv = dotted_name(func.value)
+        if recv is not None and recv.rsplit(".", 1)[-1] == attr:
+            return True
+    for node in sf.walk(ast.Assign):
+        value = dotted_name(node.value)
+        if value is None or value.rsplit(".", 1)[-1] != attr:
+            continue
+        if len(node.targets) != 1 or not isinstance(
+            node.targets[0], ast.Name
+        ):
+            continue
+        fn = sf.enclosing_function(node)
+        scope: ast.AST = fn if fn is not None else sf.tree
+        if _name_cleaned_in(scope, node.targets[0].id):
+            return True
+    for node in sf.walk(ast.For):
+        it = dotted_name(node.iter)
+        if it is None or it.rsplit(".", 1)[-1] != attr:
+            continue
+        if not isinstance(node.target, ast.Name):
+            continue
+        loop_var = node.target.id
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _CLEANUP_METHODS
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id == loop_var
+            ):
+                return True
+    return False
+
+
+def _name_cleaned_in(
+    root: ast.AST, name: str
+) -> bool:
+    for node in ast.walk(root):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _CLEANUP_METHODS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == name
+        ):
+            return True
+    return False
+
+
+class LifecyclePass(Pass):
+    name = "lifecycle"
+    whole_program = True
+    rules = {
+        "GC1401": (
+            "spawned thread/process/resource has no reachable "
+            "cleanup and no # detached: sanction"
+        ),
+        "GC1402": (
+            "# detached: name not registered in "
+            "concurrency.DETACHED_SPAWNS"
+        ),
+        "GC1403": (
+            "thread spawn without an explicit daemon= decision"
+        ),
+        "GC1404": (
+            "unbounded respawn loop without a liveness guard"
+        ),
+    }
+
+    def __init__(self):
+        self._registry_cache: dict[tuple, set[str] | None] = {}
+
+    def _registry_path(self, ctx: Context) -> str:
+        return os.path.join(
+            ctx.root,
+            ctx.options.get(
+                "concurrency_module", "adaptdl_tpu/concurrency.py"
+            ),
+        )
+
+    def cache_inputs(self, ctx: Context) -> list[str]:
+        """GC1402 judges against the DETACHED_SPAWNS registry:
+        its content joins the --fast fingerprint so registering a
+        spawn refreshes cached findings elsewhere."""
+        return [self._registry_path(ctx)]
+
+    def _registry(self, ctx: Context) -> set[str] | None:
+        path = self._registry_path(ctx)
+        try:
+            stat = os.stat(path)
+        except OSError:
+            return None
+        key = (path, stat.st_mtime, stat.st_size)
+        if key not in self._registry_cache:
+            self._registry_cache.clear()
+            self._registry_cache[key] = _load_registry(path)
+        return self._registry_cache[key]
+
+    def check_program(self, program, ctx: Context) -> list[Finding]:
+        registry = self._registry(ctx)
+        findings: list[Finding] = []
+        for sf in program.files:
+            findings.extend(self._check_file(sf, registry))
+        return findings
+
+    # ------------------------------------------------------------------
+
+    def _check_file(
+        self, sf: SourceFile, registry: set[str] | None
+    ) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in sf.walk(ast.Call):
+            name = dotted_name(node.func)
+            if name is None:
+                continue
+            ctor = name.rsplit(".", 1)[-1]
+            if ctor not in _SPAWN_CTORS:
+                continue
+            # `multiprocessing.dummy.Pool`-style false names don't
+            # appear here; accept both bare and module-qualified.
+            stmt = _enclosing_stmt(sf, node)
+            detached = DETACHED_RE.search(
+                sf.statement_comment(stmt)
+            )
+            if detached is not None:
+                if registry is not None and (
+                    detached.group(1) not in registry
+                ):
+                    findings.append(
+                        Finding(
+                            file=sf.rel,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            rule="GC1402",
+                            message=(
+                                f"detached spawn "
+                                f"{detached.group(1)!r} is not "
+                                "registered in concurrency."
+                                "DETACHED_SPAWNS"
+                            ),
+                            hint=(
+                                "add it to DETACHED_SPAWNS in "
+                                "adaptdl_tpu/concurrency.py with "
+                                "the reason it may outlive its "
+                                "parent (or fix the typo)"
+                            ),
+                        )
+                    )
+            elif not self._has_custody(sf, node):
+                kind = (
+                    "thread"
+                    if ctor in _THREAD_CTORS
+                    else "process"
+                    if ctor in _PROCESS_CTORS
+                    else "executor"
+                    if ctor in _EXECUTOR_CTORS
+                    else "temp dir"
+                )
+                findings.append(
+                    Finding(
+                        file=sf.rel,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        rule="GC1401",
+                        message=(
+                            f"spawned {kind} ({ctor}) has no "
+                            "reachable join/terminate/shutdown/"
+                            "cleanup and no # detached: sanction"
+                        ),
+                        hint=(
+                            "store the handle and clean it up on "
+                            "stop/close, or annotate the spawn "
+                            "`# detached: <registered-name>`"
+                        ),
+                    )
+                )
+            if ctor in _THREAD_CTORS:
+                findings.extend(self._check_daemon(sf, node))
+            if ctor in _THREAD_CTORS | _PROCESS_CTORS:
+                findings.extend(self._check_respawn(sf, node))
+        return findings
+
+    # -- custody analysis (GC1401) -------------------------------------
+
+    def _has_custody(self, sf: SourceFile, node: ast.Call) -> bool:
+        parent = sf.parents.get(node)
+        # `with Executor() as ex:` / `with TemporaryDirectory():`
+        if isinstance(parent, ast.withitem):
+            return True
+        # Passed onward: argument, keyword, container literal,
+        # comprehension element — custody transferred to the
+        # receiver (the aot_cache `self._writers.append(...)` shape
+        # lands here; the container attr is checked at its cleanup
+        # site, not the spawn).
+        if isinstance(
+            parent,
+            (
+                ast.keyword,
+                ast.List,
+                ast.Tuple,
+                ast.Dict,
+                ast.Return,
+                ast.Yield,
+            ),
+        ):
+            return True
+        if isinstance(parent, ast.Call) and node is not parent.func:
+            return True
+        # `Thread(...).start()` — fire-and-forget, nothing retains.
+        if isinstance(parent, ast.Attribute):
+            return False
+        if isinstance(parent, ast.Expr):
+            return False
+        if not isinstance(parent, (ast.Assign, ast.AnnAssign)):
+            # Unrecognized context (starred, conditional expression):
+            # unknown custody — stay quiet rather than guess.
+            return True
+        targets = (
+            parent.targets
+            if isinstance(parent, ast.Assign)
+            else [parent.target]
+        )
+        if len(targets) != 1:
+            return True
+        target = targets[0]
+        if isinstance(target, ast.Attribute):
+            return _attr_cleaned_in_module(sf, target.attr)
+        if not isinstance(target, ast.Name):
+            return True
+        # Local variable custody.
+        fn = sf.enclosing_function(node)
+        scope: ast.AST = fn if fn is not None else sf.tree
+        local = target.id
+        if _name_cleaned_in(scope, local):
+            return True
+        if fn is not None:
+            # Module global assigned from inside a function
+            # (`global _fit_thread`): cleanup may live anywhere in
+            # the module (the atexit join closure pattern).
+            declares_global = any(
+                isinstance(n, ast.Global) and local in n.names
+                for n in ast.walk(fn)
+            )
+            if declares_global and (
+                _name_cleaned_in(sf.tree, local)
+                or _attr_cleaned_in_module(sf, local)
+            ):
+                return True
+        # Handed onward from the local: argument, return, attribute
+        # store (custody transferred; attribute stores re-checked
+        # module-wide).
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Name) and (
+                sub.id == local
+                and isinstance(sub.ctx, ast.Load)
+            ):
+                sub_parent = sf.parents.get(sub)
+                if isinstance(
+                    sub_parent, (ast.keyword, ast.Return, ast.Yield)
+                ):
+                    return True
+                if isinstance(
+                    sub_parent, ast.Call
+                ) and sub is not sub_parent.func:
+                    return True
+            elif isinstance(sub, ast.Assign):
+                if (
+                    isinstance(sub.value, ast.Name)
+                    and sub.value.id == local
+                    and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Attribute)
+                ):
+                    return _attr_cleaned_in_module(
+                        sf, sub.targets[0].attr
+                    )
+        return False
+
+    # -- GC1403 --------------------------------------------------------
+
+    def _check_daemon(
+        self, sf: SourceFile, node: ast.Call
+    ) -> list[Finding]:
+        if any(kw.arg == "daemon" for kw in node.keywords):
+            return []
+        # `t = Thread(...); t.daemon = True` also counts.
+        parent = sf.parents.get(node)
+        if isinstance(parent, ast.Assign) and len(
+            parent.targets
+        ) == 1 and isinstance(parent.targets[0], ast.Name):
+            local = parent.targets[0].id
+            fn = sf.enclosing_function(node)
+            scope: ast.AST = fn if fn is not None else sf.tree
+            for sub in ast.walk(scope):
+                if (
+                    isinstance(sub, (ast.Assign, ast.AnnAssign))
+                    and isinstance(
+                        t := (
+                            sub.targets[0]
+                            if isinstance(sub, ast.Assign)
+                            and len(sub.targets) == 1
+                            else getattr(sub, "target", None)
+                        ),
+                        ast.Attribute,
+                    )
+                    and t.attr == "daemon"
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == local
+                ):
+                    return []
+        return [
+            Finding(
+                file=sf.rel,
+                line=node.lineno,
+                col=node.col_offset,
+                rule="GC1403",
+                message=(
+                    "thread spawned without an explicit daemon= "
+                    "decision"
+                ),
+                hint=(
+                    "pass daemon=True (die with the process) or "
+                    "daemon=False (must be joined) deliberately"
+                ),
+            )
+        ]
+
+    # -- GC1404 --------------------------------------------------------
+
+    def _check_respawn(
+        self, sf: SourceFile, node: ast.Call
+    ) -> list[Finding]:
+        fn = sf.enclosing_function(node)
+        loop_node: ast.While | None = None
+        for anc in sf.ancestors(node):
+            if anc is fn:
+                break
+            if isinstance(anc, ast.While) and (
+                isinstance(anc.test, ast.Constant)
+                and anc.test.value is True
+            ):
+                loop_node = anc
+                break
+        if loop_node is None:
+            return []
+        scope: ast.AST = fn if fn is not None else sf.tree
+        for sub in ast.walk(scope):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in ("is_alive", "join", "wait")
+            ):
+                return []
+        # The spawned handle handed to a call inside the same loop
+        # body (`self._supervise(proc, ...)`) bounds the respawn: the
+        # callee owns the wait, same custody-transfer reasoning as
+        # GC1401's argument rule.
+        parent = sf.parents.get(node)
+        if (
+            isinstance(parent, ast.Assign)
+            and len(parent.targets) == 1
+            and isinstance(parent.targets[0], ast.Name)
+        ):
+            local = parent.targets[0].id
+            for sub in ast.walk(loop_node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                operands = list(sub.args) + [
+                    kw.value for kw in sub.keywords
+                ]
+                if any(
+                    isinstance(arg, ast.Name) and arg.id == local
+                    for arg in operands
+                ):
+                    return []
+        return [
+            Finding(
+                file=sf.rel,
+                line=node.lineno,
+                col=node.col_offset,
+                rule="GC1404",
+                message=(
+                    "spawn inside `while True:` with no liveness "
+                    "guard — an unconditional respawn multiplies "
+                    "until the process dies"
+                ),
+                hint=(
+                    "guard with `if t is None or not "
+                    "t.is_alive():` or join the previous spawn "
+                    "each iteration"
+                ),
+            )
+        ]

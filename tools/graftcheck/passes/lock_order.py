@@ -1,0 +1,326 @@
+"""GC12xx — global lock-acquisition-order analysis.
+
+The control plane holds ~10 named locks across six modules, and the
+multiprocess split (shards, router, warm successors) multiplied the
+paths that take two of them at once. Per-field discipline (GC1xx)
+cannot see an ABBA: each side is perfectly guarded. This pass builds
+the program-wide acquisition-order graph (:mod:`tools.graftcheck.
+locks`) and enforces:
+
+- **GC1201** — a cycle in the order graph is a potential deadlock,
+  reported at the exact acquisition line that closes the cycle (both
+  sides of an ABBA are findings: whichever order is "right", one of
+  them must change).
+- **GC1202** — the declared hierarchy: a lock definition may carry a
+  ``# lock-order: <rank>`` annotation, and nested acquisition must go
+  from lower to strictly higher rank. An edge from a ranked lock into
+  an *unranked* lock is also a finding — once a lock participates in
+  ordered nesting it must take a place in the hierarchy, otherwise
+  the table silently decays as new locks appear.
+- **GC1203** — annotation honesty: ``# lock-order:`` must sit on a
+  recognized lock definition statement, parse as an integer, be
+  unique program-wide (the hierarchy is total), and sit on the
+  canonical lock, not on a ``Condition(existing)`` alias.
+
+RLock and Condition re-entry is excluded at edge-construction time
+(Conditions wrap an RLock); a self-edge on a plain Lock IS reported —
+that is a guaranteed self-deadlock, the cheapest cycle there is.
+"""
+
+from __future__ import annotations
+
+from tools.graftcheck.core import (
+    LOCK_ORDER_RE,
+    Context,
+    Finding,
+    Pass,
+)
+from tools.graftcheck.locks import LockModel, lock_model
+
+
+def _cycles(edges: dict) -> list[list[str]]:
+    """Strongly connected components with >1 node (plus self-loops),
+    via Tarjan; deterministic order for stable findings."""
+    graph: dict[str, list[str]] = {}
+    for held, acquired in edges:
+        graph.setdefault(held, []).append(acquired)
+        graph.setdefault(acquired, [])
+    for targets in graph.values():
+        targets.sort()
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    out: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(root: str) -> None:
+        work = [(root, iter(graph[root]))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for succ in it:
+                if succ not in index:
+                    index[succ] = low[succ] = counter[0]
+                    counter[0] += 1
+                    stack.append(succ)
+                    on_stack.add(succ)
+                    work.append((succ, iter(graph[succ])))
+                    advanced = True
+                    break
+                if succ in on_stack:
+                    low[node] = min(low[node], index[succ])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    member = stack.pop()
+                    on_stack.discard(member)
+                    comp.append(member)
+                    if member == node:
+                        break
+                if len(comp) > 1 or (node, node) in edges:
+                    out.append(sorted(comp))
+
+    for node in sorted(graph):
+        if node not in index:
+            strongconnect(node)
+    return out
+
+
+def _pretty(ident: str) -> str:
+    return ident.split("::", 1)[-1]
+
+
+class LockOrderPass(Pass):
+    name = "lock-order"
+    whole_program = True
+    rules = {
+        "GC1201": (
+            "lock-acquisition-order cycle (potential deadlock)"
+        ),
+        "GC1202": (
+            "lock acquisition violates the declared # lock-order: "
+            "hierarchy"
+        ),
+        "GC1203": "dishonest or malformed # lock-order: annotation",
+    }
+
+    def check_program(self, program, ctx: Context) -> list[Finding]:
+        model = lock_model(program)
+        findings: list[Finding] = []
+        findings.extend(self._check_cycles(model))
+        findings.extend(self._check_hierarchy(model))
+        findings.extend(self._check_annotations(model, program))
+        return findings
+
+    # -- GC1201 --------------------------------------------------------
+
+    def _check_cycles(self, model: LockModel) -> list[Finding]:
+        findings: list[Finding] = []
+        for comp in _cycles(model.edges):
+            members = set(comp)
+            ring = " -> ".join(_pretty(m) for m in comp)
+            for (held, acquired), edge in sorted(
+                model.edges.items(),
+                key=lambda kv: (kv[1].sf_rel, kv[1].line),
+            ):
+                if held not in members or acquired not in members:
+                    continue
+                findings.append(
+                    Finding(
+                        file=edge.sf_rel,
+                        line=edge.line,
+                        col=edge.col,
+                        rule="GC1201",
+                        message=(
+                            f"acquiring {_pretty(acquired)} while "
+                            f"{_pretty(held)} is held closes a "
+                            f"lock-order cycle [{ring}] "
+                            f"({edge.via})"
+                        ),
+                        hint=(
+                            "pick one global order for these locks "
+                            "and restructure the minority path "
+                            "(release before calling, or snapshot "
+                            "under the lock and act after)"
+                        ),
+                    )
+                )
+        return findings
+
+    # -- GC1202 --------------------------------------------------------
+
+    def _check_hierarchy(self, model: LockModel) -> list[Finding]:
+        findings: list[Finding] = []
+        for (held, acquired), edge in sorted(
+            model.edges.items(),
+            key=lambda kv: (kv[1].sf_rel, kv[1].line),
+        ):
+            if held == acquired:
+                continue  # self-cycles are GC1201's
+            held_def = model.defs[held]
+            acq_def = model.defs[acquired]
+            if held_def.rank is None and acq_def.rank is None:
+                continue
+            if held_def.rank is None or acq_def.rank is None:
+                ranked, unranked = (
+                    (held_def, acq_def)
+                    if held_def.rank is not None
+                    else (acq_def, held_def)
+                )
+                findings.append(
+                    Finding(
+                        file=edge.sf_rel,
+                        line=edge.line,
+                        col=edge.col,
+                        rule="GC1202",
+                        message=(
+                            f"{_pretty(unranked.ident)} nests with "
+                            f"ranked lock {_pretty(ranked.ident)} "
+                            f"(rank {ranked.rank}) but declares no "
+                            f"# lock-order: rank ({edge.via})"
+                        ),
+                        hint=(
+                            "add `# lock-order: <rank>` on the "
+                            f"definition at {unranked.sf.rel}:"
+                            f"{unranked.line} — outer locks rank "
+                            "lower than the locks they wrap"
+                        ),
+                    )
+                )
+                continue
+            if held_def.rank >= acq_def.rank:
+                findings.append(
+                    Finding(
+                        file=edge.sf_rel,
+                        line=edge.line,
+                        col=edge.col,
+                        rule="GC1202",
+                        message=(
+                            f"acquiring {_pretty(acquired)} (rank "
+                            f"{acq_def.rank}) while {_pretty(held)} "
+                            f"(rank {held_def.rank}) is held — "
+                            "nested ranks must strictly increase "
+                            f"({edge.via})"
+                        ),
+                        hint=(
+                            "acquire in rank order or release the "
+                            "outer lock first; renumber the "
+                            "hierarchy only with the full edge set "
+                            "in view (docs/static-analysis.md)"
+                        ),
+                    )
+                )
+        return findings
+
+    # -- GC1203 --------------------------------------------------------
+
+    def _check_annotations(
+        self, model: LockModel, program
+    ) -> list[Finding]:
+        findings: list[Finding] = []
+        # Annotation lines actually consumed by a lock definition.
+        claimed: dict[tuple[str, int], object] = {}
+        for ldef in model.defs.values():
+            stmt_lines = range(ldef.line, ldef.line + 4)
+            for line in stmt_lines:
+                claimed.setdefault((ldef.sf.rel, line), ldef)
+        by_rank: dict[int, object] = {}
+        for ident in sorted(model.defs):
+            ldef = model.defs[ident]
+            if ldef.rank_raw is not None:
+                findings.append(
+                    Finding(
+                        file=ldef.sf.rel,
+                        line=ldef.line,
+                        col=0,
+                        rule="GC1203",
+                        message=(
+                            f"# lock-order: rank {ldef.rank_raw!r} "
+                            f"on {_pretty(ident)} is not an integer"
+                        ),
+                        hint="ranks are integers, lower = outer",
+                    )
+                )
+                continue
+            if ldef.rank is None:
+                continue
+            if ldef.alias_of is not None:
+                findings.append(
+                    Finding(
+                        file=ldef.sf.rel,
+                        line=ldef.line,
+                        col=0,
+                        rule="GC1203",
+                        message=(
+                            f"# lock-order: rank on {_pretty(ident)}"
+                            ", a Condition alias of "
+                            f"{_pretty(ldef.alias_of)} — the rank "
+                            "belongs to the canonical lock"
+                        ),
+                        hint=(
+                            "move the annotation to the wrapped "
+                            "lock's definition"
+                        ),
+                    )
+                )
+                continue
+            other = by_rank.setdefault(ldef.rank, ldef)
+            if other is not ldef:
+                findings.append(
+                    Finding(
+                        file=ldef.sf.rel,
+                        line=ldef.line,
+                        col=0,
+                        rule="GC1203",
+                        message=(
+                            f"duplicate # lock-order: rank "
+                            f"{ldef.rank} on {_pretty(ident)} "
+                            f"(also on {_pretty(other.ident)})"
+                        ),
+                        hint=(
+                            "the hierarchy is total — give every "
+                            "ranked lock a distinct rank"
+                        ),
+                    )
+                )
+        # Annotations on lines no lock definition claims.
+        for sf in program.files:
+            for line, comment in sorted(sf.comments.items()):
+                if not LOCK_ORDER_RE.search(comment):
+                    continue
+                if any(
+                    (sf.rel, line) in claimed
+                    or (sf.rel, probe) in claimed
+                    for probe in range(max(1, line - 3), line + 1)
+                ):
+                    continue
+                findings.append(
+                    Finding(
+                        file=sf.rel,
+                        line=line,
+                        col=0,
+                        rule="GC1203",
+                        message=(
+                            "# lock-order: annotation is not "
+                            "attached to a recognized lock "
+                            "definition"
+                        ),
+                        hint=(
+                            "annotate the `x = threading.Lock()` / "
+                            "`self.x = threading.Lock()` statement "
+                            "itself"
+                        ),
+                    )
+                )
+        return findings

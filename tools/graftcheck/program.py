@@ -9,9 +9,9 @@ passes a package-wide view, still ast-only and pure stdlib:
   (with methods), constants, and import bindings, keyed by the
   module's analysis-relative path;
 - a **call graph**: each function's resolved call sites (bare names,
-  ``self.method``, ``module.function``, and by-name function
-  references handed to ``jax.lax.scan``/``jit``/``shard_map``-style
-  wrappers);
+  ``self.method``, ``module.function``, ``self.attr.method`` through
+  inferred attribute types, and by-name function references handed
+  to ``jax.lax.scan``/``jit``/``shard_map``-style wrappers);
 - a **lock-set dataflow**: the set of locks *provably held on entry*
   to each function, computed as a fixpoint over the call graph from
   lexical ``with <lock>:`` scopes and ``# holds-lock:`` annotations;
@@ -367,6 +367,15 @@ class Program:
         # Enclosing def node -> {nested def name -> FunctionInfo},
         # filled at index time so bare-name resolution never walks.
         self._nested: dict[ast.AST, dict[str, FunctionInfo]] = {}
+        # (module, class) -> {attr -> dotted type name | None}: the
+        # inferred type of ``self.attr`` fields, from constructor
+        # assignments (``self.x = ClusterState(...)``) and annotated
+        # parameters flowing in (``def __init__(self, state:
+        # ClusterState): self._state = state``). None marks an attr
+        # assigned conflicting types — resolution must not guess.
+        self._attr_types: dict[
+            tuple[str, str], dict[str, str | None]
+        ] = {}
         self._resolve_memo: dict[tuple, FunctionInfo | None] = {}
         self._payload_memo: dict[str, list[KeyAccess]] = {}
         for sf in self.files:
@@ -423,6 +432,7 @@ class Program:
                         methods[item.name] = self._add_function(
                             sf, item, node.name
                         )
+                        self._infer_attr_types(mod, node.name, item)
                 table[node.name] = ("class", methods, bases)
             elif isinstance(node, ast.Assign):
                 for target in node.targets:
@@ -491,6 +501,89 @@ class Program:
                     self._nested.setdefault(encl, {})[
                         node.name
                     ] = info
+
+    def _infer_attr_types(
+        self,
+        mod: str,
+        cls: str,
+        fn: ast.FunctionDef | ast.AsyncFunctionDef,
+    ) -> None:
+        """Record ``self.attr`` field types observable in one method:
+        direct constructor calls and annotated parameters assigned
+        through. Conflicting observations poison the attr (None) —
+        ``self.attr.m()`` resolution must never guess between types.
+        """
+        annot: dict[str, str] = {}
+        for arg in (
+            fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+        ):
+            ann = arg.annotation
+            if isinstance(ann, ast.Constant) and isinstance(
+                ann.value, str
+            ):
+                annot[arg.arg] = ann.value
+            else:
+                name = dotted_name(ann) if ann is not None else None
+                if name is not None:
+                    annot[arg.arg] = name
+        attrs = self._attr_types.setdefault((mod, cls), {})
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            for target in targets:
+                if not (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    continue
+                type_name: str | None = None
+                if isinstance(node, ast.AnnAssign):
+                    ann = node.annotation
+                    if isinstance(ann, ast.Constant) and isinstance(
+                        ann.value, str
+                    ):
+                        type_name = ann.value
+                    else:
+                        type_name = dotted_name(ann)
+                if type_name is None and isinstance(value, ast.Call):
+                    type_name = dotted_name(value.func)
+                if type_name is None and isinstance(value, ast.Name):
+                    type_name = annot.get(value.id)
+                if type_name is None:
+                    continue
+                seen = attrs.get(target.attr, type_name)
+                attrs[target.attr] = (
+                    type_name if seen == type_name else None
+                )
+
+    def _attr_class(
+        self, mod: str, cls: str, attr: str
+    ) -> tuple[str, str] | None:
+        """Resolve ``self.<attr>``'s inferred type to a (module,
+        class) the symbol table knows, or None."""
+        type_name = self._attr_types.get((mod, cls), {}).get(attr)
+        if type_name is None:
+            return None
+        parts = type_name.split(".")
+        if len(parts) == 1:
+            sym = self._module_symbol(mod, parts[0])
+            if isinstance(sym, tuple) and sym[0] == "class":
+                return mod, parts[0]
+            return None
+        if len(parts) == 2:
+            sym = self._module_symbol(mod, parts[0])
+            if isinstance(sym, tuple) and sym[0] == "module":
+                target = self._module_symbol(sym[1], parts[1])
+                if isinstance(target, tuple) and target[0] == "class":
+                    return sym[1], parts[1]
+        return None
 
     # -- resolution ----------------------------------------------------
 
@@ -598,6 +691,20 @@ class Program:
                 return self._class_method(
                     _module_key(caller.sf), caller.cls, parts[1]
                 )
+            return None
+        if parts[0] == "self" and len(parts) == 3:
+            # self.attr.method() through the attr's inferred type
+            # (constructor assignment or annotated parameter) — the
+            # edge the concurrency passes need to see a handler call
+            # into ClusterState or the journal.
+            if caller is not None and caller.cls is not None:
+                owner = self._attr_class(
+                    _module_key(caller.sf), caller.cls, parts[1]
+                )
+                if owner is not None:
+                    return self._class_method(
+                        owner[0], owner[1], parts[2]
+                    )
             return None
         # module.attr(...) or module.Class.method(...)
         sym = self._module_symbol(mod, parts[0])
