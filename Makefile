@@ -135,7 +135,12 @@ warmgate:
 # restarts anywhere, sibling shards' endpoints never degrade, the
 # recovered shard replays its exact acknowledged journal prefix, and
 # the router's per-shard circuit isolates the dead shard without
-# touching siblings.
+# touching siblings. Also the live-resharding chaos suite
+# (docs/scheduler.md "Live resharding"): 2→3 grow and 3→2 drain
+# under live worker traffic with zero restarts, plus source /
+# destination / coordinator killed at every registered reshard.*
+# fault point — each either resumes from the destination's acked
+# watermark or rolls back with the old shard authoritative.
 shardgate:
 	$(CPU_ENV) ADAPTDL_FAULT_SEED=1234 $(PY) -m pytest \
 	    tests/test_chaos_shard.py -q --durations=10

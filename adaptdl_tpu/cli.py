@@ -492,6 +492,184 @@ def _cmd_shardmap(args) -> int:  # wire: consumes=shard_map
     return 0
 
 
+def _cmd_reshard(args) -> int:  # wire: consumes=reshard,shard_map
+    """Live resharding driver. ``plan`` cuts a :class:`ReshardPlan`
+    (the tenant moves a shard-set change implies) from the router's
+    current map plus the merged inventory; ``apply`` executes a saved
+    plan move by move — each tenant migration streams, fences,
+    verifies, and flips its own map version with zero job restarts;
+    ``status`` shows every shard's migration state: per-tenant
+    watermark lag against the source journal head, fence remaining,
+    and post-flip moved markers."""
+    import sys as _sys
+
+    from adaptdl_tpu import rpc
+    from adaptdl_tpu.sched import shard as shard_mod
+
+    client = rpc.default_client()
+
+    if args.action == "apply":
+        if not args.plan or not args.map:
+            print(
+                "reshard apply requires --plan and --map",
+                file=_sys.stderr,
+            )
+            return 2
+        shard_map = shard_mod.ShardMap.load(args.map)
+        plan = shard_mod.ReshardPlan.load(args.plan)
+        # A grow plan names shards the journaled map has never seen.
+        # Mirror ShardedCluster.grow: publish a widened map FIRST,
+        # with every moving tenant pinned to its current owner (and
+        # any drain targets marked retiring), so the publish itself
+        # changes no routing — the per-tenant flips do.
+        needed = {m["from"] for m in plan.moves} | {
+            m["to"] for m in plan.moves
+        }
+        urls = dict(shard_map.shards)
+        for sid, url in plan.shards.items():
+            urls.setdefault(sid, url)
+        missing = sorted(needed - set(urls))
+        if missing:
+            print(
+                f"reshard apply: plan names shard(s) {missing} absent "
+                "from both the map and the plan's shard set",
+                file=_sys.stderr,
+            )
+            return 2
+        retiring = tuple(set(shard_map.retiring) | set(plan.retiring))
+        if urls != shard_map.shards or retiring != shard_map.retiring:
+            overrides = dict(shard_map.overrides)
+            for move in plan.moves:
+                overrides[move["tenant"]] = move["from"]
+            shard_map = shard_mod.ShardMap(
+                urls,
+                version=shard_map.version + 1,
+                overrides=overrides,
+                retiring=retiring,
+            )
+            shard_map.save(args.map)
+            print(
+                f"published widened map v{shard_map.version} "
+                f"({len(urls)} shard(s), routing unchanged)"
+            )
+        print(
+            f"applying {len(plan.moves)} move(s) "
+            f"from map v{shard_map.version}"
+        )
+        for move in plan.moves:
+            shard_map = shard_mod.migrate_tenant(
+                shard_map,
+                move["tenant"],
+                move["from"],
+                move["to"],
+                map_path=args.map,
+                client=client,
+                fence_s=args.fence_s,
+            )
+            print(
+                f"  {move['tenant']}: shard {move['from']} -> "
+                f"{move['to']} (map v{shard_map.version})"
+            )
+        print(f"done: map v{shard_map.version}")
+        return 0
+
+    if not args.supervisor:
+        print(
+            f"reshard {args.action} requires --supervisor",
+            file=_sys.stderr,
+        )
+        return 2
+    payload = client.get(
+        f"{args.supervisor}/shardmap",
+        endpoint="cli/reshard",
+        timeout=10,
+        attempts=3,
+        deadline=30.0,
+    ).json()
+    shard_map = shard_mod.ShardMap.from_payload(payload)
+
+    if args.action == "plan":
+        new_shards = dict(shard_map.shards)
+        for spec in args.add or ():
+            sid, _, url = spec.partition("=")
+            new_shards[int(sid)] = url
+        plan = shard_mod.plan_reshard(
+            shard_map,
+            new_shards=new_shards,
+            retiring=tuple(args.retire or ()),
+            client=client,
+        )
+        print(
+            f"reshard plan: map v{plan.from_version} -> "
+            f"v{plan.version}, {len(plan.moves)} move(s)"
+        )
+        rows = [("TENANT", "FROM", "TO")]
+        for move in plan.moves:
+            rows.append(
+                (move["tenant"], str(move["from"]), str(move["to"]))
+            )
+        _print_table(rows)
+        if args.out:
+            plan.save(args.out)
+            print(f"\nwrote {args.out}")
+        return 0
+
+    # status: one fan-out over the map, then cross-shard watermark
+    # lag (the epoch names the source shard, whose journal head is
+    # the target the destination watermark chases).
+    infos: dict[int, dict] = {}
+    for sid in shard_map.shard_ids():
+        infos[sid] = client.get(
+            f"{shard_map.shards[sid]}/shard/reshard/status",
+            endpoint="cli/reshard",
+            timeout=10,
+            attempts=3,
+            deadline=30.0,
+        ).json()
+    print(f"shard map v{shard_map.version}")
+    rows = [("SHARD", "SEQ", "TENANT", "STATE", "WATERMARK", "LAG", "DETAIL")]
+    for sid in sorted(infos):
+        info = infos[sid]
+        seq = int(info.get("seq") or 0)
+        busy = False
+        for tenant, entry in sorted((info.get("pending") or {}).items()):
+            busy = True
+            epoch = str(entry.get("epoch") or "")
+            lag = "-"
+            # epoch format: "{tenant}:{from}->{to}@v{version}"
+            try:
+                src_sid = int(epoch.rsplit("@", 1)[0].rsplit(":", 1)[1].split("->")[0])
+                lag = str(
+                    max(int(infos[src_sid].get("seq") or 0)
+                        - int(entry.get("watermark") or 0), 0)
+                )
+            except (KeyError, IndexError, ValueError):
+                pass
+            rows.append(
+                (str(sid), str(seq), tenant, "pending",
+                 str(entry.get("watermark")), lag,
+                 f"jobs={entry.get('jobs')} "
+                 f"skipped={entry.get('skipped')} epoch={epoch}")
+            )
+        for tenant, remaining in sorted((info.get("fenced") or {}).items()):
+            busy = True
+            rows.append(
+                (str(sid), str(seq), tenant, "fenced", "-", "-",
+                 f"remaining={float(remaining):.3f}s")
+            )
+        for tenant, marker in sorted((info.get("moved") or {}).items()):
+            busy = True
+            rows.append(
+                (str(sid), str(seq), tenant, "moved", "-", "-",
+                 f"-> shard {marker.get('shard')} "
+                 f"@ map v{marker.get('version')}")
+            )
+        if not busy:
+            rows.append((str(sid), str(seq), "-", "idle", "-", "-", "-"))
+    _print_table(rows)
+    return 0
+
+
 def _cmd_explain(args) -> int:  # wire: consumes=explain,topology
     """Decision provenance for one job: why the allocator's last
     cycle gave it THIS allocation and mesh shape — the winning
@@ -1214,6 +1392,62 @@ def main(argv=None) -> int:
         help="a namespace/name job key to resolve to its owning shard",
     )
     p.set_defaults(fn=_cmd_shardmap)
+
+    p = sub.add_parser(
+        "reshard",
+        help="live resharding: plan tenant moves for a shard-set "
+        "change, apply them with zero job restarts, or show "
+        "per-tenant migration status (watermark lag, fences, "
+        "moved markers)",
+    )
+    p.add_argument(
+        "action",
+        choices=("plan", "apply", "status"),
+        help="plan: cut a ReshardPlan from the current map + merged "
+        "inventory; apply: execute a saved plan (stream, fence, "
+        "verify, flip — one map bump per tenant); status: show each "
+        "shard's migration state",
+    )
+    p.add_argument(
+        "--supervisor",
+        help="router base URL (plan/status)",
+    )
+    p.add_argument(
+        "--retire",
+        action="append",
+        type=int,
+        metavar="SHARD",
+        help="shard id to drain out of the rendezvous (plan; "
+        "repeatable)",
+    )
+    p.add_argument(
+        "--add",
+        action="append",
+        metavar="SID=URL",
+        help="shard to add to the target set (plan; repeatable)",
+    )
+    p.add_argument(
+        "--out",
+        help="write the computed plan to this file (plan)",
+    )
+    p.add_argument(
+        "--plan",
+        help="plan file to execute (apply)",
+    )
+    p.add_argument(
+        "--map",
+        help="journaled shard-map path the flips are published to "
+        "(apply)",
+    )
+    p.add_argument(
+        "--fence-s",
+        type=float,
+        default=None,
+        dest="fence_s",
+        help="per-tenant write-fence budget in seconds (apply; "
+        "default ADAPTDL_RESHARD_FENCE_S)",
+    )
+    p.set_defaults(fn=_cmd_reshard)
 
     p = sub.add_parser(
         "explain",

@@ -728,3 +728,18 @@ def shard_map_path() -> str | None:
 def router_port() -> int | None:
     """Port the shard router's HTTP server binds (raw)."""
     return _get_opt_int("ADAPTDL_ROUTER_PORT")
+
+
+def reshard_fence_s() -> float:
+    """Per-tenant write-fence budget for a live tenant migration: the
+    source shard 503s the tenant's mutations for at most this many
+    seconds while the destination drains the final journal tail; an
+    overrun rolls the migration back (workers ride the fence out on
+    the retrying rpc client)."""
+    return _get_float("ADAPTDL_RESHARD_FENCE_S", 5.0)
+
+
+def reshard_batch_records() -> int:
+    """Max journal records (or job snapshots) per reshard stream
+    batch — bounds each `GET /shard/stream/{tenant}` response."""
+    return max(_get_int("ADAPTDL_RESHARD_BATCH", 256), 1)

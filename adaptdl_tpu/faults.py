@@ -107,6 +107,15 @@ INJECTION_POINTS = {
     "router.forward.pre": "router forwarding handler, before shard pick",
     "sup.shard.inventory.pre": "per-shard inventory publication handler",
     "shard.map.write": "before the shard map's atomic write+rename",
+    # live resharding (sched.shard migration protocol; stream/replay
+    # faults become retryable 500s, fence/flip faults abort the
+    # migration BEFORE the map version bump so the rollback leaves the
+    # source shard authoritative)
+    "sup.reshard.pre": "reshard control handlers (stream/import/fence/commit/abort)",
+    "reshard.stream.batch": "source side, before a tenant stream batch is served",
+    "reshard.replay": "destination side, before an imported batch is journaled",
+    "reshard.fence": "coordinator, before the source write-fence is raised",
+    "reshard.flip": "coordinator, before the bumped shard map is saved",
     # durable cluster state (sched.journal / sched.state)
     "sched.journal_write": "before a journal record is written+fsynced",
     "sched.snapshot_write": "before a state snapshot is written",

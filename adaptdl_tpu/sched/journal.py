@@ -117,12 +117,13 @@ class StateJournal:
 
     # -- write path ----------------------------------------------------
 
-    def append(self, record: dict) -> None:
-        """Durably append one mutation record. With group commit
-        disabled (the default) the fsync happens before return; with a
-        window, the record is written+flushed in order (a process kill
-        loses nothing acknowledged) and the fsync is deferred to the
-        flusher, bounded by the window."""
+    def append(self, record: dict) -> int:
+        """Durably append one mutation record; returns the stamped
+        ``seq`` (the live resharding stream addresses batches by it).
+        With group commit disabled (the default) the fsync happens
+        before return; with a window, the record is written+flushed in
+        order (a process kill loses nothing acknowledged) and the
+        fsync is deferred to the flusher, bounded by the window."""
         # The span covers write(+fsync) — the latency every journaled
         # supervisor mutation pays on its critical path (group commit
         # moves the fsync half off it). ``job``/``op`` attrs let a
@@ -159,6 +160,7 @@ class StateJournal:
                     self._ensure_flusher_locked()
                     self._fsync_cv.notify_all()
                 self._appends_since_snapshot += 1
+                return self._seq
 
     def _ensure_flusher_locked(self) -> None:  # holds-lock: _io_lock
         if self._fsync_thread is not None and self._fsync_thread.is_alive():
@@ -197,6 +199,13 @@ class StateJournal:
 
     def snapshot_due(self) -> bool:
         return self._appends_since_snapshot >= self._snapshot_every
+
+    @property
+    def last_seq(self) -> int:
+        """The newest stamped record sequence (0 before any append).
+        Serialized by the owning ClusterState's condition lock, like
+        append/snapshot ordering."""
+        return self._seq
 
     def write_snapshot(self, payload: dict) -> None:
         """Atomically replace the snapshot and truncate the journal.

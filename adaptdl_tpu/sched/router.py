@@ -16,12 +16,15 @@ Failure semantics, deliberately boring:
   breaker** (``endpoint="router/shard{id}"``): a dead shard costs its
   own workers a cheap 503 per circuit cadence and costs sibling
   shards nothing.
-- On a failed forward the router reloads the shard map from disk
-  (the stale-map retry): if a newer map names a different owner, the
-  request is retried once against it; otherwise the worker gets 503
-  and ITS rpc client keeps retrying — exactly how workers already
-  ride out a single-supervisor restart, so a shard kill causes zero
-  job restarts.
+- On a failed forward — or a live-resharding ``409 moved`` from a
+  tenant's old owner — the router reloads the shard map from disk
+  (the stale-map retry): every extra hop requires a STRICTLY newer
+  map version naming a DIFFERENT owner, so a stale map costs exactly
+  one re-forward (even across a double-flip) and can never loop;
+  otherwise the worker gets 503/409 and ITS rpc client keeps
+  retrying — exactly how workers already ride out a
+  single-supervisor restart, so a shard kill causes zero job
+  restarts.
 - The router itself is stateless and restartable at will: everything
   it knows is the map file plus what shards serve.
 """
@@ -30,6 +33,7 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import json
 import re
 import threading
 
@@ -282,40 +286,72 @@ class Router(ThreadedHttpServer):
 
     # -- forwarding ---------------------------------------------------
 
+    # Hop budget for one forwarded request. Every extra hop requires
+    # a STRICTLY newer map version naming a DIFFERENT owner, so the
+    # budget is only consumed by genuine concurrent flips — a single
+    # stale map resolves in exactly one re-forward, and even a
+    # double-flip (two map bumps during one in-flight request) lands
+    # on the final owner with one re-forward because the reload jumps
+    # straight to the newest version.
+    _MAX_FORWARD_HOPS = 4
+
+    @staticmethod
+    def _moved_owner_hint(text: str):  # wire: consumes=reshard
+        """Parse a live-resharding 409 body: the OLD owner of a
+        migrated tenant answers ``{"error": "moved", ...}`` post-flip.
+        Returns the payload dict, or None for any other 409 (which is
+        an application conflict the worker must see verbatim)."""
+        try:
+            payload = json.loads(text)
+        except (ValueError, TypeError):
+            return None
+        if isinstance(payload, dict) and payload.get("error") == "moved":
+            return payload
+        return None
+
     def _forward_sync(
         self, method: str, key: str, path_qs: str, body
     ) -> tuple[int, str]:
         shard_map = self.current_map()
         sid = shard_map.assign(key)
-        try:
-            resp = self._request_shard(
-                method, shard_map.shards[sid], sid, path_qs, body
-            )
-            return resp.status_code, resp.text
-        except (rpc.CircuitOpenError, rpc.RpcError):
-            # Stale-map retry: the shard set may have changed under
-            # us. Only a NEWER map that names a DIFFERENT owner earns
-            # one retry; otherwise the worker's own client retries
-            # through the shard's recovery window.
-            if self._reload_map():
+        for _hop in range(self._MAX_FORWARD_HOPS):
+            try:
+                resp = self._request_shard(
+                    method, shard_map.shards[sid], sid, path_qs, body
+                )
+            except (rpc.CircuitOpenError, rpc.RpcError):
+                # Stale-map retry: the shard set may have changed
+                # under us. Only a STRICTLY newer map that names a
+                # DIFFERENT owner earns a re-forward; otherwise the
+                # worker gets 503 and ITS rpc client retries through
+                # the shard's recovery window.
+                self._reload_map()
                 fresh = self.current_map()
                 new_sid = fresh.assign(key)
-                if new_sid != sid:
-                    try:
-                        resp = self._request_shard(
-                            method,
-                            fresh.shards[new_sid],
-                            new_sid,
-                            path_qs,
-                            body,
-                        )
-                        return resp.status_code, resp.text
-                    except (rpc.CircuitOpenError, rpc.RpcError):
-                        pass
-            return 503, (
-                '{"error": "shard unavailable", '
-                f'"shard": {sid}}}'
-            )
+                if fresh.version > shard_map.version and new_sid != sid:
+                    shard_map, sid = fresh, new_sid
+                    continue
+                return 503, (
+                    '{"error": "shard unavailable", '
+                    f'"shard": {sid}}}'
+                )
+            if resp.status_code == 409 and self._moved_owner_hint(
+                resp.text
+            ):
+                # Live resharding flipped the tenant while this
+                # request was in flight: the old owner 409s with the
+                # new owner. Reload and re-forward — the version-
+                # monotonic check makes this at most one re-forward
+                # per map bump, never a loop (two shards can never
+                # BOTH claim the tenant moved under the same version).
+                self._reload_map()
+                fresh = self.current_map()
+                new_sid = fresh.assign(key)
+                if fresh.version > shard_map.version and new_sid != sid:
+                    shard_map, sid = fresh, new_sid
+                    continue
+            return resp.status_code, resp.text
+        return resp.status_code, resp.text
 
     def _request_shard(
         self, method: str, base_url: str, sid: int, path_qs: str, body

@@ -68,7 +68,7 @@ from collections import deque
 
 from aiohttp import web
 
-from adaptdl_tpu import env, sched_hints, trace
+from adaptdl_tpu import env, faults, sched_hints, trace
 from adaptdl_tpu.sched.http_server import (
     ThreadedHttpServer,
     faultable as _faultable,
@@ -544,6 +544,239 @@ class Supervisor(ThreadedHttpServer):
                     else []
                 ),
             }
+
+        return web.json_response(await self._offload(build))
+
+    # -- live resharding (sched/shard.py migration protocol) ----------
+
+    @_faultable("sup.reshard.pre")
+    async def _reshard_stream(  # wire: produces=reshard
+        self, request: web.Request
+    ) -> web.Response:
+        """One tenant-migration stream batch (source side): a
+        snapshot-mode export when ``from_seq`` is absent, else the
+        seq-ordered delta tail above it — both sha-stamped. An
+        injected ``reshard.stream.batch`` fault is a retryable 500,
+        like every other supervisor blip the rpc client rides out."""
+        tenant = request.match_info["tenant"]
+        raw = request.query.get("from_seq")
+        from_seq = int(raw) if raw not in (None, "") else None
+        raw_limit = request.query.get("limit")
+        limit = (
+            int(raw_limit) if raw_limit not in (None, "") else None
+        )
+        try:
+            batch = await self._offload(
+                self._state.stream_tenant, tenant, from_seq, limit
+            )
+        except faults.InjectedFault as exc:
+            return web.json_response(
+                {"error": f"injected fault: {exc}"}, status=500
+            )
+        return web.json_response(batch)
+
+    @_faultable("sup.reshard.pre")
+    async def _reshard_import(  # idempotent: keyed-by=epoch # wire: consumes=reshard # wire: produces=reshard
+        self, request: web.Request
+    ) -> web.Response:
+        """Destination-side batch intake: journals + applies one
+        stream batch (the body is the batch itself plus the migration
+        ``epoch``) and acks the new durable watermark. Idempotent per
+        (epoch, seq): a re-delivered batch at or below the watermark
+        journals nothing and re-acks. A sha mismatch is a 400 — the
+        coordinator rolls the migration back rather than retrying
+        corruption."""
+        tenant = request.match_info["tenant"]
+        try:
+            body = await request.json()
+        except ValueError:
+            return web.json_response(
+                {"error": "body must be JSON"}, status=400
+            )
+        if not isinstance(body, dict) or not body.get("epoch"):
+            return web.json_response(
+                {"error": "body must carry the migration epoch"},
+                status=400,
+            )
+        epoch = str(body.get("epoch"))
+        try:
+            watermark = await self._offload(
+                self._state.reshard_import_batch, tenant, epoch, body
+            )
+        except faults.InjectedFault as exc:
+            return web.json_response(
+                {"error": f"injected fault: {exc}"}, status=500
+            )
+        except (KeyError, TypeError, ValueError) as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response(
+            {
+                "tenant": tenant,
+                "epoch": epoch,
+                "watermark": int(watermark),
+            }
+        )
+
+    @_faultable("sup.reshard.pre")
+    async def _reshard_fence(  # idempotent: keyed-by=tenant # wire: consumes=reshard # wire: produces=reshard
+        self, request: web.Request
+    ) -> web.Response:
+        """Raise (or release, with ``{"release": true}``) the
+        tenant's write fence on the source shard. The response
+        carries the fence budget left and the source journal head —
+        the seq the destination's watermark must reach before the
+        flip. Re-raising an active fence just re-arms the deadline
+        (idempotent for the coordinator's retry path)."""
+        tenant = request.match_info["tenant"]
+        body = None
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except ValueError:
+                body = None
+        body = body if isinstance(body, dict) else {}
+
+        def mutate():
+            if body.get("release"):
+                self._state.unfence_tenant(tenant)
+                return {
+                    "tenant": tenant,
+                    "fenced": False,
+                    "seq": self._state.last_journal_seq(),
+                }
+            raw = body.get("deadlineS")
+            timeout_s = None if raw is None else float(raw)
+            self._state.fence_tenant(tenant, timeout_s)
+            return {
+                "tenant": tenant,
+                "fenced": True,
+                "deadlineS": self._state.fence_remaining(tenant),
+                "seq": self._state.last_journal_seq(),
+            }
+
+        try:
+            payload = await self._offload(mutate)
+        except (TypeError, ValueError) as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response(payload)
+
+    @_faultable("sup.reshard.pre")
+    async def _reshard_commit(  # idempotent: keyed-by=epoch # wire: consumes=reshard # wire: produces=reshard
+        self, request: web.Request
+    ) -> web.Response:
+        """Commit one side of a migration epoch. ``role: "dest"``
+        promotes the caught-up import to ordinary records; ``role:
+        "source"`` (post-flip) drops the tenant's jobs, plants the
+        durable moved marker behind the 409 redirect, and releases
+        the fence. Both idempotent per epoch — re-running a crashed
+        plan journals nothing the second time."""
+        tenant = request.match_info["tenant"]
+        try:
+            body = await request.json()
+        except ValueError:
+            return web.json_response(
+                {"error": "body must be JSON"}, status=400
+            )
+        if not isinstance(body, dict) or not body.get("epoch"):
+            return web.json_response(
+                {"error": "body must carry the migration epoch"},
+                status=400,
+            )
+        epoch = str(body.get("epoch"))
+
+        def mutate():
+            if body.get("role") == "dest":
+                fresh = self._state.reshard_commit_dest(tenant, epoch)
+                return {
+                    "tenant": tenant,
+                    "epoch": epoch,
+                    "role": "dest",
+                    "committed": bool(fresh),
+                }
+            removed = self._state.reshard_commit_source(
+                tenant,
+                epoch,
+                int(body.get("toShard", -1)),
+                int(body.get("mapVersion", 0)),
+            )
+            return {
+                "tenant": tenant,
+                "epoch": epoch,
+                "role": "source",
+                "committed": True,
+                "moved": len(removed),
+            }
+
+        try:
+            payload = await self._offload(mutate)
+        except faults.InjectedFault as exc:
+            return web.json_response(
+                {"error": f"injected fault: {exc}"}, status=500
+            )
+        except (TypeError, ValueError) as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response(payload)
+
+    @_faultable("sup.reshard.pre")
+    async def _reshard_abort(  # idempotent: keyed-by=epoch # wire: consumes=reshard # wire: produces=reshard
+        self, request: web.Request
+    ) -> web.Response:
+        """Roll the migration epoch back. On the destination the
+        epoch's partially-imported jobs are discarded (journaled); on
+        the source (``role: "source"``) the fence is released — the
+        map never flipped, so the source simply resumes serving.
+        Idempotent: an unknown epoch journals nothing."""
+        tenant = request.match_info["tenant"]
+        try:
+            body = await request.json()
+        except ValueError:
+            return web.json_response(
+                {"error": "body must be JSON"}, status=400
+            )
+        if not isinstance(body, dict) or not body.get("epoch"):
+            return web.json_response(
+                {"error": "body must carry the migration epoch"},
+                status=400,
+            )
+        epoch = str(body.get("epoch"))
+
+        def mutate():
+            if body.get("role") == "source":
+                self._state.unfence_tenant(tenant)
+                return {
+                    "tenant": tenant,
+                    "epoch": epoch,
+                    "role": "source",
+                    "aborted": True,
+                }
+            dropped = self._state.reshard_abort(tenant, epoch)
+            return {
+                "tenant": tenant,
+                "epoch": epoch,
+                "role": "dest",
+                "aborted": bool(dropped),
+            }
+
+        try:
+            payload = await self._offload(mutate)
+        except faults.InjectedFault as exc:
+            return web.json_response(
+                {"error": f"injected fault: {exc}"}, status=500
+            )
+        return web.json_response(payload)
+
+    @_faultable("sup.reshard.pre")
+    async def _reshard_status(  # wire: produces=reshard
+        self, request: web.Request
+    ) -> web.Response:
+        """Migration observability for this shard: journal head seq,
+        pending imports with watermarks, moved-tenant markers, active
+        fences (the ``adaptdl-tpu reshard status`` payload)."""
+
+        def build() -> dict:
+            info = self._state.reshard_info()
+            info["shard"] = self._shard_id
+            return info
 
         return web.json_response(await self._offload(build))
 
@@ -1178,6 +1411,54 @@ class Supervisor(ThreadedHttpServer):
                 pass
 
     @web.middleware
+    async def _reshard_gate(self, request, handler):
+        """Per-tenant migration gate on every job-scoped route (the
+        ones whose path carries ``{namespace}``; the ``/shard/*``
+        control plane is structurally exempt). A migrated tenant's
+        request — any method, reads included: the jobs left with the
+        flip — is answered 409 ``{"error": "moved", "shard",
+        "version"}`` so the router re-forwards it exactly once to the
+        new owner. A mutation landing inside the live-migration write
+        fence is answered 503 with Retry-After: the worker's retrying
+        rpc client rides the bounded fence out, and reads keep
+        flowing off the still-authoritative source."""
+        tenant = request.match_info.get("namespace")
+        if tenant is None:
+            return await handler(request)
+        is_read = request.method == "GET"
+
+        def gate():
+            # State reads take _cond (held across journal fsyncs) —
+            # off the loop, like every other state access here.
+            moved = self._state.moved_owner(tenant)
+            if moved is not None:
+                return "moved", moved
+            if not is_read:
+                remaining = self._state.fence_remaining(tenant)
+                if remaining > 0:
+                    return "fenced", remaining
+            return None, None
+
+        verdict, info = await self._offload(gate)
+        if verdict == "moved":
+            return web.json_response(
+                {
+                    "error": "moved",
+                    "tenant": tenant,
+                    "shard": int(info["shard"]),
+                    "version": int(info["version"]),
+                },
+                status=409,
+            )
+        if verdict == "fenced":
+            return web.json_response(
+                {"error": "fenced", "tenant": tenant},
+                status=503,
+                headers={"Retry-After": f"{max(info, 0.05):.3f}"},
+            )
+        return await handler(request)
+
+    @web.middleware
     async def _time_endpoint(self, request, handler):
         """Server-side per-endpoint latency histogram
         (``adaptdl_trace_phase_seconds{phase="sup.endpoint.<seg>"}``)
@@ -1200,7 +1481,13 @@ class Supervisor(ThreadedHttpServer):
             )
 
     def build_app(self) -> web.Application:
-        app = web.Application(middlewares=[self._time_endpoint])
+        app = web.Application(
+            middlewares=[self._time_endpoint, self._reshard_gate],
+            # Snapshot-mode reshard imports carry a whole tenant's job
+            # table in one body; aiohttp's 1 MiB default 413s any
+            # real-sized tenant mid-migration.
+            client_max_size=64 * 1024 * 1024,
+        )
         app.add_routes(
             [
                 web.get(
@@ -1236,6 +1523,28 @@ class Supervisor(ThreadedHttpServer):
                 web.get("/status", self._status),
                 web.get("/watch", self._watch),
                 web.get("/shard/inventory", self._shard_inventory),
+                web.get(
+                    "/shard/stream/{tenant}", self._reshard_stream
+                ),
+                web.post(
+                    "/shard/reshard/import/{tenant}",
+                    self._reshard_import,
+                ),
+                web.post(
+                    "/shard/reshard/fence/{tenant}",
+                    self._reshard_fence,
+                ),
+                web.post(
+                    "/shard/reshard/commit/{tenant}",
+                    self._reshard_commit,
+                ),
+                web.post(
+                    "/shard/reshard/abort/{tenant}",
+                    self._reshard_abort,
+                ),
+                web.get(
+                    "/shard/reshard/status", self._reshard_status
+                ),
                 web.get(
                     "/explain/{namespace}/{name}", self._explain
                 ),

@@ -211,6 +211,28 @@ WIRE_CONTRACTS = {
             "kinds",
             "notice_s",
             "trace_parent",
+            # live resharding: the destination journals imported
+            # tenant snapshots/record batches (`reshard_import` /
+            # `reshard_apply`) and both sides journal the commit /
+            # abort transitions.
+            "tenant",
+            "epoch",
+            "source_seq",
+            "jobs",
+            "records",
+            "to_shard",
+            "map_version",
+            "role",
+            # in-memory delta-tail ring entries (`_op_log`) carry the
+            # journal-stamped seq; the pending/moved registries the
+            # recovery path rebuilds carry watermark/keys/skipped and
+            # the moved marker's shard/version.
+            "seq",
+            "watermark",
+            "keys",
+            "skipped",
+            "shard",
+            "version",
             # `update` op field names reach the journal as
             # update(**fields) kwargs — written at dozens of call
             # sites, readable only dynamically.
@@ -219,7 +241,21 @@ WIRE_CONTRACTS = {
             "status",
             "hints",
         ),
-        "unchecked": ("allocation", "topology", "status", "hints"),
+        "unchecked": (
+            "allocation",
+            "topology",
+            "status",
+            "hints",
+            # stamped by the journal/append path, read by the
+            # streaming reader and the tenant gate outside annotated
+            # consumers
+            "seq",
+            "watermark",
+            "keys",
+            "skipped",
+            "shard",
+            "version",
+        ),
         "required": (
             "op",
             "key",
@@ -249,9 +285,22 @@ WIRE_CONTRACTS = {
             "draining_slots",
             "hazard",
             "preempt_notices",
+            # live-resharding registries: pending imports (with their
+            # acknowledged source watermarks) and moved-out tenants
+            # (the 409-with-new-owner table). Version-optional.
+            "reshard",
+            "pending",
+            "moved",
+            "epoch",
+            "watermark",
+            "keys",
+            "skipped",
+            "shard",
         ),
         # Format stamp for future migrations; no reader today.
-        "unchecked": ("version",),
+        # The moved marker's `shard` is copied structurally
+        # (dict(info)) into the snapshot, never written as a literal.
+        "unchecked": ("version", "shard"),
         "required": (),
     },
     # ---- one job record inside a state snapshot.
@@ -602,8 +651,85 @@ WIRE_CONTRACTS = {
     "shard_map": {
         "doc": "sched.router / sched.shard rendezvous shard map",
         "persisted": True,
-        "keys": ("version", "shards"),
+        # `overrides` / `retiring` joined in the live-resharding
+        # version: per-tenant pins while a migration is in flight and
+        # shards excluded from rendezvous while draining. Both are
+        # version-optional (pre-reshard maps lack them).
+        "keys": ("version", "shards", "overrides", "retiring"),
         "required": ("version", "shards"),
+    },
+    # ---- live resharding (sched.shard migration protocol): the
+    # versioned ReshardPlan, the tenant stream batches the source
+    # serves, the destination's import acks/watermarks, and the
+    # fence/commit/abort control bodies. Persisted: the plan is saved
+    # beside the shard map and the stream/import payloads are replayed
+    # into the destination's journal.
+    "reshard": {
+        "doc": "sched.shard live tenant-migration protocol bodies",
+        "persisted": True,
+        "keys": (
+            # ReshardPlan (saved beside the shard map)
+            "version",
+            "fromVersion",
+            "retiring",
+            "moves",
+            "shards",
+            "tenant",
+            "from",
+            "to",
+            # stream batches + import acks
+            "epoch",
+            "mode",
+            "seq",
+            "from_seq",
+            "records",
+            "jobs",
+            "sha",
+            "watermark",
+            # fence / commit / abort control bodies
+            "deadlineS",
+            "fenced",
+            "role",
+            "toShard",
+            "mapVersion",
+            "committed",
+            "aborted",
+            "release",
+            # status + moved markers + gate bodies
+            "pending",
+            "moved",
+            "shard",
+            "skipped",
+            "error",
+        ),
+        "unchecked": (
+            # plan version: written for operators, readers recompute
+            # it from fromVersion + moves
+            "version",
+            # from_seq rides the stream URL's query string (the
+            # handler reads request.query, not a payload dict)
+            "from_seq",
+            # operator escape hatch (curl a fence release); no
+            # in-package producer
+            "release",
+            # commit/abort acks asserted on by tests and operators,
+            # not by the coordinator (it trusts the 200)
+            "committed",
+            "aborted",
+        ),
+        "required": (
+            "moves",
+            "tenant",
+            "from",
+            "to",
+            "epoch",
+            "mode",
+            "seq",
+            "records",
+            "jobs",
+            "sha",
+            "watermark",
+        ),
     },
     # ---- per-shard inventory slice (shard supervisor -> merged
     # allocator view; the full-cycle partition boundary).
@@ -663,3 +789,4 @@ CANDIDATE_ALLOC_KEYS = WIRE_CONTRACTS["candidate_alloc"]["keys"]
 JOURNAL_OP_KEYS = WIRE_CONTRACTS["journal_op"]["keys"]
 SHARD_MAP_KEYS = WIRE_CONTRACTS["shard_map"]["keys"]
 SHARD_INVENTORY_KEYS = WIRE_CONTRACTS["shard_inventory"]["keys"]
+RESHARD_KEYS = WIRE_CONTRACTS["reshard"]["keys"]

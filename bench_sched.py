@@ -337,6 +337,154 @@ def bench_sharded(
     return out
 
 
+def _reshard_worker_main(url, job_keys, seconds, out_queue):
+    """One simulated worker hammering the router's hot path DURING a
+    live migration: per-request latency plus a steps-lost counter —
+    any request that doesn't come back 200 after the router's own
+    stale-map/409 handling is a training step the worker would have
+    lost."""
+    import requests
+
+    session = requests.Session()
+    lat: list[float] = []
+    errors = 0
+    hints = {
+        "perfParams": None,
+        "gradParams": None,
+        "initBatchSize": 128,
+    }
+    deadline = time.monotonic() + seconds
+    i = 0
+    while time.monotonic() < deadline:
+        key = job_keys[i % len(job_keys)]
+        i += 1
+        for request_fn in (
+            lambda: session.put(
+                f"{url}/heartbeat/{key}/0?group=0", timeout=10
+            ),
+            lambda: session.put(
+                f"{url}/hints/{key}", json=hints, timeout=10
+            ),
+            lambda: session.get(f"{url}/config/{key}", timeout=10),
+        ):
+            t0 = time.monotonic()
+            try:
+                ok = request_fn().status_code == 200
+            except requests.RequestException:
+                ok = False
+            lat.append(time.monotonic() - t0)
+            if not ok:
+                errors += 1
+    out_queue.put({"lat": lat, "errors": errors})
+
+
+def bench_reshard(
+    jobs: int = 20, workers: int = 4, seconds: float = 4.0
+) -> dict:
+    """The live-resharding arm: hammer the worker hot path through
+    the router while tenants live-migrate between two shards, and
+    compare the p99 against an identical no-migration run. The gate:
+    migration-window p99 <= 1.5x the no-migration baseline (with the
+    absolute SLO floor, same rationale as the sharded arm), plus the
+    steps-lost count — requests the router could not land even after
+    its stale-map/409 re-forwarding."""
+    import os
+    import shutil
+    import tempfile
+
+    from adaptdl_tpu import rpc
+    from adaptdl_tpu.sched.router import Router
+    from adaptdl_tpu.sched.shard import ShardedCluster, migrate_tenant
+
+    arms: dict[str, dict] = {}
+    for arm in ("baseline", "migrate"):
+        tmp = tempfile.mkdtemp(prefix="adaptdl-bench-reshard-")
+        map_path = os.path.join(tmp, "shardmap.json")
+        cluster = ShardedCluster(
+            2,
+            lease_ttl=60.0,
+            sweep_interval=3600.0,
+            state_kwargs={"alloc_commit_timeout": 0.0},
+            map_path=map_path,
+        )
+        shard_map = cluster.start()
+        router = Router(shard_map, map_path=map_path)
+        url = router.start()
+        job_keys = []
+        for i in range(jobs):
+            key = f"t{i:04d}/j0"
+            shard = cluster.shard_for(key)
+            shard.state.create_job(key, spec={"max_replicas": 4})
+            shard.state.update(
+                key, status="Running", allocation=["local"]
+            )
+            shard.state.register_worker(key, 0, 0, "127.0.0.1:0")
+            job_keys.append(key)
+        ctx = multiprocessing.get_context("fork")
+        queue = ctx.Queue()
+        procs = [
+            ctx.Process(
+                target=_reshard_worker_main,
+                args=(
+                    url,
+                    job_keys[w::workers] or job_keys,
+                    seconds,
+                    queue,
+                ),
+                daemon=True,
+            )
+            for w in range(workers)
+        ]
+        for proc in procs:
+            proc.start()
+        migrated = 0
+        if arm == "migrate":
+            # Let the hammer reach steady state, then live-migrate a
+            # quarter of the tenants mid-window — each one streams,
+            # fences, verifies, and flips while its own traffic is in
+            # flight.
+            time.sleep(seconds * 0.25)
+            current = cluster.map
+            for key in job_keys[: max(jobs // 4, 1)]:
+                tenant = key.split("/", 1)[0]
+                src = current.assign(key)
+                current = migrate_tenant(
+                    current,
+                    tenant,
+                    src,
+                    1 - src,
+                    map_path=map_path,
+                    client=rpc.default_client(),
+                )
+                cluster.map = current
+                migrated += 1
+        lat: list[float] = []
+        errors = 0
+        for _ in procs:
+            got = queue.get(timeout=seconds * 5 + 60)
+            lat.extend(got["lat"])
+            errors += got["errors"]
+        for proc in procs:
+            proc.join(timeout=30)
+        router.stop()
+        cluster.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+        arms[arm] = {
+            "lat": lat, "errors": errors, "migrated": migrated,
+        }
+    base_p99 = _pct(arms["baseline"]["lat"], 0.99)
+    mig_p99 = _pct(arms["migrate"]["lat"], 0.99)
+    return {
+        "sched_reshard_migrations": arms["migrate"]["migrated"],
+        "sched_reshard_baseline_p99_s": round(base_p99, 5),
+        "sched_reshard_p99_s": round(mig_p99, 5),
+        "sched_reshard_steps_lost": arms["migrate"]["errors"],
+        "sched_reshard_p99_ok": (
+            mig_p99 <= max(1.5 * base_p99, SLOS["heartbeat"])
+        ),
+    }
+
+
 def collect(quick: bool = False) -> dict:
     """Everything on one dict (bench.py merges this into BENCH)."""
     out = {}
@@ -357,6 +505,11 @@ def collect(quick: bool = False) -> dict:
         )
         if quick
         else bench_sharded()
+    )
+    out.update(
+        bench_reshard(jobs=8, workers=2, seconds=2.0)
+        if quick
+        else bench_reshard()
     )
     return out
 

@@ -33,11 +33,16 @@ from adaptdl_tpu.sched.router import (
     merge_watch,
 )
 from adaptdl_tpu.sched.shard import (
+    ReshardError,
+    ReshardPlan,
     ShardMap,
     ShardedCluster,
+    _flip_map,
     merged_inventory,
+    migrate_tenant,
     partition_slices,
     plan_inventory_rebalance,
+    plan_reshard,
     rendezvous_shard,
     shard_key,
 )
@@ -621,4 +626,375 @@ def test_one_shard_bit_identical_to_unsharded(tmp_path):
     finally:
         router.stop()
         plain_sup.stop()
+        cluster.stop()
+
+
+# ---- live resharding: map extensions + planning ----------------------
+
+
+def test_shard_map_overrides_and_retiring():
+    m = ShardMap(
+        {0: "u0", 1: "u1", 2: "u2"},
+        version=3,
+        overrides={"tenant-x": 2},
+        retiring=(1,),
+    )
+    # A retiring shard still serves but wins no tenants.
+    assert m.active_ids() == [0, 2]
+    # The pin wins over rendezvous.
+    assert m.assign("tenant-x/j") == 2
+    # A pin to a shard no longer in the map is ignored.
+    m2 = ShardMap({0: "u0"}, overrides={"tenant-x": 9})
+    assert m2.assign("tenant-x/j") == 0
+    # Every shard retiring degenerates to the full set, never empty.
+    m3 = ShardMap({0: "u0", 1: "u1"}, retiring=(0, 1))
+    assert m3.active_ids() == [0, 1]
+
+
+def test_shard_map_payload_roundtrip_with_overrides(tmp_path):
+    path = str(tmp_path / "map.json")
+    m = ShardMap(
+        {0: "u0", 1: "u1"},
+        version=5,
+        overrides={"t": 1},
+        retiring=(0,),
+    )
+    m.save(path)
+    loaded = ShardMap.load(path)
+    assert loaded.version == 5
+    assert loaded.overrides == {"t": 1}
+    assert loaded.retiring == (0,)
+    assert loaded.assign("t/j") == 1
+    # Legacy payloads (pre-resharding) still load.
+    legacy = ShardMap.from_payload(
+        {"version": 1, "shards": {"0": "u0"}}
+    )
+    assert legacy.overrides == {} and legacy.retiring == ()
+    # Empty overrides/retiring are OMITTED: the payload a map without
+    # live migrations writes is byte-identical to the legacy format.
+    plain = ShardMap({0: "u0"}, version=1).to_payload()
+    assert "overrides" not in plain and "retiring" not in plain
+
+
+def test_reshard_plan_roundtrip(tmp_path):
+    plan = ReshardPlan(
+        [
+            {"tenant": "a", "from": 0, "to": 2},
+            {"tenant": "b", "from": 1, "to": 2},
+        ],
+        from_version=4,
+        retiring=(1,),
+        shards={0: "u0", 1: "u1", 2: "u2"},
+    )
+    # One map-version bump per tenant move.
+    assert plan.version == 6
+    path = str(tmp_path / "plan.json")
+    plan.save(path)
+    loaded = ReshardPlan.load(path)
+    assert loaded.moves == plan.moves
+    assert loaded.from_version == 4
+    assert loaded.version == 6
+    assert loaded.retiring == (1,)
+    # The target shard URL set rides along: what a standalone apply
+    # widens the journaled map with before the first migration.
+    assert loaded.shards == {0: "u0", 1: "u1", 2: "u2"}
+
+
+def test_plan_reshard_moves_follow_rendezvous():
+    shard_map = ShardMap({0: "u0", 1: "u1"}, version=3)
+    tenants = [f"tenant-{i}" for i in range(20)]
+    merged = {
+        "jobs": {
+            f"{t}/job": rendezvous_shard(t, [0, 1]) for t in tenants
+        }
+    }
+    # Grow: only tenants whose rendezvous over the widened set lands
+    # on the new shard move — and they move exactly there.
+    plan = plan_reshard(
+        shard_map,
+        new_shards={0: "u0", 1: "u1", 2: "u2"},
+        merged=merged,
+    )
+    assert plan.from_version == 3
+    expect = {
+        t: rendezvous_shard(t, [0, 1, 2])
+        for t in tenants
+        if rendezvous_shard(t, [0, 1, 2]) != rendezvous_shard(t, [0, 1])
+    }
+    assert {m["tenant"]: m["to"] for m in plan.moves} == expect
+    assert all(m["to"] == 2 for m in plan.moves)
+    # Drain: exactly the retiring shard's tenants move, to survivors.
+    plan = plan_reshard(shard_map, retiring=(1,), merged=merged)
+    assert {m["tenant"] for m in plan.moves} == {
+        t for t in tenants if rendezvous_shard(t, [0, 1]) == 1
+    }
+    assert all(m["from"] == 1 and m["to"] == 0 for m in plan.moves)
+    assert plan.retiring == (1,)
+    # Empty tenants have nothing to stream: no inventory, no moves.
+    assert plan_reshard(shard_map, retiring=(1,), merged={"jobs": {}}).moves == []
+
+
+def test_flip_map_retargets_or_prunes_pin():
+    natural0 = next(
+        t
+        for i in range(100)
+        for t in (f"tenant-{i}",)
+        if rendezvous_shard(t, [0, 1]) == 0
+    )
+    natural1 = next(
+        t
+        for i in range(100)
+        for t in (f"tenant-{i}",)
+        if rendezvous_shard(t, [0, 1]) == 1
+    )
+    base = ShardMap(
+        {0: "u0", 1: "u1"},
+        version=1,
+        overrides={natural0: 0, natural1: 0},
+    )
+    # Flip against rendezvous: the pin is retargeted.
+    flipped = _flip_map(base, natural0, 1)
+    assert flipped.version == 2
+    assert flipped.overrides[natural0] == 1
+    assert flipped.assign(f"{natural0}/j") == 1
+    # Flip TO the rendezvous winner: the pin is dropped entirely.
+    flipped = _flip_map(base, natural1, 1)
+    assert natural1 not in flipped.overrides
+    assert flipped.assign(f"{natural1}/j") == 1
+
+
+# ---- live resharding: migration end-to-end ---------------------------
+
+
+def test_migrate_tenant_end_to_end(two_shards):
+    cluster, router = two_shards
+    client = rpc.default_client()
+    tenant = _tenant_for(cluster, 0)
+    key = f"{tenant}/job"
+    cluster.create_job(key, {})
+    resp = client.put(
+        f"{router.url}/register/{key}/0/0",
+        json={"address": "10.0.0.1:1", "processes": 1},
+        endpoint="test/register",
+    )
+    assert resp.status_code == 200
+    resp = client.put(
+        f"{router.url}/hints/{key}", json=HINTS, endpoint="test/hints"
+    )
+    assert resp.status_code == 200
+
+    flipped = migrate_tenant(cluster.map, tenant, 0, 1, fence_s=5.0)
+    assert flipped.version == cluster.map.version + 1
+    assert flipped.assign(key) == 1
+    # Destination owns the full durable record now.
+    dst_state = cluster.shards[1].state
+    assert dst_state.get_job(key) is not None
+    assert dst_state.get_workers(key) == {0: "10.0.0.1:1"}
+    # Source dropped the tenant and planted the 409 marker.
+    src_state = cluster.shards[0].state
+    assert src_state.get_job(key) is None
+    moved = src_state.moved_owner(tenant)
+    assert moved["shard"] == 1
+    assert moved["version"] == flipped.version
+    # The fence never outlives the migration.
+    assert src_state.fence_remaining(tenant) == 0.0
+    # Re-running the same move (a crashed coordinator) is a pure
+    # idempotent commit tail: same map version out, no new state.
+    again = migrate_tenant(flipped, tenant, 0, 1)
+    assert again.version == flipped.version
+    # The router serves the migrated tenant on the flipped map.
+    router.set_map(flipped)
+    cluster.map = flipped
+    resp = client.get(
+        f"{router.url}/hints/{key}", endpoint="test/hints"
+    )
+    assert resp.status_code == 200
+    for field, value in HINTS.items():
+        assert resp.json()[field] == value
+
+
+def test_cluster_grow_then_drain_preserves_jobs(tmp_path):
+    map_path = str(tmp_path / "map.json")
+    cluster = ShardedCluster(
+        2,
+        lease_ttl=30.0,
+        sweep_interval=3600.0,
+        map_path=map_path,
+    )
+    cluster.start()
+    keys = [f"tenant-{i}/job-{i}" for i in range(12)]
+    for key in keys:
+        cluster.create_job(key, {})
+    try:
+        plan = cluster.grow(fence_s=5.0)
+        assert sorted(cluster.shards) == [0, 1, 2]
+        # Deterministic rendezvous over tenant-0..11 moves a nonempty
+        # strict subset to the new shard.
+        assert plan.moves
+        assert all(m["to"] == 2 for m in plan.moves)
+        for key in keys:
+            sid = cluster.map.assign(key)
+            assert cluster.shards[sid].state.get_job(key) is not None
+        # Drain the new shard back out: every tenant returns to a
+        # survivor, nothing lost, the retired shard leaves the map.
+        cluster.drain(2, fence_s=5.0)
+        assert sorted(cluster.shards) == [0, 1]
+        assert sorted(cluster.map.shards) == [0, 1]
+        assert cluster.map.retiring == ()
+        for key in keys:
+            sid = cluster.map.assign(key)
+            assert sid in (0, 1)
+            assert cluster.shards[sid].state.get_job(key) is not None
+        # The journaled map matches the in-memory one.
+        assert ShardMap.load(map_path).version == cluster.map.version
+    finally:
+        cluster.stop()
+
+
+def test_write_fence_503s_mutations_reads_flow(two_shards):
+    cluster, router = two_shards
+    client = rpc.default_client()
+    tenant = _tenant_for(cluster, 0)
+    key = f"{tenant}/job"
+    cluster.create_job(key, {})
+    state = cluster.shards[0].state
+    state.fence_tenant(tenant, 30.0)
+    try:
+        # Direct to the shard: the fence 503 carries Retry-After.
+        resp = client.put(
+            f"{cluster.shards[0].url}/hints/{key}",
+            json=HINTS,
+            endpoint="test/hints",
+            attempts=1,
+            retry_statuses=(),
+        )
+        assert resp.status_code == 503
+        assert float(resp.headers["Retry-After"]) > 0
+        # Reads keep flowing off the still-authoritative source.
+        resp = client.get(
+            f"{router.url}/config/{key}", endpoint="test/config"
+        )
+        assert resp.status_code == 200
+    finally:
+        state.unfence_tenant(tenant)
+    # Released fence: writes resume immediately.
+    resp = client.put(
+        f"{router.url}/hints/{key}", json=HINTS, endpoint="test/hints"
+    )
+    assert resp.status_code == 200
+
+
+# ---- live resharding: the 409-moved re-forward bound -----------------
+
+
+class _CountingClient:
+    """Delegating rpc client that records the endpoint label of every
+    request — the per-hop audit trail for the re-forward bound."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.endpoints = []
+
+    def request(self, method, url, **kwargs):
+        self.endpoints.append(kwargs.get("endpoint"))
+        return self._inner.request(method, url, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def hops(self):
+        return [
+            e
+            for e in self.endpoints
+            if e and e.startswith("router/shard")
+        ]
+
+
+def test_moved_owner_hint_parses_only_moved_bodies():
+    hint = Router._moved_owner_hint(
+        '{"error": "moved", "shard": 2, "version": 3}'
+    )
+    assert hint["shard"] == 2
+    # Application 409s and junk are NOT redirect hints.
+    assert Router._moved_owner_hint('{"error": "conflict"}') is None
+    assert Router._moved_owner_hint("not json") is None
+    assert Router._moved_owner_hint('["moved"]') is None
+
+
+def test_router_double_flip_single_reforward(tmp_path):
+    """The satellite regression: a request in flight across TWO map
+    bumps (the tenant migrated 0→1, then 1→2) resolves with EXACTLY
+    one re-forward — the old owner 409s ``moved``, the reload jumps
+    straight to the newest journaled map, and the second hop lands on
+    the final owner. No hop ever visits the intermediate shard and
+    the budget is never consumed twice."""
+    cluster = ShardedCluster(3, lease_ttl=30.0, sweep_interval=3600.0)
+    cluster.start()
+    map_path = str(tmp_path / "map.json")
+    stale = cluster.map  # v1, pre-migration
+    stale.save(map_path)
+    tenant = _tenant_for(cluster, 0)
+    key = f"{tenant}/job"
+    cluster.create_job(key, {})
+    router = None
+    try:
+        v2 = migrate_tenant(
+            cluster.map, tenant, 0, 1, map_path=map_path
+        )
+        cluster.map = v2
+        v3 = migrate_tenant(v2, tenant, 1, 2, map_path=map_path)
+        cluster.map = v3
+        assert v3.version == stale.version + 2
+        counting = _CountingClient(rpc.default_client())
+        router = Router(stale, map_path=map_path, client=counting)
+        url = router.start()
+        resp = rpc.default_client().put(
+            f"{url}/hints/{key}",
+            json=HINTS,
+            endpoint="test/hints",
+            attempts=1,
+            retry_statuses=(),
+        )
+        assert resp.status_code == 200
+        # Exactly one re-forward: first hop to the stale owner, second
+        # straight to the final owner — shard 1 is never touched.
+        assert counting.hops() == ["router/shard0", "router/shard2"]
+        assert router.current_map().version == v3.version
+    finally:
+        if router is not None:
+            router.stop()
+        cluster.stop()
+
+
+def test_router_moved_409_without_newer_map_is_verbatim():
+    """The other half of the at-most-once bound: a ``moved`` 409 with
+    NO newer journaled map to reload earns zero re-forwards — the
+    worker sees the 409 verbatim instead of the router looping."""
+    cluster = ShardedCluster(2, lease_ttl=30.0, sweep_interval=3600.0)
+    cluster.start()
+    tenant = _tenant_for(cluster, 0)
+    key = f"{tenant}/job"
+    cluster.create_job(key, {})
+    router = None
+    try:
+        flipped = migrate_tenant(cluster.map, tenant, 0, 1)
+        assert flipped.version == cluster.map.version + 1
+        counting = _CountingClient(rpc.default_client())
+        # Router keeps the stale map and has NO map_path to reload.
+        router = Router(cluster.map, client=counting)
+        url = router.start()
+        resp = rpc.default_client().put(
+            f"{url}/hints/{key}",
+            json=HINTS,
+            endpoint="test/hints",
+            attempts=1,
+            retry_statuses=(),
+        )
+        assert resp.status_code == 409
+        assert resp.json()["error"] == "moved"
+        assert resp.json()["shard"] == 1
+        assert counting.hops() == ["router/shard0"]
+    finally:
+        if router is not None:
+            router.stop()
         cluster.stop()
