@@ -3,7 +3,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched chaos-preempt trace-gate rescale-fast meshgate simgate watchgate warmgate shardgate bench-sched probe
+.PHONY: test test-fast bench bench-quick dryrun examples lint graftcheck chaos chaos-sched chaos-preempt guardgate trace-gate rescale-fast meshgate simgate watchgate warmgate shardgate bench-sched probe
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -66,6 +66,19 @@ chaos-sched:
 chaos-preempt:
 	$(CPU_ENV) ADAPTDL_FAULT_SEED=1234 $(PY) -m pytest \
 	    tests/test_chaos_preempt.py -q --durations=10
+
+# graftguard gate (docs/robustness.md "Numeric-health guard"): an
+# injected NaN gradient at a fixed step (seed 1234) must roll the run
+# back to the last good-marked checkpoint and finish BIT-equal to an
+# undisturbed run that skipped the poisoned batch; slot-pinned
+# corruption must quarantine exactly the offending slot (same data
+# across slots blames the data instead); incident records must
+# survive a supervisor hard-kill + journal replay bit-identically;
+# and the worker's incident report must retry through a supervisor
+# 500. Same fixed seed as `chaos`.
+guardgate:
+	$(CPU_ENV) ADAPTDL_FAULT_SEED=1234 $(PY) -m pytest \
+	    tests/test_chaos_guard.py -q --durations=10
 
 # graftscope gates (docs/observability.md): tracing on vs off on the
 # CPU harness step loop must cost < 1% step time, the span ring

@@ -88,6 +88,13 @@ LOG = logging.getLogger(__name__)
 # argument: checksums are what make frequent checkpoints trustworthy).
 MANIFEST_NAME = "manifest.json"
 
+# Last-known-good marker (graftguard): a checkpoint dir containing
+# this file has survived ADAPTDL_GUARD_CONFIRM_STEPS healthy guard
+# observations AFTER it was written — the only kind of version a
+# numeric-health rollback will restore. Written durably (fsync file +
+# dir) so the marker survives power loss alongside the checkpoint.
+GOOD_MARKER_NAME = "GOOD"
+
 # Parallel per-state serialization width for the write phase.
 _WRITE_THREADS = 4
 
@@ -201,11 +208,13 @@ class State:
 
 def _reset_registry() -> None:
     """Clear all registered states (test isolation only)."""
-    global _delta_base, _saves_since_full
+    global _delta_base, _saves_since_full, _prefer_good_heal
     wait_for_inflight_save()
     _registry.clear()
     _bad_dirs.clear()
     _loaded_from.clear()
+    _pending_good.clear()
+    _prefer_good_heal = False
     _delta_base = None
     _saves_since_full = 0
     try:
@@ -776,8 +785,17 @@ def _write_snapshots(
     # Prune everything superseded by the save that just completed,
     # including temp dirs abandoned by crashed incarnations — but
     # never a dir the new save's delta chain still references (the
-    # full base outlives its deltas until the next full save).
+    # full base outlives its deltas until the next full save), and
+    # never the newest good-marked dir (plus ITS delta chain): the
+    # guard's rollback floor must survive until a newer version earns
+    # the marker, no matter how many unconfirmed saves land meanwhile.
     keep = set(chain)
+    newest_good = _newest_good_dir(root)
+    if newest_good is not None:
+        keep.add(os.path.basename(newest_good))
+        good_manifest = read_manifest(newest_good)
+        for link in (good_manifest or {}).get("chain") or []:
+            keep.add(link)
     for _, _, path in existing:
         if os.path.basename(path) not in keep:
             shutil.rmtree(path, ignore_errors=True)
@@ -803,6 +821,15 @@ def _write_snapshots(
         _saves_since_full += 1
     for state in states:
         state.commit()
+    # Good-marker candidacy: the save just landed but must NOT be
+    # trusted for numeric-health rollback until the guard confirms
+    # ADAPTDL_GUARD_CONFIRM_STEPS subsequent healthy observations
+    # (note_healthy_step). Prune above may have removed older pending
+    # candidates; drop their stale entries.
+    _pending_good[final] = 0
+    for pending in list(_pending_good):
+        if pending != final and not os.path.isdir(pending):
+            _pending_good.pop(pending, None)
 
 
 def _record_save_metrics(handle: AsyncSaveHandle) -> None:
@@ -834,6 +861,131 @@ _bad_dirs: set[str] = set()
 # (version consistency must hold regardless of load ORDER: the state
 # that trips over the corruption is not necessarily the first loader).
 _loaded_from: dict[str, str] = {}
+
+# Good-marker candidacy (graftguard): checkpoint dir (full path) ->
+# healthy guard observations seen since its save landed. Written by
+# the background writer (_write_snapshots) and the training thread
+# (note_healthy_step / reset_health_confirmation); individual dict
+# operations only, so the GIL makes each transition atomic — the
+# worst interleaving delays a marker by one observation.
+_pending_good: dict[str, int] = {}
+
+# While a guard rollback is in flight, _poison_dir's consistency
+# re-loads must honor the same good-floor preference as the rollback
+# itself, or a heal could land one state on a newer unconfirmed
+# version than its peers.
+_prefer_good_heal = False
+
+
+def is_good_checkpoint(ckpt: str) -> bool:
+    """Whether ``ckpt`` carries the durable last-known-good marker."""
+    return os.path.exists(os.path.join(ckpt, GOOD_MARKER_NAME))
+
+
+def _newest_good_dir(root: str) -> str | None:
+    """Newest non-poisoned good-marked checkpoint dir, or None."""
+    for _, _, ckpt in reversed(_list_checkpoints(root)):
+        if ckpt in _bad_dirs:
+            continue
+        if is_good_checkpoint(ckpt):
+            return ckpt
+    return None
+
+
+def _mark_good(ckpt: str) -> None:
+    """Durably write ``ckpt``'s good marker (best-effort: a marker
+    that fails to land only delays rollback eligibility)."""
+    marker = os.path.join(ckpt, GOOD_MARKER_NAME)
+    try:
+        with open(marker, "w", encoding="utf-8") as f:
+            f.write("good\n")
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(ckpt)
+        LOG.info("checkpoint %s marked last-known-good", ckpt)
+    except OSError:
+        LOG.warning("could not mark %s good", ckpt, exc_info=True)
+
+
+def note_healthy_step() -> None:
+    """One confirmed-healthy guard observation: advance every pending
+    good-marker candidate; a candidate that has now survived
+    ``ADAPTDL_GUARD_CONFIRM_STEPS`` healthy observations earns its
+    durable marker. Called by ``guard.observe`` on the training
+    thread."""
+    if not _pending_good:
+        return
+    confirm = env.guard_confirm_steps()
+    for path in list(_pending_good):
+        count = _pending_good.get(path)
+        if count is None:
+            continue
+        count += 1
+        if count >= confirm:
+            _pending_good.pop(path, None)
+            if os.path.isdir(path):
+                _mark_good(path)
+        else:
+            _pending_good[path] = count
+
+
+def reset_health_confirmation() -> None:
+    """An unhealthy step was observed: every not-yet-confirmed
+    checkpoint may already carry the corruption (detection lags the
+    corrupting step), so none of the pending candidates may ever earn
+    the good marker."""
+    _pending_good.clear()
+
+
+def last_good_age() -> float | None:
+    """Seconds since the newest good-marked checkpoint earned its
+    marker; None when no good checkpoint exists."""
+    root = env.checkpoint_path()
+    if root is None:
+        return None
+    good = _newest_good_dir(root)
+    if good is None:
+        return None
+    try:
+        marker = os.path.join(good, GOOD_MARKER_NAME)
+        # File mtime vs the wall clock IS the definition of this age
+        # (the marker may predate this process — monotonic can't span
+        # restarts).
+        return max(time.time() - os.path.getmtime(marker), 0.0)  # graftcheck: disable=GC701
+    except OSError:
+        return None
+
+
+def rollback_to_good() -> str | None:
+    """Restore EVERY registered state from the newest good-marked
+    checkpoint — the guard's last-known-good rollback. Returns the
+    restored dir's basename, or None when no good checkpoint exists
+    (the caller degrades to skip-only). Raises
+    :class:`CheckpointUnreadableError` when good checkpoints exist but
+    none is readable — continuing on known-corrupt state is exactly
+    what the guard exists to prevent.
+
+    Read-only with respect to the checkpoint store: a crash at any
+    point during the restore leaves the markers, the version chain,
+    and every on-disk dir untouched (test_checkpoint_atomicity
+    exercises the window)."""
+    global _prefer_good_heal
+    root = env.checkpoint_path()
+    if root is None:
+        return None
+    faults.maybe_fail("guard.rollback")
+    wait_for_inflight_save()
+    if _newest_good_dir(root) is None:
+        return None
+    _prefer_good_heal = True
+    try:
+        restored: str | None = None
+        for state in list(_registry.values()):
+            if load_state(state, prefer_good=True):
+                restored = _loaded_from.get(state.name, restored)
+    finally:
+        _prefer_good_heal = False
+    return os.path.basename(restored) if restored else None
 
 
 def read_manifest(ckpt: str) -> dict | None:  # wire: consumes=ckpt_manifest
@@ -1011,7 +1163,7 @@ def _load_payload(  # wire: consumes=ckpt_manifest # wire: consumes=ckpt_contain
     state.load_chunks(assembled)
 
 
-def load_state(state: State) -> bool:
+def load_state(state: State, prefer_good: bool = False) -> bool:
     """Restore one state from the newest checkpoint; False if absent.
 
     Recovery is versioned: if the newest complete checkpoint dir is
@@ -1024,51 +1176,65 @@ def load_state(state: State) -> bool:
     exists somewhere but nowhere readable" raises
     :class:`CheckpointUnreadableError` rather than masquerading as a
     fresh start.
+
+    ``prefer_good=True`` (the guard's rollback path) restricts the
+    scan to good-marked dirs whenever at least one exists — riding the
+    same version-consistent fallback chain and delta verification —
+    and skips the warm-up hold and peer handoff fast paths, which by
+    construction hold the newest (possibly corrupt) version, not the
+    last known good one. With no good dir on disk it degenerates to
+    the normal newest-first scan.
     """
     root = env.checkpoint_path()
     if root is None:
         return False
-    # Speculative warm-up hold point: in a warm successor
-    # (ADAPTDL_WARMUP=1) everything above this line — imports, jax
-    # init, trainer build, AOT compile — ran while the incumbent was
-    # still training. maybe_hold() prefetches the peer's chunks into
-    # the differential cache, marks the process ready, and blocks
-    # until the runner cuts traffic over (or exits gracefully on a
-    # discard); a normal launch falls straight through.
-    try:
-        from adaptdl_tpu.sched import warmup as warmup_mod
+    if not prefer_good:
+        # Speculative warm-up hold point: in a warm successor
+        # (ADAPTDL_WARMUP=1) everything above this line — imports, jax
+        # init, trainer build, AOT compile — ran while the incumbent
+        # was still training. maybe_hold() prefetches the peer's
+        # chunks into the differential cache, marks the process
+        # ready, and blocks until the runner cuts traffic over (or
+        # exits gracefully on a discard); a normal launch falls
+        # straight through.
+        try:
+            from adaptdl_tpu.sched import warmup as warmup_mod
 
-        warmup_mod.maybe_hold()
-    except ImportError:  # pragma: no cover - minimal installs
-        pass
-    # Planned-rescale fast path FIRST, before joining any in-flight
-    # background write: the peer's chunks are snapshot no earlier
-    # than that write's own snapshot phase, so serving them cannot
-    # violate read-your-writes — and waiting out the storage write
-    # before a transfer that exists to bypass storage would put the
-    # write back on the critical path. Chunks are hash-verified; any
-    # failure returns False and the durable scan below (which DOES
-    # join the write) proceeds with zero correctness loss.
-    try:
-        from adaptdl_tpu import handoff as handoff_mod
+            warmup_mod.maybe_hold()
+        except ImportError:  # pragma: no cover - minimal installs
+            pass
+        # Planned-rescale fast path FIRST, before joining any
+        # in-flight background write: the peer's chunks are snapshot
+        # no earlier than that write's own snapshot phase, so serving
+        # them cannot violate read-your-writes — and waiting out the
+        # storage write before a transfer that exists to bypass
+        # storage would put the write back on the critical path.
+        # Chunks are hash-verified; any failure returns False and the
+        # durable scan below (which DOES join the write) proceeds
+        # with zero correctness loss.
+        try:
+            from adaptdl_tpu import handoff as handoff_mod
 
-        if handoff_mod.try_restore(state):
-            _loaded_from[state.name] = handoff_mod.HANDOFF_SOURCE
-            return True
-    except Exception:  # noqa: BLE001 - handoff is an optimization
-        LOG.warning(
-            "handoff restore failed for state %r; falling back to "
-            "the durable checkpoint",
-            state.name,
-            exc_info=True,
-        )
+            if handoff_mod.try_restore(state):
+                _loaded_from[state.name] = handoff_mod.HANDOFF_SOURCE
+                return True
+        except Exception:  # noqa: BLE001 - handoff is an optimization
+            LOG.warning(
+                "handoff restore failed for state %r; falling back "
+                "to the durable checkpoint",
+                state.name,
+                exc_info=True,
+            )
     # Read-your-writes: a load issued while a background write phase
     # is in flight must observe the completed save, not the previous
     # checkpoint the rename hasn't superseded yet.
     wait_for_inflight_save()
+    good_floor = _newest_good_dir(root) if prefer_good else None
     attempted = False
     for _, _, ckpt in reversed(_list_checkpoints(root)):
         if ckpt in _bad_dirs:
+            continue
+        if good_floor is not None and not is_good_checkpoint(ckpt):
             continue
         # Prove the payload before deserializing it: a bit-flipped or
         # truncated file fails its manifest digest here instead of
@@ -1163,7 +1329,7 @@ def _poison_dir(ckpt: str) -> None:
             name,
             ckpt,
         )
-        if not load_state(other):
+        if not load_state(other, prefer_good=_prefer_good_heal):
             # No older dir holds it: the state keeps a payload from
             # the poisoned dir while others fall back — refuse to
             # continue with mixed versions.

@@ -306,6 +306,43 @@ def _cmd_status(args) -> int:
                 for kind, rate in sorted(hazards.items())
             )
         )
+    incidents = payload.get("incidentsByKind") or {}
+    if incidents:
+        print(
+            "numeric incidents: "
+            + ", ".join(
+                f"{kind}={count}"
+                for kind, count in sorted(incidents.items())
+            )
+        )
+    slot_blame = payload.get("incidentSlotBlame") or {}
+    data_blame = payload.get("incidentDataBlame") or {}
+    repeat_slots = {
+        slot: datas
+        for slot, datas in slot_blame.items()
+        if len(datas) >= 2
+    }
+    repeat_data = {
+        data: slots
+        for data, slots in data_blame.items()
+        if len(slots) >= 2
+    }
+    if repeat_slots:
+        print(
+            "incident blame (slot — same slot, different data): "
+            + ", ".join(
+                f"{slot} ({len(datas)} data ids)"
+                for slot, datas in sorted(repeat_slots.items())
+            )
+        )
+    if repeat_data:
+        print(
+            "incident blame (data — same data, different slots): "
+            + ", ".join(
+                f"{data} ({len(slots)} slots)"
+                for data, slots in sorted(repeat_data.items())
+            )
+        )
     quarantined = payload.get("quarantinedSlots", {})
     strikes = payload.get("slotStrikes", {})
     if quarantined or strikes:
@@ -399,7 +436,7 @@ def _render_top(payload: dict) -> None:  # wire: consumes=watch
         rows = [
             (
                 "JOB", "TENANT", "REPLICAS", "MEASURED", "PREDICTED",
-                "DRIFT", "REPROFILE", "RHO",
+                "DRIFT", "REPROFILE", "RHO", "INCID", "ROLLBK",
             )
         ]
         for key, info in sorted(jobs.items()):
@@ -416,6 +453,8 @@ def _render_top(payload: dict) -> None:  # wire: consumes=watch
                     f"{drift:.3f}" if drift is not None else "-",
                     "YES" if info.get("reprofile") else "no",
                     f"{rho:.2f}" if rho is not None else "-",
+                    str(last.get("incidents", 0)),
+                    str(last.get("rollbacks", 0)),
                 )
             )
         print()

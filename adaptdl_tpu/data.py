@@ -161,6 +161,20 @@ class AdaptiveDataLoader:
         # only the snapshot phase blocks the loop.
         self._ckpt_every_steps = env.checkpoint_every_steps()
         self._last_profiled_config: tuple[int, int] | None = None
+        # Numeric-health guard (guard.py): poisoned sample ranges the
+        # deterministic sampler must never re-feed, as (epoch, start,
+        # end) half-open index spans into the epoch permutation, plus
+        # the span of the batch most recently yielded (the guard's
+        # blame identity for the step it is grading). Persisted with
+        # the loader position so a rollback's resume still skips them.
+        self._skip_ranges: list[tuple[int, int, int]] = []
+        self._last_span: tuple[int, int, int] | None = None
+        # Bumped by every checkpoint restore. The iterator compares it
+        # across a yield: a guard rollback restores the sampler
+        # position DURING the step, and the restored cursor is then
+        # authoritative — advancing it past the in-flight batch would
+        # silently drop the batches it rewound to.
+        self._restore_gen = 0
         # True once a (bsz, accum) decision has been taken this
         # incarnation: only *changes* after that count as live
         # re-tunes (the first decision is initialization, not a
@@ -425,6 +439,38 @@ class AdaptiveDataLoader:
             bool(_signal.get_exit_flag()), lambda vs: any(vs)
         )
 
+    # -- numeric-health guard hooks -----------------------------------
+
+    def current_batch_span(self) -> tuple[int, int, int] | None:
+        """(epoch, start, end) permutation span of the batch most
+        recently yielded — the guard's data identity for the step it
+        is grading. None before the first batch."""
+        return self._last_span
+
+    def add_skip_range(self, epoch: int, start: int, end: int) -> None:
+        """Record a poisoned sample range the sampler must skip from
+        now on (all replicas derive the same permutation, so the same
+        call on every replica keeps batches aligned). Called by the
+        guard after a skip/rollback decision; persisted by the next
+        checkpoint save."""
+        span = (int(epoch), int(start), int(end))
+        if span not in self._skip_ranges:
+            self._skip_ranges.append(span)
+            LOG.warning(
+                "guard: sampler will skip poisoned range "
+                "epoch=%d [%d, %d)", *span
+            )
+
+    def _skip_bound(self, take: int) -> int | None:
+        """Where the sampler should jump if its next ``take`` samples
+        overlap a poisoned range; None when the batch is clean."""
+        start = self.sampler.index
+        end = start + take
+        for epoch, s0, e0 in self._skip_ranges:
+            if epoch == self.sampler.epoch and s0 < end and e0 > start:
+                return e0
+        return None
+
     # -- iteration -----------------------------------------------------
 
     def __len__(self) -> int:
@@ -460,7 +506,21 @@ class AdaptiveDataLoader:
                 ):
                     break
                 take = min(global_bsz, remaining)
+                skip_to = self._skip_bound(take)
+                if skip_to is not None:
+                    # Poisoned range (guard): jump the deterministic
+                    # position past it without yielding — the same
+                    # decision replays identically on every replica
+                    # and after every restart. The jump strictly
+                    # advances the index, so this cannot loop.
+                    self.sampler.index = skip_to
+                    continue
                 self._check_exit()
+                self._last_span = (
+                    self.sampler.epoch,
+                    self.sampler.index,
+                    self.sampler.index + take,
+                )
                 indices = self.sampler.next_indices(take)
                 num_processes = env.num_processes()
                 if num_processes > 1:
@@ -479,9 +539,16 @@ class AdaptiveDataLoader:
                     indices = indices[start : start + block]
                 batch = _gather(self.dataset, indices)
                 config = (self._atomic_bsz, self._accum_steps)
+                restore_gen = self._restore_gen
                 start = time.monotonic()
                 yield batch
                 elapsed = time.monotonic() - start
+                if self._restore_gen != restore_gen:
+                    # A rollback restored the loader mid-step: the
+                    # restored position/shape is authoritative, and
+                    # the aborted step must not move the cursor or
+                    # record a profile sample.
+                    continue
                 if take == global_bsz:
                     if config == self._last_profiled_config:
                         metrics.profile_step(
@@ -558,6 +625,7 @@ class _DataLoaderCheckpoint(checkpoint.State):
                 "loops_finished": loader._loops_finished,
                 "atomic_bsz": loader._atomic_bsz,
                 "accum_steps": loader._accum_steps,
+                "skip_ranges": list(loader._skip_ranges),
             },
             fileobj,
         )
@@ -571,3 +639,8 @@ class _DataLoaderCheckpoint(checkpoint.State):
         loader._loops_finished = payload["loops_finished"]
         loader._atomic_bsz = payload["atomic_bsz"]
         loader._accum_steps = payload["accum_steps"]
+        # Pre-guard checkpoints carry no skip table.
+        loader._skip_ranges = [
+            tuple(r) for r in payload.get("skip_ranges", [])
+        ]
+        loader._restore_gen += 1

@@ -601,6 +601,48 @@ def spot_price_ratio() -> float | None:
     return _get_opt_float("ADAPTDL_SPOT_PRICE_RATIO")
 
 
+def guard_policy() -> str:
+    """What the numeric-health guard does on an unhealthy step:
+    ``off`` disables detection entirely, ``warn`` only logs and
+    reports the incident, ``skip`` additionally drops the poisoned
+    batch range from the epoch on the next pass, and ``rollback`` —
+    the default — restores the last-known-good checkpoint and skips
+    the poisoned range on resume."""
+    policy = (_get_str("ADAPTDL_GUARD_POLICY") or "rollback").lower()
+    if policy not in ("off", "warn", "skip", "rollback"):
+        return "rollback"
+    return policy
+
+
+def guard_window() -> int:
+    """Healthy-step window over which the guard keeps loss samples for
+    the rolling median+MAD spike detector. Spike detection arms only
+    once the window holds at least ``guard_min_samples()`` entries."""
+    return max(_get_int("ADAPTDL_GUARD_WINDOW", 32), 4)
+
+
+def guard_min_samples() -> int:
+    """Healthy loss samples required before the median+MAD spike
+    detector arms — NaN/Inf detection is always on, but spike
+    thresholds need a baseline first."""
+    return max(_get_int("ADAPTDL_GUARD_MIN_SAMPLES", 8), 2)
+
+
+def guard_mad_k() -> float:
+    """Spike threshold in robust sigmas: a loss farther than this many
+    scaled MADs (1.4826 * MAD) above the rolling median is flagged as
+    ``loss_spike``."""
+    return max(_get_float("ADAPTDL_GUARD_MAD_K", 8.0), 1.0)
+
+
+def guard_confirm_steps() -> int:
+    """Consecutive healthy steps after a checkpoint save before that
+    version earns the ``good`` marker ``load_state(prefer_good=True)``
+    rolls back to — the quarantine period that keeps a checkpoint
+    written just before the corruption surfaced from being trusted."""
+    return max(_get_int("ADAPTDL_GUARD_CONFIRM_STEPS", 8), 1)
+
+
 def checkpoint_verify() -> bool:
     """Whether ``load_state`` verifies per-state sha256/size against
     the checkpoint's integrity manifest before restoring (``off``/

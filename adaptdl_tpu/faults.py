@@ -123,6 +123,13 @@ INJECTION_POINTS = {
     # transactional rescale (sched.state commit path; an injected
     # fault SUPPRESSES the commit signal so the epoch times out)
     "alloc.commit_timeout": "before an allocation epoch commits",
+    # numeric-health guard (guard.py / checkpoint rollback path; a
+    # fault at corrupt_grad/loss_spike SIMULATES the corruption — the
+    # guard consumes it as a poisoned observation instead of crashing)
+    "guard.corrupt_grad": "per-step gradient-statistic intake (injects NaN)",
+    "guard.loss_spike": "per-step loss intake (injects a spike)",
+    "guard.rollback": "before a last-known-good rollback restore",
+    "sup.incident.pre": "numeric-incident intake handler",
 }
 
 

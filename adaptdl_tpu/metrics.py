@@ -140,6 +140,16 @@ class MetricsState:
     step_time_ewma: float | None = None  # guarded-by: _profile_lock
     examples_ewma: float | None = None  # guarded-by: _profile_lock
     last_global_bsz: int | None = None  # guarded-by: _profile_lock
+    # Numeric-health guard (goodput hygiene): the raw EWMAs record
+    # EVERY step including unhealthy/rolled-back ones, while the
+    # guarded EWMAs above skip the samples the guard condemned — the
+    # guarded-vs-raw gap is what a flapping job actually costs.
+    # suppress_profile_steps counts condemned samples the dataloader
+    # has not yet recorded.
+    raw_step_time_ewma: float | None = None  # guarded-by: _profile_lock
+    raw_examples_ewma: float | None = None  # guarded-by: _profile_lock
+    unhealthy_steps: int = 0  # guarded-by: _profile_lock
+    suppress_profile_steps: int = 0  # guarded-by: _profile_lock
 
 
 _state = MetricsState()
@@ -304,47 +314,70 @@ def profile_step(
     )
     key = _profile_key(atomic_bsz)
     with _profile_lock:
-        entry = _state.profile[key]
-        if accum_steps > 0 and entry.accum_count > 0:
-            accum_time = entry.accum_time_sum / entry.accum_count
-            optim_time = max(
-                step_time - accum_steps * accum_time, 0.1 * step_time
-            )
-        else:
-            optim_time = step_time
-        entry.optim_time_sum += optim_time
-        entry.optim_count += 1
-        # graftwatch's measured half: smooth the step time (straggler
-        # heartbeats) and the realized examples/s at the step's batch
-        # geometry (the measuredGoodput hint). EWMA alpha 0.2 —
-        # a few fit intervals of memory, jitter smoothed out.
+        # Goodput hygiene (guard.py): a sample the guard condemned
+        # feeds only the RAW EWMAs below, never the profile table or
+        # the guarded EWMAs behind measuredGoodput/the perf fit — a
+        # flapping job must report degraded goodput, not a lie.
+        suppressed = _state.suppress_profile_steps > 0
+        if suppressed:
+            _state.suppress_profile_steps -= 1
+        alpha = 0.2
         if step_time > 0:
             dp = env.data_parallel_replicas()
             global_bsz = int(atomic_bsz) * (int(accum_steps) + 1) * dp
             examples_s = global_bsz / step_time
-            alpha = 0.2
-            prev = _state.step_time_ewma
-            _state.step_time_ewma = (
+            prev = _state.raw_step_time_ewma
+            _state.raw_step_time_ewma = (
                 step_time if prev is None
                 else (1 - alpha) * prev + alpha * step_time
             )
-            prev = _state.examples_ewma
-            _state.examples_ewma = (
+            prev = _state.raw_examples_ewma
+            _state.raw_examples_ewma = (
                 examples_s if prev is None
                 else (1 - alpha) * prev + alpha * examples_s
             )
             _state.last_global_bsz = global_bsz
-        # The allocator's 2x scale-up gate works in CHIPS (the policy's
-        # replica axis is chips once topology search is in play), so
-        # profiled coverage must count chips too: a dp=1 x sp=8 run has
-        # profiled 8 chips, not 1 replica — otherwise sp-factorized
-        # jobs would be permanently capped at 2 chips.
-        sp, tp, ss, ep, _micro = active_topology()
-        _state.max_profiled_replicas = max(
-            _state.max_profiled_replicas,
-            env.num_replicas() * sp * tp * ss * ep,
-        )
-    _maybe_fit_and_report()
+        if not suppressed:
+            entry = _state.profile[key]
+            if accum_steps > 0 and entry.accum_count > 0:
+                accum_time = entry.accum_time_sum / entry.accum_count
+                optim_time = max(
+                    step_time - accum_steps * accum_time,
+                    0.1 * step_time,
+                )
+            else:
+                optim_time = step_time
+            entry.optim_time_sum += optim_time
+            entry.optim_count += 1
+            # graftwatch's measured half: smooth the step time
+            # (straggler heartbeats) and the realized examples/s at
+            # the step's batch geometry (the measuredGoodput hint).
+            # EWMA alpha 0.2 — a few fit intervals of memory, jitter
+            # smoothed out.
+            if step_time > 0:
+                prev = _state.step_time_ewma
+                _state.step_time_ewma = (
+                    step_time if prev is None
+                    else (1 - alpha) * prev + alpha * step_time
+                )
+                prev = _state.examples_ewma
+                _state.examples_ewma = (
+                    examples_s if prev is None
+                    else (1 - alpha) * prev + alpha * examples_s
+                )
+            # The allocator's 2x scale-up gate works in CHIPS (the
+            # policy's replica axis is chips once topology search is
+            # in play), so profiled coverage must count chips too: a
+            # dp=1 x sp=8 run has profiled 8 chips, not 1 replica —
+            # otherwise sp-factorized jobs would be permanently
+            # capped at 2 chips.
+            sp, tp, ss, ep, _micro = active_topology()
+            _state.max_profiled_replicas = max(
+                _state.max_profiled_replicas,
+                env.num_replicas() * sp * tp * ss * ep,
+            )
+    if not suppressed:
+        _maybe_fit_and_report()
 
 
 def record_checkpoint_save(
@@ -470,12 +503,47 @@ def measured_goodput() -> float | None:
         global_bsz = _state.last_global_bsz
         grad = _state.grad_params
         init = _state.init_batch_size
+    return _goodput_from(examples, global_bsz, grad, init)
+
+
+def _goodput_from(examples, global_bsz, grad, init) -> float | None:
     if examples is None or not global_bsz or grad is None or not init:
         return None
     scale = global_bsz / init
     denom = grad.var / scale + grad.sqr
     gain = (grad.var + grad.sqr) / denom if denom > 0 else 1.0
     return examples * gain / scale
+
+
+def raw_goodput() -> float | None:
+    """Unfiltered realized goodput: the same statistical-efficiency
+    weighting as :func:`measured_goodput` but over the raw throughput
+    EWMA that includes unhealthy and rolled-back steps. The
+    guarded-vs-raw gap is the throughput a flapping job wastes —
+    exported via the ``guardStats`` hint for the per-job Grafana
+    panel."""
+    with _profile_lock:
+        examples = _state.raw_examples_ewma
+        global_bsz = _state.last_global_bsz
+        grad = _state.grad_params
+        init = _state.init_batch_size
+    return _goodput_from(examples, global_bsz, grad, init)
+
+
+def note_unhealthy_step(n: int = 1) -> None:
+    """The guard condemned the current step: count it and suppress
+    the next ``n`` profile samples from the guarded EWMA and perf fit
+    (the dataloader records a step's sample only after the trainer's
+    guard has graded it). Raw EWMAs still record everything."""
+    with _profile_lock:
+        _state.unhealthy_steps += 1
+        _state.suppress_profile_steps += max(int(n), 0)
+
+
+def unhealthy_steps() -> int:
+    """Guard-condemned steps observed this incarnation."""
+    with _profile_lock:
+        return _state.unhealthy_steps
 
 
 def update_grad_params(sqr: float, var: float) -> None:
@@ -636,6 +704,17 @@ def fit_and_report_now() -> None:  # wire: produces=sched_hints
         # restart decisions against these instead of an assumed
         # penalty (sched/allocator.job_info_from_hints).
         hints["restartStats"] = stats
+    try:
+        from adaptdl_tpu import guard as guard_mod
+
+        gstats = guard_mod.guard_stats()
+    except Exception:  # noqa: BLE001 - guard is observability here
+        gstats = None
+    if gstats is not None:
+        # Numeric-health summary (incidents, rollbacks, last-good
+        # age, raw-vs-guarded goodput) for graftwatch's per-job
+        # series and the Grafana guard panels.
+        hints["guardStats"] = gstats
     if grad_params is not None:
         hints["gradParams"] = dict(grad_params._asdict())
     if perf_params is not None:
@@ -716,6 +795,9 @@ class _MetricsCheckpoint(checkpoint.State):
             "handoff_s": _state.handoff_s,
             "handoff_bytes": _state.handoff_bytes,
             "num_retunes": _state.num_retunes,
+            "raw_step_time_ewma": _state.raw_step_time_ewma,
+            "raw_examples_ewma": _state.raw_examples_ewma,
+            "unhealthy_steps": _state.unhealthy_steps,
         }
 
     def load(self, fileobj):
@@ -754,6 +836,12 @@ class _MetricsCheckpoint(checkpoint.State):
             _state.handoff_s = payload.get("handoff_s")
             _state.handoff_bytes = payload.get("handoff_bytes")
             _state.num_retunes = int(payload.get("num_retunes", 0))
+            # Pre-guard checkpoints carry no raw-EWMA fields.
+            _state.raw_step_time_ewma = payload.get("raw_step_time_ewma")
+            _state.raw_examples_ewma = payload.get("raw_examples_ewma")
+            _state.unhealthy_steps = int(
+                payload.get("unhealthy_steps", 0)
+            )
         _state.init_batch_size = payload["init_batch_size"]
         _state.max_batch_size = payload["max_batch_size"]
         _state.local_bsz_bounds = payload["local_bsz_bounds"]

@@ -49,9 +49,18 @@ class ObjectReducer:
         self._listener = None
         # All socket traffic happens on this single worker so that async
         # and sync collectives issued from user code interleave in
-        # invocation order, preserving the same-order contract.
-        self._executor = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="adaptdl-reducer"
+        # invocation order, preserving the same-order contract. A
+        # single-process world has no sockets and no ordering to
+        # protect: its reduces run inline, and skipping the executor
+        # keeps implicitly auto-initialized world-size-1 reducers
+        # (collective._require) from leaking a non-daemon thread in
+        # every process that never calls teardown().
+        self._executor = (
+            ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="adaptdl-reducer"
+            )
+            if world_size > 1
+            else None
         )
         if world_size == 1:
             return
@@ -116,13 +125,23 @@ class ObjectReducer:
         """Queue a collective; result delivered via the Future."""
         seq = self._seq
         self._seq += 1
+        if self._executor is None:
+            # World size 1: compute inline into an already-completed
+            # Future (same contract, no thread).
+            future: Future = Future()
+            try:
+                future.set_result(self._reduce_sync(obj, reduce_fn, seq))
+            except BaseException as exc:  # noqa: BLE001 - mirror executor
+                future.set_exception(exc)
+            return future
         return self._executor.submit(self._reduce_sync, obj, reduce_fn, seq)
 
     def reduce(self, obj: Any, reduce_fn: ReduceFn) -> Any:
         return self.reduce_async(obj, reduce_fn).result()
 
     def close(self) -> None:
-        self._executor.shutdown(wait=True)
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
         for conn in self._conns.values():
             conn.close()
         if self._client is not None:

@@ -395,6 +395,21 @@ class ClusterState:
         self._preempt_notices: dict[str, int] = {}  # guarded-by: _cond
         self._slot_kinds: dict[str, str] = {}  # guarded-by: _cond
         self._preemptible_slots: set[str] = set()  # guarded-by: _cond
+        # Numeric-health incidents (graftguard): per-kind counts, a
+        # bounded per-job record tail, the slot<->data recurrence
+        # tables behind blame classification — recurring incidents on
+        # the same SLOT across different data strike the slot toward
+        # quarantine; recurring incidents on the same DATA across
+        # slots blame the data (no hardware quarantine) — and the
+        # idempotency ledger (ordered-set of (key, group, step, kind)
+        # identities, deterministically bounded). All rebuilt by
+        # replaying journaled `incident` ops; counts and blame tables
+        # also ride snapshots.
+        self._incident_counts: dict[str, int] = {}  # guarded-by: _cond
+        self._incidents: dict[str, list] = {}  # guarded-by: _cond
+        self._incident_slot_data: dict[str, list] = {}  # guarded-by: _cond
+        self._incident_data_slots: dict[str, list] = {}  # guarded-by: _cond
+        self._incident_seen: dict = {}  # guarded-by: _cond
         # Incremental allocation: jobs whose scheduling inputs changed
         # since the allocator last consumed the set — arrivals,
         # departures, hint/spec updates, preemption notices, lease
@@ -503,6 +518,17 @@ class ClusterState:
                 for kind, (rate, last_ts) in self._hazard.items()
             },
             "preempt_notices": dict(self._preempt_notices),
+            "incidents": {
+                "counts": dict(self._incident_counts),
+                "slot_data": {
+                    slot: list(datas)
+                    for slot, datas in self._incident_slot_data.items()
+                },
+                "data_slots": {
+                    data: list(slots)
+                    for data, slots in self._incident_data_slots.items()
+                },
+            },
             "reshard": {
                 "pending": {
                     tenant: {
@@ -579,6 +605,25 @@ class ClusterState:
                     kind: int(n)
                     for kind, n in (
                         snapshot.get("preempt_notices") or {}
+                    ).items()
+                }
+                incidents = snapshot.get("incidents") or {}
+                self._incident_counts = {
+                    str(kind): int(n)
+                    for kind, n in (
+                        incidents.get("counts") or {}
+                    ).items()
+                }
+                self._incident_slot_data = {
+                    str(slot): [str(d) for d in datas]
+                    for slot, datas in (
+                        incidents.get("slot_data") or {}
+                    ).items()
+                }
+                self._incident_data_slots = {
+                    str(data): [str(s) for s in slots]
+                    for data, slots in (
+                        incidents.get("data_slots") or {}
                     ).items()
                 }
                 reshard = snapshot.get("reshard") or {}
@@ -697,6 +742,8 @@ class ClusterState:
             return self._apply_rollback_locked(op, now)
         if kind == "preempt":
             return self._apply_preempt_locked(op, now)
+        if kind == "incident":
+            return self._apply_incident_locked(op, now)
         if kind == "handoff":
             return self._apply_handoff_locked(op, now)
         if kind == "candidate":
@@ -737,6 +784,10 @@ class ClusterState:
 
     def _apply_remove_locked(self, op: dict, now: float) -> None:  # holds-lock: _cond # replay-pure # wire: consumes=journal_op
         self._jobs.pop(op["key"], None)
+        # Per-job incident tail goes with the job; the slot/data blame
+        # tables deliberately survive — a flaky chip stays suspect
+        # across the jobs it burns.
+        self._incidents.pop(op["key"], None)
         # A departure frees capacity — counted toward the allocator's
         # dirtiness (redistribution to survivors rides full cycles).
         self._dirty.add(op["key"])
@@ -1103,6 +1154,88 @@ class ClusterState:
                 job=record.key,
                 slots=len(op.get("slots", [])),
             )
+
+    def _apply_incident_locked(  # holds-lock: _cond # replay-pure # wire: consumes=journal_op
+        self, op: dict, now: float
+    ) -> str:
+        """A worker's numeric-health incident (NaN loss/grad or a loss
+        spike): count it, append it to the job's bounded record tail,
+        and classify blame from recurrence — the same DATA going bad
+        on two different slots indicts the data (no hardware action);
+        the same SLOT going bad on two different data ids indicts the
+        slot, which pays a strike toward quarantine exactly like a
+        failed rescale epoch. Returns the blame verdict."""
+        key = op["key"]
+        record = self._jobs.get(key)
+        kind = str(op.get("kind") or "unknown")
+        data = op.get("data")
+        slot = op.get("slot")
+        # Idempotency ledger entry is derived from the op itself so a
+        # journal replay re-arms dedupe for post-recovery retries.
+        ledger = (
+            key,
+            int(op.get("group") or 0),
+            int(op.get("step") or 0),
+            kind,
+        )
+        self._incident_seen[ledger] = None
+        while len(self._incident_seen) > 1024:
+            self._incident_seen.pop(next(iter(self._incident_seen)))
+        self._incident_counts[kind] = (
+            self._incident_counts.get(kind, 0) + 1
+        )
+        blame = "unknown"
+        if slot and data:
+            slots = self._incident_data_slots.setdefault(
+                str(data), []
+            )
+            if str(slot) not in slots:
+                slots.append(str(slot))
+                del slots[:-16]
+            datas = self._incident_slot_data.setdefault(
+                str(slot), []
+            )
+            if str(data) not in datas:
+                datas.append(str(data))
+                del datas[:-16]
+            if len(slots) >= 2:
+                blame = "data"
+            elif len(datas) >= 2:
+                blame = "slot"
+                strikes = self._slot_strikes.get(slot, 0) + 1
+                self._slot_strikes[slot] = strikes
+                if strikes >= self._strike_limit:
+                    self._quarantined[slot] = (
+                        now + self._quarantine_s
+                    )
+        tail = self._incidents.setdefault(key, [])
+        tail.append(
+            {
+                "kind": kind,
+                "step": int(op.get("step") or 0),
+                "data": str(data) if data is not None else None,
+                "slot": str(slot) if slot else None,
+                "action": str(op.get("action") or ""),
+                "blame": blame,
+                "ts": float(op.get("ts") or 0.0),
+            }
+        )
+        del tail[:-64]
+        if record is not None:
+            # A quarantine verdict (or even a suspect slot) should
+            # feed the next allocator cycle.
+            self._dirty.add(key)
+        if not self._replaying:
+            trace.event(
+                "guard.incident",
+                traceparent=(
+                    record.trace_parent if record is not None else None
+                ),
+                job=key,
+                kind=kind,
+                blame=blame,
+            )
+        return blame
 
     def _maybe_commit_locked(  # holds-lock: _cond # journaled
         self, record: JobRecord  # wire: produces=journal_op
@@ -1578,6 +1711,97 @@ class ClusterState:
             self._alloc_kick += 1
             self._cond.notify_all()
             return True
+
+    # -- numeric-health incidents (graftguard) -------------------------
+
+    def report_incident(  # journaled # wire: produces=journal_op
+        self,
+        key: str,
+        kind: str,
+        group: int | None = None,
+        rank: int | None = None,
+        step: int | None = None,
+        data: str | None = None,
+        action: str | None = None,
+    ) -> tuple | None:
+        """Intake of a worker's numeric-health incident (``POST
+        /incident``): journals it, classifies blame from the slot/data
+        recurrence tables (possibly striking the reporting slot toward
+        quarantine), and kicks the allocator so a quarantined slot's
+        job is re-placed off it immediately. Idempotent per
+        (group, step, kind): rpc retries and repeat reports of the
+        same incident return None without a second count or strike,
+        as do late reports from a superseded incarnation. Returns the
+        (blame, slot) verdict otherwise."""
+        with self._cond:
+            record = self._jobs[key]
+            if record.status in FINISHED:
+                return None
+            if group is not None and group < record.group:
+                return None
+            kind = str(kind)
+            ledger = (key, int(group or 0), int(step or 0), kind)
+            if ledger in self._incident_seen:
+                return None
+            now = self._clock.monotonic()
+            # Slot resolved at intake time from the reporting rank's
+            # position in the CURRENT allocation and journaled, so
+            # replay reproduces blame without allocation history.
+            slot = None
+            if rank is not None and 0 <= int(rank) < len(
+                record.allocation
+            ):
+                slot = record.allocation[int(rank)]
+            op = {
+                "op": "incident",
+                "key": key,
+                "kind": kind,
+                "group": int(group or 0),
+                "ts": self._clock.time(),
+            }
+            if rank is not None:
+                op["rank"] = int(rank)
+            if step is not None:
+                op["step"] = int(step)
+            if data is not None:
+                op["data"] = str(data)
+            if slot is not None:
+                op["slot"] = slot
+            if action:
+                op["action"] = str(action)
+            self._journal_append(op)
+            blame = self._apply_incident_locked(op, now)
+            # Wake the allocator NOW: a freshly quarantined slot's
+            # occupant must be re-placed off it, not wait out the
+            # optimization interval.
+            self._alloc_kick += 1
+            self._cond.notify_all()
+        # graftwatch intake carries its own lock (rank 31); the two
+        # locks never nest — called outside _cond by design.
+        self.watch.note_incident(key, kind, blame, slot)
+        return blame, slot
+
+    def incident_info(self) -> dict:
+        """Numeric-health observability in one locked snapshot:
+        per-kind incident counts, the bounded per-job record tails,
+        and the blame tables (which data ids went bad on which slots
+        and vice versa)."""
+        with self._cond:
+            return {
+                "incidentsByKind": dict(self._incident_counts),
+                "incidents": {
+                    key: [dict(r) for r in tail]
+                    for key, tail in self._incidents.items()
+                },
+                "slotBlame": {
+                    slot: list(datas)
+                    for slot, datas in self._incident_slot_data.items()
+                },
+                "dataBlame": {
+                    data: list(slots)
+                    for data, slots in self._incident_data_slots.items()
+                },
+            }
 
     def set_slot_kinds(
         self,

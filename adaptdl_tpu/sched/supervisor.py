@@ -372,6 +372,60 @@ class Supervisor(ThreadedHttpServer):
             {"ok": True, "draining": bool(accepted)}
         )
 
+    @_faultable("sup.incident.pre")
+    async def _incident(  # idempotent: keyed-by=(group,step,kind) # wire: consumes=incident
+        self, request: web.Request
+    ) -> web.Response:
+        """Numeric-incident intake (``POST /incident/{job}``): a
+        worker's guard reports a NaN/spike the moment it fires, the
+        journaled apply classifies blame (same slot across different
+        data => strike toward quarantine; same data across slots =>
+        data blame, no hardware action), and the allocator is kicked
+        so a quarantined slot's occupant is re-placed immediately.
+        Idempotent: rpc retries of the same (group, step, kind)
+        identity fold into one count and at most one strike."""
+        key = "{namespace}/{name}".format(**request.match_info)
+        group = _group_param(request)
+        try:
+            body = await request.json()
+        except ValueError:
+            body = {}
+        if not isinstance(body, dict):
+            body = {}
+        kind = body.get("kind")
+        if not kind:
+            return web.json_response(
+                {"error": "kind required"}, status=400
+            )
+
+        def mutate() -> dict | None:
+            if self._state.get_job(key) is None:
+                return None
+            verdict = self._state.report_incident(
+                key,
+                str(kind),
+                group=group,
+                rank=body.get("rank"),
+                step=body.get("step"),
+                data=body.get("data"),
+                action=body.get("action"),
+            )
+            if body.get("rank") is not None:
+                # The report is also proof of life: piggyback the
+                # lease renewal like any other worker traffic.
+                self._renew(key, int(body["rank"]), group=group)
+            if verdict is None:
+                return {"duplicate": True}
+            blame, slot = verdict
+            return {"duplicate": False, "blame": blame, "slot": slot}
+
+        verdict = await self._offload(mutate)
+        if verdict is None:
+            return web.json_response(
+                {"error": "no such job"}, status=404
+            )
+        return web.json_response({"ok": True, **verdict})
+
     @_faultable("sup.handoff.pre")
     async def _put_handoff(  # idempotent: keyed-by=group # wire: consumes=handoff_ad
         self, request: web.Request
@@ -502,6 +556,12 @@ class Supervisor(ThreadedHttpServer):
             for kind, rate in preempt["hazardRates"].items()
         }
         payload["preemptionNotices"] = preempt["noticesByKind"]
+        # graftguard: numeric-health incidents by kind plus the blame
+        # tables — "which slot (or which data) keeps going bad".
+        incidents = self._state.incident_info()
+        payload["incidentsByKind"] = incidents["incidentsByKind"]
+        payload["incidentSlotBlame"] = incidents["slotBlame"]
+        payload["incidentDataBlame"] = incidents["dataBlame"]
         # graftwatch: measured vs predicted goodput, drift, and the
         # re-profiling flag per job — "is this job healthy" answered
         # from /status alone, no Prometheus scrape needed.
@@ -1146,6 +1206,37 @@ class Supervisor(ThreadedHttpServer):
             "counter",
             "Torn journal records dropped during recovery.",
         )
+        # graftguard: numeric-health incident/rollback observability.
+        b.family(
+            "adaptdl_incidents_total",
+            "counter",
+            "Numeric-health incidents accepted by the supervisor, "
+            "by kind (nan_loss/nan_grad/loss_spike).",
+        )
+        b.family(
+            "adaptdl_job_incidents_total",
+            "counter",
+            "Numeric-health incidents accepted per job.",
+        )
+        b.family(
+            "adaptdl_guard_rollbacks_total",
+            "counter",
+            "Last-known-good checkpoint rollbacks performed per job "
+            "(from the guardStats sched hint).",
+        )
+        b.family(
+            "adaptdl_ckpt_last_good_age_seconds",
+            "gauge",
+            "Age of the job's newest health-confirmed (good-marked) "
+            "checkpoint.",
+        )
+        b.family(
+            "adaptdl_goodput_raw",
+            "gauge",
+            "Unguarded throughput-EWMA goodput per job — includes "
+            "the unhealthy/rolled-back steps the guarded "
+            "adaptdl_goodput_measured excludes.",
+        )
         lifecycle = self._state.lifecycle_metrics()
         b.sample(
             "adaptdl_job_submissions_total",
@@ -1249,6 +1340,13 @@ class Supervisor(ThreadedHttpServer):
             b.sample(
                 "adaptdl_hazard_rate", {"kind": kind}, round(rate, 9)
             )
+        incidents = self._state.incident_info()
+        for kind, count in sorted(
+            incidents["incidentsByKind"].items()
+        ):
+            b.sample(
+                "adaptdl_incidents_total", {"kind": kind}, count
+            )
         # Incremental-allocator telemetry: per-mode decision-latency
         # histograms + the last cycle's dirty-job count.
         alloc = self._state.alloc_cycle_metrics()
@@ -1287,6 +1385,28 @@ class Supervisor(ThreadedHttpServer):
                     "adaptdl_goodput_reprofile_flag",
                     labels,
                     int(job["reprofile"]),
+                )
+            if job.get("incidents"):
+                b.sample(
+                    "adaptdl_job_incidents_total",
+                    labels,
+                    job["incidents"],
+                )
+            if job.get("rollbacks"):
+                b.sample(
+                    "adaptdl_guard_rollbacks_total",
+                    labels,
+                    job["rollbacks"],
+                )
+            if job.get("lastGoodAge") is not None:
+                b.sample(
+                    "adaptdl_ckpt_last_good_age_seconds",
+                    labels,
+                    job["lastGoodAge"],
+                )
+            if job.get("rawGoodput") is not None:
+                b.sample(
+                    "adaptdl_goodput_raw", labels, job["rawGoodput"]
                 )
         for tenant, agg in sorted(watch["tenants"].items()):
             labels = {"tenant": tenant}
@@ -1508,6 +1628,9 @@ class Supervisor(ThreadedHttpServer):
                 web.get("/trace/{namespace}/{name}", self._get_trace),
                 web.post(
                     "/preempt/{namespace}/{name}", self._preempt
+                ),
+                web.post(
+                    "/incident/{namespace}/{name}", self._incident
                 ),
                 web.put(
                     "/handoff/{namespace}/{name}", self._put_handoff

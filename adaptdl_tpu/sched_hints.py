@@ -60,6 +60,10 @@ def validate_hints(  # wire: consumes=sched_hints
         hints["restartStats"], dict
     ):
         raise ValueError("restartStats must be an object")
+    if hints.get("guardStats") is not None and not isinstance(
+        hints["guardStats"], dict
+    ):
+        raise ValueError("guardStats must be an object")
     if hints.get("measuredGoodput") is not None:
         measured = hints["measuredGoodput"]
         if (

@@ -1969,12 +1969,25 @@ class ElasticTrainer:
             # graftcheck: disable=GC202 (deliberate gated pull: drains
             # once every metrics_every steps, not per step)
             jax.block_until_ready(metrics_out["loss"])
-            metrics_mod.update_grad_params(
-                float(metrics_out["grad_sqr"]),  # graftcheck: disable=GC202 (gated above)
-                float(metrics_out["grad_var"]),  # graftcheck: disable=GC202 (gated above)
-            )
+            loss_val = float(metrics_out["loss"])  # graftcheck: disable=GC202 (gated above)
+            grad_sqr = float(metrics_out["grad_sqr"])  # graftcheck: disable=GC202 (gated above)
+            grad_var = float(metrics_out["grad_var"])  # graftcheck: disable=GC202 (gated above)
+            metrics_mod.update_grad_params(grad_sqr, grad_var)
             metrics_mod.update_progress(
                 float(metrics_out["progress"])  # graftcheck: disable=GC202 (gated above)
+            )
+            # Numeric-health sentinel: grade the pulled values (free —
+            # they are already on the host) and let the guard's policy
+            # warn/skip/rollback on NaN, Inf, or a loss spike. The
+            # detection latency is metrics_every steps by
+            # construction of this gate.
+            from adaptdl_tpu import guard as guard_mod
+
+            guard_mod.observe_step(
+                loss_val,
+                grad_sqr=grad_sqr,
+                grad_var=grad_var,
+                dataloader=dataloader,
             )
         return state, metrics_out
 

@@ -188,6 +188,11 @@ class WatchStore:
         self._slo_burn: dict[str, int] = {}  # guarded-by: _lock
         # Straggler intake: job -> rank -> (slot, step-time EWMA).
         self._step_times: dict[str, dict[int, tuple]] = {}  # guarded-by: _lock
+        # Numeric-health incidents (graftguard): per-job bounded
+        # incident records (fed by ClusterState.report_incident) and a
+        # monotonic per-job counter that survives ring eviction.
+        self._incident_series: dict[str, deque] = {}  # guarded-by: _lock
+        self._incident_counts: dict[str, int] = {}  # guarded-by: _lock
         # Per-job goodput-model cache: (params signature,
         # GoodputFunction, {eval key: goodput}) — repeat cycles at an
         # unchanged allocation cost a dict lookup, not a model solve.
@@ -226,6 +231,34 @@ class WatchStore:
             ranks = self._step_times.setdefault(key, {})
             ranks[int(rank)] = (slot, float(seconds))
 
+    def note_incident(  # wire: produces=watch
+        self,
+        key: str,
+        kind: str,
+        blame: str | None = None,
+        slot: str | None = None,
+    ) -> None:
+        """One confirmed numeric-health incident for a job (the
+        supervisor's /incident intake feeds this after the journaled
+        apply): ring-buffered record + monotonic counter."""
+        now = self._clock.time()
+        with self._lock:
+            ring = self._incident_series.get(key)
+            if ring is None:
+                ring = deque(maxlen=self._buffer)
+                self._incident_series[key] = ring
+            ring.append(
+                {
+                    "t": _r6(now),
+                    "kind": str(kind),
+                    "blame": str(blame) if blame else "unknown",
+                    "slot": str(slot) if slot else None,
+                }
+            )
+            self._incident_counts[key] = (
+                self._incident_counts.get(key, 0) + 1
+            )
+
     def forget_job(self, key: str) -> None:
         """Drop a removed job's series (tenant aggregates keep their
         history — a tenant outlives its jobs)."""
@@ -239,12 +272,14 @@ class WatchStore:
                 self._explain,
                 self._step_times,
                 self._models,
+                self._incident_series,
+                self._incident_counts,
             ):
                 table.pop(key, None)
 
     # -- the per-cycle sample ------------------------------------------
 
-    def sample_cycle(  # wire: produces=watch # wire: consumes=watch_job,watch
+    def sample_cycle(  # wire: produces=watch # wire: consumes=watch_job,watch,sched_hints
         self,
         jobs: list[dict],
         total_chips: int,
@@ -312,6 +347,15 @@ class WatchStore:
                 if series is None:
                     series = deque(maxlen=self._buffer)
                     self._job_series[key] = series
+                # graftguard health series piggyback on the cycle
+                # sample: the worker's posted guardStats hint carries
+                # rollbacks / last-good checkpoint age / RAW (unguarded)
+                # goodput, and the supervisor-confirmed incident count
+                # comes from our own intake — together the
+                # guarded-vs-raw goodput and rollback panels.
+                gstats = (job.get("hints") or {}).get("guardStats") or {}
+                raw = gstats.get("rawGoodput")
+                age = gstats.get("lastGoodAge")
                 series.append(
                     {
                         "t": _r6(now),
@@ -326,6 +370,14 @@ class WatchStore:
                         ),
                         "ideal": _r6(ideal) if ideal is not None else None,
                         "rho": _r6(rho) if rho is not None else None,
+                        "incidents": self._incident_counts.get(key, 0),
+                        "rollbacks": int(gstats.get("rollbacks") or 0),
+                        "lastGoodAge": (
+                            _r6(age) if age is not None else None
+                        ),
+                        "rawGoodput": (
+                            _r6(raw) if raw is not None else None
+                        ),
                     }
                 )
                 if (
@@ -725,6 +777,10 @@ class WatchStore:
                     "rho": latest["rho"],
                     "drift": _r6(drift) if drift is not None else None,
                     "reprofile": flagged,
+                    "incidents": latest.get("incidents", 0),
+                    "rollbacks": latest.get("rollbacks", 0),
+                    "lastGoodAge": latest.get("lastGoodAge"),
+                    "rawGoodput": latest.get("rawGoodput"),
                 }
             tenants = {}
             for tenant in sorted(self._tenant_series):
@@ -768,6 +824,12 @@ class WatchStore:
                         "tenant": self._tenant.get(
                             key, tenant_of(key)
                         ),
+                        "incidents": [
+                            dict(rec)
+                            for rec in list(
+                                self._incident_series.get(key, ())
+                            )[-_SNAPSHOT_TAIL:]
+                        ],
                     }
                     for key, series in sorted(
                         self._job_series.items()
@@ -795,6 +857,9 @@ class WatchStore:
                 "goodputPredicted": job["predicted"],
                 "goodputDrift": job["drift"],
                 "reprofile": job["reprofile"],
+                "incidents": job["incidents"],
+                "rollbacks": job["rollbacks"],
+                "lastGoodAge": job["lastGoodAge"],
             }
             for key, job in view["jobs"].items()
         }

@@ -86,6 +86,10 @@ WIRE_CONTRACTS = {
             # prediction; observability-only, the policy never reads
             # it.
             "measuredGoodput",
+            # Numeric-health summary (the `guard_stats` family below):
+            # incidents, rollbacks, last-good checkpoint age, raw-vs-
+            # guarded goodput. Observability-only.
+            "guardStats",
         ),
         # Present since the first hint schema: the profiling gate
         # guarantees a job never posts hints without it.
@@ -115,6 +119,39 @@ WIRE_CONTRACTS = {
         # and the /metrics renderer's dynamic sweep — no statically
         # visible per-key consumer sites.
         "open_consumers": True,
+    },
+    # ---- numeric-health summary riding the guardStats hint
+    # (guard.guard_stats): incidents/rollbacks/last-good age plus the
+    # raw-vs-guarded goodput pair the Grafana guard panels key on.
+    "guard_stats": {
+        "doc": "guardStats sub-payload of sched hints",
+        "persisted": True,
+        "keys": (
+            "policy",
+            "incidents",
+            "incidentsByKind",
+            "rollbacks",
+            "skippedBatches",
+            "unhealthySteps",
+            "healthyStreak",
+            "lastGoodAge",
+            "rawGoodput",
+        ),
+        "required": (),
+        # Read by the watch store's hint sweep and the /metrics
+        # renderer — dynamic .get loops, no per-key consumer sites.
+        "open_consumers": True,
+    },
+    # ---- numeric-health incident intake (POST /incident body): one
+    # detected corruption event. The worker reports its RANK — the
+    # supervisor resolves the occupied slot from the job's current
+    # allocation, so blame survives reallocation races on the worker
+    # side.
+    "incident": {
+        "doc": "POST /incident body (guard.post_incident)",
+        "persisted": False,
+        "keys": ("kind", "step", "rank", "data", "action"),
+        "required": ("kind",),
     },
     # ---- cluster -> job: the current decision (GET /config).
     "config": {
@@ -233,6 +270,15 @@ WIRE_CONTRACTS = {
             "skipped",
             "shard",
             "version",
+            # numeric-health incidents (`incident` ops): the detected
+            # kind, the offending step/data identity, the resolved
+            # slot the reporting rank occupied, and the worker's
+            # chosen action. Version-optional (consumed via .get).
+            "kind",
+            "step",
+            "data",
+            "slot",
+            "action",
             # `update` op field names reach the journal as
             # update(**fields) kwargs — written at dozens of call
             # sites, readable only dynamically.
@@ -296,6 +342,13 @@ WIRE_CONTRACTS = {
             "keys",
             "skipped",
             "shard",
+            # numeric-health incident registries (graftguard): per-kind
+            # counts plus the slot<->data blame tables the recurrence
+            # classifier rebuilds on recovery. Version-optional.
+            "incidents",
+            "counts",
+            "slot_data",
+            "data_slots",
         ),
         # Format stamp for future migrations; no reader today.
         # The moved marker's `shard` is copied structurally
@@ -478,6 +531,15 @@ WIRE_CONTRACTS = {
             "job",
             "rank",
             "ratio",
+            # numeric-health guard series (graftguard): per-job
+            # incident records and the guardStats-derived gauges.
+            "incidents",
+            "rollbacks",
+            "lastGoodAge",
+            "rawGoodput",
+            "kind",
+            "blame",
+            "slot",
             # Router-merged payloads only (graftshard): the shard-id
             # list the fan-out covered. Written by the router's merge
             # (outside the annotated producer), so unchecked.
@@ -784,6 +846,8 @@ BATCH_CONFIG_KEYS = WIRE_CONTRACTS["batch_config"]["keys"]
 HEARTBEAT_KEYS = WIRE_CONTRACTS["heartbeat"]["keys"]
 REGISTER_KEYS = WIRE_CONTRACTS["register"]["keys"]
 PREEMPT_KEYS = WIRE_CONTRACTS["preempt"]["keys"]
+INCIDENT_KEYS = WIRE_CONTRACTS["incident"]["keys"]
+GUARD_STATS_KEYS = WIRE_CONTRACTS["guard_stats"]["keys"]
 HANDOFF_AD_KEYS = WIRE_CONTRACTS["handoff_ad"]["keys"]
 CANDIDATE_ALLOC_KEYS = WIRE_CONTRACTS["candidate_alloc"]["keys"]
 JOURNAL_OP_KEYS = WIRE_CONTRACTS["journal_op"]["keys"]

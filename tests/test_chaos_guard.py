@@ -1,0 +1,342 @@
+"""Chaos suite for the numeric-health guard (graftguard) — the
+`make guardgate` acceptance gate.
+
+The headline: a training run that takes an injected NaN gradient at a
+fixed step (seed 1234) detects it, rolls back to the last-known-good
+checkpoint automatically, and finishes with a trained state BIT-EQUAL
+to an undisturbed run configured to skip the poisoned batch — through
+the REAL AdaptiveDataLoader (skip table, mid-step restore) and the
+REAL checkpoint store (good markers, prefer-good restore chain).
+
+Plus the control-plane half: slot-pinned corruption reported over real
+HTTP quarantines exactly the offending slot (same-data-across-slots
+blames the data instead, no hardware action), incident records survive
+a supervisor hard-kill + journal replay bit-identically with the
+idempotency ledger re-armed, and the worker's incident report retries
+through a supervisor 500."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from adaptdl_tpu import checkpoint, faults, guard, metrics, rpc
+from adaptdl_tpu._compat import pick_unused_port
+from adaptdl_tpu.data import AdaptiveDataLoader
+from adaptdl_tpu.sched.state import ClusterState
+from adaptdl_tpu.sched.supervisor import Supervisor
+
+pytestmark = pytest.mark.chaos
+
+SEED = 1234
+LEASE_TTL = 10.0
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.reset()
+    rpc.reset_default_client()
+    guard._reset_state()
+    metrics._reset_state()
+    yield
+    faults.reset()
+    rpc.reset_default_client()
+    guard._reset_state()
+    metrics._reset_state()
+    from adaptdl_tpu import _signal
+
+    _signal.set_exit_flag(False)
+
+
+class _Weights(checkpoint.State):
+    """Deterministic trained state: the update depends only on
+    (weights, batch contents), so any correct rollback + skip replay
+    reproduces the skip-configured trajectory bit-for-bit."""
+
+    def __init__(self, holder):
+        super().__init__("guard_chaos_w")
+        self.holder = holder
+
+    def save(self, fileobj):
+        np.save(fileobj, self.holder["w"], allow_pickle=False)
+
+    def load(self, fileobj):
+        self.holder["w"] = np.load(fileobj, allow_pickle=False)
+
+
+def _apply(w, batch):
+    # Nonlinear in w so update ORDER matters: dropping, duplicating,
+    # or reordering one batch is visible in the final weights.
+    return w * 0.9 + 0.1 * np.sin(np.mean(batch["x"]) + np.sum(w))
+
+
+def _run_guarded_sim(
+    tmp_path, monkeypatch, tag, poison_at=None, skip=None
+):
+    """One pass over a fixed dataset through the real loader, grading
+    every step with guard.observe_step. ``poison_at`` injects a NaN
+    gradient statistic at that observation; ``skip`` preconfigures the
+    loader's poisoned-range table (the undisturbed reference)."""
+    ckpt_dir = tmp_path / f"ckpt-{tag}"
+    ckpt_dir.mkdir()
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(ckpt_dir))
+    # The loader's own pipelined per-step save is the good-marker
+    # candidate stream; one healthy observation confirms a candidate.
+    monkeypatch.setenv("ADAPTDL_CKPT_EVERY_STEPS", "1")
+    monkeypatch.setenv("ADAPTDL_GUARD_CONFIRM_STEPS", "1")
+    monkeypatch.delenv("ADAPTDL_SUPERVISOR_URL", raising=False)
+    monkeypatch.delenv("ADAPTDL_JOB_ID", raising=False)
+    checkpoint._reset_registry()
+    guard._reset_state()
+    metrics._reset_state()
+
+    holder = {"w": np.zeros(4, dtype=np.float64)}
+    _Weights(holder)
+    data = {"x": np.arange(128, dtype=np.float64)}
+    loader = AdaptiveDataLoader(data, batch_size=8, name="guard-sim")
+    if skip is not None:
+        loader.add_skip_range(*skip)
+    if poison_at is not None:
+        faults.configure(
+            f"guard.corrupt_grad=fail@{poison_at}", seed=SEED
+        )
+    incidents = []
+    observations = 0
+    try:
+        for batch in loader:
+            holder["w"] = _apply(holder["w"], batch)
+            verdict = guard.observe_step(
+                1.0, grad_sqr=1.0, dataloader=loader
+            )
+            observations += 1
+            if not verdict["healthy"]:
+                incidents.append(
+                    dict(verdict, span=loader.current_batch_span())
+                )
+    finally:
+        faults.configure(None)
+        checkpoint.wait_for_inflight_save()
+    return {
+        "weights": holder["w"].copy(),
+        "incidents": incidents,
+        "observations": observations,
+        "skip_ranges": list(loader._skip_ranges),
+        "stats": guard.guard_stats(),
+    }
+
+
+def test_injected_nan_rolls_back_and_matches_skip_run(
+    tmp_path, monkeypatch
+):
+    """Acceptance: NaN gradient injected at observation 5 -> automatic
+    rollback to the last good-marked checkpoint + poisoned-range skip
+    -> final weights bit-equal to an undisturbed run that skipped the
+    same batch. The replayed healthy batches between the good
+    checkpoint and the poison must reproduce their original updates
+    exactly (determinism), or equality fails."""
+    chaos = _run_guarded_sim(
+        tmp_path, monkeypatch, "chaos", poison_at=5
+    )
+    assert len(chaos["incidents"]) == 1
+    incident = chaos["incidents"][0]
+    assert incident["kind"] == "nan_grad"
+    assert incident["action"] == "rollback"
+    assert incident["restored"], "a good checkpoint must exist by then"
+    assert chaos["stats"]["rollbacks"] == 1
+    assert chaos["stats"]["skippedBatches"] == 1
+    assert chaos["stats"]["unhealthySteps"] == 1
+    assert len(chaos["skip_ranges"]) == 1
+    poisoned = chaos["skip_ranges"][0]
+
+    base = _run_guarded_sim(
+        tmp_path, monkeypatch, "base", skip=poisoned
+    )
+    assert base["incidents"] == []
+    np.testing.assert_array_equal(base["weights"], chaos["weights"])
+
+    # Negative control: a run that FEEDS the poisoned batch ends
+    # elsewhere — the equality above is not vacuous.
+    full = _run_guarded_sim(tmp_path, monkeypatch, "full")
+    assert not np.array_equal(full["weights"], chaos["weights"])
+
+
+def _boot_control_plane(tmp_path, monkeypatch, job, state_dir=None):
+    port = pick_unused_port()
+    monkeypatch.setenv(
+        "ADAPTDL_SUPERVISOR_URL", f"http://127.0.0.1:{port}"
+    )
+    monkeypatch.setenv("ADAPTDL_JOB_ID", job)
+    monkeypatch.setenv("ADAPTDL_NUM_RESTARTS", "0")
+    state = ClusterState(
+        state_dir=state_dir,
+        alloc_commit_timeout=30.0,
+        slot_strike_limit=2,
+    )
+    if state.get_job(job) is None:
+        state.create_job(job, spec={})
+        state.update(
+            job, allocation=["tpu-0", "tpu-1"], status="Running"
+        )
+    supervisor = Supervisor(state, port=port, lease_ttl=LEASE_TTL)
+    supervisor.start()
+    return state, supervisor, port
+
+
+def test_slot_pinned_corruption_quarantines_exactly_that_slot(
+    tmp_path, monkeypatch
+):
+    """Recurring incidents from rank 0 (slot tpu-0) across DIFFERENT
+    data ids strike that slot to quarantine over real HTTP; the same
+    data id recurring across slots blames the data and strikes
+    nobody. Exactly tpu-0 ends quarantined."""
+    job = "c/guard"
+    state, supervisor, port = _boot_control_plane(
+        tmp_path, monkeypatch, job
+    )
+    try:
+        for step, data in ((1, "0:0-8"), (2, "0:8-16")):
+            assert guard.post_incident(
+                "nan_grad", step=step, data_id=data,
+                action="rollback", rank=0,
+            )
+        # Same data id now seen on the OTHER slot: data blame, no
+        # strike against tpu-1.
+        assert guard.post_incident(
+            "loss_spike", step=3, data_id="0:8-16",
+            action="rollback", rank=1,
+        )
+        # Third distinct data id on tpu-0: strike 2 of 2 ->
+        # quarantine.
+        assert guard.post_incident(
+            "nan_grad", step=4, data_id="0:24-32",
+            action="rollback", rank=0,
+        )
+        health = state.slot_health()
+        assert set(health["quarantined"]) == {"tpu-0"}
+        assert health["strikes"].get("tpu-1", 0) == 0
+
+        info = state.incident_info()
+        assert info["incidentsByKind"] == {
+            "nan_grad": 3, "loss_spike": 1,
+        }
+        blames = [r["blame"] for r in info["incidents"][job]]
+        assert blames == ["unknown", "slot", "data", "slot"]
+        assert info["slotBlame"]["tpu-0"] == [
+            "0:0-8", "0:8-16", "0:24-32",
+        ]
+        assert info["dataBlame"]["0:8-16"] == ["tpu-0", "tpu-1"]
+
+        # An rpc-level retry of an already-counted incident folds:
+        # same (group, step, kind) -> duplicate, no fifth count.
+        assert guard.post_incident(
+            "nan_grad", step=4, data_id="0:24-32",
+            action="rollback", rank=0,
+        )
+        assert state.incident_info()["incidentsByKind"][
+            "nan_grad"
+        ] == 3
+
+        # One allocator-shaped watch sample (the allocator drives
+        # this in production) so the per-job guard families flow into
+        # the exposition alongside the state-side incident counters.
+        state.watch.sample_cycle(
+            [{
+                "key": job, "tenant": "c",
+                "alloc": ["tpu-0", "tpu-1"],
+                "topology": None, "batchConfig": None,
+                "hints": {"guardStats": {
+                    "policy": "rollback", "incidents": 4,
+                    "incidentsByKind": {"nan_grad": 3,
+                                        "loss_spike": 1},
+                    "rollbacks": 2, "skippedBatches": 2,
+                    "unhealthySteps": 4, "healthyStreak": 0,
+                    "lastGoodAge": 1.5, "rawGoodput": 10.0,
+                }},
+                "requested": 2,
+            }],
+            total_chips=2,
+            chips_per_slice=1,
+        )
+        text = (
+            rpc.default_client()
+            .get(f"http://127.0.0.1:{port}/metrics")
+            .text
+        )
+        assert 'adaptdl_incidents_total{kind="nan_grad"} 3' in text
+        labels = f'{{job="{job}",tenant="c"}}'
+        assert f"adaptdl_job_incidents_total{labels} 4" in text
+        assert f"adaptdl_guard_rollbacks_total{labels} 2" in text
+        assert f"adaptdl_ckpt_last_good_age_seconds{labels} 1.5" in text
+        assert f"adaptdl_goodput_raw{labels} 10" in text
+    finally:
+        supervisor.stop()
+
+
+def test_incident_journal_replay_is_bit_identical(
+    tmp_path, monkeypatch
+):
+    """Supervisor hard-killed after a mixed run of incidents (memory
+    dropped, WAL only): recovery reproduces the per-kind counts, the
+    per-job record tails (blame verdicts and timestamps included),
+    and the blame tables BIT-IDENTICALLY, keeps the struck slot
+    quarantined, and re-arms the idempotency ledger."""
+    job = "c/replay"
+    state_dir = str(tmp_path / "sched")
+    state, supervisor, _ = _boot_control_plane(
+        tmp_path, monkeypatch, job, state_dir=state_dir
+    )
+    supervisor.stop()  # direct state intake; no HTTP needed here
+    for step, kind, rank, data in (
+        (1, "nan_grad", 0, "0:0-8"),
+        (2, "nan_grad", 0, "0:8-16"),
+        (3, "loss_spike", 1, "0:8-16"),
+        (4, "nan_loss", 0, "0:24-32"),
+    ):
+        assert state.report_incident(
+            job, kind, group=0, rank=rank, step=step, data=data,
+            action="rollback",
+        ) is not None
+    before = state.incident_info()
+    assert set(before["incidentsByKind"]) == {
+        "nan_grad", "loss_spike", "nan_loss",
+    }
+    del state
+
+    recovered = ClusterState(
+        state_dir=state_dir,
+        alloc_commit_timeout=30.0,
+        slot_strike_limit=2,
+    )
+    assert recovered.incident_info() == before
+    assert "tpu-0" in recovered.quarantined_slots()
+    # The ledger was rebuilt from the replayed ops: a post-recovery
+    # retry of an already-journaled incident still folds.
+    assert recovered.report_incident(
+        job, "nan_grad", group=0, rank=0, step=2, data="0:8-16",
+        action="rollback",
+    ) is None
+    assert recovered.incident_info() == before
+
+
+def test_incident_report_retries_through_supervisor_500(
+    tmp_path, monkeypatch
+):
+    """sup.incident.pre=fail@1: the first POST /incident becomes a
+    500; the resilient client retries and the incident still lands
+    exactly once."""
+    job = "c/retry"
+    state, supervisor, _ = _boot_control_plane(
+        tmp_path, monkeypatch, job
+    )
+    try:
+        faults.configure("sup.incident.pre=fail@1", seed=SEED)
+        assert guard.post_incident(
+            "nan_grad", step=7, data_id="0:0-8",
+            action="rollback", rank=0,
+        )
+        assert faults.hit_count("sup.incident.pre") >= 2
+        assert state.incident_info()["incidentsByKind"] == {
+            "nan_grad": 1
+        }
+    finally:
+        supervisor.stop()

@@ -280,6 +280,119 @@ def test_crash_between_rename_and_prune_on_delta_save(
     assert state.parts == {"a": 4}
 
 
+def test_good_marker_survives_pruning_and_rollback_restores_it(
+    tmp_path, monkeypatch
+):
+    """The newest good-marked checkpoint (and its chain) is pinned out
+    of pruning's keep-set: later UNCONFIRMED saves never evict it, and
+    rollback_to_good() restores exactly its contents."""
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    monkeypatch.setenv("ADAPTDL_GUARD_CONFIRM_STEPS", "2")
+    state = Val("v", "known-good")
+    checkpoint.save_all_states()
+    good_dir = checkpoint.latest_checkpoint_dir(str(tmp_path))
+    checkpoint.note_healthy_step()
+    checkpoint.note_healthy_step()
+    assert checkpoint.is_good_checkpoint(good_dir)
+
+    # Two newer saves that never earn confirmation (an incident voids
+    # their pending candidates).
+    state.value = "suspect-1"
+    checkpoint.save_all_states()
+    checkpoint.reset_health_confirmation()
+    state.value = "suspect-2"
+    checkpoint.save_all_states()
+    checkpoint.reset_health_confirmation()
+
+    assert os.path.isdir(good_dir), (
+        "pruning must never evict the newest good checkpoint"
+    )
+    state.value = "corrupt-in-memory"
+    restored = checkpoint.rollback_to_good()
+    assert restored == os.path.basename(good_dir)
+    assert state.value == "known-good"
+    # A plain (non-prefer-good) load still takes the newest version.
+    state.value = None
+    assert checkpoint.load_state(state)
+    assert state.value == "suspect-2"
+
+
+def test_crash_mid_rollback_restore_keeps_marker_and_chain(
+    tmp_path, monkeypatch
+):
+    """Hard-kill DURING the rollback's restore loop: the good marker
+    stays set, the chain stays version-consistent, and a retry of the
+    rollback completes from the same good checkpoint."""
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    monkeypatch.setenv("ADAPTDL_GUARD_CONFIRM_STEPS", "1")
+    a = Val("a", "good-a")
+    b = Val("b", "good-b")
+    checkpoint.save_all_states()
+    good_dir = checkpoint.latest_checkpoint_dir(str(tmp_path))
+    checkpoint.note_healthy_step()
+    assert checkpoint.is_good_checkpoint(good_dir)
+    a.value, b.value = "bad-a", "bad-b"
+    checkpoint.save_all_states()
+    checkpoint.reset_health_confirmation()
+
+    original = Val.load
+
+    def die_mid_restore(self, fileobj):
+        if self.name == "b":
+            raise KeyboardInterrupt("killed mid-rollback")
+        original(self, fileobj)
+
+    monkeypatch.setattr(Val, "load", die_mid_restore)
+    with pytest.raises(KeyboardInterrupt):
+        checkpoint.rollback_to_good()
+    monkeypatch.setattr(Val, "load", original)
+
+    # The crash window left durable state untouched: marker set, both
+    # versions complete, manifests intact.
+    assert checkpoint.is_good_checkpoint(good_dir)
+    dirs = checkpoint.scan_versioned_dirs(
+        str(tmp_path), checkpoint._CKPT_DIR_PATTERN
+    )
+    assert len(dirs) == 2
+    for _, _, path in dirs:
+        manifest = checkpoint.read_manifest(path)
+        assert manifest is not None
+        assert {"a", "b"} <= set(manifest["states"])
+    restored = checkpoint.rollback_to_good()
+    assert restored == os.path.basename(good_dir)
+    assert (a.value, b.value) == ("good-a", "good-b")
+
+
+def test_rollback_fault_point_fires_before_any_restore(
+    tmp_path, monkeypatch
+):
+    """guard.rollback=fail: the injected fault aborts the rollback
+    BEFORE any state is touched — in-memory values keep their
+    (corrupt) contents and the good marker survives for the retry."""
+    from adaptdl_tpu import faults
+
+    monkeypatch.setenv("ADAPTDL_CHECKPOINT_PATH", str(tmp_path))
+    monkeypatch.setenv("ADAPTDL_GUARD_CONFIRM_STEPS", "1")
+    state = Val("v", "known-good")
+    checkpoint.save_all_states()
+    good_dir = checkpoint.latest_checkpoint_dir(str(tmp_path))
+    checkpoint.note_healthy_step()
+    state.value = "corrupt"
+    faults.configure("guard.rollback=fail@1", seed=1234)
+    try:
+        with pytest.raises(faults.InjectedFault):
+            checkpoint.rollback_to_good()
+        assert state.value == "corrupt", "no partial restore"
+        assert checkpoint.is_good_checkpoint(good_dir)
+        assert (
+            checkpoint.rollback_to_good()
+            == os.path.basename(good_dir)
+        )
+        assert state.value == "known-good"
+    finally:
+        faults.reset()
+
+
 def test_async_delta_save_is_point_in_time(tmp_path, monkeypatch):
     """wait=False on a delta save: the chunking runs on the writer
     thread against the SNAPSHOT, so mutations after the snapshot

@@ -135,8 +135,39 @@ def test_supervisor_mutating_handlers_declare_idempotency():
         "_put_hints",
         "_put_trace",
         "_preempt",
+        "_incident",
         "_put_handoff",
     } <= annotated, annotated
+
+
+def test_incident_endpoint_has_fault_point_and_declared_keys():
+    """The /incident route (graftguard) is faultable like every other
+    mutating handler, and the guard's poster writes only declared
+    `incident` keys."""
+    assert "sup.incident.pre" in INJECTION_POINTS
+    for point in (
+        "guard.corrupt_grad",
+        "guard.loss_spike",
+        "guard.rollback",
+    ):
+        assert point in INJECTION_POINTS, point
+    declared = set(wire.INCIDENT_KEYS)
+    assert {"kind", "step", "rank", "data", "action"} <= declared
+    assert "kind" in wire.WIRE_CONTRACTS["incident"]["required"]
+
+
+def test_guard_stats_hint_matches_wire_family():
+    """guard.guard_stats() writes exactly the declared `guard_stats`
+    keys (the sched-hints sub-payload dashboards key on)."""
+    from adaptdl_tpu import guard
+
+    guard._reset_state()
+    try:
+        stats = guard.guard_stats()
+        assert stats is not None
+        assert set(stats) == set(wire.GUARD_STATS_KEYS)
+    finally:
+        guard._reset_state()
 
 
 def test_explain_contract_uses_killed_by():
